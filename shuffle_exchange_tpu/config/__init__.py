@@ -1,0 +1,18 @@
+from .config import (
+    SXConfig,
+    FP16Config,
+    BF16Config,
+    ZeroConfig,
+    OffloadConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    MeshConfig,
+    ShuffleExchangeConfig,
+    ActivationCheckpointingConfig,
+    ElasticityConfig,
+    CheckpointConfig,
+)
+from .config_utils import ConfigError, ConfigModel
+
+# Reference-compatible alias (DeepSpeedConfigError)
+DeepSpeedConfigError = ConfigError

@@ -1,0 +1,516 @@
+"""The framework config tree — DeepSpeed-JSON compatible.
+
+Capability parity with the reference's ``runtime/config.py`` (DeepSpeedConfig),
+``runtime/constants.py`` (keys/defaults), ``runtime/zero/config.py`` and
+``runtime/zero/offload_config.py``: the same JSON document a reference user
+writes (train_batch_size / fp16 / bf16 / zero_optimization / optimizer /
+scheduler / monitor / flops_profiler / comms_logger / elasticity /
+activation_checkpointing / checkpoint ...) parses here into one typed tree,
+with the same batch-size arithmetic and validation errors.
+
+TPU-first additions live in their own sections and do not collide with
+reference keys: ``mesh`` (named-axis device mesh sizes), ``shuffle_exchange``
+(the fork's decentralized weight-sync settings, also settable via
+``initialize()`` kwargs exactly like the reference fork).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .config_utils import ConfigError, ConfigModel, config_field
+from ..utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# Precision (reference: runtime/config.py fp16/bf16 sections, fp16/loss_scaler.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FP16Config(ConfigModel):
+    enabled: bool = config_field(False)
+    auto_cast: bool = config_field(False)
+    loss_scale: float = config_field(0.0, ge=0.0)  # 0 => dynamic
+    initial_scale_power: int = config_field(16, ge=0)
+    loss_scale_window: int = config_field(1000, gt=0)
+    hysteresis: int = config_field(2, ge=1)
+    consecutive_hysteresis: bool = config_field(False)
+    min_loss_scale: float = config_field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = config_field(False)
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+@dataclass
+class BF16Config(ConfigModel):
+    enabled: bool = config_field(False, aliases=("bfloat16",))
+    # Reference bf16 optimizer accumulates grads in fp32 (bf16_optimizer.py:35).
+    immediate_grad_update: bool = config_field(True)
+
+
+_DTYPE_NAMES = ("fp32", "float32", "fp16", "float16", "bf16", "bfloat16")
+
+
+@dataclass
+class DataTypesConfig(ConfigModel):
+    grad_accum_dtype: Optional[str] = config_field(None)  # fp32|fp16|bf16
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.grad_accum_dtype is not None and self.grad_accum_dtype not in _DTYPE_NAMES:
+            raise ConfigError(f"data_types.grad_accum_dtype must be one of {_DTYPE_NAMES}, got {self.grad_accum_dtype!r}")
+
+
+# ---------------------------------------------------------------------------
+# ZeRO (reference: runtime/zero/config.py:86 DeepSpeedZeroConfig)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OffloadConfig(ConfigModel):
+    """reference: runtime/zero/offload_config.py — device none|cpu|nvme."""
+
+    device: str = config_field("none")
+    nvme_path: Optional[str] = config_field(None)
+    buffer_count: int = config_field(5, ge=1)
+    buffer_size: int = config_field(100_000_000, ge=1)
+    max_in_cpu: int = config_field(1_000_000_000, ge=0)
+    pin_memory: bool = config_field(False)
+    pipeline_read: bool = config_field(False)
+    pipeline_write: bool = config_field(False)
+    fast_init: bool = config_field(False)
+    ratio: float = config_field(1.0, ge=0.0, le=1.0)
+
+    @classmethod
+    def from_dict(cls, data=None, path=""):
+        data = dict(data or {})
+        # Legacy boolean shorthand ("cpu_offload": true) means offload-to-CPU.
+        if data.pop("enabled", False) and data.get("device", "none") == "none":
+            data["device"] = "cpu"
+        return super().from_dict(data, path=path)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.device not in ("none", "cpu", "nvme"):
+            raise ConfigError(f"offload device must be none|cpu|nvme, got {self.device!r}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.device not in ("none",)
+
+
+@dataclass
+class ZeroConfig(ConfigModel):
+    stage: int = config_field(0, ge=0, le=3)
+    contiguous_gradients: bool = config_field(True)
+    reduce_scatter: bool = config_field(True)
+    reduce_bucket_size: int = config_field(500_000_000, ge=0)
+    allgather_partitions: bool = config_field(True)
+    allgather_bucket_size: int = config_field(500_000_000, ge=0)
+    overlap_comm: Optional[bool] = config_field(None)  # default True for stage 3 (ref behavior)
+    load_from_fp32_weights: bool = config_field(True)
+    elastic_checkpoint: bool = config_field(False)
+    offload_param: OffloadConfig = config_field(default_factory=OffloadConfig)
+    offload_optimizer: OffloadConfig = config_field(default_factory=OffloadConfig)
+    sub_group_size: int = config_field(1_000_000_000, ge=0)
+    cpu_offload: Optional[bool] = config_field(None, deprecated=True, new_param="offload_optimizer")
+    # stage-3 knobs
+    stage3_max_live_parameters: int = config_field(1_000_000_000, ge=0)
+    stage3_max_reuse_distance: int = config_field(1_000_000_000, ge=0)
+    stage3_prefetch_bucket_size: int = config_field(50_000_000, ge=0)
+    stage3_param_persistence_threshold: int = config_field(100_000, ge=0)
+    stage3_model_persistence_threshold: int = config_field(9_223_372_036_854_775_807, ge=0)
+    stage3_gather_16bit_weights_on_model_save: bool = config_field(False, aliases=("stage3_gather_fp16_weights_on_model_save",))
+    stage3_use_all_reduce_for_fetch_params: bool = config_field(False)
+    # ZeRO++ (hpZ secondary partition, quantized weights/gradients)
+    zero_hpz_partition_size: int = config_field(1, ge=1)
+    zero_quantized_weights: bool = config_field(False)
+    zero_quantized_nontrainable_weights: bool = config_field(False)
+    zero_quantized_gradients: bool = config_field(False)
+    # MiCS
+    mics_shard_size: int = config_field(-1)
+    mics_hierarchical_params_gather: bool = config_field(False)
+    memory_efficient_linear: bool = config_field(True)
+    round_robin_gradients: bool = config_field(False)
+    ignore_unused_parameters: bool = config_field(True)
+    legacy_stage1: bool = config_field(False)
+    override_module_apply: bool = config_field(True)
+    log_trace_cache_warnings: bool = config_field(False)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.offload_param.enabled and self.stage != 3:
+            logger.warning("offload_param is only effective with ZeRO stage 3; ignoring")
+
+    @property
+    def effective_overlap_comm(self) -> bool:
+        return self.overlap_comm if self.overlap_comm is not None else (self.stage == 3)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler (reference: engine._configure_basic_optimizer, lr_schedules.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OptimizerConfig(ConfigModel):
+    type: str = config_field("AdamW")
+    params: Dict[str, Any] = config_field(default_factory=dict)
+    legacy_fusion: bool = config_field(False)
+
+
+@dataclass
+class SchedulerConfig(ConfigModel):
+    type: Optional[str] = config_field(None)
+    params: Dict[str, Any] = config_field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Activation checkpointing → remat policy (reference: runtime/activation_checkpointing/config.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    partition_activations: bool = config_field(False)
+    contiguous_memory_optimization: bool = config_field(False)
+    cpu_checkpointing: bool = config_field(False)
+    number_checkpoints: Optional[int] = config_field(None)
+    synchronize_checkpoint_boundary: bool = config_field(False)
+    profile: bool = config_field(False)
+    # TPU-first: which jax.checkpoint policy to use when remat is on.
+    # "none"|"full"|"dots_saveable"|"nothing_saveable"|"dots_with_no_batch_dims_saveable"
+    policy: str = config_field("dots_saveable")
+    enabled: bool = config_field(False)
+
+    VALID_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable", "dots_with_no_batch_dims_saveable")
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.policy not in self.VALID_POLICIES:
+            raise ConfigError(
+                f"activation_checkpointing.policy must be one of {self.VALID_POLICIES}, got {self.policy!r}")
+
+
+# ---------------------------------------------------------------------------
+# Monitoring / profiling / comms logging (reference: monitor/config.py,
+# profiling/config.py, comm/config.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = config_field(False)
+    output_path: str = config_field("")
+    job_name: str = config_field("DeepSpeedJobName")
+
+
+@dataclass
+class WandbConfig(ConfigModel):
+    enabled: bool = config_field(False)
+    group: Optional[str] = config_field(None)
+    team: Optional[str] = config_field(None)
+    project: str = config_field("deepspeed")
+
+
+@dataclass
+class CSVConfig(ConfigModel):
+    enabled: bool = config_field(False)
+    output_path: str = config_field("")
+    job_name: str = config_field("DeepSpeedJobName")
+
+
+@dataclass
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = config_field(False)
+    recompute_fwd_factor: float = config_field(0.0, ge=0.0)
+    profile_step: int = config_field(1, ge=1)
+    module_depth: int = config_field(-1)
+    top_modules: int = config_field(1, ge=1)
+    detailed: bool = config_field(True)
+    output_file: Optional[str] = config_field(None)
+
+
+@dataclass
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = config_field(False)
+    verbose: bool = config_field(False)
+    prof_all: bool = config_field(True)
+    debug: bool = config_field(False)
+    prof_ops: List[str] = config_field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Elasticity (reference: elasticity/config.py:28)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ElasticityConfig(ConfigModel):
+    enabled: bool = config_field(False)
+    max_train_batch_size: int = config_field(2000, ge=1)
+    micro_batch_sizes: List[int] = config_field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = config_field(1, ge=1)
+    max_gpus: int = config_field(10000, ge=1)
+    min_time: int = config_field(0, ge=0)
+    ignore_non_elastic_batch_info: bool = config_field(False)
+    prefer_larger_batch: bool = config_field(True)
+    version: float = config_field(0.2)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint behavior (reference: runtime/config.py checkpoint/data-parallel writes)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParallelWriteConfig(ConfigModel):
+    pipeline_stage: bool = config_field(False)
+
+
+@dataclass
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = config_field("Warn")  # Ignore|Warn|Fail
+    load_universal: bool = config_field(False)
+    use_node_local_storage: bool = config_field(False)
+    parallel_write: ParallelWriteConfig = config_field(default_factory=ParallelWriteConfig)
+    writer: str = config_field("torch")  # torch|fast|decoupled (engine selection parity)
+    async_save: bool = config_field(False)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.tag_validation not in ("Ignore", "Warn", "Fail"):
+            raise ConfigError(f"checkpoint.tag_validation must be Ignore|Warn|Fail, got {self.tag_validation!r}")
+
+
+# ---------------------------------------------------------------------------
+# Fork section: Shuffle-exchange decentralized weight sync (reference §2.1,
+# stage_1_and_2.py:163-241; also settable via initialize() kwargs)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ShuffleExchangeConfig(ConfigModel):
+    method: str = config_field("RR")  # RR | shuffle | H-RR | Gossip
+    rings: int = config_field(8, ge=1)
+    shuffle_step: int = config_field(50, ge=1)
+    slice_count: int = config_field(2, ge=1)
+    # Gossip mixing weight; reference uses alpha = 1/world_size (stage_1_and_2.py:199)
+    gossip_alpha: Optional[float] = config_field(None)
+    gossip_prob: float = config_field(1.0, ge=0.0, le=1.0)
+    enabled: bool = config_field(False)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.method not in ("RR", "shuffle", "H-RR", "Gossip"):
+            raise ConfigError(f"shuffle_exchange.method must be RR|shuffle|H-RR|Gossip, got {self.method!r}")
+
+
+# ---------------------------------------------------------------------------
+# TPU-first: named-axis mesh configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MeshConfig(ConfigModel):
+    """Sizes of the named mesh axes. -1 on `data` means "absorb remaining devices".
+
+    Axis order is the physical layout order (ICI-contiguous innermost-last):
+    (pipe, data, fsdp, expert, seq, tensor).
+    """
+
+    data: int = config_field(-1)
+    fsdp: int = config_field(1, ge=1)
+    tensor: int = config_field(1, ge=1)
+    expert: int = config_field(1, ge=1)
+    seq: int = config_field(1, ge=1)
+    pipe: int = config_field(1, ge=1)
+
+
+@dataclass
+class TensorParallelConfig(ConfigModel):
+    autotp_size: int = config_field(0, ge=0)
+    tp_size: int = config_field(1, ge=1)
+    tp_grain_size: int = config_field(64, ge=1)
+
+
+# ---------------------------------------------------------------------------
+# Root config
+# ---------------------------------------------------------------------------
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+
+@dataclass
+class SXConfig(ConfigModel):
+    """Root config. Construct via ``SXConfig.load(path_or_dict, world_size)``."""
+
+    train_batch_size: Optional[int] = config_field(None, gt=0)
+    train_micro_batch_size_per_gpu: Optional[int] = config_field(None, gt=0)
+    gradient_accumulation_steps: Optional[int] = config_field(None, gt=0)
+    steps_per_print: int = config_field(10, gt=0)
+    wall_clock_breakdown: bool = config_field(False)
+    dump_state: bool = config_field(False)
+    prescale_gradients: bool = config_field(False)
+    gradient_predivide_factor: float = config_field(1.0, gt=0.0)
+    gradient_clipping: float = config_field(0.0, ge=0.0)
+    sparse_gradients: bool = config_field(False)
+    memory_breakdown: bool = config_field(False)
+    seed: int = config_field(1234)
+    communication_data_type: Optional[str] = config_field(None)
+    disable_allgather: bool = config_field(False)
+    zero_allow_untested_optimizer: bool = config_field(True)
+    zero_force_ds_cpu_optimizer: bool = config_field(True)
+    graph_harvesting: bool = config_field(False)
+
+    fp16: FP16Config = config_field(default_factory=FP16Config)
+    bf16: BF16Config = config_field(default_factory=BF16Config, aliases=("bfloat16",))
+    data_types: DataTypesConfig = config_field(default_factory=DataTypesConfig)
+    zero_optimization: ZeroConfig = config_field(default_factory=ZeroConfig)
+    # None (absent section or explicit null) means "client supplies the
+    # optimizer", exactly like the reference's initialize(optimizer=...).
+    optimizer: Optional[OptimizerConfig] = config_field(None, model=OptimizerConfig)
+    scheduler: SchedulerConfig = config_field(default_factory=SchedulerConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = config_field(default_factory=ActivationCheckpointingConfig)
+
+    tensorboard: TensorBoardConfig = config_field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = config_field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = config_field(default_factory=CSVConfig)
+    flops_profiler: FlopsProfilerConfig = config_field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = config_field(default_factory=CommsLoggerConfig)
+    elasticity: ElasticityConfig = config_field(default_factory=ElasticityConfig)
+    checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
+
+    shuffle_exchange: ShuffleExchangeConfig = config_field(default_factory=ShuffleExchangeConfig)
+    mesh: MeshConfig = config_field(default_factory=MeshConfig)
+    tensor_parallel: TensorParallelConfig = config_field(default_factory=TensorParallelConfig, aliases=("autotp",))
+    sequence_parallel_size: int = config_field(1, ge=1)
+    pipeline_parallel_size: int = config_field(1, ge=1)
+
+    # Accepted-but-gated sections (feature handled elsewhere or N/A on TPU).
+    autotuning: Dict[str, Any] = config_field(default_factory=dict)
+    compression_training: Dict[str, Any] = config_field(default_factory=dict)
+    data_efficiency: Dict[str, Any] = config_field(default_factory=dict)
+    curriculum_learning: Dict[str, Any] = config_field(default_factory=dict)
+    pipeline: Dict[str, Any] = config_field(default_factory=dict)
+    hybrid_engine: Dict[str, Any] = config_field(default_factory=dict)
+    amp: Dict[str, Any] = config_field(default_factory=dict)
+    aio: Dict[str, Any] = config_field(default_factory=dict)
+    nebula: Dict[str, Any] = config_field(default_factory=dict)
+    compile: Dict[str, Any] = config_field(default_factory=dict)
+    timers: Dict[str, Any] = config_field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    # Loading & batch arithmetic (reference: runtime/config.py:93 + engine sanity checks)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def load(cls, config: Union[str, os.PathLike, Dict[str, Any], None], world_size: int = 1) -> "SXConfig":
+        if config is None:
+            config = {}
+        if isinstance(config, (str, os.PathLike)):
+            if not os.path.exists(config):
+                raise ConfigError(f"Config file not found: {config}")
+            with open(config) as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise ConfigError(f"Expected config dict or path, got {type(config).__name__}")
+        obj = cls.from_dict(config)
+        if obj.elasticity.enabled:
+            obj._apply_elastic_plan(world_size)
+        obj._resolve_batch_sizes(world_size)
+        obj._sanity_check()
+        return obj
+
+    def _apply_elastic_plan(self, world_size: int) -> None:
+        """Elasticity overrides user batch config (reference: runtime/config.py
+        elasticity handling — explicit batch keys are an error unless
+        ignore_non_elastic_batch_info, and the plan must admit world_size)."""
+        from ..runtime.elasticity import get_best_candidates
+
+        has_batch_info = any(v is not None for v in (
+            self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps))
+        if has_batch_info and not self.elasticity.ignore_non_elastic_batch_info:
+            raise ConfigError(
+                "Elasticity is enabled, but the config contains batch parameters "
+                f"({TRAIN_BATCH_SIZE}/{TRAIN_MICRO_BATCH_SIZE_PER_GPU}/{GRADIENT_ACCUMULATION_STEPS}). "
+                "Remove them or set elasticity.ignore_non_elastic_batch_info")
+        batch, micro, gas = get_best_candidates(self.elasticity, max(1, world_size))
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps = batch, micro, gas
+
+    def _resolve_batch_sizes(self, world_size: int) -> None:
+        """train = micro × gas × dp_world; infer any single missing value.
+
+        Mirrors the reference's DeepSpeedConfig._configure_train_batch_size /
+        _batch_assertion (runtime/config.py).
+        """
+        self.world_size = max(1, world_size)
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = self.world_size
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * ws)
+        elif train is not None and gas is not None:
+            micro = train // (gas * ws)
+        elif micro is not None:
+            gas = gas or 1
+            train = micro * gas * ws
+        elif train is not None:
+            gas = 1
+            micro = train // ws
+        else:
+            raise ConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps = train, micro, gas
+        if train <= 0 or micro <= 0 or gas <= 0:
+            raise ConfigError(f"Batch sizes must be >0: train={train} micro={micro} gas={gas}")
+        if train != micro * gas * ws:
+            raise ConfigError(
+                f"Check batch related parameters. train_batch_size is not equal to micro_batch_per_gpu * "
+                f"gradient_acc_step * world_size {train} != {micro} * {gas} * {ws}")
+
+    def _sanity_check(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        if self.zero_optimization.stage >= 2 and self.fp16.enabled and self.fp16.fp16_master_weights_and_grads \
+                and not self.zero_optimization.offload_optimizer.enabled:
+            raise ConfigError("fp16_master_weights_and_grads requires optimizer offload with ZeRO-2")
+        # Elasticity was already planned + world-size-validated in
+        # _apply_elastic_plan; only the version gate remains here.
+        if self.elasticity.enabled and self.elasticity.version not in (0.1, 0.2):
+            raise ConfigError(f"Unsupported elasticity version {self.elasticity.version}")
+
+    # ------------------------------------------------------------------
+
+    @property
+    def train_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def grad_accum_dtype(self):
+        import jax.numpy as jnp
+
+        name = self.data_types.grad_accum_dtype
+        if name is None:
+            return jnp.float32
+        return {"fp32": jnp.float32, "float32": jnp.float32, "fp16": jnp.float16,
+                "float16": jnp.float16, "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}[name]
+
+    def print_config(self) -> None:
+        logger.info("SXConfig:\n" + self.dump())

@@ -1,0 +1,92 @@
+"""Elastic training batch planning.
+
+Capability parity with the reference's ``elasticity/elasticity.py:83,126,233``:
+precompute the set of (train_batch_size, micro_batch, gas, world_size)
+combinations that keep the *effective* batch size identical, so a job can
+resume at any world size in range after membership changes. On TPU the
+"world" is the number of chips participating in the data axis; recovery is
+checkpoint-resume with a recomputed plan (reference §5.3).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+from ..config.config_utils import ConfigError
+
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680, 2520, 5040]
+
+
+def _get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+            continue
+        for hcn in HCN_LIST:
+            if base * hcn <= max_acceptable_batch_size:
+                candidates.add(base * hcn)
+    return sorted(candidates)
+
+
+def _get_compatible_gpus(micro_batches: List[int], batch_size: int, min_gpus: int, max_gpus: int) -> Dict[int, List[int]]:
+    """For each micro batch size, which world sizes divide batch/micro evenly."""
+    valid: Dict[int, List[int]] = {}
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        max_dp = batch_size // mb
+        sizes = [w for w in range(min_gpus, max_gpus + 1) if max_dp % w == 0]
+        if sizes:
+            valid[mb] = sizes
+    return valid
+
+
+def compute_elastic_config(elastic_config, world_size: int = 0) -> Tuple[int, Dict[int, List[int]], List[int]]:
+    """Pick the final train batch size + valid world-size map.
+
+    Returns (final_batch_size, {micro_batch: [world sizes]}, micro_batches).
+    Mirrors reference ``compute_elastic_config`` (elasticity/elasticity.py:233).
+    """
+    micro_batches = sorted(elastic_config.micro_batch_sizes, reverse=elastic_config.prefer_larger_batch)
+    if not micro_batches or any(m <= 0 for m in micro_batches):
+        raise ConfigError(f"Invalid micro_batch_sizes: {elastic_config.micro_batch_sizes}")
+    candidates = _get_candidate_batch_sizes(micro_batches, elastic_config.max_train_batch_size)
+    best_batch, best_map, best_metric = 0, {}, (-1, -1)
+    for batch in candidates:
+        gpu_map = _get_compatible_gpus(micro_batches, batch, elastic_config.min_gpus, elastic_config.max_gpus)
+        if not gpu_map:
+            continue
+        # Coverage-first, batch size only as tiebreak (reference
+        # elasticity/elasticity.py:74-75 ordering).
+        coverage = len({w for sizes in gpu_map.values() for w in sizes})
+        metric = (coverage, batch if elastic_config.prefer_larger_batch else -batch)
+        if metric > best_metric:
+            best_metric, best_batch, best_map = metric, batch, gpu_map
+    if not best_batch:
+        raise ConfigError(
+            f"No valid elastic batch plan for micro_batch_sizes={micro_batches} "
+            f"max={elastic_config.max_train_batch_size} gpus=[{elastic_config.min_gpus},{elastic_config.max_gpus}]")
+    if world_size:
+        ok = any(world_size in sizes for sizes in best_map.values())
+        if not ok:
+            raise ConfigError(f"World size {world_size} is not compatible with elastic plan {best_map}")
+    return best_batch, best_map, micro_batches
+
+
+def get_best_candidates(elastic_config, world_size: int) -> Tuple[int, int, int]:
+    """(micro_batch, gas) for this world size under the plan."""
+    batch, gpu_map, micro_batches = compute_elastic_config(elastic_config, world_size)
+    for mb in micro_batches:
+        if mb in gpu_map and world_size in gpu_map[mb]:
+            gas = batch // (mb * world_size)
+            return batch, mb, gas
+    raise ConfigError(f"World size {world_size} has no valid (micro, gas) under elastic plan")
+
+
+def verify_elastic_config(elastic_config, world_size: int = 0) -> None:
+    """Raise if the elastic plan is invalid or incompatible with world_size."""
+    if elastic_config.version not in (0.1, 0.2):
+        raise ConfigError(f"Unsupported elasticity version {elastic_config.version}")
+    compute_elastic_config(elastic_config, world_size=world_size)

@@ -1,0 +1,71 @@
+"""Rank-aware logging.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py``
+(``logger``, ``log_dist``, rank filtering): on TPU/JAX the "rank" is the JAX
+process index, and single-controller runs are process 0.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "shuffle_exchange_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s", datefmt="%H:%M:%S")
+        )
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(level=LOG_LEVELS.get(os.environ.get("SXT_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module load; jax import is expensive and some
+    # tooling (e.g. config linting) should not need a backend.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (None or [-1] = all)."""
+    my_rank = _process_index()
+    if ranks is None or ranks == [-1] or my_rank in set(ranks):
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    if max_log_level_str.lower() not in LOG_LEVELS:
+        raise ValueError(f"{max_log_level_str} is not one of the `logging` levels")
+    return logger.getEffectiveLevel() <= LOG_LEVELS[max_log_level_str.lower()]
+
+
+def warning_once(message: str) -> None:
+    _warn_once(message)
+
+
+@functools.lru_cache(None)
+def _warn_once(message: str) -> None:
+    logger.warning(message)

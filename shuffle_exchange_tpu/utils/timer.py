@@ -1,0 +1,199 @@
+"""Wall-clock and throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` (named timers with elapsed/mean, device
+synchronization before reading) and ``ThroughputTimer`` (samples/sec, TFLOPS).
+On TPU, "synchronize" means blocking on the last dispatched computation
+(``jax.block_until_ready`` is the caller's job for specific arrays; here we use
+``jax.effects_barrier``-style full sync via a device sync call).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+BACKWARD_INNER_MICRO_TIMER = "bwd_inner_microstep"
+BACKWARD_INNER_GLOBAL_TIMER = "bwd_inner"
+BACKWARD_REDUCE_MICRO_TIMER = "bwd_allreduce_microstep"
+BACKWARD_REDUCE_GLOBAL_TIMER = "bwd_allreduce"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_sync() -> None:
+    try:
+        import jax
+
+        # Block until dispatched work on every local device is complete — a
+        # token computation per device, not just the default device.
+        tokens = [jax.device_put(0.0, d) for d in jax.local_devices()]
+        for t in tokens:
+            t.block_until_ready()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.records: List[float] = []
+
+    def start(self, sync: bool = False) -> None:
+        if sync:
+            _device_sync()
+        self.start_time = time.time()
+        self.started = True
+
+    def stop(self, record: bool = True, sync: bool = False) -> None:
+        if not self.started:
+            return
+        if sync:
+            _device_sync()
+        dt = time.time() - self.start_time
+        self.elapsed_ += dt
+        if record:
+            self.records.append(dt)
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        now = time.time()
+        value = self.elapsed_
+        if self.started:
+            value += now - self.start_time
+        if reset:
+            self.elapsed_ = 0.0
+            # Restart the in-flight interval so a later stop() doesn't
+            # double-count the portion already reported.
+            if self.started:
+                self.start_time = now
+        return value
+
+    def mean(self) -> float:
+        return sum(self.records) / max(1, len(self.records))
+
+    def reset(self) -> None:
+        self.started = False
+        self.elapsed_ = 0.0
+        self.records = []
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry; `log()` prints ms per timer like the reference."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"mem in_use={in_use:.2f}GB peak={peak:.2f}GB"
+        except Exception:
+            return "mem stats unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, ranks=None, memory_breakdown=False) -> None:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        msg = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            msg += " | " + self.memory_usage()
+        log_dist(msg, ranks=ranks or [0])
+
+    def get_timers(self):
+        return self.timers
+
+
+class NoopTimer:
+    class _N:
+        def start(self, *a, **k): ...
+        def stop(self, *a, **k): ...
+        def elapsed(self, *a, **k): return 0.0
+        def mean(self): return 0.0
+        def reset(self): ...
+
+    def __call__(self, name):
+        return self._N()
+
+    def has(self, name):
+        return True
+
+    def log(self, *a, **k): ...
+
+
+class ThroughputTimer:
+    """samples/sec + TFLOPS estimate over global steps (ref: utils/timer.py ThroughputTimer)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self) -> None:
+        pass
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, flops_per_sample: Optional[float] = None) -> None:
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time and self.global_step_count > self.start_step:
+            _device_sync()
+            duration = time.time() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.global_step_count % self.steps_per_output == 0:
+                msg = (
+                    f"step={self.global_step_count}, "
+                    f"throughput={self.avg_samples_per_sec():.2f} samples/s, "
+                    f"latency={self.total_elapsed_time / max(1, self.global_step_count - self.start_step):.3f}s"
+                )
+                if flops_per_sample:
+                    tflops = flops_per_sample * self.avg_samples_per_sec() / 1e12
+                    msg += f", tflops={tflops:.1f}"
+                log_dist(msg, ranks=[0])
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = (self.global_step_count - self.start_step) * self.batch_size
+            return samples / self.total_elapsed_time
+        return 0.0

@@ -1,0 +1,25 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX import.
+
+This replaces the reference's forked-process DistributedTest fixture
+(SURVEY.md §4): JAX exposes N host devices via XLA_FLAGS, so multi-"chip"
+sharding tests run on one box with no pod.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SXT_LOG_LEVEL", "warning")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,136 @@
+"""Config parsing/validation tests (reference test surface: SURVEY.md §4c)."""
+
+import json
+
+import pytest
+
+from shuffle_exchange_tpu.config import ConfigError, SXConfig
+
+
+def test_batch_arithmetic_infer_gas():
+    cfg = SXConfig.load({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}, world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_arithmetic_infer_train():
+    cfg = SXConfig.load({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3}, world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_arithmetic_mismatch_raises():
+    with pytest.raises(ConfigError, match="batch related parameters"):
+        SXConfig.load(
+            {"train_batch_size": 30, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4},
+            world_size=4,
+        )
+
+
+def test_missing_batch_raises():
+    with pytest.raises(ConfigError):
+        SXConfig.load({}, world_size=1)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError, match="fp16 and bf16"):
+        SXConfig.load(
+            {"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+            world_size=1,
+        )
+
+
+def test_zero_stage_bounds():
+    with pytest.raises(ConfigError):
+        SXConfig.load({"train_batch_size": 8, "zero_optimization": {"stage": 4}}, world_size=1)
+
+
+def test_deepspeed_style_json_roundtrip(tmp_path):
+    ds_json = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 2000,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001, "betas": [0.8, 0.999], "eps": 1e-8, "weight_decay": 3e-7}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001, "warmup_num_steps": 1000}},
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "fp16": {"enabled": False, "loss_scale": 0, "loss_scale_window": 1000, "hysteresis": 2, "min_loss_scale": 1},
+        "bf16": {"enabled": True},
+        "wall_clock_breakdown": False,
+        "zero_optimization": {
+            "stage": 2,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "allgather_bucket_size": 5e8,
+            "reduce_bucket_size": 5e8,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+        },
+    }
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(ds_json))
+    cfg = SXConfig.load(str(path), world_size=8)
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.zero_optimization.reduce_bucket_size == int(5e8)
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 0.001
+    assert cfg.bf16.enabled and not cfg.fp16.enabled
+    assert cfg.train_micro_batch_size_per_gpu == 2  # 16 / (1 * 8)
+    # round-trip through to_dict
+    d = cfg.to_dict()
+    assert d["zero_optimization"]["stage"] == 2
+
+
+def test_shuffle_exchange_section():
+    cfg = SXConfig.load(
+        {"train_batch_size": 8, "shuffle_exchange": {"method": "shuffle", "rings": 4, "shuffle_step": 10, "slice_count": 2}},
+        world_size=8,
+    )
+    assert cfg.shuffle_exchange.method == "shuffle"
+    assert cfg.shuffle_exchange.rings == 4
+    with pytest.raises(ConfigError, match="method"):
+        SXConfig.load({"train_batch_size": 8, "shuffle_exchange": {"method": "bogus"}}, world_size=1)
+
+
+def test_offload_device_validation():
+    with pytest.raises(ConfigError, match="offload device"):
+        SXConfig.load(
+            {"train_batch_size": 8, "zero_optimization": {"stage": 3, "offload_param": {"device": "gpu"}}},
+            world_size=1,
+        )
+
+
+def test_elasticity_plan():
+    from shuffle_exchange_tpu.runtime.elasticity import compute_elastic_config, get_best_candidates
+
+    elastic = {
+        "enabled": True,
+        "max_train_batch_size": 128,
+        "micro_batch_sizes": [2, 4],
+        "min_gpus": 1,
+        "max_gpus": 8,
+    }
+    # Elasticity replaces user batch config; explicit batch keys are an error
+    # unless ignore_non_elastic_batch_info (reference runtime/config.py behavior).
+    with pytest.raises(ConfigError, match="batch parameters"):
+        SXConfig.load({"train_batch_size": 8, "elasticity": elastic}, world_size=4)
+    cfg = SXConfig.load({"elasticity": elastic}, world_size=4)
+    assert cfg.train_batch_size == cfg.train_micro_batch_size_per_gpu * cfg.gradient_accumulation_steps * 4
+    batch, gpu_map, micro = compute_elastic_config(cfg.elasticity)
+    assert batch <= 128 and gpu_map
+    b, mb, gas = get_best_candidates(cfg.elasticity, world_size=4)
+    assert b == mb * gas * 4
+
+
+def test_string_batch_size_coerced():
+    cfg = SXConfig.load({"train_batch_size": "32"}, world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_bfloat16_legacy_section_name():
+    cfg = SXConfig.load({"train_batch_size": 8, "bfloat16": {"enabled": True}}, world_size=1)
+    assert cfg.bf16.enabled
+
+
+def test_grad_accum_dtype_validated():
+    with pytest.raises(ConfigError, match="grad_accum_dtype"):
+        SXConfig.load({"train_batch_size": 8, "data_types": {"grad_accum_dtype": "float64"}}, world_size=1)
