@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Metric (BASELINE.json): tokens/sec/chip for the flagship training config on
+the available hardware. On the single tunneled TPU chip this runs a
+GPT-2-small-class model with the full engine path (ZeRO sharding policy,
+bf16, fused jitted train step); on CPU (no TPU) it runs a tiny config so the
+line is always produced.
+
+vs_baseline: ratio against the H100-class reference throughput scaled to
+this config — the reference snapshot publishes no rigorous numbers
+(BASELINE.md), so the denominator is a model-FLOPs-derived H100 estimate:
+assume the reference hits 45% MFU on H100 (989 TFLOP/s bf16 dense), i.e.
+tokens/sec = 0.45 * 989e12 / (6 * n_params). The same formula with the
+chip's peak gives our MFU-normalized comparison until real H100 runs exist.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, gpt2_small, tiny
+
+    if on_tpu:
+        import dataclasses
+
+        model = Transformer(dataclasses.replace(gpt2_small(), remat=True))
+        batch_size, seq_len, steps, warmup = 8, 1024, 20, 3
+    else:
+        model = Transformer(tiny(vocab=512, d=128, layers=2, heads=4, seq=128))
+        batch_size, seq_len, steps, warmup = 8, 128, 5, 1
+
+    cfg = {
+        "train_batch_size": batch_size,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10**9,
+    }
+    engine, *_ = sxt.initialize(model=model, config=cfg)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, model.config.vocab_size,
+                                       size=(batch_size, seq_len)).astype(np.int32)}
+
+    for _ in range(warmup):
+        engine.train_batch(batch).block_until_ready()
+    t0 = time.time()
+    times = []
+    for _ in range(steps):
+        s = time.time()
+        engine.train_batch(batch).block_until_ready()
+        times.append(time.time() - s)
+    total = time.time() - t0
+
+    n_chips = len(jax.devices())
+    tokens_per_step = batch_size * (seq_len - 1)
+    tokens_per_sec_chip = tokens_per_step * steps / total / n_chips
+    p50 = sorted(times)[len(times) // 2]
+
+    # Param count + H100-reference estimate (see module docstring).
+    import jax.tree_util as jtu
+
+    n_params = sum(int(np.prod(l.shape)) for l in jtu.tree_leaves(engine.state.master))
+    if engine.ensemble:
+        n_params //= engine.replicas
+    # vs_baseline is hardware-normalized: our MFU on this chip vs the 45% MFU
+    # assumed for the reference on its chip (BASELINE.md has no real numbers).
+    peak_flops = {"tpu": 197e12}.get(platform, 50e12)  # v5e bf16 dense peak
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5p" in kind or "v4" in kind:
+        peak_flops = 459e12 if "v5p" in kind else 275e12
+    our_mfu = 6.0 * n_params * tokens_per_sec_chip / peak_flops
+    vs_baseline = our_mfu / 0.45
+
+    result = {
+        "metric": f"train tokens/sec/chip ({'gpt2-125M' if on_tpu else 'tiny-cpu'} ZeRO-1 bf16, step p50 {p50*1000:.0f}ms)",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

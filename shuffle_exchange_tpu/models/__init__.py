@@ -1,0 +1,26 @@
+from .transformer import (
+    Transformer,
+    TransformerConfig,
+    gpt2_small,
+    gpt2_large,
+    llama3_8b,
+    llama3_70b,
+    tiny,
+)
+
+MODEL_REGISTRY = {
+    "gpt2-small": gpt2_small,
+    "gpt2-large": gpt2_large,
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+    "tiny": tiny,
+}
+
+
+def get_model(name: str, **overrides) -> Transformer:
+    import dataclasses
+
+    cfg = MODEL_REGISTRY[name]()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return Transformer(cfg)

@@ -1,0 +1,316 @@
+"""Decoder-only transformer family, TPU-first.
+
+This is the model zoo used by the benchmarks and the serving engine — the
+capability analog of the reference's supported architectures
+(``module_inject/containers/`` gpt2/llama/llama2 etc., and
+``inference/v2/model_implementations/llama_v2/model.py``), built the JAX way:
+
+- **Scanned layers**: per-layer params are stacked on a leading dim and the
+  layer body runs under ``lax.scan`` — O(1) compile time in depth, natural
+  remat boundaries, and the stack dim later doubles as the pipeline-stage
+  dim.
+- **Mesh-aware partition specs**: every weight carries a logical
+  PartitionSpec (heads/ffn over "tensor", vocab over "tensor") — the AutoTP
+  analog (module_inject/auto_tp.py): XLA inserts the row/column-parallel
+  collectives the reference implements as LinearLayer/LinearAllreduce
+  (module_inject/layers.py:388,465).
+- bf16-friendly: params live in the engine's train dtype; norms/softmax/CE
+  computed in fp32.
+
+Configs cover GPT-2 (learned pos, LayerNorm, GELU) and Llama-3 (RoPE,
+RMSNorm, SwiGLU, GQA) families plus tiny test sizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None          # None = MHA; < n_heads = GQA
+    d_ff: Optional[int] = None                 # default 4*d (gelu) or 8/3*d (swiglu)
+    max_seq_len: int = 2048
+    activation: str = "gelu"                   # "gelu" | "swiglu"
+    norm: str = "layernorm"                    # "layernorm" | "rmsnorm"
+    position: str = "learned"                  # "learned" | "rope"
+    rope_theta: float = 500000.0
+    tie_embeddings: bool = True
+    dropout: float = 0.0
+    dtype: Any = None                          # compute dtype override (engine usually casts)
+    remat: bool = False
+    remat_policy: str = "dots_saveable"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff:
+            return self.d_ff
+        if self.activation == "swiglu":
+            # Llama convention: 2/3 * 4d rounded to multiple of 256
+            d = int(8 * self.d_model / 3)
+            return 256 * ((d + 255) // 256)
+        return 4 * self.d_model
+
+
+# ---------------------------------------------------------------------------
+# Presets (sizes match the reference's benchmark configs, BASELINE.md)
+# ---------------------------------------------------------------------------
+
+def gpt2_small() -> TransformerConfig:  # 125M — capability config #1
+    return TransformerConfig(vocab_size=50257, d_model=768, n_layers=12, n_heads=12,
+                             max_seq_len=1024, activation="gelu", norm="layernorm", position="learned")
+
+
+def gpt2_large() -> TransformerConfig:
+    return TransformerConfig(vocab_size=50257, d_model=1280, n_layers=36, n_heads=20,
+                             max_seq_len=1024, activation="gelu", norm="layernorm", position="learned")
+
+
+def llama3_8b() -> TransformerConfig:  # capability config #2 (north star)
+    return TransformerConfig(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+                             d_ff=14336, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+                             position="rope", rope_theta=500000.0, tie_embeddings=False)
+
+
+def llama3_70b() -> TransformerConfig:  # capability config #4
+    return TransformerConfig(vocab_size=128256, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                             d_ff=28672, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+                             position="rope", tie_embeddings=False)
+
+
+def tiny(vocab=256, d=64, layers=2, heads=4, seq=64, **kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+                             max_seq_len=seq, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Core ops (jnp reference implementations; Pallas kernels swap in via ops/)
+# ---------------------------------------------------------------------------
+
+
+def _norm(x, weight, bias, kind: str, eps: float = 1e-5):
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        from ..ops.rmsnorm import rmsnorm
+
+        return rmsnorm(x32, weight.astype(jnp.float32), eps=eps).astype(x.dtype)
+    mean = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mean) * (1.0 / jnp.sqrt(var + eps))
+    out = out * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float):
+    import jax.numpy as jnp
+
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [T, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, T, H, D]; rotate pairs (even, odd) halves-interleaved."""
+    import jax.numpy as jnp
+
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def causal_attention(q, k, v, attention_impl: str = "auto"):
+    """q: [B,T,H,D], k/v: [B,T,Hkv,D] → [B,T,H,D]. fp32 softmax.
+
+    Dispatches to the Pallas flash kernel on TPU (ops/flash_attention);
+    jnp reference elsewhere.
+    """
+    import jax.numpy as jnp
+
+    from ..ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=True, impl=attention_impl)
+
+
+# ---------------------------------------------------------------------------
+# The model
+# ---------------------------------------------------------------------------
+
+
+class Transformer:
+    """Functional model: ``init(rng) -> params``, ``apply(params, ids) ->
+    logits``, ``loss(params, batch, rng) -> scalar`` (next-token CE)."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    # -- parameters ----------------------------------------------------
+
+    def init(self, rng) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        L, D, H, KV, Dh, F = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.ff_dim
+        keys = iter(jax.random.split(rng, 16))
+
+        params: Dict[str, Any] = {
+            "embed": jax.random.normal(next(keys), (cfg.vocab_size, D), jnp.float32) * 0.02,
+        }
+        if cfg.position == "learned":
+            params["pos_embed"] = jax.random.normal(next(keys), (cfg.max_seq_len, D), jnp.float32) * 0.02
+        # stacked per-layer weights: leading dim L
+        def stack(key, shape, fan_in, scale=1.0):
+            return jax.random.normal(key, (L,) + shape, jnp.float32) * (scale / math.sqrt(fan_in))
+
+        layer = {
+            "ln1_w": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
+            "ln2_w": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
+            "wq": stack(next(keys), (D, H * Dh), D),
+            "wk": stack(next(keys), (D, KV * Dh), D),
+            "wv": stack(next(keys), (D, KV * Dh), D),
+            "wo": stack(next(keys), (H * Dh, D), H * Dh, scale=1.0 / math.sqrt(2 * L)),
+        }
+        if cfg.activation == "swiglu":
+            layer["w_gate"] = stack(next(keys), (D, F), D)
+            layer["w_up"] = stack(next(keys), (D, F), D)
+            layer["w_down"] = stack(next(keys), (F, D), F, scale=1.0 / math.sqrt(2 * L))
+        else:
+            layer["w_up"] = stack(next(keys), (D, F), D)
+            layer["b_up"] = jnp.zeros((L, F))
+            layer["w_down"] = stack(next(keys), (F, D), F, scale=1.0 / math.sqrt(2 * L))
+            layer["b_down"] = jnp.zeros((L, D))
+        params["layers"] = layer
+        params["ln_f_w"] = jnp.ones((D,))
+        params["ln_f_b"] = jnp.zeros((D,))
+        if not cfg.tie_embeddings:
+            params["unembed"] = jax.random.normal(next(keys), (D, cfg.vocab_size), jnp.float32) * 0.02
+        return params
+
+    # -- partition specs (AutoTP analog) -------------------------------
+
+    def partition_specs(self, params) -> Dict[str, Any]:
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.config
+
+        def spec_for(path: Tuple[str, ...], leaf):
+            name = path[-1]
+            stacked = path[0] == "layers"
+            lead = (None,) if stacked else ()
+            if name in ("wq", "wk", "wv", "w_gate", "w_up"):
+                return P(*lead, None, "tensor")       # column parallel
+            if name in ("wo", "w_down"):
+                return P(*lead, "tensor", None)       # row parallel
+            if name in ("b_up",):
+                return P(*lead, "tensor")
+            if name == "embed":
+                return P("tensor", None)              # vocab parallel
+            if name == "unembed":
+                return P(None, "tensor")
+            return P(*((None,) * leaf.ndim))
+
+        flat = {}
+        def walk(tree, path):
+            if isinstance(tree, dict):
+                return {k: walk(v, path + (k,)) for k, v in tree.items()}
+            return spec_for(path, tree)
+
+        return walk(params, ())
+
+    # -- forward -------------------------------------------------------
+
+    def apply(self, params, input_ids):
+        """input_ids [B, T] -> logits [B, T, vocab] (fp32)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        B, T = input_ids.shape
+        H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        x = jnp.take(params["embed"], input_ids, axis=0)
+        dtype = x.dtype
+        if cfg.position == "learned":
+            x = x + params["pos_embed"][:T].astype(dtype)
+            cos = sin = None
+        else:
+            cos, sin = rope_table(T, Dh, cfg.rope_theta)
+
+        def layer_fn(h, lw):
+            y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm)
+            q = (y @ lw["wq"]).reshape(B, T, H, Dh)
+            k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
+            v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
+            if cfg.position == "rope":
+                q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+            attn = causal_attention(q, k, v).reshape(B, T, H * Dh)
+            h = h + attn @ lw["wo"]
+            y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm)
+            if cfg.activation == "swiglu":
+                ff = (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
+            else:
+                ff = (jax.nn.gelu(y @ lw["w_up"] + lw["b_up"].astype(dtype))) @ lw["w_down"] + lw["b_down"].astype(dtype)
+            h = h + ff
+            return h, None
+
+        if cfg.remat:
+            policy = _remat_policy(cfg.remat_policy)
+            layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+        x, _ = jax.lax.scan(lambda h, lw: layer_fn(h, lw), x, params["layers"])
+        x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+        if cfg.tie_embeddings:
+            logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+        else:
+            logits = x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
+        return logits
+
+    def loss(self, params, batch, rng=None):
+        """Next-token cross entropy. batch: {"input_ids": [B,T]} (+ optional
+        "labels" already shifted, -100 = ignore)."""
+        import jax
+        import jax.numpy as jnp
+
+        ids = batch["input_ids"]
+        if "labels" in batch:
+            labels = batch["labels"]
+            logits = self.apply(params, ids)
+        else:
+            labels = ids[:, 1:]
+            logits = self.apply(params, ids[:, :-1])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        mask = (labels >= 0)
+        safe_labels = jnp.where(mask, labels, 0)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def _remat_policy(name: str):
+    import jax
+
+    policies = {
+        "none": None,
+        "full": jax.checkpoint_policies.nothing_saveable,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    return policies.get(name, jax.checkpoint_policies.dots_saveable)

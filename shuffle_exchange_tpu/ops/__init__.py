@@ -1,0 +1,2 @@
+from .flash_attention import flash_attention
+from .rmsnorm import rmsnorm, rmsnorm_reference

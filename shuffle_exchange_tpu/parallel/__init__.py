@@ -1,0 +1,10 @@
+from .mesh import (
+    AXIS_ORDER,
+    MeshTopology,
+    get_topology,
+    initialize_topology,
+    reset_topology,
+    topology_is_initialized,
+    resolve_axis_sizes,
+)
+from . import comm

@@ -1,0 +1,244 @@
+"""Communication facade: JAX collectives + op-level accounting.
+
+Capability parity with the reference's ``deepspeed.comm`` (``comm/comm.py``:
+global backend, ``@timed_op`` comms logging, ``init_distributed`` env/MPI
+rank discovery, ``log_summary`` straggler/bandwidth report). The TPU-native
+difference (SURVEY.md §2.8): collectives are *traced* into jit programs and
+scheduled by XLA over ICI/DCN, so instrumentation happens at trace time —
+every wrapper records op name, payload bytes and axis — and wall-clock
+timing is measured around the jitted step, not per op. Eager (host-driven)
+collectives (checkpoint barriers, multihost sync) are timed directly.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..utils.logging import log_dist, logger
+
+# ----------------------------------------------------------------------
+# Comms logger (reference: utils/comms_logging.py + comm/comm.py:102-142)
+# ----------------------------------------------------------------------
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False, prof_all: bool = True,
+                 debug: bool = False, prof_ops: Optional[List[str]] = None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        # op name -> {"count": n, "bytes": total, "times": [..] (eager only)}
+        self.stats: Dict[str, Dict[str, Any]] = defaultdict(lambda: {"count": 0, "bytes": 0, "times": []})
+
+    def configure(self, config) -> None:
+        self.enabled = config.enabled
+        self.verbose = config.verbose
+        self.prof_all = config.prof_all
+        self.debug = config.debug
+        self.prof_ops = list(config.prof_ops)
+
+    def _should_log(self, name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or name in self.prof_ops
+
+    def record(self, name: str, nbytes: int, elapsed: Optional[float] = None, note: str = "") -> None:
+        if not self._should_log(name):
+            return
+        rec = self.stats[name]
+        rec["count"] += 1
+        rec["bytes"] += int(nbytes)
+        if elapsed is not None:
+            rec["times"].append(elapsed)
+        if self.verbose:
+            log_dist(f"comm op: {name} | bytes: {nbytes} | {note}", ranks=[0])
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        """Bandwidth/count table; eager ops include measured time."""
+        lines = [f"{'Op':<24}{'Count':>8}{'Total MB':>12}{'Avg ms':>10}{'Busbw GB/s':>12}"]
+        for name, rec in sorted(self.stats.items()):
+            mb = rec["bytes"] / 1e6
+            if rec["times"]:
+                avg_ms = 1000 * sum(rec["times"]) / len(rec["times"])
+                busbw = (rec["bytes"] / max(1, rec["count"])) / max(1e-9, (sum(rec["times"]) / len(rec["times"]))) / 1e9
+            else:
+                avg_ms, busbw = 0.0, 0.0
+            lines.append(f"{name:<24}{rec['count']:>8}{mb:>12.2f}{avg_ms:>10.3f}{busbw:>12.2f}")
+        report = "\n".join(lines)
+        log_dist("comms log summary:\n" + report, ranks=[0])
+        return report
+
+    def reset(self) -> None:
+        self.stats.clear()
+
+
+comms_logger = CommsLogger()
+
+
+def configure(comms_config) -> None:
+    comms_logger.configure(comms_config)
+
+
+def log_summary(show_straggler: bool = False) -> str:
+    return comms_logger.log_summary(show_straggler=show_straggler)
+
+
+def _nbytes(x) -> int:
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(x)
+        return sum(getattr(l, "size", 0) * getattr(getattr(l, "dtype", None), "itemsize", 4) for l in leaves)
+    except Exception:
+        return 0
+
+
+# ----------------------------------------------------------------------
+# Distributed bootstrap (reference: comm/comm.py:643 init_distributed +
+# mpi_discovery :712). On TPU this is jax.distributed.initialize; rank/size
+# come from the TPU runtime or from env/MPI-style variables.
+# ----------------------------------------------------------------------
+
+_INITIALIZED = False
+
+
+def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = True,
+                     init_method: Optional[str] = None, rank: int = -1, world_size: int = -1,
+                     timeout=None, dist_init_required: Optional[bool] = None) -> None:
+    """Idempotent multi-host bring-up.
+
+    Discovery order mirrors the reference: explicit args > launcher env
+    (COORDINATOR_ADDRESS/PROCESS_ID/NUM_PROCESSES, or RANK/WORLD_SIZE/
+    MASTER_ADDR:MASTER_PORT) > MPI-style env (OMPI_COMM_WORLD_*) > single
+    process.
+    """
+    global _INITIALIZED
+    if _INITIALIZED or dist_init_required is False:
+        return
+    import jax
+
+    coordinator = init_method or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator is None and os.environ.get("MASTER_ADDR"):
+        coordinator = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', '29500')}"
+    if rank < 0:
+        rank = int(os.environ.get("PROCESS_ID", os.environ.get("RANK",
+                   os.environ.get("OMPI_COMM_WORLD_RANK", "-1") if auto_mpi_discovery else "-1")))
+    if world_size < 0:
+        world_size = int(os.environ.get("NUM_PROCESSES", os.environ.get("WORLD_SIZE",
+                         os.environ.get("OMPI_COMM_WORLD_SIZE", "-1") if auto_mpi_discovery else "-1")))
+    try:
+        if coordinator and world_size > 1:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=world_size,
+                                       process_id=max(0, rank))
+            log_dist(f"jax.distributed initialized: {coordinator} rank={rank}/{world_size}", ranks=[0])
+        elif jax.process_count() > 1:
+            pass  # TPU runtime already initialized multi-host
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise
+    _INITIALIZED = True
+
+
+def get_rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def barrier(name: str = "barrier") -> None:
+    """Host-level sync across processes (eager, timed)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    t0 = time.time()
+    multihost_utils.sync_global_devices(name)
+    comms_logger.record("barrier", 0, elapsed=time.time() - t0, note=name)
+
+
+# ----------------------------------------------------------------------
+# In-jit collectives. Thin wrappers over lax so every collective the
+# framework issues is (a) named consistently and (b) accounted at trace time.
+# ----------------------------------------------------------------------
+
+
+def psum(x, axis_name, axis_index_groups=None):
+    import jax
+
+    comms_logger.record("all_reduce", _nbytes(x), note=str(axis_name))
+    return jax.lax.psum(x, axis_name, axis_index_groups=axis_index_groups)
+
+
+def pmean(x, axis_name, axis_index_groups=None):
+    import jax
+
+    comms_logger.record("all_reduce", _nbytes(x), note=str(axis_name))
+    return jax.lax.pmean(x, axis_name, axis_index_groups=axis_index_groups)
+
+
+def pmax(x, axis_name):
+    import jax
+
+    comms_logger.record("all_reduce", _nbytes(x), note=str(axis_name))
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name, axis: int = 0, tiled: bool = True, axis_index_groups=None):
+    import jax
+
+    comms_logger.record("all_gather", _nbytes(x), note=str(axis_name))
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled, axis_index_groups=axis_index_groups)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension: int = 0, tiled: bool = True, axis_index_groups=None):
+    import jax
+
+    comms_logger.record("reduce_scatter", _nbytes(x), note=str(axis_name))
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled,
+                                axis_index_groups=axis_index_groups)
+
+
+def all_to_all(x, axis_name, split_axis: int, concat_axis: int, tiled: bool = False, axis_index_groups=None):
+    import jax
+
+    comms_logger.record("all_to_all", _nbytes(x), note=str(axis_name))
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+                              tiled=tiled, axis_index_groups=axis_index_groups)
+
+
+def ppermute(x, axis_name, perm: Sequence):
+    import jax
+
+    comms_logger.record("send_recv", _nbytes(x), note=str(axis_name))
+    return jax.lax.ppermute(x, axis_name, perm=list(perm))
+
+
+def axis_index(axis_name):
+    import jax
+
+    return jax.lax.axis_index(axis_name)
+
+
+def broadcast_one_to_all(x, is_source: Optional[bool] = None):
+    """Eager host-level broadcast from process 0 (reference: dist.broadcast
+    of initial weights, engine.py:1242)."""
+    from jax.experimental import multihost_utils
+
+    t0 = time.time()
+    out = multihost_utils.broadcast_one_to_all(x, is_source=is_source)
+    comms_logger.record("broadcast", _nbytes(x), elapsed=time.time() - t0)
+    return out

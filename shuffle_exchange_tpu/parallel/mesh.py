@@ -1,0 +1,230 @@
+"""Named-axis device mesh topology.
+
+This is the TPU-native replacement for the reference's process-group
+machinery: ``utils/groups.py`` (MP/DP/EP/SP group registry),
+``runtime/pipe/topology.py`` (ProcessTopology rank grid) and
+``comm/comm.py:616`` (``initialize_mesh_device``). Instead of NCCL process
+groups, every parallel dimension is a named axis of one
+``jax.sharding.Mesh``; collectives ride ICI when the axis maps onto
+physically-adjacent chips and DCN across slices/hosts.
+
+Axes (reference strategy → mesh axis):
+  DP / decentralized-sync replicas  → "data"
+  ZeRO partitioning (stages 1-3)    → "fsdp"
+  Tensor parallel (AutoTP)          → "tensor"
+  Expert parallel (MoE)             → "expert"
+  Ulysses / ring sequence parallel  → "seq"
+  Pipeline stages                   → "pipe"
+
+Axis order is (pipe, data, fsdp, expert, seq, tensor): innermost axes get
+ICI-contiguous device ranges, so tensor/seq/expert collectives (latency
+sensitive, per-layer) ride ICI while pipe/data (less frequent) may cross DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.config_utils import ConfigError
+from ..utils.logging import log_dist, logger
+
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+# ZeRO parameter/optimizer partitioning shards over both data-like axes: the
+# reference partitions over the whole DP world; here the DP world is
+# data × fsdp (fsdp is the dedicated shard axis, data may add replicas).
+ZERO_AXES: Tuple[str, ...] = ("data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Resolved axis sizes for a device count."""
+
+    sizes: Dict[str, int]
+
+    @property
+    def total(self) -> int:
+        out = 1
+        for v in self.sizes.values():
+            out *= v
+        return out
+
+
+def resolve_axis_sizes(mesh_config, n_devices: int) -> MeshSpec:
+    """Fill in data=-1 from the device count and validate divisibility."""
+    sizes = {ax: getattr(mesh_config, ax) for ax in AXIS_ORDER}
+    fixed = 1
+    for ax, v in sizes.items():
+        if v == 0 or v < -1:
+            raise ConfigError(f"mesh.{ax} must be positive or -1, got {v}")
+        if v != -1:
+            fixed *= v
+    wildcard = [ax for ax, v in sizes.items() if v == -1]
+    if len(wildcard) > 1:
+        raise ConfigError(f"Only one mesh axis may be -1, got {wildcard}")
+    if wildcard:
+        if n_devices % fixed:
+            raise ConfigError(
+                f"Device count {n_devices} not divisible by fixed mesh axes product {fixed} ({sizes})")
+        sizes[wildcard[0]] = n_devices // fixed
+    else:
+        if fixed != n_devices:
+            raise ConfigError(f"Mesh sizes {sizes} multiply to {fixed} != device count {n_devices}")
+    return MeshSpec(sizes)
+
+
+class MeshTopology:
+    """The one device mesh + axis bookkeeping for a run.
+
+    Construction: ``MeshTopology.build(mesh_config)`` uses all visible
+    devices. Thin API mirrors the reference groups registry (§2.7) so
+    engine/moe/sequence code asks topology questions in one place.
+    """
+
+    def __init__(self, mesh: "jax.sharding.Mesh"):
+        self.mesh = mesh
+        self.axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def build(cls, mesh_config=None, n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> "MeshTopology":
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+        if mesh_config is None:
+            from ..config.config import MeshConfig
+
+            mesh_config = MeshConfig()
+        spec = resolve_axis_sizes(mesh_config, len(devices))
+        shape = tuple(spec.sizes[ax] for ax in AXIS_ORDER)
+        dev_array = np.asarray(devices).reshape(shape)
+        mesh = jax.sharding.Mesh(dev_array, AXIS_ORDER)
+        log_dist(f"Mesh built: {dict(zip(AXIS_ORDER, shape))} over {len(devices)} devices", ranks=[0])
+        return cls(mesh)
+
+    # -- axis queries (reference utils/groups.py getters) --------------
+
+    def size(self, *axes: str) -> int:
+        out = 1
+        for ax in axes:
+            out *= self.axis_sizes[ax]
+        return out
+
+    @property
+    def world_size(self) -> int:
+        return self.size(*AXIS_ORDER)
+
+    @property
+    def data_parallel_world_size(self) -> int:
+        # ZeRO/DP world = data × fsdp (see ZERO_AXES).
+        return self.size(*ZERO_AXES)
+
+    @property
+    def replica_world_size(self) -> int:
+        return self.size("data")
+
+    @property
+    def model_parallel_world_size(self) -> int:
+        return self.size("tensor")
+
+    @property
+    def expert_parallel_world_size(self) -> int:
+        return self.size("expert")
+
+    @property
+    def sequence_parallel_world_size(self) -> int:
+        return self.size("seq")
+
+    @property
+    def pipe_parallel_world_size(self) -> int:
+        return self.size("pipe")
+
+    def active_axes(self) -> List[str]:
+        return [ax for ax in AXIS_ORDER if self.axis_sizes[ax] > 1]
+
+    # -- shardings -----------------------------------------------------
+
+    def named_sharding(self, *spec) -> "jax.sharding.NamedSharding":
+        import jax
+        from jax.sharding import PartitionSpec
+
+        return jax.sharding.NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> "jax.sharding.NamedSharding":
+        return self.named_sharding()
+
+    def batch_sharding(self, extra_axes: Sequence[str] = ()) -> "jax.sharding.NamedSharding":
+        """Global batch dim sharded over every data-like axis (+ optional)."""
+        axes = tuple(ax for ax in ("data", "fsdp", *extra_axes) if self.axis_sizes.get(ax, 1) >= 1)
+        return self.named_sharding(axes)
+
+    # -- pipeline grid (reference runtime/pipe/topology.py) ------------
+
+    def pipe_coord(self, device_index: int) -> Dict[str, int]:
+        """Axis coordinates of a flat device index in the mesh grid."""
+        shape = tuple(self.axis_sizes[ax] for ax in AXIS_ORDER)
+        coords = np.unravel_index(device_index, shape)
+        return dict(zip(AXIS_ORDER, (int(c) for c in coords)))
+
+    def __repr__(self) -> str:
+        return f"MeshTopology({self.axis_sizes})"
+
+
+# ----------------------------------------------------------------------
+# Module-level registry (reference utils/groups.py singleton pattern)
+# ----------------------------------------------------------------------
+
+_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def initialize_topology(mesh_config=None, n_devices: Optional[int] = None, devices=None, force: bool = False) -> MeshTopology:
+    global _TOPOLOGY
+    if _TOPOLOGY is not None and not force:
+        logger.warning("MeshTopology already initialized; reusing (pass force=True to rebuild)")
+        return _TOPOLOGY
+    _TOPOLOGY = MeshTopology.build(mesh_config, n_devices=n_devices, devices=devices)
+    return _TOPOLOGY
+
+
+def get_topology() -> MeshTopology:
+    if _TOPOLOGY is None:
+        raise RuntimeError("MeshTopology not initialized; call initialize_topology() or sxt.initialize() first")
+    return _TOPOLOGY
+
+
+def topology_is_initialized() -> bool:
+    return _TOPOLOGY is not None
+
+
+def reset_topology() -> None:
+    global _TOPOLOGY
+    _TOPOLOGY = None
+
+
+# Reference-compatible getter names (utils/groups.py:57-749).
+
+def get_data_parallel_world_size() -> int:
+    return get_topology().data_parallel_world_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().model_parallel_world_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return get_topology().expert_parallel_world_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_topology().sequence_parallel_world_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return get_topology().pipe_parallel_world_size

@@ -1,0 +1,106 @@
+"""Sharding-aware data loading.
+
+Capability parity with the reference's ``runtime/dataloader.py``
+(DeepSpeedDataLoader with auto DistributedSampler over the DP group, and
+RepeatingLoader). TPU-native form: the loader yields *global* batches and
+``shard_batch`` places them as a single sharded jax.Array over the data axes
+(device_put with a NamedSharding) — the per-host slice is what this process
+contributes in multi-host runs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart at StopIteration (reference :17)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def shard_batch(batch, topology, extra_axes=()):
+    """Place a host-global batch as a jax.Array sharded over the data axes."""
+    import jax
+
+    sharding = topology.batch_sharding(extra_axes)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(np.asarray(x), sharding), batch)
+
+
+class DataLoader:
+    """Iterates a dataset in global batches, sharded over the mesh.
+
+    dataset: indexable or iterable of examples (dict/tuple/array pytrees).
+    collate_fn: stacks a list of examples into a batch pytree (default: stack
+    leaves with np.stack, mirroring torch's default_collate).
+    """
+
+    def __init__(self, dataset, batch_size: int, topology=None, collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False, seed: int = 0, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.topology = topology
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        try:
+            self._len = len(dataset)
+        except TypeError:
+            self._len = None
+
+    def __len__(self):
+        if self._len is None:
+            raise TypeError("dataset has no length")
+        n = self._len // self.batch_size
+        if not self.drop_last and self._len % self.batch_size:
+            n += 1
+        return n
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator:
+        if self._len is not None:
+            order = np.arange(self._len)
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                rng.shuffle(order)
+            for start in range(0, self._len - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
+                idx = order[start:start + self.batch_size]
+                batch = self.collate_fn([self.dataset[int(i)] for i in idx])
+                yield self._place(batch)
+        else:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk or (self.drop_last and len(chunk) < self.batch_size):
+                    return
+                yield self._place(self.collate_fn(chunk))
+
+    def _place(self, batch):
+        if self.topology is None:
+            return batch
+        return shard_batch(batch, self.topology)
+
+
+def _default_collate(examples):
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *examples)

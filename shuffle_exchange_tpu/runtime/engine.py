@@ -1,0 +1,533 @@
+"""The training engine.
+
+Capability parity with the reference's ``DeepSpeedEngine``
+(``runtime/engine.py:195``): wraps a model + config into an object exposing
+``forward`` / ``backward`` / ``step`` / ``train_batch`` / ``eval_batch``,
+builds the parallel topology, wraps the optimizer (ZeRO stages as sharding
+policies, fp16 dynamic loss scaling, bf16 fp32-master accumulation), drives
+LR schedules, throughput/wall-clock timers, and the fork's decentralized
+weight-sync (§2.1) via ``shuffle_exchange()`` / ``synchronization()`` /
+``reset_rings()``.
+
+TPU-native structure (SURVEY.md §7): the hot path is ONE jitted
+``train_step`` — loss, grads (with gradient accumulation as a ``lax.scan``),
+loss-scale bookkeeping, optimizer update, weight mixing — with every array's
+placement given by NamedShardings derived from the ZeRO stage. XLA inserts
+and overlaps the reduce-scatters/all-gathers the reference issues by hand
+(stage_1_and_2.py:1242,2254; stage3.py:1305). The ``forward``/``backward``/
+``step`` triple is kept for API parity and stages the same computation.
+
+Decentralized mode: when ``shuffle_exchange`` is enabled, the engine holds
+R = |data axis| independent replicas: every leaf gains a leading replica dim
+sharded over "data", gradients reduce only over "fsdp" (the reference's
+slice group — stage_1_and_2.py:290 sets dp_process_group = slice_pg), and a
+per-step R×R mixing matrix couples the replicas (see runtime/sync/).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..config.config import SXConfig
+from ..config.config_utils import ConfigError
+from ..parallel.mesh import MeshTopology
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (
+    BACKWARD_GLOBAL_TIMER,
+    FORWARD_GLOBAL_TIMER,
+    STEP_GLOBAL_TIMER,
+    TRAIN_BATCH_TIMER,
+    NoopTimer,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)
+from . import loss_scaler as ls
+from .dataloader import DataLoader, RepeatingLoader
+from .lr_schedules import build_schedule
+from .optimizers import build_optimizer, get_base_lr
+from .sync.decentralized import DecentralizedSync, apply_mixing
+from .zero.partitioning import ZeroShardingPolicy
+
+
+class TrainState(NamedTuple):
+    """Everything that evolves across steps; a pure pytree, donated each step."""
+
+    master: Any          # fp32 master params (leading replica dim in ensemble mode)
+    opt_state: Any
+    loss_scale: ls.LossScaleState
+    step: Any            # i32 scalar
+
+
+def _tree_select(pred, a_tree, b_tree):
+    """where(pred, a, b) leaf-wise, preserving dtypes (pred is a traced bool)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda a, b: jnp.where(pred, a, b), a_tree, b_tree)
+
+
+class Engine:
+    def __init__(
+        self,
+        config: SXConfig,
+        topology: MeshTopology,
+        loss_fn: Callable,                       # (params, batch, rng) -> scalar loss
+        params: Any,                             # initial params pytree (unsharded ok)
+        optimizer=None,                          # optax.GradientTransformation (client override)
+        lr_scheduler=None,                       # step -> lr callable (client override)
+        model_partition_specs=None,              # pytree of PartitionSpec (TP/model axes)
+        training_data=None,
+        collate_fn=None,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.config = config
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self._rng = np.random.default_rng(seed)
+        self.global_steps = 0
+        self.global_samples = 0
+        self.skipped_steps = 0
+        self.micro_steps = 0
+        self._stashed_batch = None
+        self._accum_grads = None
+        self._accum_count = 0
+
+        self.train_dtype = config.train_dtype
+        self.fp16_enabled = config.fp16.enabled
+        self.bfloat16_enabled = config.bf16.enabled
+        self.gas = config.gradient_accumulation_steps
+        self.zero_stage = config.zero_optimization.stage
+
+        # --- decentralized (fork) setup --------------------------------
+        self.ensemble = bool(config.shuffle_exchange.enabled)
+        self.replicas = topology.axis_sizes["data"] if self.ensemble else 1
+        self.sync: Optional[DecentralizedSync] = None
+        if self.ensemble:
+            if topology.axis_sizes["data"] < 2:
+                logger.warning("shuffle_exchange enabled but data axis is 1; sync is a no-op")
+            self.sync = DecentralizedSync(config.shuffle_exchange, self.replicas, seed=config.seed)
+
+        # --- sharding policy -------------------------------------------
+        self.policy = ZeroShardingPolicy(
+            topology, self.zero_stage,
+            persistence_threshold=config.zero_optimization.stage3_param_persistence_threshold,
+            model_specs=model_partition_specs,
+            # Ensemble replicas are independent ZeRO worlds over the slice
+            # (fsdp) axis; "data" becomes the replica dim prepended below.
+            zero_axes=("fsdp",) if self.ensemble else ("fsdp", "data"))
+        log_dist(self.policy.describe(params), ranks=[0])
+
+        mesh = topology.mesh
+
+        def ens_sharding(spec):
+            """Prepend the replica dim (sharded over "data") in ensemble mode."""
+            from jax.sharding import PartitionSpec
+
+            if not self.ensemble:
+                return jax.sharding.NamedSharding(mesh, spec)
+            return jax.sharding.NamedSharding(mesh, PartitionSpec("data", *spec))
+
+        master_specs = self.policy._map_with_specs(params, self.policy.master_spec)
+        param_specs = self.policy._map_with_specs(params, self.policy.param_spec)
+        self.master_shardings = jax.tree_util.tree_map(ens_sharding, master_specs)
+        self.param_shardings = jax.tree_util.tree_map(ens_sharding, param_specs)
+        self.repl_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+        # --- place master params ---------------------------------------
+        def place_master(p, sh):
+            arr = np.asarray(jax.device_get(p), dtype=np.float32)
+            if self.ensemble:
+                arr = np.broadcast_to(arr, (self.replicas,) + arr.shape)
+            return jax.device_put(arr, sh)
+
+        master = jax.tree_util.tree_map(place_master, params, self.master_shardings)
+
+        # --- optimizer --------------------------------------------------
+        self.client_optimizer = optimizer is not None
+        base_lr = get_base_lr(config.optimizer)
+        self.lr_schedule = lr_scheduler if lr_scheduler is not None else build_schedule(config.scheduler, base_lr)
+        if optimizer is not None:
+            self.tx = optimizer
+        else:
+            if config.optimizer is None:
+                raise ConfigError("Provide an optimizer: config 'optimizer' section or a client optax transformation")
+            self.tx = build_optimizer(config.optimizer, self.lr_schedule, config.gradient_clipping)
+
+        def init_opt(m):
+            if self.ensemble:
+                return jax.vmap(self.tx.init)(m)
+            return self.tx.init(m)
+
+        opt_state = jax.jit(init_opt)(master)
+        scale_state = ls.init_loss_scale(config.fp16)
+        self.state = TrainState(master=master, opt_state=opt_state, loss_scale=scale_state,
+                                step=jnp.asarray(0, jnp.int32))
+
+        # --- timers / monitors -----------------------------------------
+        self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size,
+                                          steps_per_output=config.steps_per_print)
+        self.monitor = None  # attached by initialize() once monitor package lands
+
+        # --- data -------------------------------------------------------
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = DataLoader(
+                training_data, batch_size=config.train_batch_size, topology=topology,
+                collate_fn=collate_fn, shuffle=False, seed=config.seed)
+            self._data_iter = iter(RepeatingLoader(self.training_dataloader))
+        else:
+            self._data_iter = None
+
+        # --- jitted programs -------------------------------------------
+        self._build_programs()
+
+    # ==================================================================
+    # jitted step construction
+    # ==================================================================
+
+    def _build_programs(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        fp16_cfg = cfg.fp16
+        dtype = self.train_dtype
+        gas = self.gas
+        prescale = cfg.prescale_gradients
+        predivide = cfg.gradient_predivide_factor
+        ensemble = self.ensemble
+
+        def fwd_weights(master, mix):
+            p16 = jax.tree_util.tree_map(lambda m: m.astype(dtype), master)
+            if ensemble:
+                p16 = apply_mixing(p16, mix)
+            return p16
+
+        def scaled_loss_fn(p16, micro, rng, scale):
+            loss = self.loss_fn(p16, micro, rng)
+            return loss * scale.astype(loss.dtype), loss
+
+        def replica_grads(p16, micro, rng, scale):
+            grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
+            g, loss = grad_fn(p16, micro, rng, scale)
+            g = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
+            return g, loss
+
+        def batch_grads(p16, micro, rng, scale):
+            """Gradients for one microbatch; vmapped over replicas in ensemble mode."""
+            if ensemble:
+                g, loss = jax.vmap(replica_grads, in_axes=(0, 0, None, None))(p16, micro, rng, scale)
+                return g, jnp.mean(loss)
+            return replica_grads(p16, micro, rng, scale)
+
+        def accumulate(master, p16, batch, rng, scale):
+            """lax.scan over the gas dim of the batch; fp32 accumulation."""
+            zeros = jax.tree_util.tree_map(lambda m: jnp.zeros(m.shape, jnp.float32), master)
+
+            def body(acc, micro_and_key):
+                micro, key = micro_and_key
+                g, loss = batch_grads(p16, micro, key, scale)
+                acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                return acc, loss
+
+            keys = jax.random.split(rng, gas)
+            if gas == 1:
+                micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+                g, loss = batch_grads(p16, micro, keys[0], scale)
+                return g, loss
+            acc, losses = jax.lax.scan(body, zeros, (batch, keys))
+            return acc, jnp.mean(losses)
+
+        def apply_update(grads, opt_state, master):
+            if ensemble:
+                def upd(g, o, m):
+                    updates, new_o = self.tx.update(g, o, m)
+                    return jax.tree_util.tree_map(lambda a, u: a + u, m, updates), new_o
+
+                return jax.vmap(upd)(grads, opt_state, master)
+            updates, new_o = self.tx.update(grads, opt_state, master)
+            import optax
+
+            return optax.apply_updates(master, updates), new_o
+
+        def train_step(state: TrainState, batch, mix, rng):
+            p16 = fwd_weights(state.master, mix)
+            scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
+            grads, loss = accumulate(state.master, p16, batch, rng, scale)
+            # normalize: mean over gas microbatches + undo loss scale
+            denom = scale * gas
+            if prescale and predivide != 1.0:
+                denom = denom * predivide
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            overflow = ls.check_overflow(grads) if fp16_cfg.enabled else jnp.asarray(False)
+            new_master, new_opt = apply_update(grads, state.opt_state, state.master)
+            new_master = _tree_select(overflow, state.master, new_master)
+            new_opt = _tree_select(overflow, state.opt_state, new_opt)
+            new_scale = ls.update(state.loss_scale, overflow, fp16_cfg)
+            new_state = TrainState(master=new_master, opt_state=new_opt, loss_scale=new_scale,
+                                   step=state.step + jnp.where(overflow, 0, 1).astype(jnp.int32))
+            grad_norm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree_util.tree_leaves(grads))).real
+            return new_state, loss, overflow, grad_norm
+
+        donate = (0,)
+        self._train_step = jax.jit(train_step, donate_argnums=donate)
+
+        def eval_step(state: TrainState, batch, mix, rng):
+            p16 = fwd_weights(state.master, mix)
+            if ensemble:
+                micro = batch
+                loss = jnp.mean(jax.vmap(self.loss_fn, in_axes=(0, 0, None))(p16, micro, rng))
+            else:
+                loss = self.loss_fn(p16, batch, rng)
+            return loss
+
+        self._eval_step = jax.jit(eval_step)
+
+        def grads_only(state: TrainState, micro, mix, rng):
+            p16 = fwd_weights(state.master, mix)
+            scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
+            g, loss = batch_grads(p16, micro, rng, scale)
+            return g, loss
+
+        self._grads_only = jax.jit(grads_only)
+
+        def apply_only(state: TrainState, grads, n_micro):
+            scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
+            denom = scale * n_micro
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            overflow = ls.check_overflow(grads) if fp16_cfg.enabled else jnp.asarray(False)
+            new_master, new_opt = apply_update(grads, state.opt_state, state.master)
+            new_master = _tree_select(overflow, state.master, new_master)
+            new_opt = _tree_select(overflow, state.opt_state, new_opt)
+            new_scale = ls.update(state.loss_scale, overflow, fp16_cfg)
+            return TrainState(new_master, new_opt, new_scale,
+                              state.step + jnp.where(overflow, 0, 1).astype(jnp.int32)), overflow
+
+        self._apply_only = jax.jit(apply_only, donate_argnums=(0,))
+
+        def materialize(state: TrainState, mix):
+            return fwd_weights(state.master, mix)
+
+        self._materialize = jax.jit(materialize)
+        self._apply_mixing_jit = jax.jit(apply_mixing)
+
+    # ==================================================================
+    # batch plumbing
+    # ==================================================================
+
+    def _mix_matrix(self, sync_matrix: bool = False, advance: bool = False):
+        """Mixing matrix for the jitted programs. ``advance`` moves the sync
+        protocol forward one optimizer step and must be passed exactly once
+        per step (fused train_batch, or step() on the staged path); all other
+        callers (forward/backward/eval/module_weights) read the current
+        matrix purely."""
+        import jax.numpy as jnp
+
+        if not self.ensemble:
+            return jnp.zeros((1, 1), jnp.float32)  # unused placeholder
+        if sync_matrix:
+            A = self.sync.synchronization_matrix()
+        elif advance:
+            A = self.sync.advance()
+        else:
+            A = self.sync.current_matrix()
+        return jnp.asarray(A)
+
+    def _reshape_batch(self, batch, gas: Optional[int] = None):
+        """[B_global, ...] -> [gas, (R,) micro, ...] with sharding constraints."""
+        import jax
+
+        gas = self.gas if gas is None else gas
+
+        def reshape(x):
+            x = np.asarray(x) if not hasattr(x, "reshape") else x
+            b = x.shape[0]
+            if b % gas:
+                raise ConfigError(f"Batch dim {b} not divisible by gradient_accumulation_steps {gas}")
+            micro = b // gas
+            if self.ensemble:
+                if micro % self.replicas:
+                    raise ConfigError(f"Micro batch {micro} not divisible by replica count {self.replicas}")
+                return x.reshape((gas, self.replicas, micro // self.replicas) + x.shape[1:])
+            return x.reshape((gas, micro) + x.shape[1:])
+
+        batch = jax.tree_util.tree_map(reshape, batch)
+        # Shard: gas dim replicated; replica dim over "data"; batch dim over
+        # fsdp (ensemble) or data+fsdp (standard).
+        from jax.sharding import PartitionSpec as P
+
+        if self.ensemble:
+            spec = P(None, "data", "fsdp")
+        else:
+            spec = P(None, ("data", "fsdp"))
+        sharding = jax.sharding.NamedSharding(self.topology.mesh, spec)
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+    def _next_rng(self):
+        import jax
+
+        return jax.random.PRNGKey(int(self._rng.integers(0, 2**31 - 1)))
+
+    # ==================================================================
+    # public API (reference parity)
+    # ==================================================================
+
+    def train_batch(self, batch=None, data_iter=None):
+        """One full optimizer step over a global batch (fwd+bwd+step fused).
+
+        ``batch`` leaves are [train_batch_size, ...]; alternatively pull from
+        ``data_iter`` or the engine's own dataloader (reference
+        PipelineEngine.train_batch signature)."""
+        if batch is None:
+            it = data_iter or self._data_iter
+            if it is None:
+                raise ConfigError("train_batch needs a batch, a data_iter, or training_data at init")
+            batch = next(it)
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        shaped = self._reshape_batch(batch)
+        mix = self._mix_matrix(advance=True)
+        self.state, loss, overflow, grad_norm = self._train_step(self.state, shaped, mix, self._next_rng())
+        self._last_grad_norm = grad_norm
+        self._post_step(overflow)
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.tput_timer.stop(global_step=True)
+        return loss
+
+    def forward(self, batch, rng=None):
+        """Loss for a micro-batch with current forward weights; stashes the
+        batch so ``backward()`` can compute grads (API parity: the reference
+        returns module outputs; our models fold loss into the step)."""
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        shaped = self._reshape_batch(batch, gas=1)
+        micro = self._take_micro(shaped)
+        loss = self._eval_step(self.state, micro, self._mix_matrix(), rng or self._next_rng())
+        self._stashed_batch = micro
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def _take_micro(self, shaped):
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: x[0], shaped)
+
+    def backward(self, loss=None, batch=None):
+        """Accumulate gradients for the stashed (or given) micro-batch.
+
+        Functional-JAX note: gradients are computed here (not during
+        ``forward``), so ``loss`` is accepted for API parity but the batch is
+        what matters."""
+        import jax
+
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if batch is not None:
+            micro = self._take_micro(self._reshape_batch(batch, gas=1))
+        elif self._stashed_batch is not None:
+            micro = self._stashed_batch
+        else:
+            raise ConfigError("backward() without a prior forward() or an explicit batch")
+        grads, loss_val = self._grads_only(self.state, micro, self._mix_matrix(), self._next_rng())
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            self._accum_grads = jax.tree_util.tree_map(lambda a, g: a + g, self._accum_grads, grads)
+        self._accum_count += 1
+        self.micro_steps += 1
+        self._stashed_batch = None
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss_val
+
+    def step(self):
+        """Apply accumulated gradients (reference engine.step / _take_model_step)."""
+        if self._accum_grads is None:
+            raise ConfigError("step() with no accumulated gradients; call backward() first")
+        self.timers(STEP_GLOBAL_TIMER).start()
+        if self.ensemble:
+            self.sync.advance()  # staged path: protocol moves once per optimizer step
+        self.state, overflow = self._apply_only(self.state, self._accum_grads, float(self._accum_count))
+        self._accum_grads = None
+        self._accum_count = 0
+        self._post_step(overflow)
+        self.timers(STEP_GLOBAL_TIMER).stop()
+
+    def eval_batch(self, batch, rng=None):
+        shaped = self._reshape_batch(batch, gas=1)
+        return self._eval_step(self.state, self._take_micro(shaped), self._mix_matrix(), rng or self._next_rng())
+
+    def _post_step(self, overflow) -> None:
+        self.global_steps += 1
+        self.global_samples += self.config.train_batch_size
+        if self.sync is not None:
+            # Reference calls shuffle_exchange() per batch to drive ring
+            # re-randomization (stage_1_and_2.py:694-698).
+            self.sync.shuffle_exchange()
+        if self.fp16_enabled and bool(overflow):
+            self.skipped_steps += 1
+            log_dist(f"step {self.global_steps}: fp16 overflow, skipping update "
+                     f"(loss scale -> {self.loss_scale()})", ranks=[0])
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} loss_scale={self.loss_scale()}", ranks=[0])
+
+    # -- fork control surface (reference stage_1_and_2.py:692-734) ------
+
+    def shuffle_exchange(self) -> None:
+        if self.sync is not None:
+            self.sync.shuffle_exchange()
+
+    def synchronization(self) -> None:
+        """Full-world weight average to re-converge replicas. Applies to the
+        fp32 masters (see module docstring for the deviation rationale)."""
+        if self.sync is None:
+            return
+        A = self._mix_matrix(sync_matrix=True)
+        self.state = self.state._replace(master=self._apply_mixing_jit(self.state.master, A))
+
+    def reset_rings(self, rings: int) -> None:
+        if self.sync is not None:
+            self.sync.reset_rings(rings)
+
+    # -- introspection ---------------------------------------------------
+
+    def module_weights(self, consensus: bool = True):
+        """Current forward weights (bit16). In ensemble mode, the uniform
+        consensus average by default (else replica-stacked)."""
+        mix = self._mix_matrix(sync_matrix=consensus)
+        return self._materialize(self.state, mix)
+
+    def get_lr(self) -> float:
+        try:
+            return float(self.lr_schedule(self.global_steps))
+        except TypeError:
+            return float(self.lr_schedule)
+
+    def loss_scale(self) -> float:
+        import jax
+
+        return float(jax.device_get(self.state.loss_scale.scale))
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        norm = getattr(self, "_last_grad_norm", None)
+        if norm is None:
+            return None
+        import jax
+
+        return float(jax.device_get(norm))
+
+    @property
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    @property
+    def gradient_accumulation_steps_(self) -> int:
+        return self.gas
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage

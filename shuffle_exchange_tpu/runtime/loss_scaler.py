@@ -1,0 +1,91 @@
+"""Static and dynamic fp16 loss scaling as pure pytree state.
+
+Capability parity with the reference's ``runtime/fp16/loss_scaler.py:69,93,211``
+(LossScaler / DynamicLossScaler: window growth, backoff factor, hysteresis,
+min scale). Functional form so it lives inside the jitted train step:
+``scale_loss`` multiplies, ``update`` consumes the overflow flag via lax.cond
+semantics (implemented with jnp.where — no host round-trip).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class LossScaleState(NamedTuple):
+    scale: "jax.Array"          # f32 scalar
+    good_steps: "jax.Array"     # i32 scalar — consecutive non-overflow steps
+    hysteresis_left: "jax.Array"  # i32 scalar
+
+    @property
+    def loss_scale(self):
+        return self.scale
+
+
+def init_loss_scale(config) -> LossScaleState:
+    """From an FP16Config (static when loss_scale>0, else dynamic)."""
+    import jax.numpy as jnp
+
+    if config.enabled and config.dynamic_loss_scale:
+        initial = float(2.0 ** config.initial_scale_power)
+    elif config.enabled:
+        initial = float(config.loss_scale)
+    else:
+        initial = 1.0
+    return LossScaleState(
+        scale=jnp.asarray(initial, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis_left=jnp.asarray(int(config.hysteresis) if config.enabled else 1, jnp.int32),
+    )
+
+
+def scale_loss(state: LossScaleState, loss):
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale(state: LossScaleState, grads):
+    import jax
+
+    inv = 1.0 / state.scale
+    return jax.tree_util.tree_map(lambda g: (g.astype("float32") * inv), grads)
+
+
+def check_overflow(grads) -> "jax.Array":
+    """True if any grad element is non-finite (reference CheckOverflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    finite = [jnp.all(jnp.isfinite(l)) for l in leaves]
+    return jnp.logical_not(jnp.all(jnp.stack(finite)))
+
+
+def update(state: LossScaleState, overflow, config) -> LossScaleState:
+    """Dynamic-scale bookkeeping (reference DynamicLossScaler.update_scale).
+
+    On overflow: consume hysteresis; once exhausted, scale /= scale_factor
+    (floored at min_loss_scale) and reset the window. On success: after
+    loss_scale_window consecutive good steps, scale *= scale_factor.
+    """
+    import jax.numpy as jnp
+
+    if not config.enabled or not config.dynamic_loss_scale:
+        return state
+    factor = 2.0
+    window = config.loss_scale_window
+    min_scale = max(config.min_loss_scale, 1e-8)
+
+    hyst = jnp.where(overflow, jnp.maximum(state.hysteresis_left - 1, 0), state.hysteresis_left)
+    do_backoff = jnp.logical_and(overflow, hyst == 0)
+    new_scale = jnp.where(do_backoff, jnp.maximum(state.scale / factor, min_scale), state.scale)
+    new_hyst = jnp.where(do_backoff, jnp.asarray(int(config.hysteresis), jnp.int32), hyst)
+    if config.consecutive_hysteresis:
+        # replenish hysteresis on good steps
+        new_hyst = jnp.where(overflow, new_hyst, jnp.asarray(int(config.hysteresis), jnp.int32))
+    good = jnp.where(overflow, 0, state.good_steps + 1)
+    do_grow = good >= window
+    new_scale = jnp.where(do_grow, new_scale * factor, new_scale)
+    good = jnp.where(do_grow, 0, good)
+    return LossScaleState(scale=new_scale, good_steps=good.astype(jnp.int32), hysteresis_left=new_hyst.astype(jnp.int32))

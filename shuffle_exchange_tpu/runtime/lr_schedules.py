@@ -1,0 +1,153 @@
+"""Learning-rate schedules.
+
+Capability parity with the reference's ``runtime/lr_schedules.py:273-777``:
+LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR — the same
+names and params a reference JSON ``scheduler`` section uses, realized as
+pure ``step -> lr`` callables (optax-style schedules) so they trace cleanly
+into the jitted train step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+from ..config.config_utils import ConfigError
+
+Schedule = Callable[[Any], Any]  # step (int or traced int32) -> lr
+
+
+def _as_float(x):
+    return float(x)
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """LR sweep for finding a good lr (reference LRRangeTest :273)."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float = 0.0, cycle_max_lr: float = 1e-3, decay_lr_rate: float = 0.0,
+              cycle_first_step_size: int = 2000, cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0, cycle_momentum: bool = True, cycle_min_mom: float = 0.85,
+              cycle_max_mom: float = 0.99, decay_mom_rate: float = 0.0, last_batch_iteration: int = -1, **_) -> Schedule:
+    """Triangular one-cycle policy (reference OneCycle :388)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, dtype=jnp.float32)
+        up_frac = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down_frac = jnp.clip((step - cycle_first_step_size) / max(1, second), 0.0, 1.0)
+        in_up = step <= cycle_first_step_size
+        lr = jnp.where(
+            in_up,
+            cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up_frac,
+            cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac,
+        )
+        # post-cycle decay
+        post = jnp.maximum(step - total_cycle, 0.0)
+        decay_steps = post / max(1, decay_step_size) if decay_step_size else post
+        lr = jnp.where(step > total_cycle, cycle_min_lr / (1.0 + decay_lr_rate * decay_steps), lr)
+        return lr
+
+    return schedule
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+              warmup_type: str = "log", **_) -> Schedule:
+    """Warmup then constant (reference WarmupLR :620)."""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, dtype=jnp.float32)
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            frac = jnp.log1p(jnp.maximum(step, 1.0)) / math.log(warmup_num_steps + 1)
+            frac = jnp.clip(frac, 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps,
+                         warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac,
+                         warmup_max_lr)
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+                    warmup_num_steps: int = 1000, warmup_type: str = "log", **_) -> Schedule:
+    """Warmup then linear decay to 0 over total_num_steps (reference WarmupDecayLR :737)."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, dtype=jnp.float32)
+        w = base(step)
+        decay_frac = jnp.clip((total_num_steps - step) / max(1.0, float(total_num_steps - warmup_num_steps)), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, w, warmup_max_lr * decay_frac)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0, warmup_num_steps: int = 1000,
+                     cos_min_ratio: float = 0.0001, warmup_type: str = "linear", lr: float = 1e-3, **_) -> Schedule:
+    """Warmup then cosine decay (reference WarmupCosineLR :777). ``lr`` is the
+    peak learning rate (the reference scales the optimizer's base lr by ratio;
+    a pure schedule needs the peak explicitly)."""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm_ratio = warmup_min_ratio + (1.0 - warmup_min_ratio) * jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        progress = jnp.clip((step - warmup_num_steps) / max(1.0, float(total_num_steps - warmup_num_steps)), 0.0, 1.0)
+        cosine = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        ratio = jnp.where(step < warmup_num_steps, warm_ratio, cosine)
+        return lr * ratio
+
+    return schedule
+
+
+def constant_lr(lr: float = 1e-3, **_) -> Schedule:
+    def schedule(step):
+        return lr
+
+    return schedule
+
+
+VALID_LR_SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "LRRangeTest": lr_range_test,
+    "OneCycle": one_cycle,
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "WarmupCosineLR": warmup_cosine_lr,
+    "Constant": constant_lr,
+}
+
+
+def build_schedule(scheduler_config, base_lr: float) -> Schedule:
+    """Build a schedule from a config ``scheduler`` section; default constant."""
+    if scheduler_config is None or scheduler_config.type is None:
+        return constant_lr(lr=base_lr)
+    name = scheduler_config.type
+    if name not in VALID_LR_SCHEDULES:
+        raise ConfigError(f"Unknown scheduler type {name!r}; valid: {sorted(VALID_LR_SCHEDULES)}")
+    params = dict(scheduler_config.params)
+    if name == "WarmupCosineLR":
+        params.setdefault("lr", base_lr)
+    return VALID_LR_SCHEDULES[name](**params)

@@ -1,0 +1,97 @@
+"""Optimizer construction from config.
+
+Capability parity with the reference's ``runtime/engine.py:1473``
+(_configure_basic_optimizer): the same ``optimizer.type`` names a reference
+JSON uses (Adam/AdamW/FusedAdam variants, Lamb, Lion, SGD, Adagrad, Muon;
+OneBit* map to their base optimizers — 1-bit compression is a collective
+concern, not an update rule, and XLA collectives are not bandwidth-bound the
+same way). Fused CUDA kernels (FusedAdamBuilder etc., §2.13) map to the
+Pallas fused optimizer in ``ops/fused_adam.py`` which the engine swaps in for
+flat-sharded states; the optax path here is the reference implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import optax
+
+from ..config.config_utils import ConfigError
+from ..utils.logging import log_dist
+
+# type -> (factory, accepted param names)
+_ADAM_DEFAULTS = dict(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0)
+
+
+def _split_wd(params_fn: Optional[Callable] = None):
+    return params_fn
+
+
+def build_optimizer(optimizer_config, lr_schedule, gradient_clipping: float = 0.0,
+                    weight_decay_mask: Optional[Any] = None) -> optax.GradientTransformation:
+    """Build the optax chain: [clip_by_global_norm] -> update rule (lr = schedule).
+
+    Loss-scale unscaling and overflow skipping are handled by the engine
+    around this transformation (they need the loss-scale state).
+    """
+    if optimizer_config is None:
+        raise ConfigError("No optimizer section in config and no client optimizer provided")
+    name = optimizer_config.type
+    params = dict(optimizer_config.params)
+    lr = params.pop("lr", params.pop("learning_rate", 1e-3))
+    betas = params.pop("betas", (0.9, 0.999))
+    b1, b2 = float(betas[0]), float(betas[1])
+    eps = float(params.pop("eps", 1e-8))
+    wd = float(params.pop("weight_decay", 0.0))
+    momentum = float(params.pop("momentum", 0.0))
+    schedule = lr_schedule if lr_schedule is not None else lr
+
+    lowered = name.lower()
+    if lowered in ("adam", "fusedadam", "cpuadam", "adamw", "onebitadam", "zerooneadam"):
+        adam_w_mode = params.pop("adam_w_mode", lowered == "adamw")
+        if adam_w_mode or lowered == "adamw":
+            tx = optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd, mask=weight_decay_mask)
+        else:
+            tx = optax.adam(schedule, b1=b1, b2=b2, eps=eps)
+            if wd:
+                tx = optax.chain(optax.add_decayed_weights(wd, mask=weight_decay_mask), tx)
+    elif lowered in ("lamb", "fusedlamb", "onebitlamb"):
+        tx = optax.lamb(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd, mask=weight_decay_mask)
+    elif lowered in ("lion", "fusedlion", "cpulion"):
+        tx = optax.lion(schedule, b1=b1, b2=b2, weight_decay=wd, mask=weight_decay_mask)
+    elif lowered == "sgd":
+        tx = optax.sgd(schedule, momentum=momentum if momentum else None,
+                       nesterov=bool(params.pop("nesterov", False)))
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd, mask=weight_decay_mask), tx)
+    elif lowered in ("adagrad", "cpuadagrad"):
+        tx = optax.adagrad(schedule, eps=eps)
+    elif lowered == "muon":
+        # Muon (reference ops/muon): Newton-Schulz orthogonalized momentum.
+        # optax ships a contrib implementation in recent versions.
+        try:
+            from optax import contrib as _contrib
+
+            tx = _contrib.muon(schedule, beta=b1 or 0.95, weight_decay=wd)  # type: ignore[attr-defined]
+        except (ImportError, AttributeError):
+            log_dist("optax.contrib.muon unavailable; falling back to AdamW", ranks=[0])
+            tx = optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd)
+    else:
+        raise ConfigError(f"Unknown optimizer type {name!r}")
+
+    if params:
+        log_dist(f"Optimizer {name}: ignoring unsupported params {sorted(params)}", ranks=[0])
+    if gradient_clipping and gradient_clipping > 0:
+        tx = optax.chain(optax.clip_by_global_norm(gradient_clipping), tx)
+    return tx
+
+
+def get_base_lr(optimizer_config) -> float:
+    if optimizer_config is None:
+        return 1e-3
+    p = optimizer_config.params
+    return float(p.get("lr", p.get("learning_rate", 1e-3)))
+
+
+class DummyOptim:
+    """Optimizer-less path marker (reference runtime/utils.py DummyOptim)."""

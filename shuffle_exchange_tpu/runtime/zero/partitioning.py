@@ -1,0 +1,153 @@
+"""ZeRO stages as sharding rules.
+
+This is the TPU-native reduction of the reference's ZeRO machinery
+(``runtime/zero/stage_1_and_2.py``, ``stage3.py``, ``partition_parameters.py``):
+instead of flattening params into rank-owned buffers with hook-driven
+all-gathers, each stage is a *sharding policy* over the mesh's ZeRO axes and
+XLA inserts/schedules the reduce-scatters and all-gathers (SURVEY.md §2.6):
+
+  stage 0 — params, grads, optimizer state replicated; grad all-reduce.
+  stage 1 — optimizer state + fp32 master sharded over (data, fsdp).
+  stage 2 — stage 1 + grads reduce-scattered (XLA derives this from the
+            master/opt shardings; stages 1 and 2 compile identically here).
+  stage 3 — params themselves sharded over fsdp (FSDP): XLA all-gathers just
+            ahead of use and frees after, which is the param coordinator's
+            prefetch/release behavior by construction.
+
+Small params stay replicated below ``stage3_param_persistence_threshold``
+(mirroring the reference's persisted-params optimization,
+stage3.py persistence_threshold).
+
+Composition with tensor parallelism: a model supplies its own logical
+PartitionSpecs (tensor/expert axes); ZeRO claims a *free* dimension.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+from ...utils.logging import logger
+
+
+def _axis_size(mesh_axis_sizes: Dict[str, int], axes) -> int:
+    n = 1
+    for ax in axes if isinstance(axes, (tuple, list)) else (axes,):
+        n *= mesh_axis_sizes.get(ax, 1)
+    return n
+
+
+def choose_shard_dim(shape: Tuple[int, ...], divisor: int, taken: Tuple[Optional[Any], ...]) -> Optional[int]:
+    """Largest free dim divisible by ``divisor``; None if none qualifies."""
+    best, best_size = None, 0
+    for i, s in enumerate(shape):
+        if taken[i] is not None:
+            continue
+        if s % divisor == 0 and s > best_size:
+            best, best_size = i, s
+    return best
+
+
+def _normalize_spec(spec, ndim: int) -> Tuple[Optional[Any], ...]:
+    if spec is None:
+        entries: Tuple[Optional[Any], ...] = ()
+    else:
+        entries = tuple(spec)
+    entries = entries + (None,) * (ndim - len(entries))
+    return entries[:ndim]
+
+
+def add_axes_to_spec(spec, shape: Tuple[int, ...], axes: Tuple[str, ...], mesh_axis_sizes: Dict[str, int],
+                     min_size: int = 0):
+    """Return a PartitionSpec with ``axes`` added on the best free dim.
+
+    If no dim is divisible by the full axes product, try progressively
+    smaller axis subsets (dropping trailing axes). Params smaller than
+    ``min_size`` keep their spec unchanged (persistence threshold).
+    """
+    from jax.sharding import PartitionSpec
+
+    entries = list(_normalize_spec(spec, len(shape)))
+    numel = math.prod(shape) if shape else 1
+    axes = tuple(ax for ax in axes if mesh_axis_sizes.get(ax, 1) > 1)
+    if not axes or numel < min_size or not shape:
+        return PartitionSpec(*entries)
+    for k in range(len(axes), 0, -1):
+        subset = axes[:k]
+        divisor = _axis_size(mesh_axis_sizes, subset)
+        dim = choose_shard_dim(shape, divisor, tuple(entries))
+        if dim is not None:
+            current = entries[dim]
+            if current is None:
+                entries[dim] = subset if len(subset) > 1 else subset[0]
+            return PartitionSpec(*entries)
+    return PartitionSpec(*entries)
+
+
+class ZeroShardingPolicy:
+    """Resolves per-leaf shardings for params / master+optimizer / grads."""
+
+    def __init__(self, topology, stage: int, persistence_threshold: int = 0, model_specs=None,
+                 zero_axes: Tuple[str, ...] = ("fsdp", "data")):
+        self.topology = topology
+        self.stage = stage
+        self.persistence_threshold = persistence_threshold if stage == 3 else 0
+        self.model_specs = model_specs  # pytree of PartitionSpec or None
+        self.axis_sizes = topology.axis_sizes
+        # In decentralized (ensemble) mode each replica is an independent ZeRO
+        # world over its slice group, so "data" must NOT appear here — the
+        # engine prepends it as the replica dim instead.
+        self.zero_axes = zero_axes
+
+    # -- per-leaf spec functions --------------------------------------
+
+    def param_spec(self, shape, base_spec=None):
+        from jax.sharding import PartitionSpec
+
+        if self.stage < 3:
+            return PartitionSpec(*_normalize_spec(base_spec, len(shape)))
+        return add_axes_to_spec(base_spec, tuple(shape), ("fsdp",), self.axis_sizes,
+                                min_size=self.persistence_threshold)
+
+    def master_spec(self, shape, base_spec=None):
+        from jax.sharding import PartitionSpec
+
+        if self.stage == 0:
+            return PartitionSpec(*_normalize_spec(base_spec, len(shape)))
+        # Shard master/opt over the whole ZeRO world (fsdp first — same dim
+        # as the stage-3 param shard — then data if it still divides).
+        return add_axes_to_spec(base_spec, tuple(shape), self.zero_axes, self.axis_sizes)
+
+    # -- pytree resolution --------------------------------------------
+
+    def _map_with_specs(self, params, fn):
+        import jax
+
+        if self.model_specs is None:
+            return jax.tree_util.tree_map(lambda p: fn(p.shape, None), params)
+        return jax.tree_util.tree_map(lambda p, s: fn(p.shape, s), params, self.model_specs)
+
+    def param_shardings(self, params):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda spec: jax.sharding.NamedSharding(self.topology.mesh, spec),
+            self._map_with_specs(params, self.param_spec))
+
+    def master_shardings(self, params):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda spec: jax.sharding.NamedSharding(self.topology.mesh, spec),
+            self._map_with_specs(params, self.master_spec))
+
+    def describe(self, params) -> str:
+        """Human-readable partition report (reference: see_memory_usage /
+        PartitionedParameterProfiler breadcrumbs)."""
+        import jax
+
+        n_total = sum(math.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+        specs = jax.tree_util.tree_leaves(self._map_with_specs(params, self.param_spec))
+        n_sharded = sum(1 for s in specs if any(e is not None for e in s))
+        return (f"ZeRO stage {self.stage}: {len(specs)} params ({n_total/1e6:.1f}M elems), "
+                f"{n_sharded} sharded leaves, axes={ {k: v for k, v in self.axis_sizes.items() if v > 1} }")

@@ -10,7 +10,18 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image presets JAX_PLATFORMS (e.g. to the tunneled TPU backend), so this
+# must be a hard override, not setdefault. Set SXT_TEST_TPU=1 to run the
+# suite against the real chip instead (single device; mesh tests will skip).
+if not os.environ.get("SXT_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The image's sitecustomize imports jax at interpreter start (before this
+    # file runs), so the env var alone is latched too late — update the
+    # already-imported config as well. Backends are not yet instantiated at
+    # collection time, so this still takes effect.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 os.environ.setdefault("SXT_LOG_LEVEL", "warning")
 
 import pytest  # noqa: E402

@@ -1,0 +1,191 @@
+"""End-to-end engine tests on the 8-device virtual CPU mesh.
+
+Covers the reference's core train loop semantics (SURVEY.md §3.2): initialize
+→ train_batch (fused) and forward/backward/step (staged), ZeRO stages as
+sharding, fp16 dynamic loss scale, and the fork's decentralized sync methods.
+"""
+
+import numpy as np
+import pytest
+
+import shuffle_exchange_tpu as sxt
+
+
+def _toy_model(din=8, dh=32, dout=4):
+    import jax
+    import jax.numpy as jnp
+
+    class Toy:
+        def init(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {
+                "w1": jax.random.normal(k1, (din, dh)) * 0.1,
+                "b1": jnp.zeros((dh,)),
+                "w2": jax.random.normal(k2, (dh, dout)) * 0.1,
+                "b2": jnp.zeros((dout,)),
+            }
+
+        def loss(self, params, batch, rng=None):
+            x, y = batch["x"], batch["y"]
+            h = jnp.tanh(x @ params["w1"].astype(x.dtype) + params["b1"].astype(x.dtype))
+            logits = h @ params["w2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    return Toy()
+
+
+def _batch(n=32, din=8, dout=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.normal(size=(n, din)).astype(np.float32),
+            "y": rng.integers(0, dout, size=(n,)).astype(np.int32)}
+
+
+def _make_engine(config_extra=None, **init_kwargs):
+    cfg = {"train_batch_size": 32, "steps_per_print": 1000,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}}}
+    cfg.update(config_extra or {})
+    engine, opt, loader, sched = sxt.initialize(model=_toy_model(), config=cfg, **init_kwargs)
+    return engine
+
+
+@pytest.mark.parametrize("stage", [0, 1, 3])
+def test_train_batch_loss_decreases(stage):
+    engine = _make_engine({"zero_optimization": {"stage": stage}, "bf16": {"enabled": True}})
+    batch = _batch()
+    losses = [float(engine.train_batch(batch)) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert engine.global_steps == 20
+
+
+def test_gradient_accumulation_matches_big_batch():
+    # gas=4 over the same data should follow a similar trajectory to gas=1.
+    e1 = _make_engine({"gradient_accumulation_steps": 1})
+    e2 = _make_engine({"gradient_accumulation_steps": 4})
+    batch = _batch(32)
+    l1 = [float(e1.train_batch(batch)) for _ in range(5)]
+    l2 = [float(e2.train_batch(batch)) for _ in range(5)]
+    np.testing.assert_allclose(l1[0], l2[0], rtol=1e-4)
+    assert abs(l1[-1] - l2[-1]) < 0.2
+
+
+def test_forward_backward_step_parity():
+    engine = _make_engine()
+    batch = _batch()
+    loss0 = engine.forward(batch)
+    engine.backward(loss0)
+    engine.step()
+    loss1 = engine.forward(batch)
+    assert float(loss1) < float(loss0)
+
+
+def test_fp16_dynamic_loss_scale_overflow_skip():
+    engine = _make_engine({"fp16": {"enabled": True, "initial_scale_power": 4}})
+    scale0 = engine.loss_scale()
+    assert scale0 == 16.0
+    batch = _batch()
+    # poison one batch to overflow
+    bad = dict(batch)
+    bad["x"] = np.full_like(batch["x"], np.nan)  # NaN grads = guaranteed overflow signal
+    # default hysteresis=2: the first overflow only consumes hysteresis
+    # (reference DynamicLossScaler), the second consecutive one halves.
+    engine.train_batch(bad)
+    assert engine.loss_scale() == 16.0
+    engine.train_batch(bad)
+    assert engine.loss_scale() == 8.0
+    # params were not corrupted by the skipped steps: clean training resumes
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert np.isfinite(losses).all()
+
+
+def test_client_optimizer_and_scheduler():
+    import optax
+
+    engine, opt, _, sched = sxt.initialize(
+        model=_toy_model(),
+        config={"train_batch_size": 32},
+        optimizer=optax.sgd(1e-2),
+        lr_scheduler=lambda step: 1e-2,
+    )
+    batch = _batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(10):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
+
+
+@pytest.mark.parametrize("method", ["RR", "shuffle", "H-RR", "Gossip"])
+def test_decentralized_methods_train(method):
+    engine = _make_engine(
+        {"bf16": {"enabled": True}},
+        method=method, rings=2, shuffle_step=3, slice_count=2,
+    )
+    assert engine.ensemble and engine.replicas == 4  # 8 devices / slice_count 2
+    batch = _batch(32)
+    losses = [float(engine.train_batch(batch)) for _ in range(12)]
+    assert losses[-1] < losses[0], losses
+    # control surface
+    engine.shuffle_exchange()
+    engine.reset_rings(4)
+    engine.synchronization()
+    # after synchronization all replicas should hold identical masters
+    import jax
+
+    m = jax.device_get(engine.state.master["w1"])
+    for r in range(1, engine.replicas):
+        np.testing.assert_allclose(m[0], m[r], rtol=1e-5)
+
+
+def test_shuffle_rings_rerandomize():
+    engine = _make_engine({}, method="shuffle", rings=2, shuffle_step=2, slice_count=1)
+    a0 = engine.sync.ring_assignment.copy()
+    batch = _batch()
+    for _ in range(6):
+        engine.train_batch(batch)
+    assert engine.sync.batch_count == 6
+    # shuffle_step=2 → 3 re-randomizations of 8 replicas into 2 rings; with
+    # the deterministic seeded rng the assignment must have changed.
+    assert not np.array_equal(a0, engine.sync.ring_assignment)
+
+
+def test_gossip_state_pure_reads():
+    """eval/forward must not advance the gossip protocol (alpha, pending)."""
+    engine = _make_engine({}, method="Gossip", slice_count=2)
+    batch = _batch()
+    engine.train_batch(batch)
+    alpha0 = engine.sync.alpha.copy()
+    pending0 = list(engine.sync._pending)
+    engine.eval_batch(batch)
+    engine.forward(batch)
+    engine.module_weights(consensus=False)
+    np.testing.assert_array_equal(alpha0, engine.sync.alpha)
+    assert pending0 == engine.sync._pending
+    # grad-norm introspection API returns a real value after train_batch
+    assert engine.get_global_grad_norm() is not None and np.isfinite(engine.get_global_grad_norm())
+
+
+def test_ensemble_with_zero_stage_shards():
+    """Decentralized sync composes with ZeRO stages (review regression)."""
+    engine = _make_engine({"zero_optimization": {"stage": 1}, "bf16": {"enabled": True}},
+                          method="RR", slice_count=2)
+    batch = _batch()
+    l0 = float(engine.train_batch(batch))
+    l1 = float(engine.train_batch(batch))
+    assert np.isfinite(l0) and np.isfinite(l1)
+
+
+def test_decentralized_consensus_matches_sgd():
+    """With method=RR and SGD, the consensus trajectory equals plain data-
+    parallel SGD over the same global batch (gradient averaging at the
+    consensus point; masters receive identical updates under linear SGD)."""
+    import optax
+
+    batch = _batch(32)
+    e_ref, *_ = sxt.initialize(model=_toy_model(), config={"train_batch_size": 32},
+                               optimizer=optax.sgd(1e-2))
+    e_rr, *_ = sxt.initialize(model=_toy_model(), config={"train_batch_size": 32},
+                              optimizer=optax.sgd(1e-2), method="RR", slice_count=2)
+    for _ in range(5):
+        l_ref = float(e_ref.train_batch(batch))
+        l_rr = float(e_rr.train_batch(batch))
+    np.testing.assert_allclose(l_ref, l_rr, rtol=2e-3)
