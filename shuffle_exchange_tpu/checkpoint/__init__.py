@@ -1,0 +1,9 @@
+from .engine import (
+    CheckpointEngine,
+    MockCheckpointEngine,
+    OrbaxCheckpointEngine,
+    get_checkpoint_engine,
+    read_latest_tag,
+    write_latest_tag,
+)
+from .universal import consolidate_to_fp32

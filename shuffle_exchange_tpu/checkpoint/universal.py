@@ -1,0 +1,88 @@
+"""Universal checkpoint utilities: offline consolidation + resharded resume.
+
+Capability parity with the reference's ``checkpoint/ds_to_universal.py`` and
+``utils/zero_to_fp32.py`` (SURVEY.md §5.4). Most of the machinery collapses
+on TPU: checkpoints written by OrbaxCheckpointEngine carry per-array global
+shapes, so loading into a different (dp, fsdp, tp, pp) topology is just a
+restore with new shardings (Engine.load_checkpoint does this). What remains
+is the offline path: consolidating a sharded training checkpoint into a
+single fp32 state dict on the host for export/serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def consolidate_to_fp32(checkpoint_dir: str, output_file: str, tag: Optional[str] = None,
+                        replica_mode: str = "mean") -> str:
+    """Read a checkpoint directory (any topology) and write a flat fp32 npz.
+
+    replica_mode: how to collapse the decentralized replica dim if present —
+    "mean" (consensus, matches synchronization()) or "first".
+    """
+    from .engine import OrbaxCheckpointEngine, read_latest_tag
+
+    tag = tag or read_latest_tag(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(f"No 'latest' tag in {checkpoint_dir}")
+    path = os.path.join(checkpoint_dir, tag, "model")
+    eng = OrbaxCheckpointEngine()
+    master = eng.load(path)  # host restore, no target
+
+    host_meta_path = os.path.join(checkpoint_dir, tag, "host_state.json")
+    has_replicas = False
+    if os.path.exists(host_meta_path):
+        with open(host_meta_path) as f:
+            has_replicas = "sync" in json.load(f)
+
+    def collapse(leaf):
+        arr = np.asarray(leaf, dtype=np.float32)
+        if has_replicas:
+            arr = arr.mean(axis=0) if replica_mode == "mean" else arr[0]
+        return arr
+
+    flat = {}
+
+    def walk(tree, prefix=""):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, f"{prefix}{k}.")
+        else:
+            flat[prefix.rstrip(".")] = collapse(tree)
+
+    walk(master)
+    np.savez(output_file, **flat)
+    return output_file
+
+
+def main(argv=None):
+    # Host-side tool: never bring up an accelerator (reference zero_to_fp32
+    # also runs detached from the training cluster). Backends are not yet
+    # instantiated at entry, so this override still takes effect even though
+    # the interpreter may have imported jax at startup.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    p = argparse.ArgumentParser(description="Consolidate a sharded checkpoint to a single fp32 npz "
+                                            "(reference zero_to_fp32.py CLI)")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    p.add_argument("--replica-mode", choices=["mean", "first"], default="mean")
+    args = p.parse_args(argv)
+    out = consolidate_to_fp32(args.checkpoint_dir, args.output_file, tag=args.tag,
+                              replica_mode=args.replica_mode)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,12 @@ class TransformerConfig:
     dtype: Any = None                          # compute dtype override (engine usually casts)
     remat: bool = False
     remat_policy: str = "dots_saveable"
+    # MoE (reference moe/layer.py MoE wrapper; Mixtral-style when set)
+    n_experts: int = 0                         # 0 = dense
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    attention_impl: str = "auto"
 
     @property
     def kv_heads(self) -> int:
@@ -92,9 +98,22 @@ def llama3_70b() -> TransformerConfig:  # capability config #4
                              position="rope", tie_embeddings=False)
 
 
+def mixtral_8x7b() -> TransformerConfig:  # capability config #3
+    return TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+                             d_ff=14336, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+                             position="rope", rope_theta=1e6, tie_embeddings=False,
+                             n_experts=8, moe_top_k=2)
+
+
 def tiny(vocab=256, d=64, layers=2, heads=4, seq=64, **kw) -> TransformerConfig:
     return TransformerConfig(vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
                              max_seq_len=seq, **kw)
+
+
+def tiny_moe(vocab=256, d=64, layers=2, heads=4, seq=64, experts=4, **kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=vocab, d_model=d, n_layers=layers, n_heads=heads,
+                             max_seq_len=seq, activation="swiglu", norm="rmsnorm", position="rope",
+                             n_experts=experts, moe_top_k=2, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -188,7 +207,18 @@ class Transformer:
             "wv": stack(next(keys), (D, KV * Dh), D),
             "wo": stack(next(keys), (H * Dh, D), H * Dh, scale=1.0 / math.sqrt(2 * L)),
         }
-        if cfg.activation == "swiglu":
+        if cfg.n_experts > 0:
+            import jax.random as jrandom
+
+            from ..moe.layer import init_expert_mlp
+
+            ek = next(keys)
+            per_layer = [init_expert_mlp(k, cfg.n_experts, D, F, cfg.activation)
+                         for k in jrandom.split(ek, L)]
+            layer["moe_gate"] = stack(next(keys), (D, cfg.n_experts), D)
+            for name in per_layer[0]:
+                layer[f"moe_{name}"] = jnp.stack([p[name] for p in per_layer])
+        elif cfg.activation == "swiglu":
             layer["w_gate"] = stack(next(keys), (D, F), D)
             layer["w_up"] = stack(next(keys), (D, F), D)
             layer["w_down"] = stack(next(keys), (F, D), F, scale=1.0 / math.sqrt(2 * L))
@@ -216,6 +246,14 @@ class Transformer:
             name = path[-1]
             stacked = path[0] == "layers"
             lead = (None,) if stacked else ()
+            if name.startswith("moe_") and name != "moe_gate":
+                # single source of truth for expert sharding lives in moe/layer.py
+                from ..moe.layer import expert_partition_specs
+
+                base = expert_partition_specs({name[4:]: None})[name[4:]]
+                return P(*lead, *base)
+            if name == "moe_gate":
+                return P(*lead, None, None)
             if name in ("wq", "wk", "wv", "w_gate", "w_up"):
                 return P(*lead, None, "tensor")       # column parallel
             if name in ("wo", "w_down"):
@@ -240,6 +278,10 @@ class Transformer:
 
     def apply(self, params, input_ids):
         """input_ids [B, T] -> logits [B, T, vocab] (fp32)."""
+        return self.apply_with_aux(params, input_ids)[0]
+
+    def apply_with_aux(self, params, input_ids):
+        """Returns (logits, moe_aux_loss) — aux is 0 for dense models."""
         import jax
         import jax.numpy as jnp
 
@@ -261,27 +303,35 @@ class Transformer:
             v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
             if cfg.position == "rope":
                 q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-            attn = causal_attention(q, k, v).reshape(B, T, H * Dh)
+            attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl).reshape(B, T, H * Dh)
             h = h + attn @ lw["wo"]
             y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm)
-            if cfg.activation == "swiglu":
+            aux = jnp.zeros((), jnp.float32)
+            if cfg.n_experts > 0:
+                from ..moe.layer import moe_layer
+
+                expert_params = {name[4:]: lw[name] for name in lw if name.startswith("moe_") and name != "moe_gate"}
+                res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
+                                capacity_factor=cfg.capacity_factor, activation=cfg.activation)
+                ff, aux = res.output, res.aux_loss
+            elif cfg.activation == "swiglu":
                 ff = (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
             else:
                 ff = (jax.nn.gelu(y @ lw["w_up"] + lw["b_up"].astype(dtype))) @ lw["w_down"] + lw["b_down"].astype(dtype)
             h = h + ff
-            return h, None
+            return h, aux
 
         if cfg.remat:
             policy = _remat_policy(cfg.remat_policy)
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
-        x, _ = jax.lax.scan(lambda h, lw: layer_fn(h, lw), x, params["layers"])
+        x, aux_losses = jax.lax.scan(lambda h, lw: layer_fn(h, lw), x, params["layers"])
         x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
         if cfg.tie_embeddings:
             logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
         else:
             logits = x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
-        return logits
+        return logits, jnp.sum(aux_losses)
 
     def loss(self, params, batch, rng=None):
         """Next-token cross entropy. batch: {"input_ids": [B,T]} (+ optional
@@ -292,15 +342,16 @@ class Transformer:
         ids = batch["input_ids"]
         if "labels" in batch:
             labels = batch["labels"]
-            logits = self.apply(params, ids)
+            logits, aux = self.apply_with_aux(params, ids)
         else:
             labels = ids[:, 1:]
-            logits = self.apply(params, ids[:, :-1])
+            logits, aux = self.apply_with_aux(params, ids[:, :-1])
         logp = jax.nn.log_softmax(logits, axis=-1)
         mask = (labels >= 0)
         safe_labels = jnp.where(mask, labels, 0)
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+        ce = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+        return ce + self.config.aux_loss_coef * aux
 
 
 def _remat_policy(name: str):

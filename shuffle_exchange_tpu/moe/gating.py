@@ -1,0 +1,103 @@
+"""MoE gating: top-1 / top-2 / top-k with capacity and aux losses.
+
+Capability parity with the reference's ``moe/sharded_moe.py`` (top1gating
+:183, top2gating :290, topkgating :374 — itself the GShard formulation):
+softmax gate over experts, iterative top-k selection, per-expert capacity
+``ceil(k·S/E · capacity_factor)`` with overflow drop, load-balancing aux
+loss ``E · Σ_e mean(gates_e)·mean(mask_e)``, optional gate-noise for
+exploration, and the (combine_weights, dispatch_mask) einsum-dispatch
+contract.
+
+All shapes are static: [S, E] in, ([S, E, C], [S, E, C] bool, aux) out —
+XLA-friendly (no dynamic token routing; drops are masked, not ragged).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+
+class GateOutput(NamedTuple):
+    combine_weights: "jax.Array"   # [S, E, C] f32
+    dispatch_mask: "jax.Array"     # [S, E, C] bool
+    aux_loss: "jax.Array"          # scalar
+    metadata: dict                 # expert_counts, dropped fraction (traced)
+
+
+def compute_capacity(num_tokens: int, num_experts: int, k: int, capacity_factor: float,
+                     min_capacity: int = 4) -> int:
+    cap = int(-(-num_tokens * k * capacity_factor // num_experts))
+    return max(cap, min_capacity)
+
+
+def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
+                train: bool = True, rng=None, noise_std: float = 0.0,
+                normalize_weights: bool = True, drop_tokens: bool = True) -> GateOutput:
+    """logits [S, E] -> GateOutput. top1/top2 are k=1/2 (reference dispatch
+    table moe/sharded_moe.py:587-678 calls into the same machinery)."""
+    import jax
+    import jax.numpy as jnp
+
+    S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    if train and noise_std > 0.0 and rng is not None:
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape, jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    capacity = compute_capacity(S, E, k, capacity_factor, min_capacity) if drop_tokens else S
+
+    masks = []
+    masked_logits = logits
+    for _ in range(k):
+        idx = jnp.argmax(masked_logits, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        masks.append(m)
+        masked_logits = jnp.where(m > 0, -jnp.inf, masked_logits)
+
+    # Aux load-balancing loss on the first choice (reference l_aux):
+    me = gates.mean(axis=0)                  # mean gate prob per expert
+    ce = masks[0].mean(axis=0)               # fraction of tokens routed (top-1)
+    aux_loss = E * jnp.sum(me * ce)
+
+    # Position of each token within its expert's buffer, priority: choice
+    # order first (all 1st choices beat 2nd choices), token order second.
+    locations = []
+    running = jnp.zeros((E,), jnp.float32)
+    kept_masks = []
+    for m in masks:
+        loc = jnp.cumsum(m, axis=0) - m + running[None, :]
+        running = running + m.sum(axis=0)
+        if drop_tokens:
+            m = m * (loc < capacity)
+        kept_masks.append(m)
+        locations.append(loc)
+
+    gate_weights = []
+    for m in kept_masks:
+        gate_weights.append(jnp.sum(gates * m, axis=-1))  # [S]
+    if normalize_weights and k > 1:
+        denom = sum(gate_weights)
+        denom = jnp.maximum(denom, 1e-9)
+        gate_weights = [g / denom for g in gate_weights]
+
+    combine = jnp.zeros((S, E, capacity), jnp.float32)
+    for m, loc, gw in zip(kept_masks, locations, gate_weights):
+        loc_idx = (loc * m).sum(axis=-1).astype(jnp.int32)        # [S]
+        loc_oh = jax.nn.one_hot(loc_idx, capacity, dtype=jnp.float32)  # [S, C]
+        combine = combine + gw[:, None, None] * m[:, :, None] * loc_oh[:, None, :]
+    dispatch = combine > 0
+
+    expert_counts = sum(kept_masks).sum(axis=0)
+    kept = sum(m.sum() for m in kept_masks)
+    total = sum(m.sum() for m in masks)
+    metadata = {"expert_counts": expert_counts, "drop_fraction": 1.0 - kept / jnp.maximum(total, 1.0),
+                "capacity": capacity}
+    return GateOutput(combine, dispatch, aux_loss, metadata)
+
+
+def top1_gating(logits, **kw) -> GateOutput:
+    return topk_gating(logits, k=1, **kw)
+
+
+def top2_gating(logits, **kw) -> GateOutput:
+    return topk_gating(logits, k=2, **kw)

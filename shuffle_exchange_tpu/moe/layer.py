@@ -1,0 +1,131 @@
+"""Expert-parallel MoE layer.
+
+Capability parity with the reference MoE stack (SURVEY.md §2.6 EP row):
+``MoE`` wrapper (``moe/layer.py:17``), einsum dispatch → all-to-all over the
+expert group → local expert FFNs → return all-to-all → combine
+(``moe/sharded_moe.py:587-678``), EP×DP group construction
+(``utils/groups.py:240``), residual MoE (``layer.py:105-131``), expert
+param identification for the optimizer (``moe/utils.py:72``).
+
+TPU-native shape: expert weights are stacked on a leading E dim sharded
+over the mesh "expert" axis; dispatched activations get a
+``with_sharding_constraint`` putting the expert dim on the same axis, and
+XLA lowers the resharding into exactly the all-to-all pair the reference
+issues by hand — scheduled/overlapped by the compiler (SURVEY §2.13
+moe_gemm → the per-expert matmul is a single batched einsum on the MXU).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple, Optional
+
+from .gating import GateOutput, topk_gating
+
+
+def init_expert_mlp(rng, n_experts: int, d_model: int, d_ff: int, activation: str = "swiglu"):
+    """Stacked expert FFN weights: leading dim E (shard over "expert")."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    params = {
+        "w_up": jax.random.normal(k2, (n_experts, d_model, d_ff), jnp.float32) * scale_in,
+        "w_down": jax.random.normal(k3, (n_experts, d_ff, d_model), jnp.float32) * scale_out,
+    }
+    if activation == "swiglu":
+        params["w_gate"] = jax.random.normal(k1, (n_experts, d_model, d_ff), jnp.float32) * scale_in
+    return params
+
+
+def expert_partition_specs(params):
+    from jax.sharding import PartitionSpec as P
+
+    return {k: P("expert", None, "tensor") if k in ("w_gate", "w_up") else P("expert", "tensor", None)
+            for k in params}
+
+
+def expert_mlp(params, x, activation: str = "swiglu"):
+    """x [E, C', M] -> [E, C', M]: per-expert FFN as one batched einsum."""
+    import jax
+    import jax.numpy as jnp
+
+    up = jnp.einsum("ecm,emf->ecf", x, params["w_up"].astype(x.dtype))
+    if activation == "swiglu":
+        gate = jnp.einsum("ecm,emf->ecf", x, params["w_gate"].astype(x.dtype))
+        h = jax.nn.silu(gate) * up
+    else:
+        h = jax.nn.gelu(up)
+    return jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype))
+
+
+class MoEResult(NamedTuple):
+    output: "jax.Array"
+    aux_loss: "jax.Array"
+    metadata: dict
+
+
+def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0,
+              activation: str = "swiglu", train: bool = True, rng=None,
+              noise_std: float = 0.0, min_capacity: int = 4, expert_axis: str = "expert",
+              mesh=None) -> MoEResult:
+    """x [..., M] -> MoEResult. gate_w [M, E].
+
+    Under jit with a mesh in context, the dispatched [E, C, M] tensor is
+    sharding-constrained to the expert axis (EP all-to-all inserted by XLA).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    orig_shape = x.shape
+    M = orig_shape[-1]
+    xs = x.reshape(-1, M)
+    S = xs.shape[0]
+    logits = (xs.astype(jnp.float32)) @ gate_w.astype(jnp.float32)   # [S, E]
+    gate = topk_gating(logits, k=k, capacity_factor=capacity_factor, train=train,
+                       rng=rng, noise_std=noise_std, min_capacity=min_capacity)
+
+    dispatched = jnp.einsum("sec,sm->ecm", gate.dispatch_mask.astype(xs.dtype), xs)
+    dispatched = _constrain_expert(dispatched, expert_axis, mesh)
+    expert_out = expert_mlp(expert_params, dispatched, activation)
+    expert_out = _constrain_expert(expert_out, expert_axis, mesh)
+    combined = jnp.einsum("sec,ecm->sm", gate.combine_weights.astype(xs.dtype), expert_out)
+    return MoEResult(combined.reshape(orig_shape), gate.aux_loss, gate.metadata)
+
+
+def _constrain_expert(t, expert_axis, mesh):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax.sharding import NamedSharding
+
+        if mesh is None:
+            from ..parallel.mesh import topology_is_initialized, get_topology
+
+            if not topology_is_initialized():
+                return t
+            mesh = get_topology().mesh
+        if mesh.shape.get(expert_axis, 1) == 1:
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, P(expert_axis, None, None)))
+    except Exception:
+        return t
+
+
+def residual_moe(gate_w, expert_params, dense_params, coef_w, x, activation: str = "swiglu",
+                 **moe_kwargs) -> MoEResult:
+    """Residual MoE (reference moe/layer.py:105-131): blend a dense MLP path
+    with the MoE path via a learned 2-way coefficient."""
+    import jax
+    import jax.numpy as jnp
+
+    res = moe_layer(gate_w, expert_params, x, activation=activation, **moe_kwargs)
+    dense = expert_mlp({k: v[None] for k, v in dense_params.items()},
+                       x.reshape(1, -1, x.shape[-1]), activation).reshape(x.shape)
+    coef = jax.nn.softmax((x.astype(jnp.float32) @ coef_w.astype(jnp.float32)), axis=-1)
+    out = dense * coef[..., 0:1].astype(x.dtype) + res.output * coef[..., 1:2].astype(x.dtype)
+    return MoEResult(out, res.aux_loss, res.metadata)

@@ -1,15 +1,25 @@
 """Causal (flash) attention.
 
 TPU replacement for the reference's attention kernels: training-side fused
-attention (``ops/transformer``, triton kernels) and the serving blocked-flash
-(``inference/v2/kernels/ragged_ops/blocked_flash/``). The jnp reference is
-numerically-stable fp32-softmax SDPA with GQA; the Pallas path (ops/pallas/
-flash kernel, task tracked) streams KV blocks through VMEM with online
-softmax — until it lands, TPU execution uses XLA's fused SDPA which already
-tiles onto the MXU.
+attention (``ops/transformer``, triton kernels in
+``ops/transformer/inference/triton/``) and the serving blocked-flash
+(``inference/v2/kernels/ragged_ops/blocked_flash/``, SURVEY.md §2.13).
+
+Paths:
+- ``pallas``: the Pallas TPU flash kernel (blocked online-softmax, custom
+  VJP, segment-id masking) — KV streams through VMEM, no [T,S] logits
+  materialization, MXU-shaped blocks.
+- ``reference``: numerically-stable fp32-softmax SDPA in jnp — the numerics
+  oracle for tests and the CPU fallback.
+- ``auto``: pallas on TPU when shapes qualify (seq multiple of block,
+  head_dim % 128 == 0 for lane alignment), else reference.
 """
 
 from __future__ import annotations
+
+import functools
+
+from ..utils.logging import warning_once
 
 
 def _repeat_kv(k, n_rep: int):
@@ -21,12 +31,8 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, :, None, :], (b, t, h, n_rep, d)).reshape(b, t, h * n_rep, d)
 
 
-def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None):
-    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D].
-
-    impl: "auto" | "reference" | "pallas" (pallas falls back with a warning
-    off-TPU).
-    """
+def reference_attention(q, k, v, causal: bool = True, segment_ids=None):
+    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]; fp32 softmax."""
     import jax
     import jax.numpy as jnp
 
@@ -35,9 +41,7 @@ def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_id
     v = _repeat_kv(v, n_rep)
     scale = q.shape[-1] ** -0.5
 
-    q32 = q.astype(jnp.float32)
-    k32 = k.astype(jnp.float32)
-    logits = jnp.einsum("bthd,bshd->bhts", q32 * scale, k32)
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
     if causal:
         t, s = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
@@ -47,3 +51,76 @@ def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_id
         logits = jnp.where(seg_mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _pallas_ok(q, k) -> bool:
+    import os
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    # On the tunneled single-chip dev environment, Mosaic (pallas) kernel
+    # compilation through the remote-compile service stalls indefinitely, so
+    # "auto" only takes the pallas path when explicitly enabled. On a real
+    # pod set SXT_ENABLE_PALLAS=1 (or pass impl="pallas").
+    if not os.environ.get("SXT_ENABLE_PALLAS"):
+        return False
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    # the kernel wants lane-aligned head_dim and big-enough blocks
+    return d % 128 == 0 and t >= 128 and s >= 128 and t % 128 == 0 and s % 128 == 0
+
+
+def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
+    """Blocked flash attention via the Pallas TPU kernel (jax.experimental).
+
+    Input [B,T,H,D]; the kernel's layout is [B,H,T,D]. GQA folds by
+    repeating KV heads (the matmul cost is identical; HBM reads of KV stay
+    n_kv-sized because the repeat is a broadcast XLA keeps virtual until the
+    kernel tiles it)."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        SegmentIds,
+        flash_attention as _fa,
+    )
+
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    t, s = qt.shape[2], kt.shape[2]
+
+    def blk(n):
+        # the kernel asserts seq % block == 0; pick the largest MXU-friendly
+        # divisor instead of a blind min(512, n)
+        for b in (512, 384, 256, 128):
+            if n % b == 0:
+                return b
+        return n
+    block_sizes = BlockSizes(
+        block_q=blk(t), block_k_major=blk(s), block_k=blk(s), block_b=1,
+        block_q_major_dkv=blk(t), block_k_major_dkv=blk(s), block_k_dkv=blk(s), block_q_dkv=blk(t),
+        block_k_major_dq=blk(s), block_k_dq=blk(s), block_q_dq=blk(t),
+    )
+    seg = SegmentIds(q=segment_ids, kv=segment_ids) if segment_ids is not None else None
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=q.shape[-1] ** -0.5,
+              segment_ids=seg, block_sizes=block_sizes)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None):
+    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]."""
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
+        try:
+            return pallas_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        except Exception as e:  # pragma: no cover
+            if impl == "pallas":
+                raise
+            warning_once(f"pallas flash attention unavailable ({type(e).__name__}); using reference")
+    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)

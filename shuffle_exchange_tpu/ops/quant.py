@@ -1,0 +1,55 @@
+"""Quantization kernels: int8 symmetric quantize/dequantize.
+
+TPU replacement for the reference's quantizer extensions (QuantizerBuilder /
+FPQuantizerBuilder, ``ops/quantizer`` + ``ops/fp_quantizer``; CUDAQuantizer
+for ZeRO++ quantized all-gather, ``partition_parameters.py:824``; qgZ
+quantized all-to-all, ``runtime/comm/coalesced_collectives.py:31``,
+SURVEY.md §2.13). Group-wise symmetric int8: values are scaled per group of
+``group_size`` elements by max-abs / 127.
+
+Used by: ZeRO++-style quantized weight all-gather and gradient
+reduce-scatter (parallel/comm.py quantized collectives), and weight-only
+quantized serving matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def quantize_int8(x, group_size: int = 2048) -> Tuple["jax.Array", "jax.Array"]:
+    """x (any shape) -> (q int8 flat-grouped, scales f32 [groups]).
+
+    The trailing partial group is zero-padded; ``dequantize_int8`` takes the
+    original shape to unpad.
+    """
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    groups = -(-n // group_size)
+    pad = groups * group_size - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    g = flat.reshape(groups, group_size)
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return q, scale[:, 0]
+
+
+def dequantize_int8(q, scale, shape, dtype=None):
+    import jax.numpy as jnp
+
+    out = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+    n = 1
+    for s in shape:
+        n *= s
+    out = out[:n].reshape(shape)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def quantize_dequantize(x, group_size: int = 2048):
+    """The round-trip used by quantized-collective simulations and tests."""
+    q, s = quantize_int8(x, group_size)
+    return dequantize_int8(q, s, x.shape, x.dtype)

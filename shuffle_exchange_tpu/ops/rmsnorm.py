@@ -21,8 +21,14 @@ def rmsnorm_reference(x, weight, eps: float = 1e-5):
 
 
 def _use_pallas(x) -> bool:
+    import os
+
     import jax
 
+    # See ops/flash_attention._pallas_ok: pallas compile stalls through the
+    # dev tunnel's remote-compile service; opt in explicitly on real pods.
+    if not os.environ.get("SXT_ENABLE_PALLAS"):
+        return False
     try:
         platform = x.devices().pop().platform if hasattr(x, "devices") else jax.default_backend()
     except Exception:

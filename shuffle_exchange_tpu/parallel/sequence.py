@@ -1,0 +1,228 @@
+"""Sequence parallelism and long context.
+
+Capability parity with the reference's SP stack (SURVEY.md §5.7):
+
+- **Ulysses** (``sequence/layer.py:277,331`` ``_SeqAllToAll`` +
+  ``DistributedAttention``): activations arrive sharded on the sequence dim;
+  two all-to-alls swap seq↔head sharding around any core attention so each
+  device sees full sequence for a subset of heads.
+- **Ring attention** (the TPU-idiomatic replacement for FPDT chunked
+  attention, ``sequence/fpdt_layer.py:510,971``): KV blocks rotate around
+  the "seq" mesh axis via ``ppermute`` while each device keeps its Q shard,
+  with online-softmax (log-sum-exp) accumulation — full-sequence attention
+  with O(T/sp) activation memory and comm overlapped by XLA.
+- **Tiled compute** (``runtime/sequence_parallel/ulysses_sp.py:757,915``
+  TiledMLP / tiled loss): lax.map over sequence chunks bounds activation
+  memory for the MLP and the logits/loss.
+- **Vocab-parallel cross entropy** (``sequence/cross_entropy.py``): CE with
+  logits sharded over the "tensor" axis, no full-vocab gather.
+
+All functions are written for use inside ``shard_map`` (axis names must be
+bound); pure-jit callers get the same math when the axis is size 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+from . import comm
+
+
+# ----------------------------------------------------------------------
+# Ulysses
+# ----------------------------------------------------------------------
+
+
+def seq_to_head_a2a(x, axis_name: str = "seq"):
+    """[B, T/sp, H, D] -> [B, T, H/sp, D] (head-scatter, seq-gather)."""
+    import jax
+
+    sp = jax.lax.axis_size(axis_name)
+    if x.shape[2] % sp:
+        raise ValueError(
+            f"Ulysses needs head count ({x.shape[2]}) divisible by the sequence-parallel "
+            f"degree ({sp}); use ring_attention for sp > heads (reference supports uneven "
+            "heads via padding — sequence/layer.py:111 — not yet implemented here)")
+    return comm.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def head_to_seq_a2a(x, axis_name: str = "seq"):
+    """[B, T, H/sp, D] -> [B, T/sp, H, D] (seq-scatter, head-gather)."""
+    return comm.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+class DistributedAttention:
+    """Ulysses wrapper around any local attention fn (reference
+    ``sequence/layer.py:331``): q/k/v sharded on seq dim in, output sharded
+    on seq dim out."""
+
+    def __init__(self, local_attention: Callable, sequence_axis: str = "seq",
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis = sequence_axis
+
+    def __call__(self, q, k, v, *args, **kwargs):
+        qh = seq_to_head_a2a(q, self.axis)
+        kh = seq_to_head_a2a(k, self.axis)
+        vh = seq_to_head_a2a(v, self.axis)
+        out = self.local_attn(qh, kh, vh, *args, **kwargs)
+        return head_to_seq_a2a(out, self.axis)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", attn_fn: Optional[Callable] = None,
+                      causal: bool = True):
+    """Functional form of DistributedAttention."""
+    from ..ops.flash_attention import flash_attention
+
+    attn = attn_fn or (lambda q, k, v: flash_attention(q, k, v, causal=causal))
+    return DistributedAttention(attn, axis_name)(q, k, v)
+
+
+# ----------------------------------------------------------------------
+# Ring attention (causal, online softmax)
+# ----------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True):
+    """Blockwise full-sequence attention with rotating KV.
+
+    q/k/v: [B, T_local, H|Hkv, D] — this device's sequence shard (layout
+    matches ops.flash_attention). Must run inside shard_map with
+    ``axis_name`` bound. Accumulation in fp32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    n_rep = H // k.shape[2]
+    if n_rep > 1:
+        from ..ops.flash_attention import _repeat_kv
+
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+    scale = D ** -0.5
+    q32 = q.astype(jnp.float32) * scale
+
+    q_pos = my_idx * Tq + jnp.arange(Tq)
+
+    def partial_attn(carry, kv_and_src):
+        acc, m_run, l_run = carry
+        (k_blk, v_blk), src_idx = kv_and_src
+        logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
+        if causal:
+            kv_pos = src_idx * Tq + jnp.arange(Tq)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        m_blk = jnp.max(logits, axis=-1)                      # [B,H,T]
+        m_new = jnp.maximum(m_run, m_blk)
+        # guard fully-masked blocks (m_new = -inf): contribute nothing
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+        l_new = l_run * correction + p.sum(-1)
+        acc_new = acc * correction[..., None] + jnp.einsum("bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
+        return (acc_new, m_new, l_new), None
+
+    def rotate(kv):
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        return jax.tree_util.tree_map(lambda x: comm.ppermute(x, axis_name, perm), kv)
+
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+
+    carry = (acc0, m0, l0)
+    kv = (k, v)
+    # Unrolled python loop over sp hops (sp is static); XLA overlaps each
+    # ppermute with the previous block's compute.
+    for r in range(sp):
+        src_idx = (my_idx - r) % sp
+        carry, _ = partial_attn(carry, (kv, src_idx))
+        if r != sp - 1:
+            kv = rotate(kv)
+    acc, m_run, l_run = carry
+    out = acc / jnp.maximum(l_run[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,T,H,D]
+
+
+# ----------------------------------------------------------------------
+# Tiled compute
+# ----------------------------------------------------------------------
+
+
+def tiled_mlp(fn: Callable, x, n_tiles: int, axis: int = 1):
+    """Apply ``fn`` over sequence tiles to bound activation memory
+    (reference TiledMLP ulysses_sp.py:757). fn must be pointwise along
+    ``axis`` (true for transformer MLPs)."""
+    import jax
+    import jax.numpy as jnp
+
+    if n_tiles <= 1:
+        return fn(x)
+    T = x.shape[axis]
+    assert T % n_tiles == 0, f"seq {T} not divisible by n_tiles {n_tiles}"
+    tiles = jnp.moveaxis(x, axis, 0).reshape((n_tiles, T // n_tiles) + x.shape[:axis] + x.shape[axis + 1:])
+    out_tiles = jax.lax.map(lambda t: fn(jnp.moveaxis(t, 0, axis)), tiles)
+    # out_tiles: [n_tiles, ..., tile, ...] with tile at `axis`+1
+    out = jnp.concatenate([out_tiles[i] for i in range(n_tiles)], axis=axis)
+    return out
+
+
+def tiled_loss(loss_fn: Callable, logits_fn: Callable, x, labels, n_tiles: int):
+    """Chunked logits+loss (reference tiled loss ulysses_sp.py:915; FPDT
+    chunked logits fpdt_layer.py:1137): never materializes [B, T, vocab]."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T = labels.shape
+    assert T % n_tiles == 0
+    chunk = T // n_tiles
+
+    def body(i, acc):
+        sl = jax.lax.dynamic_slice_in_dim(x, i * chunk, chunk, axis=1)
+        lb = jax.lax.dynamic_slice_in_dim(labels, i * chunk, chunk, axis=1)
+        logits = logits_fn(sl)
+        loss, count = loss_fn(logits, lb)
+        return (acc[0] + loss, acc[1] + count)
+
+    total, count = jax.lax.fori_loop(0, n_tiles, body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)))
+    return total / jnp.maximum(count, 1.0)
+
+
+# ----------------------------------------------------------------------
+# Vocab-parallel cross entropy (reference sequence/cross_entropy.py)
+# ----------------------------------------------------------------------
+
+
+def vocab_parallel_cross_entropy(logits_shard, labels, axis_name: str = "tensor",
+                                 vocab_shard_size: Optional[int] = None, ignore_index: int = -100):
+    """CE where logits [.., V/tp] are sharded on the vocab dim over
+    ``axis_name``. Returns mean NLL over non-ignored labels. Runs inside
+    shard_map."""
+    import jax
+    import jax.numpy as jnp
+
+    V_local = logits_shard.shape[-1]
+    tp_idx = jax.lax.axis_index(axis_name)
+    vocab_start = tp_idx * V_local
+    logits32 = logits_shard.astype(jnp.float32)
+
+    local_max = logits32.max(-1)
+    global_max = comm.pmax(local_max, axis_name)
+    sumexp = jnp.exp(logits32 - global_max[..., None]).sum(-1)
+    global_sumexp = comm.psum(sumexp, axis_name)
+    lse = global_max + jnp.log(global_sumexp)
+
+    local_label = labels - vocab_start
+    in_shard = (local_label >= 0) & (local_label < V_local)
+    safe = jnp.clip(local_label, 0, V_local - 1)
+    picked = jnp.take_along_axis(logits32, safe[..., None], axis=-1)[..., 0]
+    target_logit = comm.psum(jnp.where(in_shard, picked, 0.0), axis_name)
+
+    mask = labels != ignore_index
+    nll = jnp.where(mask, lse - target_logit, 0.0)
+    return nll.sum() / jnp.maximum(mask.sum(), 1)

@@ -62,6 +62,26 @@ class TrainState(NamedTuple):
     step: Any            # i32 scalar
 
 
+def _flatten_dict(tree, prefix=""):
+    if not isinstance(tree, dict):
+        return {prefix.rstrip("."): tree}
+    out = {}
+    for k, v in tree.items():
+        out.update(_flatten_dict(v, f"{prefix}{k}."))
+    return out
+
+
+def _denumpify(obj):
+    """json round-trips numpy rng state dicts with ints as strings; restore ints."""
+    if isinstance(obj, dict):
+        return {k: _denumpify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_denumpify(v) for v in obj]
+    if isinstance(obj, str) and obj.isdigit():
+        return int(obj)
+    return obj
+
+
 def _tree_select(pred, a_tree, b_tree):
     """where(pred, a, b) leaf-wise, preserving dtypes (pred is a traced bool)."""
     import jax
@@ -165,10 +185,49 @@ class Engine:
                 return jax.vmap(self.tx.init)(m)
             return self.tx.init(m)
 
-        opt_state = jax.jit(init_opt)(master)
-        scale_state = ls.init_loss_scale(config.fp16)
+        # Optimizer-state shardings: optax states embed copies of the param
+        # tree (mu/nu/...), so an opt leaf's path ends with some master
+        # leaf's path — match by that suffix (shape alone is ambiguous: wq
+        # and wo share a shape but transpose their tensor-parallel specs).
+        # Without explicit out_shardings the init jit commits everything to
+        # one device, wasting HBM and poisoning checkpoint-restore placements.
+        def path_keys(path):
+            out = []
+            for e in path:
+                if hasattr(e, "key"):
+                    out.append(str(e.key))
+                elif hasattr(e, "idx"):
+                    out.append(str(e.idx))
+                elif hasattr(e, "name"):
+                    out.append(str(e.name))
+            return tuple(out)
+
+        master_by_path = {}
+        for path, m_sh in jax.tree_util.tree_flatten_with_path(self.master_shardings)[0]:
+            master_by_path[path_keys(path)] = m_sh
+        master_shapes = {p: tuple(l.shape) for p, l in
+                         ((path_keys(path), leaf) for path, leaf in jax.tree_util.tree_flatten_with_path(master)[0])}
+
+        def opt_leaf_sharding(path, leaf):
+            keys = path_keys(path)
+            for start in range(len(keys)):
+                suffix = keys[start:]
+                if suffix in master_by_path and master_shapes[suffix] == tuple(leaf.shape):
+                    return master_by_path[suffix]
+            return self.repl_sharding
+
+        opt_shapes = jax.eval_shape(init_opt, master)
+        self.opt_shardings = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(opt_shapes),
+            [opt_leaf_sharding(path, leaf)
+             for path, leaf in jax.tree_util.tree_flatten_with_path(opt_shapes)[0]])
+        opt_state = jax.jit(init_opt, out_shardings=self.opt_shardings)(master)
+        # Scalars are explicitly replicated over the mesh so that checkpoint
+        # restore (which reproduces input placements exactly) stays mesh-wide.
+        scale_state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self.repl_sharding), ls.init_loss_scale(config.fp16))
         self.state = TrainState(master=master, opt_state=opt_state, loss_scale=scale_state,
-                                step=jnp.asarray(0, jnp.int32))
+                                step=jax.device_put(jnp.asarray(0, jnp.int32), self.repl_sharding))
 
         # --- timers / monitors -----------------------------------------
         self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
@@ -501,6 +560,144 @@ class Engine:
         consensus average by default (else replica-stacked)."""
         mix = self._mix_matrix(sync_matrix=consensus)
         return self._materialize(self.state, mix)
+
+    # -- checkpointing (reference engine.py:2997,3343,3911; SURVEY §5.4) ----
+
+    def _checkpoint_engine(self):
+        if not hasattr(self, "_ckpt_engine") or self._ckpt_engine is None:
+            from ..checkpoint.engine import get_checkpoint_engine
+
+            self._ckpt_engine = get_checkpoint_engine(self.config)
+        return self._ckpt_engine
+
+    def _host_state(self) -> dict:
+        state = {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+            "rng_state": self._rng.bit_generator.state,
+        }
+        if self.sync is not None:
+            state["sync"] = {
+                "batch_count": self.sync.batch_count,
+                "rings": self.sync.rings,
+                "ring_assignment": self.sync.ring_assignment.tolist(),
+                "alpha": self.sync.alpha.tolist(),
+                "pending": list(self.sync._pending),
+                "rng_state": self.sync._rng.bit_generator.state,
+            }
+        return state
+
+    def _restore_host_state(self, state: dict) -> None:
+        self.global_steps = state["global_steps"]
+        self.global_samples = state.get("global_samples", 0)
+        self.skipped_steps = state.get("skipped_steps", 0)
+        self.micro_steps = state.get("micro_steps", 0)
+        if "rng_state" in state:
+            self._rng.bit_generator.state = state["rng_state"]
+        if self.sync is not None and "sync" in state:
+            s = state["sync"]
+            self.sync.batch_count = s["batch_count"]
+            self.sync.rings = s["rings"]
+            self.sync.ring_assignment = np.asarray(s["ring_assignment"], dtype=np.int64)
+            self.sync.alpha = np.asarray(s["alpha"], dtype=np.float64)
+            self.sync._pending = [tuple(p) for p in s["pending"]]
+            self.sync._rng.bit_generator.state = s["rng_state"]
+            self.sync._current = None
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None,
+                        exclude_frozen_parameters: bool = False):
+        """Write the full training state (sharded, async-capable) + host
+        metadata + `latest` tag (reference engine.save_checkpoint :3343)."""
+        import json
+        import os
+
+        from ..checkpoint.engine import validate_tag, write_latest_tag
+
+        import jax
+
+        tag = tag or f"global_step{self.global_steps}"
+        validate_tag(tag, self.config.checkpoint.tag_validation)
+        path = os.path.join(save_dir, tag)
+        eng = self._checkpoint_engine()
+        # Model weights and optimizer state are separate items so that
+        # load_module_only never reads the (2x-params) optimizer bytes.
+        eng.save(self.state.master, os.path.join(path, "model"))
+        eng.save({"opt_state": self.state.opt_state,
+                  "loss_scale": self.state.loss_scale,
+                  "step": self.state.step}, os.path.join(path, "opt"))
+        eng.commit(tag)
+        # Host-side metadata + tag: single-writer (process 0) on shared storage.
+        if jax.process_index() == 0:
+            host = self._host_state()
+            if client_state:
+                host["client_state"] = client_state
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "host_state.json"), "w") as f:
+                json.dump(host, f, default=str)
+            write_latest_tag(save_dir, tag)
+        from ..parallel import comm as _comm
+
+        _comm.barrier("save_checkpoint")
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return path
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
+                        load_module_only: bool = False):
+        """Restore into the *current* topology's shardings — a checkpoint
+        written at any dp/fsdp/tp layout reshards on read (the universal-
+        checkpoint capability, reference checkpoint/ds_to_universal.py)."""
+        import json
+        import os
+
+        from ..checkpoint.engine import read_latest_tag
+
+        tag = tag or read_latest_tag(load_dir)
+        if tag is None:
+            raise ConfigError(f"No 'latest' tag in {load_dir} and none given")
+        path = os.path.join(load_dir, tag)
+        eng = self._checkpoint_engine()
+        master = eng.load(os.path.join(path, "model"), target=self.state.master)
+        opt_state, loss_scale, step = self.state.opt_state, self.state.loss_scale, self.state.step
+        if load_optimizer_states and not load_module_only:
+            rest = eng.load(os.path.join(path, "opt"),
+                            target={"opt_state": opt_state, "loss_scale": loss_scale, "step": step})
+            opt_state, loss_scale = rest["opt_state"], rest["loss_scale"]
+            if load_lr_scheduler_states:
+                step = rest["step"]
+        self.state = TrainState(master=master, opt_state=opt_state, loss_scale=loss_scale, step=step)
+        host_path = os.path.join(path, "host_state.json")
+        client_state = {}
+        if os.path.exists(host_path):
+            with open(host_path) as f:
+                host = json.load(f)
+            client_state = host.pop("client_state", {})
+            if not load_module_only:
+                self._restore_host_state(_denumpify(host))
+                if not load_lr_scheduler_states:
+                    # LR schedules derive from the step counters; a caller
+                    # declining scheduler state restarts the schedule.
+                    self.global_steps = 0
+        log_dist(f"loaded checkpoint {path}", ranks=[0])
+        return path, client_state
+
+    def save_16bit_model(self, save_dir: str, filename: str = "model_weights.npz"):
+        """Consolidated bit16 consensus weights for serving (reference
+        save_16bit_model engine.py:3911 + ZeRO-3 gather :3842 — the gather
+        is jax.device_get of the sharded tree)."""
+        import os
+
+        import jax
+
+        os.makedirs(save_dir, exist_ok=True)
+        weights = jax.device_get(self.module_weights(consensus=True))
+        flat = _flatten_dict(weights)
+        out = os.path.join(save_dir, filename)
+        np.savez(out, **{k: np.asarray(v) for k, v in flat.items()})
+        log_dist(f"saved 16-bit model to {out}", ranks=[0])
+        return out
 
     def get_lr(self) -> float:
         try:

@@ -1,0 +1,108 @@
+"""Kernel numerics tests (CPU: reference paths + interpret-mode pallas).
+
+Pallas-vs-reference numerics on the real chip run via tests/tpu_smoke.py
+(SURVEY.md §4b: kernel parity tests compare fused ops vs reference impls).
+"""
+
+import numpy as np
+import pytest
+
+
+def test_reference_attention_causality():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    out1 = reference_attention(q, k, v, causal=True)
+    # Perturb the future: outputs at position t must not change.
+    k2 = k.at[:, 5:].set(99.0)
+    v2 = v.at[:, 5:].set(-99.0)
+    out2 = reference_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :5]), np.asarray(out2[:, :5]), rtol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, 6:]), np.asarray(out2[:, 6:]))
+
+
+def test_gqa_equals_repeated_mha():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 16, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 16, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 16, 2, 8)), jnp.float32)
+    out_gqa = reference_attention(q, k, v)
+    k_full = jnp.repeat(k, 2, axis=2)
+    v_full = jnp.repeat(v, 2, axis=2)
+    out_full = reference_attention(q, k_full, v_full)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_full), rtol=1e-5)
+
+
+def test_fused_adam_reference_matches_optax():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from shuffle_exchange_tpu.ops.fused_adam import _reference_update
+
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    m = jnp.zeros((64,), jnp.float32)
+    v = jnp.zeros((64,), jnp.float32)
+    lr, wd = 1e-2, 0.1
+    new_p, new_m, new_v = _reference_update(p, g, m, v, lr=lr, b1=0.9, b2=0.999,
+                                            eps=1e-8, weight_decay=wd, step=1)
+    tx = optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+    state = tx.init(p)
+    updates, _ = tx.update(g, state, p)
+    expected = optax.apply_updates(p, updates)
+    np.testing.assert_allclose(np.asarray(new_p), np.asarray(expected), rtol=1e-5, atol=1e-7)
+
+
+def test_pallas_adamw_transformation_trains():
+    import jax.numpy as jnp
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.ops.fused_adam import pallas_adamw
+    from tests.test_engine import _batch, _toy_model
+
+    engine, *_ = sxt.initialize(model=_toy_model(), config={"train_batch_size": 32},
+                                optimizer=pallas_adamw(1e-2, weight_decay=0.01))
+    batch = _batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(10):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
+
+
+def test_int8_quant_roundtrip():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.quant import quantize_dequantize, quantize_int8
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(300, 70)).astype(np.float32))
+    y = quantize_dequantize(x, group_size=256)
+    # int8 symmetric: relative error bounded by ~1/127 of group max
+    err = np.abs(np.asarray(x) - np.asarray(y))
+    assert err.max() <= float(np.abs(np.asarray(x)).max()) / 127 + 1e-6
+    q, s = quantize_int8(x, group_size=256)
+    assert q.dtype == jnp.int8
+
+
+def test_rmsnorm_reference():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.rmsnorm import rmsnorm_reference
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 128)), jnp.float32)
+    w = jnp.ones((128,))
+    out = rmsnorm_reference(x, w)
+    norms = np.sqrt((np.asarray(out) ** 2).mean(-1))
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
