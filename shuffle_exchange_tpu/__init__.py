@@ -100,6 +100,32 @@ def initialize(
 
     topology = initialize_topology(cfg.mesh, force=True)
 
+    # Pipeline parallelism: wrap zoo models so the 1F1B microbatch loop runs
+    # inside the jitted step (the reference's PipelineEngine path,
+    # runtime/pipe/engine.py:338 — here a model wrapper, see parallel/pipeline.py).
+    if topology.axis_sizes.get("pipe", 1) > 1:
+        from .parallel.pipeline import PipelinedModel
+
+        if isinstance(model, PipelinedModel):
+            pass
+        elif model is not None and hasattr(model, "stack_apply"):
+            n_micro = cfg.pipeline.micro_batches or cfg.gradient_accumulation_steps
+            model = PipelinedModel(model, n_stages=topology.axis_sizes["pipe"], micro_batches=n_micro)
+            # Microbatching moves inside the pipeline; the engine sees one
+            # macro batch per step. Keep train = micro * gas * dp consistent.
+            cfg.pipeline.micro_batches = n_micro
+            cfg.gradient_accumulation_steps = 1
+            dp = max(1, cfg.world_size // cfg.model_parallel_size)
+            cfg.train_micro_batch_size_per_gpu = cfg.train_batch_size // dp
+        else:
+            from .utils.logging import logger
+
+            logger.warning(
+                "mesh.pipe=%d but the model does not expose stack_apply — the pipe "
+                "axis will only replicate compute. Wrap your loss in "
+                "parallel.PipelinedModel (or use a model-zoo Transformer) for real "
+                "pipeline parallelism.", topology.axis_sizes["pipe"])
+
     # Resolve model/params/loss.
     resolved_params = params
     partition_specs = None

@@ -339,6 +339,25 @@ class TensorParallelConfig(ConfigModel):
     tp_grain_size: int = config_field(64, ge=1)
 
 
+@dataclass
+class PipelineParallelConfig(ConfigModel):
+    """Pipeline section (reference: PipelineModule kwargs + config
+    "pipeline" keys, runtime/pipe/module.py:86, runtime/config.py).
+
+    stages=0 reads the mesh "pipe" axis; micro_batches=0 uses
+    gradient_accumulation_steps (the reference equivalence: PipelineEngine
+    consumes gas microbatches per train_batch, runtime/pipe/engine.py:338).
+    """
+
+    stages: int = config_field(0, ge=0)
+    micro_batches: int = config_field(0, ge=0)
+    partition_method: str = config_field("uniform", aliases=("partition",))
+    activation_checkpoint_interval: int = config_field(0, ge=0)
+    seed_layers: bool = config_field(False)
+    pipe_partitioned: bool = config_field(True)
+    grad_partitioned: bool = config_field(True)
+
+
 # ---------------------------------------------------------------------------
 # Root config
 # ---------------------------------------------------------------------------
@@ -399,7 +418,7 @@ class SXConfig(ConfigModel):
     compression_training: Dict[str, Any] = config_field(default_factory=dict)
     data_efficiency: Dict[str, Any] = config_field(default_factory=dict)
     curriculum_learning: Dict[str, Any] = config_field(default_factory=dict)
-    pipeline: Dict[str, Any] = config_field(default_factory=dict)
+    pipeline: PipelineParallelConfig = config_field(default_factory=PipelineParallelConfig)
     hybrid_engine: Dict[str, Any] = config_field(default_factory=dict)
     amp: Dict[str, Any] = config_field(default_factory=dict)
     aio: Dict[str, Any] = config_field(default_factory=dict)
@@ -423,11 +442,29 @@ class SXConfig(ConfigModel):
         if not isinstance(config, dict):
             raise ConfigError(f"Expected config dict or path, got {type(config).__name__}")
         obj = cls.from_dict(config)
+        obj._map_parallel_sizes()
         if obj.elasticity.enabled:
             obj._apply_elastic_plan(world_size)
         obj._resolve_batch_sizes(world_size)
         obj._sanity_check()
         return obj
+
+    def _map_parallel_sizes(self) -> None:
+        """Size-style parallelism knobs (reference tp_size / sp size /
+        pipeline stages) map onto mesh axes left at default."""
+        if self.pipeline.stages > 1 and self.mesh.pipe == 1:
+            self.mesh.pipe = self.pipeline.stages
+        if self.pipeline_parallel_size > 1 and self.mesh.pipe == 1:
+            self.mesh.pipe = self.pipeline_parallel_size
+        if self.sequence_parallel_size > 1 and self.mesh.seq == 1:
+            self.mesh.seq = self.sequence_parallel_size
+        if self.tensor_parallel.tp_size > 1 and self.mesh.tensor == 1:
+            self.mesh.tensor = self.tensor_parallel.tp_size
+
+    @property
+    def model_parallel_size(self) -> int:
+        """Axes that do NOT consume batch: pipe × tensor × seq × expert."""
+        return max(1, self.mesh.pipe * self.mesh.tensor * self.mesh.seq * self.mesh.expert)
 
     def _apply_elastic_plan(self, world_size: int) -> None:
         """Elasticity overrides user batch config (reference: runtime/config.py
@@ -455,7 +492,14 @@ class SXConfig(ConfigModel):
         train = self.train_batch_size
         micro = self.train_micro_batch_size_per_gpu
         gas = self.gradient_accumulation_steps
-        ws = self.world_size
+        # The batch splits over the data-parallel world only — devices on
+        # pipe/tensor/seq/expert axes see the same samples (reference:
+        # dp_world = world // (pp * mp), runtime/config.py batch arithmetic).
+        if self.world_size % self.model_parallel_size:
+            raise ConfigError(
+                f"World size {self.world_size} not divisible by model-parallel axes "
+                f"product {self.model_parallel_size} (mesh={self.mesh.to_dict()})")
+        ws = max(1, self.world_size // self.model_parallel_size)
         if train is not None and micro is not None and gas is not None:
             pass
         elif train is not None and micro is not None:

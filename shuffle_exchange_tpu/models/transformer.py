@@ -274,6 +274,88 @@ class Transformer:
 
         return walk(params, ())
 
+    # -- forward pieces (shared by the plain and pipelined paths) ------
+
+    def embed(self, params, input_ids):
+        """ids [.., T] -> (x [.., T, D], rope (cos, sin) or (None, None))."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        T = input_ids.shape[-1]
+        x = jnp.take(params["embed"], input_ids, axis=0)
+        if cfg.position == "learned":
+            x = x + params["pos_embed"][:T].astype(x.dtype)
+            return x, (None, None)
+        return x, rope_table(T, cfg.head_dim, cfg.rope_theta)
+
+    def layer_apply(self, lw, h, rope):
+        """One transformer block. h [B, T, D] -> (h, moe_aux)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        B, T = h.shape[:2]
+        H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        cos, sin = rope
+        dtype = h.dtype
+        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm)
+        q = (y @ lw["wq"]).reshape(B, T, H, Dh)
+        k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
+        v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
+        if cfg.position == "rope":
+            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl).reshape(B, T, H * Dh)
+        h = h + attn @ lw["wo"]
+        y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm)
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.n_experts > 0:
+            from ..moe.layer import moe_layer
+
+            expert_params = {name[4:]: lw[name] for name in lw if name.startswith("moe_") and name != "moe_gate"}
+            res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
+                            capacity_factor=cfg.capacity_factor, activation=cfg.activation)
+            ff, aux = res.output, res.aux_loss
+        elif cfg.activation == "swiglu":
+            ff = (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
+        else:
+            ff = (jax.nn.gelu(y @ lw["w_up"] + lw["b_up"].astype(dtype))) @ lw["w_down"] + lw["b_down"].astype(dtype)
+        h = h + ff
+        return h, aux
+
+    def stack_apply(self, stacked_layers, x, rope):
+        """Scan the (sub)stack of layers over x. Returns (x, summed aux)."""
+        import jax
+        import jax.numpy as jnp
+
+        def layer_fn(h, lw):
+            return self.layer_apply(lw, h, rope)
+
+        if self.config.remat:
+            layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(self.config.remat_policy))
+        x, aux_losses = jax.lax.scan(layer_fn, x, stacked_layers)
+        return x, jnp.sum(aux_losses)
+
+    def head(self, params, x):
+        """Final norm + unembed: x [.., T, D] -> logits [.., T, vocab] fp32."""
+        import jax.numpy as jnp
+
+        x = _norm(x, params["ln_f_w"], params["ln_f_b"], self.config.norm)
+        if self.config.tie_embeddings:
+            return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+        return x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
+
+    @staticmethod
+    def token_loss(logits, labels):
+        """Per-batch CE pieces: (nll_sum, token_count); -100/negative = ignore."""
+        import jax
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        mask = (labels >= 0)
+        safe_labels = jnp.where(mask, labels, 0)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        return (nll * mask).sum(), mask.sum()
+
     # -- forward -------------------------------------------------------
 
     def apply(self, params, input_ids):
@@ -282,61 +364,13 @@ class Transformer:
 
     def apply_with_aux(self, params, input_ids):
         """Returns (logits, moe_aux_loss) — aux is 0 for dense models."""
-        import jax
-        import jax.numpy as jnp
-
-        cfg = self.config
-        B, T = input_ids.shape
-        H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-        x = jnp.take(params["embed"], input_ids, axis=0)
-        dtype = x.dtype
-        if cfg.position == "learned":
-            x = x + params["pos_embed"][:T].astype(dtype)
-            cos = sin = None
-        else:
-            cos, sin = rope_table(T, Dh, cfg.rope_theta)
-
-        def layer_fn(h, lw):
-            y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm)
-            q = (y @ lw["wq"]).reshape(B, T, H, Dh)
-            k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
-            v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
-            if cfg.position == "rope":
-                q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-            attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl).reshape(B, T, H * Dh)
-            h = h + attn @ lw["wo"]
-            y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm)
-            aux = jnp.zeros((), jnp.float32)
-            if cfg.n_experts > 0:
-                from ..moe.layer import moe_layer
-
-                expert_params = {name[4:]: lw[name] for name in lw if name.startswith("moe_") and name != "moe_gate"}
-                res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
-                                capacity_factor=cfg.capacity_factor, activation=cfg.activation)
-                ff, aux = res.output, res.aux_loss
-            elif cfg.activation == "swiglu":
-                ff = (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
-            else:
-                ff = (jax.nn.gelu(y @ lw["w_up"] + lw["b_up"].astype(dtype))) @ lw["w_down"] + lw["b_down"].astype(dtype)
-            h = h + ff
-            return h, aux
-
-        if cfg.remat:
-            policy = _remat_policy(cfg.remat_policy)
-            layer_fn = jax.checkpoint(layer_fn, policy=policy)
-
-        x, aux_losses = jax.lax.scan(lambda h, lw: layer_fn(h, lw), x, params["layers"])
-        x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
-        if cfg.tie_embeddings:
-            logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
-        else:
-            logits = x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
-        return logits, jnp.sum(aux_losses)
+        x, rope = self.embed(params, input_ids)
+        x, aux = self.stack_apply(params["layers"], x, rope)
+        return self.head(params, x), aux
 
     def loss(self, params, batch, rng=None):
         """Next-token cross entropy. batch: {"input_ids": [B,T]} (+ optional
         "labels" already shifted, -100 = ignore)."""
-        import jax
         import jax.numpy as jnp
 
         ids = batch["input_ids"]
@@ -346,11 +380,8 @@ class Transformer:
         else:
             labels = ids[:, 1:]
             logits, aux = self.apply_with_aux(params, ids[:, :-1])
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        mask = (labels >= 0)
-        safe_labels = jnp.where(mask, labels, 0)
-        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-        ce = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+        nll_sum, count = self.token_loss(logits, labels)
+        ce = nll_sum / jnp.maximum(count, 1)
         return ce + self.config.aux_loss_coef * aux
 
 

@@ -8,3 +8,4 @@ from .mesh import (
     resolve_axis_sizes,
 )
 from . import comm
+from .pipeline import PipelinedModel, spmd_pipeline

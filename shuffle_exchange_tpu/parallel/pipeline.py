@@ -1,0 +1,262 @@
+"""Pipeline parallelism: SPMD microbatch pipeline inside one jitted step.
+
+Capability parity with the reference's pipeline stack (SURVEY.md §2.6 PP,
+§3.4): ``PipelineModule`` layer partitioning (``runtime/pipe/module.py:86``),
+the instruction-list 1F1B ``TrainSchedule`` (``runtime/pipe/schedule.py:189``),
+``PipelineEngine.train_batch`` (``runtime/pipe/engine.py:338``) and the p2p
+activation exchange (``runtime/pipe/p2p.py``).
+
+TPU-native design — no host-driven schedule, no p2p process groups:
+
+- Layer partitioning: the model's stacked per-layer params keep their
+  leading L dim; the pipeline shards it over the mesh "pipe" axis, so each
+  stage owns L/S contiguous layers (the analog of PipelineModule's
+  partition_method="uniform").
+- The schedule is a ``lax.scan`` over pipeline *ticks* inside the jitted
+  train step. Each tick every stage runs its layer block and passes
+  activations to the next stage with ``lax.ppermute`` — XLA schedules the
+  sends on ICI and overlaps them with compute. The reference's
+  SendActivation/RecvActivation instruction pairs (``schedule.py``)
+  collapse into that single collective permute.
+- The loop runs under a *partial-manual* ``shard_map``: only "pipe" is
+  manual; data/fsdp/tensor/expert/seq stay auto, so ZeRO sharding, AutoTP
+  matmul sharding and MoE dispatch inside a stage still compile through
+  XLA's SPMD partitioner unchanged.
+- Backward: ``jax.grad`` through the scan replays ticks in reverse with the
+  transposed ppermute — the BackwardPass/SendGrad/RecvGrad instructions of
+  the reference schedule, derived instead of hand-written. Activation
+  memory is bounded by remat (the model's ``remat`` flag), which is the
+  reference's activation-checkpoint interval analog.
+- Tied weights (embed used at stage 0, tied unembed at the last stage)
+  enter the shard_map replicated over "pipe"; the shard_map transpose
+  psums their cotangents — the reference's tied-weight allreduce
+  (``runtime/pipe/module.py:454``) by construction.
+
+GPipe vs 1F1B: with everything traced into one XLA program, the
+forward/backward interleave is the compiler's scheduling decision; the
+tick loop fixes data dependencies only. Bubble fraction is the usual
+(S-1)/(n_micro+S-1) — pick micro_batches ≥ 4·stages to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..config.config_utils import ConfigError
+from . import comm
+
+
+def pipeline_stage_count(topology=None) -> int:
+    from .mesh import get_topology
+
+    topo = topology or get_topology()
+    return topo.axis_sizes.get("pipe", 1)
+
+
+def spmd_pipeline(stage_fn: Callable, x_micro, *, n_stages: int, axis_name: str = "pipe"):
+    """Run the microbatch pipeline. Must execute inside shard_map with
+    ``axis_name`` manual.
+
+    stage_fn: (h [mb, ...]) -> (h_out [mb, ...], aux scalar) — this stage's
+      layer block.
+    x_micro: [n_micro, mb, ...] microbatched stage-0 inputs (replicated over
+      the pipe axis; only stage 0 reads them).
+
+    Returns (outputs [n_micro, mb, ...] — valid on the LAST stage, zeros
+    elsewhere; aux — sum of stage_fn aux over all (stage, microbatch) pairs,
+    bubble ticks masked out).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_micro = x_micro.shape[0]
+    stage = jax.lax.axis_index(axis_name)
+    n_ticks = n_micro + n_stages - 1
+    # No wrap-around edge: stage 0 always reads fresh microbatch input, so
+    # the (S-1 -> 0) send would be dead traffic (devices with no source
+    # receive zeros, which stage 0 never consumes).
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outputs, aux_acc = carry
+        idx = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage == 0,
+                        jax.lax.dynamic_index_in_dim(x_micro, idx, 0, keepdims=False),
+                        state)
+        out, aux = stage_fn(inp)
+        # Tick t is a real microbatch for this stage iff stage <= t < stage+n_micro.
+        active = (t >= stage) & (t < stage + n_micro)
+        aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        write = (stage == n_stages - 1) & (t >= n_stages - 1)
+        cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, cur), out_idx, 0)
+        state = comm.ppermute(out, axis_name, perm)
+        return (state, outputs, aux_acc), None
+
+    state0 = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
+    outputs0 = jnp.zeros_like(x_micro)
+    carry0 = (state0, outputs0, jnp.zeros((), jnp.float32))
+    (state, outputs, aux), _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+    return outputs, aux
+
+
+class PipelinedModel:
+    """Wrap a model-zoo Transformer for pipeline-parallel training.
+
+    Same surface as the wrapped model (``init`` / ``loss`` /
+    ``partition_specs``), so the Engine needs no pipeline-specific code —
+    the reference's separate PipelineEngine subclass (runtime/pipe/engine.py)
+    collapses into a model wrapper because the schedule lives inside the
+    jitted step. ``apply``/generation use the wrapped model directly
+    (inference uses the non-pipelined path).
+
+    micro_batches plays the role of the reference's gradient accumulation
+    steps on the pipeline path (PipelineEngine consumes gas microbatches per
+    train_batch — runtime/pipe/engine.py:338).
+    """
+
+    def __init__(self, model, n_stages: Optional[int] = None, micro_batches: int = 1,
+                 axis_name: str = "pipe"):
+        self.model = model
+        self.config = model.config
+        self.axis_name = axis_name
+        self.micro_batches = int(micro_batches)
+        self._n_stages = n_stages
+        if self.config.n_layers % self.n_stages:
+            raise ConfigError(
+                f"n_layers {self.config.n_layers} not divisible by pipeline stages {self.n_stages} "
+                "(reference partition_method='uniform', runtime/pipe/module.py:393)")
+        if self.micro_batches < 1:
+            raise ConfigError(f"micro_batches must be >= 1, got {self.micro_batches}")
+
+    @property
+    def n_stages(self) -> int:
+        return self._n_stages if self._n_stages is not None else pipeline_stage_count()
+
+    # -- delegation ----------------------------------------------------
+
+    def init(self, rng):
+        return self.model.init(rng)
+
+    def apply(self, params, input_ids):
+        return self.model.apply(params, input_ids)
+
+    def partition_specs(self, params):
+        """Model specs with the stacked-layer leading dim put on "pipe"."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        base = self.model.partition_specs(params)
+
+        def pin_stage_dim(path, spec):
+            keys = [getattr(e, "key", getattr(e, "name", None)) for e in path]
+            if keys and keys[0] == "layers":
+                rest = tuple(spec)[1:] if len(spec) else ()
+                return P(self.axis_name, *rest)
+            return spec
+
+        return jax.tree_util.tree_map_with_path(pin_stage_dim, base)
+
+    # -- the pipelined loss --------------------------------------------
+
+    def loss(self, params, batch, rng=None):
+        """Next-token CE over the pipeline; numerically matches
+        ``model.loss`` (up to MoE aux averaging across microbatches)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        model = self.model
+        S = self.n_stages
+        n_micro = self.micro_batches
+
+        ids = batch["input_ids"]
+        if "labels" in batch:
+            labels, inputs = batch["labels"], ids
+        else:
+            labels, inputs = ids[:, 1:], ids[:, :-1]
+        B, T = inputs.shape
+        if B % n_micro:
+            raise ConfigError(f"Batch {B} not divisible by pipeline micro_batches {n_micro}")
+        mb = B // n_micro
+        inputs = inputs.reshape(n_micro, mb, T)
+        labels = labels.reshape(n_micro, mb, T)
+        mesh = _current_mesh()
+        # Re-constrain params to their model (pipe/tensor) specs before the
+        # manual region: any extra ZeRO axis on the masters is all-gathered
+        # OUT HERE by XLA (one gather per stage-local stack — the PP analog
+        # of the per-stage ZeRO gather), and never reaches the partial-manual
+        # shard_map, whose partitioner mishandles such subgroup collectives.
+        model_shardings = jax.tree_util.tree_map(
+            lambda s: jax.sharding.NamedSharding(mesh, s), self.partition_specs(params))
+        params = jax.tree_util.tree_map(jax.lax.with_sharding_constraint, params, model_shardings)
+
+        layer_params = params["layers"]
+        other_params = {k: v for k, v in params.items() if k != "layers"}
+        layer_specs = jax.tree_util.tree_map(lambda _: P(self.axis_name), layer_params)
+
+        # XLA's partial-manual partitioner CHECK-fails when a convert feeds a
+        # replicated (P()) shard_map input whose cotangent must psum over the
+        # manual axis in low precision. Route replicated params in at fp32
+        # and re-cast inside the manual region (double converts cancel when
+        # the engine's bf16 cast sits just outside).
+        other_dtypes = jax.tree_util.tree_map(lambda v: v.dtype, other_params)
+        other_params = jax.tree_util.tree_map(
+            lambda v: v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.floating) else v,
+            other_params)
+
+        def inner(layer_params, other_params, inputs, labels):
+            other_params = jax.tree_util.tree_map(
+                lambda v, d: v.astype(d), other_params, other_dtypes)
+            # Embed per microbatch (cheap gather; runs on every stage but
+            # only stage 0's result is consumed — its cotangent is zero
+            # elsewhere, so tied/embed grads stay correct).
+            x, rope = model.embed(other_params, inputs)   # [n_micro, mb, T, D]
+
+            def stage_fn(h):
+                return model.stack_apply(layer_params, h, rope)
+
+            outputs, aux = spmd_pipeline(stage_fn, x, n_stages=S, axis_name=self.axis_name)
+
+            stage = jax.lax.axis_index(self.axis_name)
+
+            def last_stage_ce(outputs):
+                def one(args):
+                    o, lb = args
+                    logits = model.head(other_params, o)
+                    s, c = model.token_loss(logits, lb)
+                    return s, c.astype(jnp.float32)
+
+                sums, counts = jax.lax.map(one, (outputs, labels))
+                return sums.sum(), counts.sum()
+
+            nll_sum, count = jax.lax.cond(
+                stage == S - 1, last_stage_ce,
+                lambda o: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                outputs)
+            # Per-stage partials, reduced OUTSIDE the manual region (the
+            # reference broadcasts the aggregated loss from the last stage,
+            # runtime/pipe/engine.py:584; here summing the [S] vector is
+            # that broadcast — claiming replicated P() output for a psum'd
+            # scalar trips XLA's partial-manual partitioner instead).
+            return (nll_sum.reshape(1), count.reshape(1), aux.reshape(1))
+
+        fn = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(layer_specs, P(), P(), P()),
+            out_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name)),
+            axis_names={self.axis_name}, check_vma=False)
+        nll_parts, count_parts, aux_parts = fn(layer_params, other_params, inputs, labels)
+        nll_sum, count, aux = nll_parts.sum(), count_parts.sum(), aux_parts.sum()
+        ce = nll_sum / jnp.maximum(count, 1.0)
+        # aux summed layers×micros; dense model sums layers on the full
+        # batch, so average over microbatches to keep the coefficient scale.
+        return ce + self.config.aux_loss_coef * aux / n_micro
+
+
+def _current_mesh():
+    from .mesh import get_topology
+
+    return get_topology().mesh
