@@ -155,16 +155,16 @@ def initialize(
     return engine, engine.tx, engine.training_dataloader, engine.lr_schedule
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Inference engine bring-up (reference deepspeed/__init__.py:299)."""
-    try:
-        from .inference.engine import InferenceEngine
-    except ImportError as e:
-        raise NotImplementedError(
-            "The inference engine has not landed yet in this build; "
-            "training (sxt.initialize) is available.") from e
+def init_inference(model=None, params=None, config=None, **kwargs):
+    """Inference engine bring-up (reference deepspeed/__init__.py:299).
 
-    return InferenceEngine(model=model, config=config, **kwargs)
+    Delegates to :func:`shuffle_exchange_tpu.inference.init_inference`, which
+    accepts a reference-format config dict (or InferenceConfig) and requires
+    the weights pytree via ``params``.
+    """
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(model=model, params=params, config=config, **kwargs)
 
 
 def add_config_arguments(parser):

@@ -452,14 +452,19 @@ class SXConfig(ConfigModel):
     def _map_parallel_sizes(self) -> None:
         """Size-style parallelism knobs (reference tp_size / sp size /
         pipeline stages) map onto mesh axes left at default."""
-        if self.pipeline.stages > 1 and self.mesh.pipe == 1:
-            self.mesh.pipe = self.pipeline.stages
-        if self.pipeline_parallel_size > 1 and self.mesh.pipe == 1:
-            self.mesh.pipe = self.pipeline_parallel_size
-        if self.sequence_parallel_size > 1 and self.mesh.seq == 1:
-            self.mesh.seq = self.sequence_parallel_size
-        if self.tensor_parallel.tp_size > 1 and self.mesh.tensor == 1:
-            self.mesh.tensor = self.tensor_parallel.tp_size
+        def merge(axis: str, knob_name: str, value: int) -> None:
+            current = getattr(self.mesh, axis)
+            if value > 1 and current == 1:
+                setattr(self.mesh, axis, value)
+            elif value > 1 and current != value:
+                raise ConfigError(
+                    f"conflicting parallelism config: {knob_name}={value} but "
+                    f"mesh.{axis}={current}; set one or make them agree")
+
+        merge("pipe", "pipeline.stages", self.pipeline.stages)
+        merge("pipe", "pipeline_parallel_size", self.pipeline_parallel_size)
+        merge("seq", "sequence_parallel_size", self.sequence_parallel_size)
+        merge("tensor", "tensor_parallel.tp_size", self.tensor_parallel.tp_size)
 
     @property
     def model_parallel_size(self) -> int:

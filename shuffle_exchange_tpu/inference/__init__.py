@@ -1,0 +1,26 @@
+"""Inference engines — the serving half of the framework.
+
+Capability analog of the reference's two inference stacks:
+  - v1 "kernel injection" serving (``inference/engine.py:40`` InferenceEngine,
+    ``init_inference`` ``deepspeed/__init__.py:299``): here a jit-compiled
+    prefill + decode path over a dense KV cache with tensor-parallel sharded
+    weights (the AutoTP analog is the model's partition specs).
+  - v2 "FastGen" ragged/paged serving (``inference/v2/engine_v2.py:30``):
+    here a paged KV cache (block allocator + block tables), per-sequence
+    state manager, and a continuous-batching ``put/query/flush`` API.
+"""
+
+from .config import InferenceConfig
+from .engine import InferenceEngine, init_inference
+from .paged import BlockedAllocator, PagedKVCache
+from .engine_v2 import InferenceEngineV2, SequenceDescriptor
+
+__all__ = [
+    "InferenceConfig",
+    "InferenceEngine",
+    "init_inference",
+    "BlockedAllocator",
+    "PagedKVCache",
+    "InferenceEngineV2",
+    "SequenceDescriptor",
+]

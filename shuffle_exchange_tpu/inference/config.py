@@ -1,0 +1,89 @@
+"""Inference config (reference ``inference/config.py:118`` DeepSpeedInferenceConfig
+and ``inference/v2/config_v2.py`` RaggedInferenceEngineConfig).
+
+One typed config covers both engines; unknown reference keys that are
+CUDA-specific (cuda_graph, triton) are accepted and ignored with a log line
+so reference configs load cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..config.config_utils import ConfigError
+from ..utils.logging import logger
+
+_DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16", "fp16": "float16",
+           "float16": "float16", "fp32": "float32", "float32": "float32"}
+
+
+@dataclasses.dataclass
+class InferenceConfig:
+    # shared
+    dtype: str = "bfloat16"
+    tensor_parallel: int = 1                  # reference tp_size
+    max_batch_size: int = 8                   # reference max_out_tokens sizing
+    max_seq_len: int = 2048
+    # v1 generate
+    max_new_tokens: int = 128
+    eos_token_id: int = -1                    # -1 = never stop early
+    pad_token_id: int = 0
+    # sampling defaults (overridable per generate() call)
+    temperature: float = 0.0                  # 0 = greedy
+    top_k: int = 0                            # 0 = off
+    top_p: float = 1.0                        # 1 = off
+    # kernels
+    attention_impl: str = "auto"              # reference replace_with_kernel_inject
+    # quantization (reference quant.enabled / FP6): int8 weight-only supported
+    quantize_weights: bool = False
+    quant_group_size: int = 2048
+    # v2 paged KV (reference ragged/kv_cache.py BlockedKVCache)
+    kv_block_size: int = 64
+    num_kv_blocks: int = 256
+    # misc
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "InferenceConfig":
+        d = dict(d or {})
+        # reference compat: nested tensor_parallel {"tp_size": n}, "tp_size" alias
+        tp = d.pop("tensor_parallel", None)
+        if isinstance(tp, dict):
+            d["tensor_parallel"] = int(tp.get("tp_size", 1))
+        elif tp is not None:
+            d["tensor_parallel"] = int(tp)
+        if "tp_size" in d:
+            d["tensor_parallel"] = int(d.pop("tp_size"))
+        if "replace_with_kernel_inject" in d:
+            # kernel injection == our fused/pallas attention path
+            d.setdefault("attention_impl", "auto" if d.pop("replace_with_kernel_inject") else "reference")
+        if "quant" in d:
+            q = d.pop("quant")
+            if isinstance(q, dict):
+                d["quantize_weights"] = bool(q.get("enabled", False))
+        dtype = d.get("dtype")
+        if dtype is not None:
+            key = str(dtype).replace("torch.", "")
+            if key == "int8":
+                # reference dtype=torch.int8 means int8-quantized weights with
+                # fp16 compute; here: weight-only quantization + bf16 compute.
+                d["dtype"] = "bfloat16"
+                d["quantize_weights"] = True
+            elif key not in _DTYPES:
+                raise ConfigError(f"unsupported inference dtype {dtype!r}")
+            else:
+                d["dtype"] = _DTYPES[key]
+        known = {f.name for f in dataclasses.fields(cls)}
+        ignored = {k: d.pop(k) for k in list(d) if k not in known}
+        if ignored:
+            logger.info("InferenceConfig: ignoring CUDA-specific/unknown keys %s", sorted(ignored))
+        try:
+            return cls(**d)
+        except TypeError as e:  # pragma: no cover
+            raise ConfigError(f"bad inference config: {e}") from e
+
+    def jax_dtype(self) -> Any:
+        import jax.numpy as jnp
+
+        return getattr(jnp, self.dtype)

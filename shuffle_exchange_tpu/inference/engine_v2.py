@@ -1,0 +1,238 @@
+"""Inference engine v2 — continuous batching over a paged KV cache.
+
+Capability analog of the reference FastGen stack (``inference/v2/engine_v2.py:30``
+InferenceEngineV2, ``ragged/ragged_manager.py:19`` DSStateManager,
+``ragged/sequence_descriptor.py:59``): host-side sequence state + block
+allocator, device-side paged attention, and the ``put / query / flush``
+serving API. Logits come back to the host (the reference samples on host
+too); the v1 engine's fused generate covers the on-device loop.
+
+TPU-first: every device program has static shapes — prompts are bucketed to
+block multiples, decode batches to power-of-two widths — so a serving
+process compiles a handful of programs total and replays them (the XLA
+equivalent of the reference's CUDA-graph strategy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+from .config import InferenceConfig
+from .engine import InferenceEngine, _bucket, _rope_rows, _apply_rope_batched
+from .paged import (BlockedAllocator, PagedKVCache, append_token_kv, blocks_needed,
+                    paged_decode_attention, write_prefill_kv)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Host state for one live sequence (ragged/sequence_descriptor.py:59)."""
+
+    uid: int
+    seen_tokens: int = 0
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    last_logits: Optional[np.ndarray] = None
+
+
+class InferenceEngineV2(InferenceEngine):
+    """Paged continuous-batching engine.
+
+    ``put(uids, tokens)`` runs prefill for new uids and single/multi-token
+    extension for known ones, returning next-token logits per uid in order.
+    """
+
+    def __init__(self, model, params, config: Optional[InferenceConfig] = None):
+        super().__init__(model, params, config)
+        cfg, mcfg = self.config, self._mcfg
+        if cfg.max_seq_len % cfg.kv_block_size:
+            raise ValueError("max_seq_len must be a multiple of kv_block_size")
+        self.cache = PagedKVCache.create(mcfg.n_layers, cfg.num_kv_blocks, cfg.kv_block_size,
+                                         mcfg.kv_heads, mcfg.head_dim, cfg.jax_dtype())
+        self.allocator = BlockedAllocator(cfg.num_kv_blocks)
+        # block 0 is scratch: padding table entries scribble here, never read.
+        self._scratch = self.allocator.allocate(1)[0]
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+        self._max_blocks = cfg.max_seq_len // cfg.kv_block_size
+        self._prefill_cache: Dict[int, object] = {}
+        self._decode_cache: Dict[int, object] = {}
+
+    # -- scheduling queries (engine_v2.py:158-232) ---------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(max further tokens for uid, free blocks) — engine_v2.py:158."""
+        desc = self._seqs.get(uid)
+        seen = desc.seen_tokens if desc else 0
+        have = len(desc.blocks) * self.cache.block_size if desc else 0
+        headroom = (have - seen) + self.allocator.free_blocks * self.cache.block_size
+        return min(self.config.max_seq_len - seen, headroom), self.allocator.free_blocks
+
+    def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
+        """Admission check (engine_v2.py:184 can_schedule)."""
+        need = 0
+        for uid, n in zip(uids, lengths):
+            desc = self._seqs.get(uid)
+            seen = desc.seen_tokens if desc else 0
+            have = len(desc.blocks) if desc else 0
+            if seen + n > self.config.max_seq_len:
+                return False
+            need += max(0, blocks_needed(seen + n, self.cache.block_size) - have)
+        return need <= self.allocator.free_blocks
+
+    # -- device programs ----------------------------------------------
+
+    def _paged_prefill_fn(self, tpad: int):
+        fn = self._prefill_cache.get(tpad)
+        if fn is not None:
+            return fn
+        import jax
+
+        fn = jax.jit(functools.partial(self._paged_prefill_impl, tpad=tpad),
+                     donate_argnums=(1,))
+        self._prefill_cache[tpad] = fn
+        return fn
+
+    def _paged_prefill_impl(self, params, cache: PagedKVCache, ids, plen, btable, *, tpad: int):
+        """ids [1,tpad]; btable [tpad//block] (scratch-padded); -> cache, logits [1,V]."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.flash_attention import flash_attention
+
+        mcfg = self._mcfg
+        x, (cos, sin), positions = self._embed_at(params, ids, jnp.zeros((1,), jnp.int32))
+
+        def layer_fn(h, layer_and_cache):
+            lw, ck, cv = layer_and_cache
+
+            def attn_fn(q, k, v):
+                ck2, cv2 = write_prefill_kv(ck, cv, k[0], v[0], btable)
+                return flash_attention(q, k, v, causal=True, impl=mcfg.attention_impl), (ck2, cv2)
+
+            return self._layer_body(lw, h, cos, sin, positions, attn_fn)
+
+        x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
+        x_last = jnp.take_along_axis(x, (plen - 1)[:, None, None].astype(jnp.int32), axis=1)
+        logits = self.model.head(params, x_last)[:, 0]
+        return PagedKVCache(kp, vp), logits
+
+    def _paged_decode_fn(self, b: int):
+        fn = self._decode_cache.get(b)
+        if fn is not None:
+            return fn
+        import jax
+
+        fn = jax.jit(self._paged_decode_impl, donate_argnums=(1,))
+        self._decode_cache[b] = fn
+        return fn
+
+    def _paged_decode_impl(self, params, cache: PagedKVCache, tok, pos, btables):
+        """tok [B], pos [B] (next slot), btables [B, max_blocks]."""
+        import jax
+        import jax.numpy as jnp
+
+        x, (cos, sin), _ = self._embed_at(params, tok[:, None], pos)
+
+        def layer_fn(h, layer_and_cache):
+            lw, ck, cv = layer_and_cache
+
+            def attn_fn(q, k, v):
+                ck2, cv2 = append_token_kv(ck, cv, k[:, 0], v[:, 0], btables, pos)
+                return paged_decode_attention(q, ck2, cv2, btables, kv_len=pos + 1), (ck2, cv2)
+
+            return self._layer_body(lw, h, cos, sin, pos, attn_fn)
+
+        x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
+        logits = self.model.head(params, x)[:, 0]
+        return PagedKVCache(kp, vp), logits
+
+    # -- host-side scheduling ------------------------------------------
+
+    def _ensure_blocks(self, desc: SequenceDescriptor, total_tokens: int) -> None:
+        need = blocks_needed(total_tokens, self.cache.block_size) - len(desc.blocks)
+        if need > 0:
+            desc.blocks.extend(self.allocator.allocate(need))
+
+    def _table(self, desc: SequenceDescriptor) -> np.ndarray:
+        t = np.full((self._max_blocks,), self._scratch, dtype=np.int32)
+        t[:len(desc.blocks)] = desc.blocks
+        return t
+
+    def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
+        """Serve one engine step (engine_v2.py:107). New uids are prefilled;
+        known uids extended by their new tokens. Returns fp32 logits
+        [len(uids), vocab] for each sequence's latest position, in order."""
+        import jax.numpy as jnp
+
+        if len(uids) != len(tokens):
+            raise ValueError("uids and tokens must align")
+        if not self.can_schedule(uids, [len(t) for t in tokens]):
+            raise RuntimeError("cannot schedule batch: KV pool exhausted or length cap hit "
+                               "(check query()/free_blocks, flush finished sequences)")
+        bs = self.cache.block_size
+        prefills: List[Tuple[SequenceDescriptor, List[int]]] = []
+        extends: List[Tuple[SequenceDescriptor, List[int]]] = []
+        for uid, toks in zip(uids, tokens):
+            toks = list(map(int, toks))
+            if uid in self._seqs:
+                if toks:
+                    extends.append((self._seqs[uid], toks))
+            else:
+                if not toks:
+                    raise ValueError(f"new uid {uid} with no tokens")
+                desc = SequenceDescriptor(uid=uid)
+                self._seqs[uid] = desc
+                prefills.append((desc, toks))
+
+        for desc, toks in prefills:
+            T = len(toks)
+            self._ensure_blocks(desc, T)
+            tpad = max(bs, _bucket(T, minimum=bs))
+            tpad = -(-tpad // bs) * bs
+            nblk_pad = tpad // bs
+            ids = np.zeros((1, tpad), np.int32)
+            ids[0, :T] = toks
+            btable = np.full((nblk_pad,), self._scratch, np.int32)
+            btable[:len(desc.blocks)] = desc.blocks[:nblk_pad]
+            fn = self._paged_prefill_fn(tpad)
+            self.cache, logits = fn(self.params, self.cache, ids,
+                                    np.array([T], np.int32), btable)
+            desc.seen_tokens = T
+            desc.last_logits = np.asarray(logits[0])
+
+        # multi-token extension = repeated batched single-token decode
+        # (chunked-prefill analog; reference schedules these as ragged atoms)
+        while any(toks for _, toks in extends):
+            batch = [(d, toks.pop(0)) for d, toks in extends if toks]
+            for d, _ in batch:
+                self._ensure_blocks(d, d.seen_tokens + 1)
+            B = _bucket(len(batch), minimum=1)
+            tok = np.zeros((B,), np.int32)
+            pos = np.zeros((B,), np.int32)
+            tables = np.full((B, self._max_blocks), self._scratch, np.int32)
+            for i, (d, t) in enumerate(batch):
+                tok[i], pos[i] = t, d.seen_tokens
+                tables[i] = self._table(d)
+            fn = self._paged_decode_fn(B)
+            self.cache, logits = fn(self.params, self.cache, tok, pos, tables)
+            logits = np.asarray(logits)
+            for i, (d, _) in enumerate(batch):
+                d.seen_tokens += 1
+                d.last_logits = logits[i]
+
+        return np.stack([self._seqs[uid].last_logits for uid in uids])
+
+    def flush(self, uids: Sequence[int]) -> None:
+        """Free all state for finished sequences (engine_v2.py:242)."""
+        for uid in uids:
+            desc = self._seqs.pop(uid, None)
+            if desc is None:
+                raise ValueError(f"unknown uid {uid}")
+            self.allocator.free(desc.blocks)

@@ -1,0 +1,132 @@
+"""Paged KV cache: block allocator + block-table attention.
+
+Capability analog of the reference v2 ragged stack:
+  - ``BlockedAllocator`` (ragged/blocked_allocator.py:11) — host-side
+    free-list of KV blocks.
+  - ``BlockedKVCache`` (ragged/kv_cache.py:40) — here ``PagedKVCache``:
+    per-layer-stacked block pool [L, nblocks, block, KV, Dh] on device.
+  - ``blocked_flash`` + ``atom_builder`` + ``linear_blocked_kv_rotary``
+    (inference/v2/kernels/ragged_ops/) — here ``paged_decode_attention``
+    (gather-by-block-table attention; the Pallas kernel variant lives in
+    ops/paged_attention.py and is dispatched when on TPU).
+
+TPU-first notes: block tables are static-shape int32 arrays padded with -1;
+gathers/scatters are XLA ops inside jit, so a whole decode step (append +
+attention over all layers) is one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over ``num_blocks`` KV blocks (host side).
+
+    Mirrors ragged/blocked_allocator.py:11 (allocate/free with a linked
+    free-list); numpy-free python deque is plenty at host rates.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError("num_blocks must be positive")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"out of KV blocks: want {n}, have {len(self._free)}")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if not (0 <= b < self.num_blocks):
+                raise ValueError(f"bad block id {b}")
+        self._free.extend(blocks)
+        assert len(self._free) <= self.num_blocks, "double free"
+
+
+class PagedKVCache(NamedTuple):
+    """Device block pool. k/v: [L, num_blocks, block_size, KV, Dh]."""
+
+    k: "object"
+    v: "object"
+
+    @classmethod
+    def create(cls, n_layers: int, num_blocks: int, block_size: int,
+               kv_heads: int, head_dim: int, dtype) -> "PagedKVCache":
+        import jax.numpy as jnp
+
+        shape = (n_layers, num_blocks, block_size, kv_heads, head_dim)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def blocks_needed(n_tokens: int, block_size: int) -> int:
+    return max(1, -(-n_tokens // block_size))
+
+
+def gather_kv(ck, cv, block_table):
+    """ck/cv [nblk, bs, KV, Dh] (one layer), block_table [B, maxblk] (-1 pad)
+    -> k/v [B, maxblk*bs, KV, Dh]. Padding rows gather block 0; callers mask
+    by seq length so the junk never contributes."""
+    import jax.numpy as jnp
+
+    bt = jnp.maximum(block_table, 0)
+    B, M = bt.shape
+    k = jnp.take(ck, bt.reshape(-1), axis=0).reshape(B, M * ck.shape[1], *ck.shape[2:])
+    v = jnp.take(cv, bt.reshape(-1), axis=0).reshape(B, M * cv.shape[1], *cv.shape[2:])
+    return k, v
+
+
+def append_token_kv(ck, cv, newk, newv, block_table, pos):
+    """Scatter one new token's K/V per sequence into the block pool.
+
+    ck/cv [nblk, bs, KV, Dh]; newk/newv [B, KV, Dh]; block_table [B, maxblk];
+    pos [B] = token index within the sequence (the slot being written).
+    Reference: linear_blocked_kv_rotary's KV append half.
+    """
+    import jax.numpy as jnp
+
+    bs = ck.shape[1]
+    blk = jnp.take_along_axis(jnp.maximum(block_table, 0), (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs
+    ck = ck.at[blk, off].set(newk.astype(ck.dtype))
+    cv = cv.at[blk, off].set(newv.astype(cv.dtype))
+    return ck, cv
+
+
+def write_prefill_kv(ck, cv, ks, vs, block_table):
+    """Write a whole prompt's K/V (one sequence) into its blocks.
+
+    ck/cv [nblk, bs, KV, Dh]; ks/vs [Tpad, KV, Dh] with Tpad == nseq_blocks*bs
+    (caller pads); block_table [nseq_blocks] real ids.
+    """
+    bs = ck.shape[1]
+    n = block_table.shape[0]
+    ck = ck.at[block_table].set(ks.reshape(n, bs, *ks.shape[1:]).astype(ck.dtype))
+    cv = cv.at[block_table].set(vs.reshape(n, bs, *vs.shape[1:]).astype(cv.dtype))
+    return ck, cv
+
+
+def paged_decode_attention(q, ck, cv, block_table, kv_len):
+    """q [B,1,H,Dh] against paged KV (one layer) [nblk,bs,KV,Dh].
+
+    Gather-by-block-table, then the shared dense decode attention (the
+    reference's blocked_flash CUDA kernel equivalent; a fused Pallas variant
+    that skips the materialized gather is the optimization path).
+    """
+    from .engine import decode_attention
+
+    k, v = gather_kv(ck, cv, block_table)              # [B, S, KV, Dh]
+    return decode_attention(q, k, v, kv_len)

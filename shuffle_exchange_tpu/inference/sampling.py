@@ -1,0 +1,51 @@
+"""Token sampling inside jit (reference leaves sampling to the host caller;
+``inference/v2/engine_v2.py:107`` returns logits — we additionally provide
+fused on-device sampling so the decode loop never leaves the chip).
+
+All samplers take fp32 logits [B, V] and return int32 tokens [B].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def greedy(logits):
+    import jax.numpy as jnp
+
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample(logits, rng, temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+    """Temperature / top-k / top-p (nucleus) sampling.
+
+    ``top_k`` is static (compiled in); temperature and top_p are traced.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    logits = logits.astype(jnp.float32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    neg = jnp.finfo(jnp.float32).min
+
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+
+    # nucleus: keep the smallest prefix of the sorted distribution with
+    # cumulative prob >= top_p (always keep the argmax).
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p                     # first token always kept
+    cutoff = jnp.where(keep, sorted_logits, jnp.inf).min(axis=-1, keepdims=True)
+    logits = jnp.where(logits < cutoff, neg, logits)
+
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_or_greedy(logits, rng, temperature: float, top_k: int = 0, top_p: float = 1.0):
+    """Static dispatch: temperature == 0 (python float) means greedy."""
+    if temperature == 0.0:
+        return greedy(logits)
+    return sample(logits, rng, temperature=temperature, top_k=top_k, top_p=top_p)

@@ -5,7 +5,9 @@ from .transformer import (
     gpt2_large,
     llama3_8b,
     llama3_70b,
+    mixtral_8x7b,
     tiny,
+    tiny_moe,
 )
 
 MODEL_REGISTRY = {
@@ -13,7 +15,9 @@ MODEL_REGISTRY = {
     "gpt2-large": gpt2_large,
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
+    "mixtral-8x7b": mixtral_8x7b,
     "tiny": tiny,
+    "tiny-moe": tiny_moe,
 }
 
 

@@ -134,3 +134,15 @@ def test_bfloat16_legacy_section_name():
 def test_grad_accum_dtype_validated():
     with pytest.raises(ConfigError, match="grad_accum_dtype"):
         SXConfig.load({"train_batch_size": 8, "data_types": {"grad_accum_dtype": "float64"}}, world_size=1)
+
+
+def test_conflicting_parallelism_knobs_rejected():
+    with pytest.raises(ConfigError, match="conflicting parallelism"):
+        SXConfig.load({"train_batch_size": 8, "pipeline": {"stages": 4},
+                       "mesh": {"pipe": 2, "data": -1}}, world_size=8)
+
+
+def test_agreeing_parallelism_knobs_ok():
+    cfg = SXConfig.load({"train_batch_size": 8, "pipeline": {"stages": 2},
+                         "mesh": {"pipe": 2, "data": -1}}, world_size=8)
+    assert cfg.mesh.pipe == 2
