@@ -203,7 +203,7 @@ class InferenceEngine:
 
         def layer_fn(h, lw):
             def attn_fn(q, k, v):
-                return flash_attention(q, k, v, causal=True, impl=cfg.attention_impl), (k, v)
+                return flash_attention(q, k, v, causal=True, impl=self.config.attention_impl), (k, v)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
 
@@ -281,6 +281,9 @@ class InferenceEngine:
         cfg = self.config
         ids = np.asarray(input_ids, dtype=np.int32)
         B, T = ids.shape
+        if B > cfg.max_batch_size:
+            raise ValueError(f"batch {B} exceeds max_batch_size {cfg.max_batch_size} "
+                             "(raise it in the inference config)")
         if prompt_lengths is None:
             prompt_lengths = np.full((B,), T, dtype=np.int32)
         prompt_lengths = np.asarray(prompt_lengths, dtype=np.int32)

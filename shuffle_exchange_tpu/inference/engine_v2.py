@@ -114,7 +114,8 @@ class InferenceEngineV2(InferenceEngine):
 
             def attn_fn(q, k, v):
                 ck2, cv2 = write_prefill_kv(ck, cv, k[0], v[0], btable)
-                return flash_attention(q, k, v, causal=True, impl=mcfg.attention_impl), (ck2, cv2)
+                return flash_attention(q, k, v, causal=True,
+                                       impl=self.config.attention_impl), (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
 
@@ -173,6 +174,9 @@ class InferenceEngineV2(InferenceEngine):
 
         if len(uids) != len(tokens):
             raise ValueError("uids and tokens must align")
+        if len(set(uids)) != len(uids):
+            raise ValueError("duplicate uid in one put() batch: a sequence can "
+                             "advance at most one decode position per engine step")
         if not self.can_schedule(uids, [len(t) for t in tokens]):
             raise RuntimeError("cannot schedule batch: KV pool exhausted or length cap hit "
                                "(check query()/free_blocks, flush finished sequences)")
@@ -195,7 +199,7 @@ class InferenceEngineV2(InferenceEngine):
             T = len(toks)
             self._ensure_blocks(desc, T)
             tpad = max(bs, _bucket(T, minimum=bs))
-            tpad = -(-tpad // bs) * bs
+            tpad = min(-(-tpad // bs) * bs, self.config.max_seq_len)
             nblk_pad = tpad // bs
             ids = np.zeros((1, tpad), np.int32)
             ids[0, :T] = toks
@@ -211,6 +215,9 @@ class InferenceEngineV2(InferenceEngine):
         # (chunked-prefill analog; reference schedules these as ragged atoms)
         while any(toks for _, toks in extends):
             batch = [(d, toks.pop(0)) for d, toks in extends if toks]
+            if len(batch) > self.config.max_batch_size:
+                raise ValueError(f"decode batch {len(batch)} exceeds max_batch_size "
+                                 f"{self.config.max_batch_size} (raise it in the inference config)")
             for d, _ in batch:
                 self._ensure_blocks(d, d.seen_tokens + 1)
             B = _bucket(len(batch), minimum=1)

@@ -37,7 +37,8 @@ def sample(logits, rng, temperature: float = 1.0, top_k: int = 0, top_p: float =
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    keep = cum - probs < top_p                     # first token always kept
+    # first token always kept, even at top_p == 0 (cum - probs == 0 there)
+    keep = cum - probs < jnp.maximum(top_p, 1e-6)
     cutoff = jnp.where(keep, sorted_logits, jnp.inf).min(axis=-1, keepdims=True)
     logits = jnp.where(logits < cutoff, neg, logits)
 

@@ -1,0 +1,5 @@
+"""Experiment monitoring fan-out (reference ``monitor/monitor.py:13,30``)."""
+
+from .monitor import Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor, CSVMonitor
+
+__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "CSVMonitor"]

@@ -1,0 +1,133 @@
+"""Metric monitors: TensorBoard / Weights&Biases / CSV with a fan-out master.
+
+Capability analog of the reference's ``Monitor`` ABC + ``MonitorMaster``
+(``monitor/monitor.py:13,30``; backends ``monitor/tensorboard.py``,
+``monitor/wandb.py``, ``monitor/csv_monitor.py``; config
+``monitor/config.py:125``). Events are ``(label, value, step)`` tuples —
+the exact reference event shape — and only the rank-0 process writes
+(reference gates on ``dist.get_rank()``; here ``jax.process_index()`` via
+the comm facade).
+
+Backends whose packages are missing degrade to disabled with a log line —
+the framework never hard-depends on tensorboard/wandb.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+from abc import ABC, abstractmethod
+from typing import Any, List, Sequence, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+
+def _rank() -> int:
+    from ..parallel import comm
+
+    return comm.get_rank()
+
+
+class Monitor(ABC):
+    """One metrics sink (reference monitor/monitor.py:13)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+
+    @abstractmethod
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        ...
+
+
+class TensorBoardMonitor(Monitor):
+    """SummaryWriter sink (reference monitor/tensorboard.py)."""
+
+    def __init__(self, config):
+        super().__init__(enabled=config.enabled and _rank() == 0)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception as e:  # tensorboard not installed
+            logger.warning("TensorBoard monitor disabled (import failed: %s)", e)
+            self.enabled = False
+            return
+        log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+        os.makedirs(log_dir, exist_ok=True)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self.summary_writer.add_scalar(label, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """Weights&Biases sink (reference monitor/wandb.py)."""
+
+    def __init__(self, config):
+        super().__init__(enabled=config.enabled and _rank() == 0)
+        self._wandb = None
+        if not self.enabled:
+            return
+        try:
+            import wandb
+        except Exception as e:
+            logger.warning("W&B monitor disabled (import failed: %s)", e)
+            self.enabled = False
+            return
+        self._wandb = wandb
+        wandb.init(project=config.project, group=config.group, entity=config.team)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self._wandb.log({label: float(value)}, step=int(step))
+
+
+class CSVMonitor(Monitor):
+    """One CSV file per metric label (reference monitor/csv_monitor.py)."""
+
+    def __init__(self, config):
+        super().__init__(enabled=config.enabled and _rank() == 0)
+        if not self.enabled:
+            return
+        self.log_dir = os.path.join(config.output_path or "./csv_logs", config.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            fname = os.path.join(self.log_dir, label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = _csv.writer(f)
+                if new:
+                    w.writerow(["step", label])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled backend (reference monitor/monitor.py:30)."""
+
+    def __init__(self, monitor_config):
+        super().__init__(enabled=True)
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.csv_monitor = CSVMonitor(monitor_config.csv_monitor)
+        self._sinks: List[Monitor] = [m for m in
+                                      (self.tb_monitor, self.wandb_monitor, self.csv_monitor)
+                                      if m.enabled]
+        self.enabled = bool(self._sinks)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        for sink in self._sinks:
+            sink.write_events(event_list)

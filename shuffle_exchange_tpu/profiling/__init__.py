@@ -1,0 +1,9 @@
+"""Profiling: flops profiler (reference ``profiling/flops_profiler/``)."""
+
+from .flops_profiler import (FlopsProfiler, compiled_flops, count_params,
+                             flops_to_string, get_model_profile, number_to_string,
+                             params_breakdown, params_to_string)
+
+__all__ = ["FlopsProfiler", "compiled_flops", "count_params", "flops_to_string",
+           "get_model_profile", "number_to_string", "params_breakdown",
+           "params_to_string"]

@@ -1,0 +1,166 @@
+"""Flops profiler — XLA cost analysis instead of op monkeypatching.
+
+Capability analog of the reference ``FlopsProfiler``
+(``profiling/flops_profiler/profiler.py:30``), which patches
+``torch.nn.functional`` to count MACs per module and times each module on
+device. On TPU the compiler already knows the exact flop count of the
+compiled program (``Compiled.cost_analysis()``), so:
+
+  - program flops come from XLA cost analysis of the jitted step — this is
+    the *post-fusion* truth, not an analytic estimate;
+  - parameter counts/breakdowns come from the params pytree;
+  - latency comes from wall-clock around a synchronized step.
+
+Per-module latency does not exist under one fused program (that's the
+point of XLA); the per-subtree *parameter* breakdown plus whole-program
+flops/TFLOPS replaces the reference's module tree. The standalone
+``get_model_profile`` mirrors ``profiling/flops_profiler/profiler.py``'s
+API of the same name.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..utils.logging import log_dist, logger
+
+
+# -- formatting helpers (reference profiler.py number/flops/params_to_string) --
+
+def number_to_string(num: float, units: Optional[str] = None, precision: int = 2) -> str:
+    if units is None:
+        for cut, u in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+            if abs(num) >= cut:
+                return f"{num / cut:.{precision}f} {u}"
+        return f"{num:.{precision}f}"
+    scale = {"T": 1e12, "G": 1e9, "M": 1e6, "K": 1e3, "": 1.0}[units]
+    return f"{num / scale:.{precision}f} {units}"
+
+
+def flops_to_string(flops: float, units: Optional[str] = None, precision: int = 2) -> str:
+    return number_to_string(flops, units, precision) + "FLOPS"
+
+
+def params_to_string(n: float, units: Optional[str] = None, precision: int = 2) -> str:
+    return number_to_string(n, units, precision)
+
+
+# -- counting ---------------------------------------------------------------
+
+def count_params(params) -> int:
+    import jax
+
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def params_breakdown(params, depth: int = 1) -> Dict[str, int]:
+    """Per-subtree parameter counts down to ``depth`` path segments."""
+    import jax
+
+    out: Dict[str, int] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        keys = []
+        for p in path:
+            keys.append(str(getattr(p, "key", getattr(p, "idx", p))))
+        label = "/".join(keys[:depth]) if keys else "<root>"
+        out[label] = out.get(label, 0) + int(leaf.size)
+    return out
+
+
+def compiled_flops(fn: Callable, *args, **kwargs) -> float:
+    """Post-fusion flop count of ``jit(fn)(*args)`` from XLA cost analysis.
+
+    ``fn`` may already be a jit-wrapped callable (it is lowered AOT either
+    way). Returns 0.0 if the backend exposes no cost model.
+    """
+    import jax
+
+    lowered = (fn if hasattr(fn, "lower") else jax.jit(fn)).lower(*args, **kwargs)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):  # some jax versions: one dict per device program
+        cost = cost[0] if cost else {}
+    return float((cost or {}).get("flops", 0.0))
+
+
+def get_model_profile(model=None, params=None, batch=None, fn: Optional[Callable] = None,
+                      args: Tuple = (), as_string: bool = False, print_profile: bool = False,
+                      output_file: Optional[str] = None):
+    """(flops, macs, params) for one forward pass — reference
+    ``get_model_profile`` (profiler.py). Either pass ``model``+``params``+
+    ``batch`` (our model zoo: profiles ``model.apply``) or an explicit
+    ``fn``+``args``.
+    """
+    import jax
+
+    if fn is None:
+        if model is None or params is None or batch is None:
+            raise ValueError("get_model_profile needs (model, params, batch) or (fn, args)")
+        fn, args = model.apply, (params, batch["input_ids"] if isinstance(batch, dict) else batch)
+        n_params = count_params(params)
+    else:
+        n_params = count_params(args[0]) if args else 0
+    flops = compiled_flops(fn, *args)
+    macs = flops / 2.0
+    if print_profile or output_file:
+        text = (f"fwd flops: {flops_to_string(flops)}  macs: {number_to_string(macs)}MACs  "
+                f"params: {params_to_string(n_params)}")
+        if output_file:
+            with open(output_file, "a") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+    if as_string:
+        return flops_to_string(flops), number_to_string(macs) + "MACs", params_to_string(n_params)
+    return flops, macs, n_params
+
+
+class FlopsProfiler:
+    """Train-step profiler driven by the engine at ``profile_step``
+    (reference engine auto-run ``runtime/engine.py:320-321,2480-2492``).
+
+    ``profile(fn, args, latency_s, batch_size)`` computes whole-program
+    flops, prints the summary table, and returns a dict of the numbers.
+    """
+
+    def __init__(self, config, params=None):
+        self.config = config
+        self.params = params
+
+    def profile(self, fn: Callable, args: Tuple, latency_s: float,
+                batch_size: Optional[int] = None) -> Dict[str, Any]:
+        flops = compiled_flops(fn, *args)
+        n_params = count_params(self.params) if self.params is not None else 0
+        tflops = flops / latency_s / 1e12 if latency_s > 0 else 0.0
+        out = {
+            "flops": flops,
+            "params": n_params,
+            "latency_s": latency_s,
+            "tflops_per_step": tflops,
+            "samples_per_s": (batch_size / latency_s) if (batch_size and latency_s > 0) else None,
+        }
+        lines = [
+            "-------------------------- Flops Profiler --------------------------",
+            f"params:                 {params_to_string(n_params)}",
+            f"step flops (post-XLA):  {flops_to_string(flops)}",
+            f"step latency:           {latency_s * 1e3:.2f} ms",
+            f"achieved:               {tflops:.2f} TFLOPS",
+        ]
+        if out["samples_per_s"] is not None:
+            lines.append(f"throughput:             {out['samples_per_s']:.2f} samples/s")
+        if self.params is not None and self.config.detailed:
+            depth = self.config.module_depth if self.config.module_depth > 0 else 2
+            lines.append("param breakdown:")
+            top = sorted(params_breakdown(self.params, depth).items(),
+                         key=lambda kv: -kv[1])
+            for name, n in top[:self.config.top_modules]:
+                lines.append(f"  {name:<30} {params_to_string(n)}")
+        lines.append("---------------------------------------------------------------------")
+        text = "\n".join(lines)
+        if self.config.output_file:
+            with open(self.config.output_file, "a") as f:
+                f.write(text + "\n")
+        else:
+            log_dist(text, ranks=[0])
+        return out

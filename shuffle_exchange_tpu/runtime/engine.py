@@ -233,7 +233,17 @@ class Engine:
         self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
         self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size,
                                           steps_per_output=config.steps_per_print)
-        self.monitor = None  # attached by initialize() once monitor package lands
+        # monitor fan-out (reference monitor/monitor.py:30 MonitorMaster;
+        # engine event writes runtime/engine.py:2200-2208)
+        from ..monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(config)
+        # flops profiler auto-run (reference runtime/engine.py:320-321)
+        self.flops_profiler = None
+        if config.flops_profiler.enabled:
+            from ..profiling import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(config.flops_profiler, params=self.state.master)
 
         # --- data -------------------------------------------------------
         self.training_dataloader = None
@@ -454,9 +464,31 @@ class Engine:
         self.timers(TRAIN_BATCH_TIMER).start()
         shaped = self._reshape_batch(batch)
         mix = self._mix_matrix(advance=True)
-        self.state, loss, overflow, grad_norm = self._train_step(self.state, shaped, mix, self._next_rng())
+        rng = self._next_rng()
+        profiling = (self.flops_profiler is not None
+                     and self.global_steps + 1 == self.config.flops_profiler.profile_step)
+        if profiling and self.global_steps == 0:
+            logger.warning(
+                "flops_profiler: profile_step=1 measures the first step, whose wall clock "
+                "includes XLA compilation — set profile_step>=2 for steady-state TFLOPS")
+        t0 = time.time() if profiling else 0.0
+        self.state, loss, overflow, grad_norm = self._train_step(self.state, shaped, mix, rng)
+        if profiling:
+            import jax
+
+            jax.block_until_ready(loss)
+            self.flops_profiler.profile(self._train_step, (self.state, shaped, mix, rng),
+                                        latency_s=time.time() - t0,
+                                        batch_size=self.config.train_batch_size)
         self._last_grad_norm = grad_norm
         self._post_step(overflow)
+        if self.monitor.enabled:
+            s = self.global_samples
+            self.monitor.write_events([
+                ("Train/Samples/train_loss", float(loss), s),
+                ("Train/Samples/lr", self.get_lr(), s),
+                ("Train/Samples/loss_scale", self.loss_scale(), s),
+            ])
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         return loss

@@ -225,6 +225,12 @@ class TestV2Paged:
         with pytest.raises(ValueError):
             eng.flush([9])
 
+    def test_duplicate_uid_rejected(self):
+        model, params, eng = self._engine()
+        eng.put([5], [[1, 2, 3]])
+        with pytest.raises(ValueError, match="duplicate uid"):
+            eng.put([5, 5], [[4], [5]])
+
     def test_admission_control(self):
         model, params, eng = self._engine()
         # 11 usable blocks (1 scratch), block 16, max_seq 64

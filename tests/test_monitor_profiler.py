@@ -1,0 +1,86 @@
+"""Monitor fan-out + flops profiler tests (reference monitor/monitor.py,
+profiling/flops_profiler/profiler.py capability)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import shuffle_exchange_tpu as sxt
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.monitor import CSVMonitor, MonitorMaster
+from shuffle_exchange_tpu.parallel import reset_topology
+from shuffle_exchange_tpu.profiling import (compiled_flops, count_params,
+                                            flops_to_string, get_model_profile,
+                                            number_to_string, params_breakdown)
+
+
+class _CSVCfg:
+    enabled = True
+    output_path = ""
+    job_name = "job"
+
+
+def test_csv_monitor_writes_per_label_files(tmp_path):
+    cfg = _CSVCfg()
+    cfg.output_path = str(tmp_path)
+    mon = CSVMonitor(cfg)
+    mon.write_events([("Train/loss", 1.5, 10), ("Train/lr", 0.1, 10)])
+    mon.write_events([("Train/loss", 1.2, 20)])
+    loss_file = tmp_path / "job" / "Train_loss.csv"
+    assert loss_file.exists()
+    lines = loss_file.read_text().strip().splitlines()
+    assert lines[0] == "step,Train/loss" and len(lines) == 3
+    assert lines[2].startswith("20,")
+
+
+def test_formatting_helpers():
+    assert number_to_string(1.5e12) == "1.50 T"
+    assert number_to_string(2_000_000) == "2.00 M"
+    assert flops_to_string(3e9) == "3.00 GFLOPS"
+
+
+def test_count_and_breakdown():
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=4, seq=32))
+    params = model.init(jax.random.PRNGKey(0))
+    n = count_params(params)
+    bd = params_breakdown(params, depth=1)
+    assert n == sum(bd.values()) and bd["layers"] > 0 and n > 0
+
+
+def test_get_model_profile():
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=4, seq=32))
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {"input_ids": np.zeros((2, 16), np.int32)}
+    flops, macs, n_params = get_model_profile(model=model, params=params, batch=batch)
+    assert flops > 0 and macs == flops / 2 and n_params == count_params(params)
+    s_flops, s_macs, s_params = get_model_profile(model=model, params=params, batch=batch,
+                                                  as_string=True)
+    assert "FLOPS" in s_flops
+
+
+def test_engine_monitor_and_profiler_integration(tmp_path):
+    reset_topology()
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=4, seq=32))
+    prof_file = str(tmp_path / "prof.txt")
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path), "job_name": "t"},
+        "flops_profiler": {"enabled": True, "profile_step": 2, "detailed": True,
+                           "output_file": prof_file},
+    })
+    assert engine.monitor.enabled
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, size=(8, 32)).astype(np.int32)}
+    for _ in range(2):
+        loss = engine.train_batch(batch)
+    assert np.isfinite(float(loss))
+    csv_dir = tmp_path / "t"
+    assert (csv_dir / "Train_Samples_train_loss.csv").exists()
+    assert (csv_dir / "Train_Samples_lr.csv").exists()
+    text = open(prof_file).read()
+    assert "Flops Profiler" in text and "achieved:" in text and "params:" in text
