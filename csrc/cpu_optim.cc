@@ -1,0 +1,137 @@
+/* CPU fused optimizers for the host-offload path.
+ *
+ * Capability parity with the reference's AVX CPU optimizers
+ * (CPUAdamBuilder / CPUAdagradBuilder / CPULionBuilder, SURVEY.md §2.13;
+ * call sites ops/adam/cpu_adam.py:10) used when optimizer state is offloaded
+ * to host memory: the step runs on the host over flat fp32 state while the
+ * device keeps only the bit16 working copy.  Loops are written scalar and
+ * auto-vectorized (-O3 -march=native) with OpenMP over chunks; each loop
+ * optionally emits the updated parameters as bfloat16 in the same pass so
+ * the host→device transfer needs no second sweep.
+ */
+#include "sxt_native.h"
+
+#include <cmath>
+#include <cstring>
+
+namespace {
+
+/* Round-to-nearest-even fp32 -> bf16, matching XLA/JAX semantics. */
+inline uint16_t to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, sizeof(bits));
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+void sxt_adam_step(float *param, float *exp_avg, float *exp_avg_sq,
+                   const float *grad, size_t n, float lr, float beta1,
+                   float beta2, float eps, float weight_decay, int step,
+                   int adamw, int bias_correction, uint16_t *bf16_out) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+    bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  }
+  const float step_size = lr / bc1;
+  const float inv_sqrt_bc2 = 1.0f / std::sqrt(bc2);
+#pragma omp parallel for simd schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    float p = param[i];
+    if (!adamw && weight_decay != 0.0f) g += weight_decay * p; /* L2 grad */
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_sqrt_bc2 + eps;
+    if (adamw && weight_decay != 0.0f) p -= lr * weight_decay * p;
+    p -= step_size * m / denom;
+    param[i] = p;
+    if (bf16_out) bf16_out[i] = to_bf16(p);
+  }
+}
+
+void sxt_adagrad_step(float *param, float *exp_avg_sq, const float *grad,
+                      size_t n, float lr, float eps, float weight_decay,
+                      uint16_t *bf16_out) {
+#pragma omp parallel for simd schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    float p = param[i];
+    if (weight_decay != 0.0f) g += weight_decay * p;
+    float v = exp_avg_sq[i] + g * g;
+    exp_avg_sq[i] = v;
+    p -= lr * g / (std::sqrt(v) + eps);
+    param[i] = p;
+    if (bf16_out) bf16_out[i] = to_bf16(p);
+  }
+}
+
+void sxt_lion_step(float *param, float *exp_avg, const float *grad, size_t n,
+                   float lr, float beta1, float beta2, float weight_decay,
+                   uint16_t *bf16_out) {
+#pragma omp parallel for simd schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    float p = param[i];
+    float m = exp_avg[i];
+    float update = beta1 * m + (1.0f - beta1) * g;
+    float sign = (update > 0.0f) ? 1.0f : ((update < 0.0f) ? -1.0f : 0.0f);
+    if (weight_decay != 0.0f) p -= lr * weight_decay * p;
+    p -= lr * sign;
+    exp_avg[i] = beta2 * m + (1.0f - beta2) * g;
+    param[i] = p;
+    if (bf16_out) bf16_out[i] = to_bf16(p);
+  }
+}
+
+void sxt_lamb_step(float *param, float *exp_avg, float *exp_avg_sq,
+                   const float *grad, size_t n, float lr, float beta1,
+                   float beta2, float eps, float weight_decay, int step,
+                   int bias_correction, uint16_t *bf16_out) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+    bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  }
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_sqrt_bc2 = 1.0f / std::sqrt(bc2);
+  /* Pass 1: moments + raw update, accumulating ||param|| and ||update||. */
+  double p_sq = 0.0, u_sq = 0.0;
+#pragma omp parallel for reduction(+ : p_sq, u_sq) schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    float p = param[i];
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float u = (m * inv_bc1) / (std::sqrt(v) * inv_sqrt_bc2 + eps) +
+              weight_decay * p;
+    p_sq += static_cast<double>(p) * p;
+    u_sq += static_cast<double>(u) * u;
+  }
+  float p_norm = static_cast<float>(std::sqrt(p_sq));
+  float u_norm = static_cast<float>(std::sqrt(u_sq));
+  float trust = (p_norm > 0.0f && u_norm > 0.0f) ? p_norm / u_norm : 1.0f;
+  const float scaled_lr = lr * trust;
+  /* Pass 2: apply (recompute u from the stored moments; avoids an n-sized
+   * scratch buffer, which matters when offloading billions of params). */
+#pragma omp parallel for simd schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float p = param[i];
+    float u = (exp_avg[i] * inv_bc1) /
+                  (std::sqrt(exp_avg_sq[i]) * inv_sqrt_bc2 + eps) +
+              weight_decay * p;
+    p -= scaled_lr * u;
+    param[i] = p;
+    if (bf16_out) bf16_out[i] = to_bf16(p);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+/* 1-bit sign packing for compressed collectives.
+ *
+ * The reference packs sign bits on-device (PackbitsBuilder, SURVEY.md
+ * §2.13; used by runtime/comm/compressed.py's CompressedBackend for 1-bit
+ * Adam/LAMB allreduce).  On TPU the in-jit compression path is jnp/Pallas;
+ * this host version serves the host-offload and multi-host DCN aggregation
+ * paths where packing happens on CPU before the wire.
+ */
+#include "sxt_native.h"
+
+extern "C" {
+
+size_t sxt_packbits(const float *x, uint8_t *out, size_t n) {
+  size_t nbytes = (n + 7) / 8;
+  size_t full = n / 8;
+#pragma omp parallel for schedule(static)
+  for (size_t b = 0; b < full; ++b) {
+    const float *p = x + b * 8;
+    uint8_t byte = 0;
+    for (int j = 0; j < 8; ++j) byte |= static_cast<uint8_t>(p[j] >= 0.0f) << j;
+    out[b] = byte;
+  }
+  if (full < nbytes) {
+    uint8_t byte = 0;
+    for (size_t j = full * 8; j < n; ++j)
+      byte |= static_cast<uint8_t>(x[j] >= 0.0f) << (j - full * 8);
+    out[full] = byte;
+  }
+  return nbytes;
+}
+
+void sxt_unpackbits(const uint8_t *in, float *out, size_t n, float scale) {
+#pragma omp parallel for schedule(static)
+  for (size_t i = 0; i < n; ++i)
+    out[i] = ((in[i / 8] >> (i % 8)) & 1) ? scale : -scale;
+}
+
+int sxt_native_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,99 @@
+/* C API of the framework's native runtime library (libsxt_native.so).
+ *
+ * Capability parity with the reference's native extension set (SURVEY.md
+ * §2.13): the async NVMe/disk IO engine (AsyncIOBuilder / deepspeed
+ * ops/aio + runtime/swap_tensor), the AVX CPU fused optimizers for the
+ * host-offload path (CPUAdamBuilder / CPUAdagradBuilder / CPULionBuilder),
+ * and the 1-bit sign packing used by compressed collectives
+ * (PackbitsBuilder).  The design is our own: a C-linkage surface loaded via
+ * ctypes (no pybind11 in this image), thread-pool IO instead of libaio, and
+ * flat fp32 state arrays matching the TPU engine's flat host-offload
+ * layout.
+ */
+#ifndef SXT_NATIVE_H
+#define SXT_NATIVE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ------------------------------------------------------------------ */
+/* Async IO engine                                                     */
+/* ------------------------------------------------------------------ */
+
+/* Create an IO engine with `num_threads` worker threads.  When
+ * `use_odirect` != 0 files are opened with O_DIRECT (buffers and offsets
+ * must then be 4096-aligned; sxt_aligned_alloc provides such buffers). */
+void *sxt_aio_create(int num_threads, int use_odirect);
+void sxt_aio_destroy(void *engine);
+
+/* Submit a read/write of `nbytes` at `offset` in `path`.  Returns a
+ * request id >= 0, or -1 on submit failure.  Writes create/extend the file. */
+int64_t sxt_aio_submit_read(void *engine, const char *path, void *buf,
+                            size_t nbytes, size_t offset);
+int64_t sxt_aio_submit_write(void *engine, const char *path, const void *buf,
+                             size_t nbytes, size_t offset);
+
+/* Block until request `req` completes; returns bytes transferred or
+ * -errno.  sxt_aio_wait_all returns 0 if every outstanding request
+ * succeeded, else the first negative error. */
+int64_t sxt_aio_wait(void *engine, int64_t req);
+int64_t sxt_aio_wait_all(void *engine);
+
+/* Nonblocking: 1 if complete, 0 if pending, -1 if unknown id. */
+int sxt_aio_poll(void *engine, int64_t req);
+
+/* Aligned host buffers (O_DIRECT-compatible; also the pinned-buffer analog
+ * of the reference's fast_host_buffer). */
+void *sxt_aligned_alloc(size_t nbytes, size_t alignment);
+void sxt_aligned_free(void *p);
+
+/* ------------------------------------------------------------------ */
+/* CPU fused optimizers (host-offload path)                            */
+/* ------------------------------------------------------------------ */
+
+/* Fused Adam/AdamW over flat fp32 arrays.  `step` is 1-based.  When
+ * `bf16_out` is non-NULL the updated parameters are also written as
+ * round-to-nearest-even bfloat16 (the bit16 working copy the device will
+ * consume).  adamw != 0 selects decoupled weight decay. */
+void sxt_adam_step(float *param, float *exp_avg, float *exp_avg_sq,
+                   const float *grad, size_t n, float lr, float beta1,
+                   float beta2, float eps, float weight_decay, int step,
+                   int adamw, int bias_correction, uint16_t *bf16_out);
+
+void sxt_adagrad_step(float *param, float *exp_avg_sq, const float *grad,
+                      size_t n, float lr, float eps, float weight_decay,
+                      uint16_t *bf16_out);
+
+void sxt_lion_step(float *param, float *exp_avg, const float *grad, size_t n,
+                   float lr, float beta1, float beta2, float weight_decay,
+                   uint16_t *bf16_out);
+
+/* LAMB: two-pass (update norm + param norm, then trust-ratio apply). */
+void sxt_lamb_step(float *param, float *exp_avg, float *exp_avg_sq,
+                   const float *grad, size_t n, float lr, float beta1,
+                   float beta2, float eps, float weight_decay, int step,
+                   int bias_correction, uint16_t *bf16_out);
+
+/* ------------------------------------------------------------------ */
+/* 1-bit sign packing (compressed collectives)                         */
+/* ------------------------------------------------------------------ */
+
+/* Pack sign bits of x[0..n) into out (ceil(n/8) bytes, LSB-first;
+ * bit=1 means x>=0).  Returns the number of bytes written. */
+size_t sxt_packbits(const float *x, uint8_t *out, size_t n);
+
+/* Unpack: out[i] = bit ? +scale : -scale. */
+void sxt_unpackbits(const uint8_t *in, float *out, size_t n, float scale);
+
+/* ABI/version probe for the Python loader. */
+int sxt_native_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SXT_NATIVE_H */

@@ -183,6 +183,7 @@ class InferenceEngineV2(InferenceEngine):
         bs = self.cache.block_size
         prefills: List[Tuple[SequenceDescriptor, List[int]]] = []
         extends: List[Tuple[SequenceDescriptor, List[int]]] = []
+        new_uids = []
         for uid, toks in zip(uids, tokens):
             toks = list(map(int, toks))
             if uid in self._seqs:
@@ -191,9 +192,16 @@ class InferenceEngineV2(InferenceEngine):
             else:
                 if not toks:
                     raise ValueError(f"new uid {uid} with no tokens")
+                new_uids.append(uid)
                 desc = SequenceDescriptor(uid=uid)
-                self._seqs[uid] = desc
                 prefills.append((desc, toks))
+        # Admission check BEFORE any KV mutation: a rejected put() must leave
+        # the engine untouched so the caller can retry it verbatim.
+        if len(extends) > self.config.max_batch_size:
+            raise ValueError(f"decode batch {len(extends)} exceeds max_batch_size "
+                             f"{self.config.max_batch_size} (raise it in the inference config)")
+        for uid, (desc, _) in zip(new_uids, prefills):
+            self._seqs[uid] = desc
 
         for desc, toks in prefills:
             T = len(toks)
@@ -215,9 +223,6 @@ class InferenceEngineV2(InferenceEngine):
         # (chunked-prefill analog; reference schedules these as ragged atoms)
         while any(toks for _, toks in extends):
             batch = [(d, toks.pop(0)) for d, toks in extends if toks]
-            if len(batch) > self.config.max_batch_size:
-                raise ValueError(f"decode batch {len(batch)} exceeds max_batch_size "
-                                 f"{self.config.max_batch_size} (raise it in the inference config)")
             for d, _ in batch:
                 self._ensure_blocks(d, d.seen_tokens + 1)
             B = _bucket(len(batch), minimum=1)

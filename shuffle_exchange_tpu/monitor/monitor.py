@@ -100,7 +100,6 @@ class CSVMonitor(Monitor):
             return
         self.log_dir = os.path.join(config.output_path or "./csv_logs", config.job_name)
         os.makedirs(self.log_dir, exist_ok=True)
-        self._files = {}
 
     def write_events(self, event_list: Sequence[Event]) -> None:
         if not self.enabled:

@@ -1,0 +1,19 @@
+"""Native runtime library surface (C++ via ctypes).
+
+The TPU-native analog of the reference's native extension set (SURVEY.md
+§2.13): async disk IO for the NVMe offload/fast-checkpoint tier, CPU fused
+optimizers for host offload, and 1-bit sign packing for compressed
+collectives. Compute kernels stay in Pallas/XLA (``ops/``); this package is
+the *runtime* native layer.
+"""
+
+from .aio import AsyncIOEngine, get_io_engine
+from .builder import load_native, native_available
+from .cpu_optimizer import (adagrad_step, adam_step, lamb_step, lion_step,
+                            packbits, unpackbits)
+
+__all__ = [
+    "AsyncIOEngine", "get_io_engine", "load_native", "native_available",
+    "adam_step", "adagrad_step", "lion_step", "lamb_step",
+    "packbits", "unpackbits",
+]

@@ -1,0 +1,136 @@
+"""Async file IO: Python surface over the native engine.
+
+Capability parity with the reference's DeepNVMe stack (``ops/aio`` +
+``runtime/swap_tensor`` + ``nvme/`` harness, SURVEY.md §2.13): submit
+reads/writes of flat arrays against files, overlap them with compute, and
+join at a barrier. Used by the NVMe offload tier and the fast checkpoint
+writer. Falls back to synchronous NumPy file IO when the native library
+can't be built.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .builder import load_native
+
+
+class AsyncIOEngine:
+    """Thread-pool async reads/writes of numpy arrays to files.
+
+    ``submit_read`` / ``submit_write`` return a request handle; ``wait``
+    blocks on one; ``wait_all`` joins everything outstanding. Arrays must be
+    C-contiguous; the caller keeps them alive until waited on.
+    """
+
+    def __init__(self, num_threads: int = 4, use_odirect: bool = False):
+        self._lib = load_native()
+        self._handle = None
+        self.num_threads = num_threads
+        self.use_odirect = use_odirect
+        self._sync_results: Dict[int, int] = {}
+        self._sync_next = 0
+        # keepalive: request id -> array (protects buffers from GC mid-flight)
+        self._pinned: Dict[int, np.ndarray] = {}
+        if self._lib is not None:
+            self._handle = self._lib.sxt_aio_create(int(num_threads), int(use_odirect))
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def _check(self, arr: np.ndarray) -> np.ndarray:
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("AsyncIOEngine needs C-contiguous arrays")
+        return arr
+
+    def submit_write(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+        arr = self._check(np.ascontiguousarray(arr))
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if self._handle is None:
+            with open(path, "r+b" if os.path.exists(path) else "wb") as f:
+                f.seek(offset)
+                f.write(arr.tobytes())
+            self._sync_next += 1
+            self._sync_results[self._sync_next] = arr.nbytes
+            return self._sync_next
+        req = self._lib.sxt_aio_submit_write(
+            self._handle, path.encode(), arr.ctypes.data, arr.nbytes, offset)
+        self._pinned[req] = arr
+        return req
+
+    def submit_read(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+        arr = self._check(arr)
+        if self._handle is None:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(arr.nbytes)
+            arr.view(np.uint8).reshape(-1)[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+            self._sync_next += 1
+            self._sync_results[self._sync_next] = len(data)
+            return self._sync_next
+        req = self._lib.sxt_aio_submit_read(
+            self._handle, path.encode(), arr.ctypes.data, arr.nbytes, offset)
+        self._pinned[req] = arr
+        return req
+
+    def wait(self, req: int) -> int:
+        if self._handle is None:
+            return self._sync_results.pop(req)
+        result = int(self._lib.sxt_aio_wait(self._handle, req))
+        self._pinned.pop(req, None)
+        if result < 0:
+            raise OSError(-result, os.strerror(-result))
+        return result
+
+    def wait_all(self) -> None:
+        if self._handle is None:
+            self._sync_results.clear()
+            return
+        err = int(self._lib.sxt_aio_wait_all(self._handle))
+        self._pinned.clear()
+        if err < 0:
+            raise OSError(-err, os.strerror(-err))
+
+    def poll(self, req: int) -> bool:
+        """True when complete; raises KeyError for an unknown/waited id."""
+        if self._handle is None:
+            if req not in self._sync_results:
+                raise KeyError(f"unknown aio request {req}")
+            return True
+        state = int(self._lib.sxt_aio_poll(self._handle, req))
+        if state < 0:
+            raise KeyError(f"unknown aio request {req}")
+        return bool(state)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.sxt_aio_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait_all()
+        self.close()
+
+
+_DEFAULT: Optional[AsyncIOEngine] = None
+
+
+def get_io_engine(num_threads: int = 4) -> AsyncIOEngine:
+    """Process-wide shared engine (swap tier + fast checkpoint writer)."""
+    global _DEFAULT
+    if _DEFAULT is None or (_DEFAULT._handle is None and _DEFAULT._lib is not None):
+        _DEFAULT = AsyncIOEngine(num_threads=num_threads)
+    return _DEFAULT

@@ -1,0 +1,156 @@
+"""CPU fused optimizer steps over flat fp32 arrays (host-offload path).
+
+Python surface for the native kernels in ``csrc/cpu_optim.cc`` — the
+capability analog of the reference's CPUAdam/CPUAdagrad/CPULion extensions
+(``ops/adam/cpu_adam.py:10``, SURVEY.md §2.13). Each ``*_step`` mutates the
+fp32 ``param`` and state arrays in place and (optionally) fills a bf16
+mirror for the device working copy in the same pass. NumPy fallbacks keep
+the path alive without a toolchain and serve as the parity reference in
+tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .builder import load_native
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u16(a: Optional[np.ndarray]):
+    if a is None:
+        return None
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def _as_bf16_bits(param: np.ndarray, out: Optional[np.ndarray]) -> None:
+    """NumPy round-to-nearest-even fp32 -> bf16 bit pattern."""
+    if out is None:
+        return
+    bits = param.view(np.uint32)
+    rounding = np.uint32(0x7FFF) + ((bits >> np.uint32(16)) & np.uint32(1))
+    out[...] = ((bits + rounding) >> np.uint32(16)).astype(np.uint16)
+
+
+def _check(name, *arrays, bf16=None):
+    n = arrays[0].size
+    for a in arrays:
+        if not (a.flags["C_CONTIGUOUS"] and a.size == n):
+            raise ValueError(f"{name}: arrays must be C-contiguous and same-size")
+        if a.dtype != np.float32:
+            raise ValueError(f"{name}: expected float32 arrays, got {a.dtype}")
+    if bf16 is not None and not (bf16.flags["C_CONTIGUOUS"] and bf16.size == n
+                                 and bf16.dtype == np.uint16):
+        raise ValueError(f"{name}: bf16_out must be C-contiguous uint16 of size {n}")
+
+
+def adam_step(param: np.ndarray, exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+              grad: np.ndarray, lr: float, beta1: float = 0.9, beta2: float = 0.999,
+              eps: float = 1e-8, weight_decay: float = 0.0, step: int = 1,
+              adamw: bool = True, bias_correction: bool = True,
+              bf16_out: Optional[np.ndarray] = None) -> None:
+    _check("adam_step", param, exp_avg, exp_avg_sq, grad, bf16=bf16_out)
+    lib = load_native()
+    if lib is not None:
+        lib.sxt_adam_step(_fp(param), _fp(exp_avg), _fp(exp_avg_sq), _fp(grad),
+                          param.size, lr, beta1, beta2, eps, weight_decay,
+                          int(step), int(adamw), int(bias_correction), _u16(bf16_out))
+        return
+    g = grad if adamw or weight_decay == 0.0 else grad + weight_decay * param
+    exp_avg *= beta1
+    exp_avg += (1 - beta1) * g
+    exp_avg_sq *= beta2
+    exp_avg_sq += (1 - beta2) * g * g
+    bc1 = 1 - beta1 ** step if bias_correction else 1.0
+    bc2 = 1 - beta2 ** step if bias_correction else 1.0
+    if adamw and weight_decay != 0.0:
+        param -= lr * weight_decay * param
+    param -= (lr / bc1) * exp_avg / (np.sqrt(exp_avg_sq) / np.sqrt(bc2) + eps)
+    _as_bf16_bits(param, bf16_out)
+
+
+def adagrad_step(param: np.ndarray, exp_avg_sq: np.ndarray, grad: np.ndarray,
+                 lr: float, eps: float = 1e-10, weight_decay: float = 0.0,
+                 bf16_out: Optional[np.ndarray] = None) -> None:
+    _check("adagrad_step", param, exp_avg_sq, grad, bf16=bf16_out)
+    lib = load_native()
+    if lib is not None:
+        lib.sxt_adagrad_step(_fp(param), _fp(exp_avg_sq), _fp(grad), param.size,
+                             lr, eps, weight_decay, _u16(bf16_out))
+        return
+    g = grad if weight_decay == 0.0 else grad + weight_decay * param
+    exp_avg_sq += g * g
+    param -= lr * g / (np.sqrt(exp_avg_sq) + eps)
+    _as_bf16_bits(param, bf16_out)
+
+
+def lion_step(param: np.ndarray, exp_avg: np.ndarray, grad: np.ndarray,
+              lr: float, beta1: float = 0.9, beta2: float = 0.99,
+              weight_decay: float = 0.0, bf16_out: Optional[np.ndarray] = None) -> None:
+    _check("lion_step", param, exp_avg, grad, bf16=bf16_out)
+    lib = load_native()
+    if lib is not None:
+        lib.sxt_lion_step(_fp(param), _fp(exp_avg), _fp(grad), param.size,
+                          lr, beta1, beta2, weight_decay, _u16(bf16_out))
+        return
+    update = np.sign(beta1 * exp_avg + (1 - beta1) * grad)
+    if weight_decay != 0.0:
+        param -= lr * weight_decay * param
+    param -= lr * update
+    exp_avg *= beta2
+    exp_avg += (1 - beta2) * grad
+    _as_bf16_bits(param, bf16_out)
+
+
+def lamb_step(param: np.ndarray, exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+              grad: np.ndarray, lr: float, beta1: float = 0.9, beta2: float = 0.999,
+              eps: float = 1e-6, weight_decay: float = 0.0, step: int = 1,
+              bias_correction: bool = True, bf16_out: Optional[np.ndarray] = None) -> None:
+    _check("lamb_step", param, exp_avg, exp_avg_sq, grad, bf16=bf16_out)
+    lib = load_native()
+    if lib is not None:
+        lib.sxt_lamb_step(_fp(param), _fp(exp_avg), _fp(exp_avg_sq), _fp(grad),
+                          param.size, lr, beta1, beta2, eps, weight_decay,
+                          int(step), int(bias_correction), _u16(bf16_out))
+        return
+    exp_avg *= beta1
+    exp_avg += (1 - beta1) * grad
+    exp_avg_sq *= beta2
+    exp_avg_sq += (1 - beta2) * grad * grad
+    bc1 = 1 - beta1 ** step if bias_correction else 1.0
+    bc2 = 1 - beta2 ** step if bias_correction else 1.0
+    u = (exp_avg / bc1) / (np.sqrt(exp_avg_sq) / np.sqrt(bc2) + eps) + weight_decay * param
+    p_norm, u_norm = np.linalg.norm(param), np.linalg.norm(u)
+    trust = p_norm / u_norm if (p_norm > 0 and u_norm > 0) else 1.0
+    param -= lr * trust * u
+    _as_bf16_bits(param, bf16_out)
+
+
+def packbits(x: np.ndarray) -> np.ndarray:
+    """Sign bits of x (>=0 → 1), LSB-first per byte; ceil(n/8) bytes."""
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    out = np.empty((x.size + 7) // 8, dtype=np.uint8)
+    lib = load_native()
+    if lib is not None:
+        lib.sxt_packbits(_fp(x), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), x.size)
+        return out
+    return np.packbits(x >= 0, bitorder="little")
+
+
+def unpackbits(packed: np.ndarray, n: int, scale: float = 1.0) -> np.ndarray:
+    """Inverse of packbits: ±scale per element."""
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    out = np.empty(n, dtype=np.float32)
+    lib = load_native()
+    if lib is not None:
+        lib.sxt_unpackbits(packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                           _fp(out), n, scale)
+        return out
+    bits = np.unpackbits(packed, count=n, bitorder="little").astype(np.float32)
+    return (2.0 * bits - 1.0) * scale

@@ -159,8 +159,11 @@ class FlopsProfiler:
         lines.append("---------------------------------------------------------------------")
         text = "\n".join(lines)
         if self.config.output_file:
-            with open(self.config.output_file, "a") as f:
-                f.write(text + "\n")
+            import jax
+
+            if jax.process_index() == 0:  # single writer on shared storage
+                with open(self.config.output_file, "a") as f:
+                    f.write(text + "\n")
         else:
             log_dist(text, ranks=[0])
         return out
