@@ -98,6 +98,23 @@ def initialize(
             cfg.mesh.fsdp = sc
             cfg.mesh.data = -1
 
+    # ZeRO++ hpZ / MiCS: both express "shard over a small fast group,
+    # replicate across groups" (reference zero_hpz_partition_size /
+    # mics_shard_size, runtime/zero/config.py + mics.py). On the mesh this is
+    # an fsdp axis of the group size with the remaining DP factor on data —
+    # param all-gathers then ride the (ICI-contiguous) fsdp axis only.
+    z = cfg.zero_optimization
+    group = None
+    if z.mics_shard_size and z.mics_shard_size > 0:
+        group = z.mics_shard_size
+    elif z.stage == 3 and z.zero_hpz_partition_size > 1:
+        group = z.zero_hpz_partition_size
+    if group is not None and cfg.mesh.fsdp == 1:
+        if n_devices % group:
+            raise ConfigError(f"hpZ/MiCS shard group {group} must divide device count {n_devices}")
+        cfg.mesh.fsdp = group
+        cfg.mesh.data = -1
+
     topology = initialize_topology(cfg.mesh, force=True)
 
     # Pipeline parallelism: wrap zoo models so the 1F1B microbatch loop runs

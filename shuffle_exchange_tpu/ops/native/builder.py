@@ -39,7 +39,7 @@ def _compile() -> Optional[str]:
     so_path = os.path.join(out_dir, "libsxt_native.so")
     srcs = [os.path.join(CSRC_DIR, f) for f in ("aio.cc", "cpu_optim.cc", "packbits.cc")]
     hdr = os.path.join(CSRC_DIR, "sxt_native.h")
-    if not all(os.path.exists(s) for s in srcs):
+    if not all(os.path.exists(s) for s in srcs + [hdr]):
         return None
     if os.path.exists(so_path):
         newest_src = max(os.path.getmtime(p) for p in srcs + [hdr])

@@ -146,6 +146,8 @@ def packbits(x: np.ndarray) -> np.ndarray:
 def unpackbits(packed: np.ndarray, n: int, scale: float = 1.0) -> np.ndarray:
     """Inverse of packbits: ±scale per element."""
     packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    if packed.size * 8 < n:
+        raise ValueError(f"unpackbits: {packed.size} bytes holds {packed.size * 8} bits < n={n}")
     out = np.empty(n, dtype=np.float32)
     lib = load_native()
     if lib is not None:

@@ -8,4 +8,5 @@ from .mesh import (
     resolve_axis_sizes,
 )
 from . import comm
+from . import compressed
 from .pipeline import PipelinedModel, spmd_pipeline

@@ -135,13 +135,17 @@ class Engine:
             self.sync = DecentralizedSync(config.shuffle_exchange, self.replicas, seed=config.seed)
 
         # --- sharding policy -------------------------------------------
+        # MiCS (reference runtime/zero/mics.py): optimizer/master shards stay
+        # inside the fsdp sub-group; replicas across "data" are plain DP.
+        self.mics = bool(config.zero_optimization.mics_shard_size
+                         and config.zero_optimization.mics_shard_size > 0)
         self.policy = ZeroShardingPolicy(
             topology, self.zero_stage,
             persistence_threshold=config.zero_optimization.stage3_param_persistence_threshold,
             model_specs=model_partition_specs,
             # Ensemble replicas are independent ZeRO worlds over the slice
             # (fsdp) axis; "data" becomes the replica dim prepended below.
-            zero_axes=("fsdp",) if self.ensemble else ("fsdp", "data"))
+            zero_axes=("fsdp",) if (self.ensemble or self.mics) else ("fsdp", "data"))
         log_dist(self.policy.describe(params), ranks=[0])
 
         mesh = topology.mesh
@@ -222,6 +226,29 @@ class Engine:
             [opt_leaf_sharding(path, leaf)
              for path, leaf in jax.tree_util.tree_flatten_with_path(opt_shapes)[0]])
         opt_state = jax.jit(init_opt, out_shardings=self.opt_shardings)(master)
+
+        # --- optimizer-state offload tier (reference offload_config.py) --
+        # Between steps the optimizer state leaves HBM — to host RAM (cpu)
+        # or to disk via the native async IO engine (nvme) — and returns
+        # just before the next update (see runtime/zero/offload.py).
+        off = config.zero_optimization.offload_optimizer
+        self._opt_swapper = None
+        self._opt_resident = True
+        self._opt_dev_shardings = self.opt_shardings
+        if off.enabled and off.device == "cpu":
+            from .zero.offload import HostStateSwapper
+
+            self._opt_swapper = HostStateSwapper()
+            log_dist("optimizer state offloading to host RAM between steps", ranks=[0])
+        elif off.enabled and off.device == "nvme":
+            import os as _os
+
+            from .zero.offload import NvmeStateSwapper
+
+            swap_dir = _os.path.join(off.nvme_path or "/tmp/sxt_nvme_swap",
+                                     f"rank{jax.process_index()}")
+            self._opt_swapper = NvmeStateSwapper(swap_dir, aio_threads=off.buffer_count)
+            log_dist(f"optimizer state swapping to NVMe at {swap_dir}", ranks=[0])
         # Scalars are explicitly replicated over the mesh so that checkpoint
         # restore (which reproduces input placements exactly) stays mesh-wide.
         scale_state = jax.tree_util.tree_map(
@@ -274,8 +301,23 @@ class Engine:
         predivide = cfg.gradient_predivide_factor
         ensemble = self.ensemble
 
+        # ZeRO++ qwZ (reference partition_parameters.py:824 CUDAQuantizer):
+        # forward weights pass through blockwise-int8 quantization, so the
+        # bytes XLA all-gathers for sharded params are the int8 payload and
+        # the forward numerics carry the same rounding the reference's
+        # quantized all-gather does.
+        qw = cfg.zero_optimization.zero_quantized_weights
+        # qgZ (reference coalesced_collectives.py:31): gradients carry
+        # blockwise-int8 rounding, matching the quantized two-level reduce.
+        qg = cfg.zero_optimization.zero_quantized_gradients
+        if qw or qg:
+            from ..ops.quant import quantize_dequantize
+
         def fwd_weights(master, mix):
             p16 = jax.tree_util.tree_map(lambda m: m.astype(dtype), master)
+            if qw:
+                p16 = jax.tree_util.tree_map(
+                    lambda p: quantize_dequantize(p, group_size=2048).astype(dtype), p16)
             if ensemble:
                 p16 = apply_mixing(p16, mix)
             return p16
@@ -336,6 +378,9 @@ class Engine:
             if prescale and predivide != 1.0:
                 denom = denom * predivide
             grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            if qg:
+                grads = jax.tree_util.tree_map(
+                    lambda g: quantize_dequantize(g, group_size=2048), grads)
             overflow = ls.check_overflow(grads) if fp16_cfg.enabled else jnp.asarray(False)
             new_master, new_opt = apply_update(grads, state.opt_state, state.master)
             new_master = _tree_select(overflow, state.master, new_master)
@@ -449,6 +494,47 @@ class Engine:
     # public API (reference parity)
     # ==================================================================
 
+    # -- offload tiers ---------------------------------------------------
+
+    def _ensure_opt_resident(self) -> None:
+        """Bring swapped-out optimizer state back on device."""
+        if self._opt_swapper is not None and not self._opt_resident:
+            opt = self._opt_swapper.swap_in(self._opt_dev_shardings)
+            self.state = self.state._replace(opt_state=opt)
+            self._opt_resident = True
+
+    def _maybe_swap_out_opt(self) -> None:
+        """Release optimizer state to the offload tier between steps."""
+        if self._opt_swapper is not None and self._opt_resident:
+            self._opt_swapper.swap_out(self.state.opt_state)
+            self.state = self.state._replace(opt_state=None)
+            self._opt_resident = False
+
+    def offload_states(self) -> None:
+        """Move master params + optimizer state to host RAM, freeing HBM
+        (reference engine.offload_states, runtime/engine.py:4042 — used to
+        park a training engine while e.g. generation runs)."""
+        from .zero.offload import HostStateSwapper
+
+        if getattr(self, "_offloaded_states", None) is not None:
+            return
+        self._ensure_opt_resident()
+        sw_master, sw_opt = HostStateSwapper(), HostStateSwapper()
+        sw_master.swap_out(self.state.master)
+        sw_opt.swap_out(self.state.opt_state)
+        self._offloaded_states = (sw_master, sw_opt)
+        self.state = self.state._replace(master=None, opt_state=None)
+
+    def reload_states(self) -> None:
+        """Inverse of :meth:`offload_states` (reference reload_states)."""
+        swappers = getattr(self, "_offloaded_states", None)
+        if swappers is None:
+            return
+        sw_master, sw_opt = swappers
+        self.state = self.state._replace(master=sw_master.swap_in(self.master_shardings),
+                                         opt_state=sw_opt.swap_in(self.opt_shardings))
+        self._offloaded_states = None
+
     def train_batch(self, batch=None, data_iter=None):
         """One full optimizer step over a global batch (fwd+bwd+step fused).
 
@@ -462,6 +548,7 @@ class Engine:
             batch = next(it)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        self._ensure_opt_resident()
         shaped = self._reshape_batch(batch)
         mix = self._mix_matrix(advance=True)
         rng = self._next_rng()
@@ -489,6 +576,7 @@ class Engine:
                 ("Train/Samples/lr", self.get_lr(), s),
                 ("Train/Samples/loss_scale", self.loss_scale(), s),
             ])
+        self._maybe_swap_out_opt()
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         return loss
@@ -541,12 +629,14 @@ class Engine:
         if self._accum_grads is None:
             raise ConfigError("step() with no accumulated gradients; call backward() first")
         self.timers(STEP_GLOBAL_TIMER).start()
+        self._ensure_opt_resident()
         if self.ensemble:
             self.sync.advance()  # staged path: protocol moves once per optimizer step
         self.state, overflow = self._apply_only(self.state, self._accum_grads, float(self._accum_count))
         self._accum_grads = None
         self._accum_count = 0
         self._post_step(overflow)
+        self._maybe_swap_out_opt()
         self.timers(STEP_GLOBAL_TIMER).stop()
 
     def eval_batch(self, batch, rng=None):
@@ -650,6 +740,7 @@ class Engine:
         import jax
 
         tag = tag or f"global_step{self.global_steps}"
+        self._ensure_opt_resident()
         validate_tag(tag, self.config.checkpoint.tag_validation)
         path = os.path.join(save_dir, tag)
         eng = self._checkpoint_engine()
@@ -689,6 +780,7 @@ class Engine:
         tag = tag or read_latest_tag(load_dir)
         if tag is None:
             raise ConfigError(f"No 'latest' tag in {load_dir} and none given")
+        self._ensure_opt_resident()
         path = os.path.join(load_dir, tag)
         eng = self._checkpoint_engine()
         master = eng.load(os.path.join(path, "model"), target=self.state.master)

@@ -1,0 +1,276 @@
+"""1-bit optimizers: communication-compressed Adam/LAMB variants.
+
+Capability parity with the reference's onebit family
+(``runtime/fp16/onebit/{adam,lamb,zoadam}.py``, SURVEY.md §2.5): after a
+full-precision warmup ("freeze" point), the momentum exchanged between
+data-parallel workers is compressed to sign × scale with error feedback,
+and the variance term is frozen (OnebitAdam) or updated on a schedule
+(ZeroOneAdam); OnebitLamb freezes per-tensor LAMB trust ratios at the
+freeze point.
+
+TPU-native shape: each optimizer is an ``optax.GradientTransformation``
+whose update happens inside the jitted train step; the warmup/compressed
+stages are a ``lax.cond`` so each step runs (and communicates) only its
+stage's path. Compression applies to the *synchronized* momentum exactly as
+the reference applies it to the communicated momentum: sign(m + e)·scale
+with the residual carried to the next step. When ``axis_name`` is given
+(shard_map/explicit-collective use), gradients are expected to be *local*
+(unreduced) and the momentum exchange itself rides the compressed wire
+(``parallel/compressed.sign_psum`` — int8 signs on the interconnect instead
+of fp32, SURVEY.md §2.8 "compressed collectives"); otherwise grads arrive
+already averaged (the engine's sharding-based SPMD) and compression shapes
+only the update numerics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import optax
+
+
+class OnebitState(NamedTuple):
+    count: Any        # i32 step counter
+    exp_avg: Any      # momentum
+    exp_avg_sq: Any   # variance (frozen after freeze_step for OnebitAdam)
+    error: Any        # compression error feedback (worker error, reference adam.py)
+    scaling: Any      # OnebitLamb frozen trust ratios (per-leaf scalar); else unused
+
+
+def _tree(f, *trees):
+    import jax
+
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def sign_compress(x, err):
+    """(x + err) -> (sign·scale, new_err), scale = mean|x + err| per leaf.
+
+    The reference's server/worker error-feedback compression
+    (runtime/comm/compressed.py) collapsed to its numerics: the carrier keeps
+    what compression lost and re-injects it next step.
+    """
+    import jax.numpy as jnp
+
+    combined = x + err
+    scale = jnp.mean(jnp.abs(combined))
+    compressed = jnp.sign(combined) * scale
+    return compressed, combined - compressed
+
+
+def _compress_tree(m, err, axis_name: Optional[str]):
+    """Compress momentum leaf-wise; with axis_name, average over the axis on
+    the compressed wire. Returns (compressed_tree, new_error_tree)."""
+    import jax
+
+    if axis_name is None:
+        fn = sign_compress
+    else:
+        from ..parallel.compressed import sign_psum
+
+        def fn(x, e):
+            return sign_psum(x, axis_name, err=e)
+
+    leaves_m, treedef = jax.tree_util.tree_flatten(m)
+    leaves_e = treedef.flatten_up_to(err)
+    pairs = [fn(x, e) for x, e in zip(leaves_m, leaves_e)]
+    comp = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+    new_err = jax.tree_util.tree_unflatten(treedef, [p[1] for p in pairs])
+    return comp, new_err
+
+
+def _tree_avg(g, axis_name: Optional[str]):
+    if axis_name is None:
+        return g
+    import jax
+
+    return _tree(lambda x: jax.lax.pmean(x, axis_name), g)
+
+
+def _wd_factors(mask, params):
+    """Per-leaf 0/1 weight-decay factors honoring an optax-style mask
+    (pytree of bools, or callable params -> pytree)."""
+    if params is None:
+        return None
+    if mask is None:
+        return _tree(lambda p: 1.0, params)
+    m = mask(params) if callable(mask) else mask
+    return _tree(lambda flag: 1.0 if flag else 0.0, m)
+
+
+def onebit_adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100,
+                axis_name: Optional[str] = None, mask=None) -> optax.GradientTransformation:
+    """OnebitAdam (reference runtime/fp16/onebit/adam.py): exact Adam during
+    warmup; after ``freeze_step`` the variance freezes and the momentum is
+    exchanged sign-compressed with error feedback."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        return OnebitState(count=jnp.zeros((), jnp.int32),
+                           exp_avg=_tree(jnp.zeros_like, params),
+                           exp_avg_sq=_tree(jnp.zeros_like, params),
+                           error=_tree(jnp.zeros_like, params),
+                           scaling=_tree(lambda p: jnp.ones((), jnp.float32), params))
+
+    def update(grads, state: OnebitState, params=None):
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        frozen = count > freeze_step
+
+        def warm(operand):
+            g, m0, v0, e0 = operand
+            g_avg = _tree_avg(g, axis_name)
+            m = _tree(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m0, g_avg)
+            v = _tree(lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_, v0, g_avg)
+            return m, v, e0
+
+        def compressed(operand):
+            g, m0, v0, e0 = operand
+            m_local = _tree(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m0, g)
+            m, e = _compress_tree(m_local, e0, axis_name)
+            return m, v0, e
+
+        m, v, err = jax.lax.cond(frozen, compressed, warm,
+                                 (grads, state.exp_avg, state.exp_avg_sq, state.error))
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        wd = _wd_factors(mask, params)
+
+        def upd(m_, v_, p, w):
+            u = -(lr / bc1) * m_ / (jnp.sqrt(v_ / bc2) + eps)
+            if weight_decay and params is not None:
+                u = u - lr * weight_decay * w * p
+            return u
+
+        updates = _tree(upd, m, v, params if params is not None else m,
+                        wd if wd is not None else m)
+        return updates, OnebitState(count=count, exp_avg=m, exp_avg_sq=v,
+                                    error=err, scaling=state.scaling)
+
+    return optax.GradientTransformation(init, update)
+
+
+def zero_one_adam(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                  weight_decay: float = 0.0, var_freeze_step: int = 100,
+                  var_update_scaler: int = 16, local_step_clipper: int = 32,
+                  axis_name: Optional[str] = None, mask=None) -> optax.GradientTransformation:
+    """0/1 Adam (reference runtime/fp16/onebit/zoadam.py): variance updates
+    on a doubling interval after ``var_freeze_step`` (learning-rate-scale
+    policy collapsed to the interval schedule), momentum always exchanged
+    sign-compressed with error feedback."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        return OnebitState(count=jnp.zeros((), jnp.int32),
+                           exp_avg=_tree(jnp.zeros_like, params),
+                           exp_avg_sq=_tree(jnp.zeros_like, params),
+                           error=_tree(jnp.zeros_like, params),
+                           scaling=_tree(lambda p: jnp.ones((), jnp.float32), params))
+
+    def update(grads, state: OnebitState, params=None):
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        m_local = _tree(lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, grads)
+        m, err = _compress_tree(m_local, state.error, axis_name)
+
+        # Variance: dense updates until var_freeze_step, then on intervals
+        # k = var_update_scaler * 2^j, capped at local_step_clipper.
+        since = jnp.maximum(count - var_freeze_step, 0)
+        interval = jnp.minimum(
+            var_update_scaler * 2 ** jnp.floor(jnp.log2(1 + since.astype(jnp.float32) / var_update_scaler)),
+            float(local_step_clipper)).astype(jnp.int32)
+        do_var = jnp.logical_or(count <= var_freeze_step, since % jnp.maximum(interval, 1) == 0)
+
+        def var_update(operand):
+            v0, g = operand
+            g_avg = _tree_avg(g, axis_name)
+            return _tree(lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_, v0, g_avg)
+
+        v = jax.lax.cond(do_var, var_update, lambda op: op[0], (state.exp_avg_sq, grads))
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        wd = _wd_factors(mask, params)
+
+        def upd(m_, v_, p, w):
+            u = -(lr / bc1) * m_ / (jnp.sqrt(v_ / bc2) + eps)
+            if weight_decay and params is not None:
+                u = u - lr * weight_decay * w * p
+            return u
+
+        updates = _tree(upd, m, v, params if params is not None else m,
+                        wd if wd is not None else m)
+        return updates, OnebitState(count=count, exp_avg=m, exp_avg_sq=v,
+                                    error=err, scaling=state.scaling)
+
+    return optax.GradientTransformation(init, update)
+
+
+def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6,
+                weight_decay: float = 0.0, freeze_step: int = 100,
+                max_coeff: float = 10.0, min_coeff: float = 0.01,
+                axis_name: Optional[str] = None, mask=None) -> optax.GradientTransformation:
+    """OnebitLamb (reference runtime/fp16/onebit/lamb.py): exact LAMB during
+    warmup while recording per-tensor trust ratios; after the freeze the
+    ratios are frozen and momentum is exchanged sign-compressed."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        return OnebitState(count=jnp.zeros((), jnp.int32),
+                           exp_avg=_tree(jnp.zeros_like, params),
+                           exp_avg_sq=_tree(jnp.zeros_like, params),
+                           error=_tree(jnp.zeros_like, params),
+                           scaling=_tree(lambda p: jnp.ones((), jnp.float32), params))
+
+    def update(grads, state: OnebitState, params=None):
+        assert params is not None, "onebit_lamb needs params for trust ratios"
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        frozen = count > freeze_step
+
+        def warm(operand):
+            g, m0, v0, e0 = operand
+            g_avg = _tree_avg(g, axis_name)
+            m = _tree(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m0, g_avg)
+            v = _tree(lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_, v0, g_avg)
+            return m, v, e0
+
+        def compressed(operand):
+            g, m0, v0, e0 = operand
+            m_local = _tree(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m0, g)
+            m, e = _compress_tree(m_local, e0, axis_name)
+            return m, v0, e
+
+        m, v, err = jax.lax.cond(frozen, compressed, warm,
+                                 (grads, state.exp_avg, state.exp_avg_sq, state.error))
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        wd = _wd_factors(mask, params)
+
+        def raw_update(m_, v_, p, w):
+            return m_ / bc1 / (jnp.sqrt(v_ / bc2) + eps) + weight_decay * w * p
+
+        raw = _tree(raw_update, m, v, params, wd)
+
+        def trust(p, u):
+            pn = jnp.linalg.norm(p.reshape(-1))
+            un = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where((pn > 0) & (un > 0), pn / jnp.maximum(un, 1e-12), 1.0)
+            return jnp.clip(ratio, min_coeff, max_coeff)
+
+        live = _tree(trust, params, raw)
+        coeff = _tree(lambda lv, fz: jnp.where(frozen, fz, lv), live, state.scaling)
+        updates = _tree(lambda u, c: -lr * c * u, raw, coeff)
+        # Record ratios while warm so the freeze point captures the last ones.
+        new_scaling = _tree(lambda lv, fz: jnp.where(frozen, fz, lv), live, state.scaling)
+        return updates, OnebitState(count=count, exp_avg=m, exp_avg_sq=v,
+                                    error=err, scaling=new_scaling)
+
+    return optax.GradientTransformation(init, update)

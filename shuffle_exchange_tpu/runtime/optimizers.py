@@ -2,12 +2,12 @@
 
 Capability parity with the reference's ``runtime/engine.py:1473``
 (_configure_basic_optimizer): the same ``optimizer.type`` names a reference
-JSON uses (Adam/AdamW/FusedAdam variants, Lamb, Lion, SGD, Adagrad, Muon;
-OneBit* map to their base optimizers — 1-bit compression is a collective
-concern, not an update rule, and XLA collectives are not bandwidth-bound the
-same way). Fused CUDA kernels (FusedAdamBuilder etc., §2.13) map to the
-Pallas fused optimizer in ``ops/fused_adam.py`` which the engine swaps in for
-flat-sharded states; the optax path here is the reference implementation.
+JSON uses (Adam/AdamW/FusedAdam variants, Lamb, Lion, SGD, Adagrad, Muon,
+and the 1-bit family OnebitAdam/ZeroOneAdam/OnebitLamb — see
+``runtime/onebit.py`` for the compressed-momentum update rules). Fused CUDA
+kernels (FusedAdamBuilder etc., §2.13) map to the Pallas fused optimizer in
+``ops/fused_adam.py`` which the engine swaps in for flat-sharded states; the
+optax path here is the reference implementation.
 """
 
 from __future__ import annotations
@@ -47,7 +47,25 @@ def build_optimizer(optimizer_config, lr_schedule, gradient_clipping: float = 0.
     schedule = lr_schedule if lr_schedule is not None else lr
 
     lowered = name.lower()
-    if lowered in ("adam", "fusedadam", "cpuadam", "adamw", "onebitadam", "zerooneadam"):
+    if lowered in ("onebitadam", "zerooneadam", "onebitlamb"):
+        from .onebit import onebit_adam, onebit_lamb, zero_one_adam
+
+        freeze = int(params.pop("freeze_step", 100))
+        if lowered == "onebitadam":
+            tx = onebit_adam(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+                             freeze_step=freeze, mask=weight_decay_mask)
+        elif lowered == "zerooneadam":
+            tx = zero_one_adam(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+                               var_freeze_step=int(params.pop("var_freeze_step", freeze)),
+                               var_update_scaler=int(params.pop("var_update_scaler", 16)),
+                               local_step_clipper=int(params.pop("local_step_clipper", 32)),
+                               mask=weight_decay_mask)
+        else:
+            tx = onebit_lamb(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd, freeze_step=freeze,
+                             max_coeff=float(params.pop("max_coeff", 10.0)),
+                             min_coeff=float(params.pop("min_coeff", 0.01)),
+                             mask=weight_decay_mask)
+    elif lowered in ("adam", "fusedadam", "cpuadam", "adamw"):
         adam_w_mode = params.pop("adam_w_mode", lowered == "adamw")
         if adam_w_mode or lowered == "adamw":
             tx = optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd, mask=weight_decay_mask)
@@ -55,7 +73,7 @@ def build_optimizer(optimizer_config, lr_schedule, gradient_clipping: float = 0.
             tx = optax.adam(schedule, b1=b1, b2=b2, eps=eps)
             if wd:
                 tx = optax.chain(optax.add_decayed_weights(wd, mask=weight_decay_mask), tx)
-    elif lowered in ("lamb", "fusedlamb", "onebitlamb"):
+    elif lowered in ("lamb", "fusedlamb"):
         tx = optax.lamb(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd, mask=weight_decay_mask)
     elif lowered in ("lion", "fusedlion", "cpulion"):
         tx = optax.lion(schedule, b1=b1, b2=b2, weight_decay=wd, mask=weight_decay_mask)

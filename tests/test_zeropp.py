@@ -1,0 +1,102 @@
+"""ZeRO++ (hpZ / qwZ / qgZ) and MiCS sharding policies (SURVEY.md §2.6
+ZeRO++ row; runtime/zero/config.py knobs; mics.py)."""
+
+import numpy as np
+import pytest
+
+import shuffle_exchange_tpu as sxt
+from shuffle_exchange_tpu.parallel import reset_topology
+from shuffle_exchange_tpu.models import Transformer, tiny
+
+
+def _base_config(**zero):
+    z = {"stage": 3}
+    z.update(zero)
+    return {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": z,
+        "steps_per_print": 10**9,
+    }
+
+
+def _model():
+    return Transformer(tiny(vocab=128, d=64, layers=2, heads=4, seq=32))
+
+
+def _batch(b=8, t=32):
+    return {"input_ids": np.random.default_rng(0).integers(0, 128, size=(b, t)).astype(np.int32)}
+
+
+def _leaf_axes(tree, topo):
+    """Mesh axes (with size > 1) that actually shard any leaf."""
+    import jax
+
+    axes = set()
+    for sh in jax.tree_util.tree_leaves(tree):
+        for entry in sh.spec:
+            if entry is None:
+                continue
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if topo.axis_sizes.get(ax, 1) > 1:
+                    axes.add(ax)
+    return axes
+
+
+def test_hpz_mesh_derivation_and_param_gather_group(devices8):
+    reset_topology()
+    engine, *_ = sxt.initialize(model=_model(),
+                                config=_base_config(zero_hpz_partition_size=2))
+    topo = engine.topology
+    assert topo.axis_sizes["fsdp"] == 2 and topo.axis_sizes["data"] == 4
+    # params (forward copies) shard over fsdp only; master/opt over both.
+    assert _leaf_axes(engine.param_shardings, topo) <= {"fsdp"}
+    assert "data" in _leaf_axes(engine.master_shardings, topo)
+    loss = engine.train_batch(_batch())
+    assert np.isfinite(float(loss))
+
+
+def test_mics_shards_stay_in_group(devices8):
+    reset_topology()
+    engine, *_ = sxt.initialize(model=_model(),
+                                config=_base_config(mics_shard_size=4))
+    topo = engine.topology
+    assert topo.axis_sizes["fsdp"] == 4 and topo.axis_sizes["data"] == 2
+    # MiCS: master/opt replicated across groups (no "data" sharding at all).
+    assert "data" not in _leaf_axes(engine.master_shardings, topo)
+    loss = engine.train_batch(_batch())
+    assert np.isfinite(float(loss))
+
+
+def test_qwz_quantized_weights_close_to_exact(devices8):
+    reset_topology()
+    e_exact, *_ = sxt.initialize(model=_model(), config=_base_config())
+    w_exact = e_exact.module_weights()
+    reset_topology()
+    e_q, *_ = sxt.initialize(model=_model(), config=_base_config(zero_quantized_weights=True))
+    w_q = e_q.module_weights()
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(w_exact), jax.tree_util.tree_leaves(w_q)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        # quantization rounding is small but (usually) nonzero
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=0.05)
+    loss = e_q.train_batch(_batch())
+    assert np.isfinite(float(loss))
+
+
+def test_qgz_quantized_gradients_trains(devices8):
+    reset_topology()
+    engine, *_ = sxt.initialize(model=_model(),
+                                config=_base_config(zero_quantized_gradients=True))
+    l0 = float(engine.train_batch(_batch()))
+    for _ in range(3):
+        l1 = float(engine.train_batch(_batch()))
+    assert np.isfinite(l1) and l1 < l0
+
+
+def test_hpz_group_must_divide_world(devices8):
+    reset_topology()
+    with pytest.raises(sxt.ConfigError):
+        sxt.initialize(model=_model(), config=_base_config(zero_hpz_partition_size=3))
