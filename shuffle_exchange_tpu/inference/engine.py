@@ -161,7 +161,8 @@ class InferenceEngine:
         T = ids.shape[1]
         positions = pos[:, None] + jnp.arange(T)[None, :]       # [B,T]
         if cfg.position == "learned":
-            x = x + jnp.take(params["pos_embed"], positions, axis=0).astype(x.dtype)
+            x = x + jnp.take(params["pos_embed"], positions + cfg.pos_offset,
+                             axis=0).astype(x.dtype)
             return x, (None, None), positions
         cos, sin = rope_table(self.config.max_seq_len, cfg.head_dim, cfg.rope_theta)
         return x, (cos, sin), positions
@@ -176,16 +177,23 @@ class InferenceEngine:
         cfg = self._mcfg
         B, T = h.shape[:2]
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm)
+        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm, eps=cfg.norm_eps)
         q = (y @ lw["wq"]).reshape(B, T, H, Dh)
         k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
         v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
+        if cfg.attn_qkv_bias:
+            q = q + lw["b_q"].astype(y.dtype).reshape(H, Dh)
+            k = k + lw["b_k"].astype(y.dtype).reshape(KV, Dh)
+            v = v + lw["b_v"].astype(y.dtype).reshape(KV, Dh)
         if cfg.position == "rope":
             pc, ps = _rope_rows(cos, sin, positions)
             q, k = _apply_rope_batched(q, pc, ps), _apply_rope_batched(k, pc, ps)
         attn, cache_out = attn_fn(q, k, v)
-        h = h + attn.reshape(B, T, H * Dh) @ lw["wo"]
-        y2 = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm)
+        attn_out = attn.reshape(B, T, H * Dh) @ lw["wo"]
+        if cfg.attn_out_bias:
+            attn_out = attn_out + lw["b_o"].astype(attn_out.dtype)
+        h = h + attn_out
+        y2 = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
         h = h + self._ffn(lw, y2)
         return h, cache_out
 
@@ -228,7 +236,10 @@ class InferenceEngine:
             return res.output
         if cfg.activation == "swiglu":
             return (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
-        return (jax.nn.gelu(y @ lw["w_up"] + lw["b_up"].astype(y.dtype))) @ lw["w_down"] + lw["b_down"].astype(y.dtype)
+        from ..models.transformer import activation_fn
+
+        act = activation_fn(cfg.activation)
+        return act(y @ lw["w_up"] + lw["b_up"].astype(y.dtype)) @ lw["w_down"] + lw["b_down"].astype(y.dtype)
 
     def _decode_step(self, params, cache: KVCache, tok, pos):
         """One token for every sequence. tok [B], pos [B] = cache fill level.
@@ -352,11 +363,17 @@ class InferenceEngine:
 def init_inference(model=None, params=None, config=None, **kwargs) -> InferenceEngine:
     """Build an InferenceEngine (reference ``deepspeed.init_inference``,
     ``deepspeed/__init__.py:299``). ``config`` is a dict in the reference's
-    inference-config format or an InferenceConfig."""
+    inference-config format or an InferenceConfig. ``model`` may also be a
+    HF checkpoint path or transformers model — the engine-factory dispatch
+    of the reference (inference/v2/engine_factory.py:32) via models/hf.py."""
     if not isinstance(config, InferenceConfig):
         cfg_dict = dict(config or {})
         cfg_dict.update(kwargs)
         config = InferenceConfig.from_dict(cfg_dict)
+    if isinstance(model, str) or (model is not None and hasattr(model, "state_dict")):
+        from ..models.hf import from_hf
+
+        model, params = from_hf(model)
     if params is None:
         raise ValueError("init_inference requires params (the model weights pytree)")
     log_dist(f"init_inference: dtype={config.dtype} tp={config.tensor_parallel} "

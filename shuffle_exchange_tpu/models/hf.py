@@ -1,0 +1,268 @@
+"""HuggingFace model import: config + weight conversion into the model zoo.
+
+Capability parity with the reference's per-architecture support surface —
+the v1 injection policies/containers (``module_inject/containers/`` gpt2,
+llama/llama2, opt, …) and the v2 engine factory's arch dispatch
+(``inference/v2/engine_factory.py:32,69``: llama, mistral, mixtral, opt,
+phi/phi3, qwen/qwen2, falcon). A reference user points the engine at an HF
+model; here ``from_hf(model_or_path)`` returns ``(Transformer, params)``
+ready for ``sxt.initialize`` / ``init_inference``.
+
+TPU-native shape: instead of swapping nn.Modules layer by layer, the HF
+state dict is re-laid-out once into the zoo Transformer's stacked-scanned
+format (per-layer weights stacked on a leading L dim; torch Linear weights
+transposed to [in, out]); tensor-parallel sharding then comes from
+``Transformer.partition_specs`` (the AutoTP analog) with no per-arch
+kernels. Conversions accept a transformers model object, a state-dict, or
+a local checkpoint directory — no network access is assumed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+from .transformer import Transformer, TransformerConfig
+
+# HF architecture class name -> family key
+_ARCH_FAMILIES = {
+    "LlamaForCausalLM": "llama",
+    "MistralForCausalLM": "llama",        # same wiring, different defaults
+    "Qwen2ForCausalLM": "qwen2",
+    "MixtralForCausalLM": "mixtral",
+    "GPT2LMHeadModel": "gpt2",
+    "OPTForCausalLM": "opt",
+    "Phi3ForCausalLM": "phi3",
+}
+
+
+_MODEL_TYPE_FAMILIES = {"llama": "llama", "mistral": "llama", "qwen2": "qwen2",
+                        "mixtral": "mixtral", "gpt2": "gpt2", "opt": "opt",
+                        "phi3": "phi3"}
+
+
+def _family(cfg: Dict[str, Any]) -> str:
+    archs = cfg.get("architectures") or []
+    family = next((_ARCH_FAMILIES[a] for a in archs if a in _ARCH_FAMILIES), None)
+    if family is None:
+        family = _MODEL_TYPE_FAMILIES.get(cfg.get("model_type", ""))
+    if family is None:
+        raise ValueError(f"Unsupported HF architecture {archs or cfg.get('model_type')!r}; "
+                         f"supported: {sorted(set(_ARCH_FAMILIES.values()))}")
+    return family
+
+
+def config_from_hf(hf_config) -> TransformerConfig:
+    """Map an HF config object/dict to a TransformerConfig."""
+    cfg = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    family = _family(cfg)
+
+    if family == "gpt2":
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["n_embd"], n_layers=cfg["n_layer"],
+            n_heads=cfg["n_head"], max_seq_len=cfg.get("n_positions", 1024),
+            activation="gelu", norm="layernorm", position="learned",
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+            attn_qkv_bias=True, attn_out_bias=True, tie_embeddings=True)
+    if family == "opt":
+        if cfg.get("word_embed_proj_dim") not in (None, cfg["hidden_size"]):
+            raise ValueError(
+                "OPT with word_embed_proj_dim != hidden_size (project_in/out, e.g. "
+                "opt-350m) is not supported by this conversion")
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"], n_heads=cfg["num_attention_heads"],
+            d_ff=cfg.get("ffn_dim"), max_seq_len=cfg.get("max_position_embeddings", 2048),
+            activation=cfg.get("activation_function", "relu"),
+            norm="layernorm", position="learned", pos_offset=2,
+            attn_qkv_bias=cfg.get("enable_bias", True), attn_out_bias=cfg.get("enable_bias", True),
+            tie_embeddings=cfg.get("tie_word_embeddings", True))
+    # rope/rmsnorm families
+    common = dict(
+        vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"], n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads"),
+        d_ff=cfg.get("intermediate_size"),
+        max_seq_len=cfg.get("max_position_embeddings", 4096),
+        activation="swiglu", norm="rmsnorm", position="rope",
+        rope_theta=float(cfg.get("rope_theta", 10000.0)),
+        norm_eps=cfg.get("rms_norm_eps", 1e-6),
+        tie_embeddings=cfg.get("tie_word_embeddings", False))
+    if family == "qwen2":
+        return TransformerConfig(attn_qkv_bias=True, **common)
+    if family == "mixtral":
+        return TransformerConfig(
+            n_experts=cfg["num_local_experts"], moe_top_k=cfg.get("num_experts_per_tok", 2),
+            aux_loss_coef=cfg.get("router_aux_loss_coef", 0.02),
+            # generous capacity: HF routes without drops
+            capacity_factor=float(cfg.get("capacity_factor", 8.0)), **common)
+    return TransformerConfig(**common)  # llama / mistral / phi3
+
+
+# ---------------------------------------------------------------------------
+# weight conversion
+# ---------------------------------------------------------------------------
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu")
+        try:
+            return t.numpy().astype(np.float32)
+        except TypeError:
+            return t.float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _stack(sd: Dict[str, Any], fmt: str, L: int, transpose: bool = False) -> np.ndarray:
+    mats = [_np(sd[fmt.format(i)]) for i in range(L)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return np.stack(mats)
+
+
+def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
+                           family: str) -> Dict[str, Any]:
+    """Re-lay an HF state dict into the zoo Transformer's stacked format."""
+    L = config.n_layers
+    sd = {k.removeprefix("transformer.").removeprefix("model."): v for k, v in sd.items()}
+    p: Dict[str, Any] = {}
+
+    if family == "gpt2":
+        p["embed"] = _np(sd["wte.weight"])
+        p["pos_embed"] = _np(sd["wpe.weight"])
+        # GPT-2 Conv1D stores [in, out] — our layout already; fused qkv split.
+        qkv = _stack(sd, "h.{}.attn.c_attn.weight", L)          # [L, D, 3D]
+        D = config.d_model
+        p_layers = {
+            "ln1_w": _stack(sd, "h.{}.ln_1.weight", L), "ln1_b": _stack(sd, "h.{}.ln_1.bias", L),
+            "ln2_w": _stack(sd, "h.{}.ln_2.weight", L), "ln2_b": _stack(sd, "h.{}.ln_2.bias", L),
+            "wq": qkv[:, :, :D], "wk": qkv[:, :, D:2 * D], "wv": qkv[:, :, 2 * D:],
+            "wo": _stack(sd, "h.{}.attn.c_proj.weight", L),
+            "b_o": _stack(sd, "h.{}.attn.c_proj.bias", L),
+            "w_up": _stack(sd, "h.{}.mlp.c_fc.weight", L),
+            "b_up": _stack(sd, "h.{}.mlp.c_fc.bias", L),
+            "w_down": _stack(sd, "h.{}.mlp.c_proj.weight", L),
+            "b_down": _stack(sd, "h.{}.mlp.c_proj.bias", L),
+        }
+        qkv_b = _stack(sd, "h.{}.attn.c_attn.bias", L)
+        p_layers["b_q"], p_layers["b_k"], p_layers["b_v"] = (
+            qkv_b[:, :D], qkv_b[:, D:2 * D], qkv_b[:, 2 * D:])
+        p["layers"] = p_layers
+        p["ln_f_w"], p["ln_f_b"] = _np(sd["ln_f.weight"]), _np(sd["ln_f.bias"])
+        return p
+
+    if family == "opt":
+        dec = "decoder."
+        p["embed"] = _np(sd[dec + "embed_tokens.weight"])
+        p["pos_embed"] = _np(sd[dec + "embed_positions.weight"])
+        p["layers"] = {
+            "ln1_w": _stack(sd, dec + "layers.{}.self_attn_layer_norm.weight", L),
+            "ln1_b": _stack(sd, dec + "layers.{}.self_attn_layer_norm.bias", L),
+            "ln2_w": _stack(sd, dec + "layers.{}.final_layer_norm.weight", L),
+            "ln2_b": _stack(sd, dec + "layers.{}.final_layer_norm.bias", L),
+            "wq": _stack(sd, dec + "layers.{}.self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, dec + "layers.{}.self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, dec + "layers.{}.self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, dec + "layers.{}.self_attn.out_proj.weight", L, transpose=True),
+            "b_q": _stack(sd, dec + "layers.{}.self_attn.q_proj.bias", L),
+            "b_k": _stack(sd, dec + "layers.{}.self_attn.k_proj.bias", L),
+            "b_v": _stack(sd, dec + "layers.{}.self_attn.v_proj.bias", L),
+            "b_o": _stack(sd, dec + "layers.{}.self_attn.out_proj.bias", L),
+            "w_up": _stack(sd, dec + "layers.{}.fc1.weight", L, transpose=True),
+            "b_up": _stack(sd, dec + "layers.{}.fc1.bias", L),
+            "w_down": _stack(sd, dec + "layers.{}.fc2.weight", L, transpose=True),
+            "b_down": _stack(sd, dec + "layers.{}.fc2.bias", L),
+        }
+        p["ln_f_w"] = _np(sd[dec + "final_layer_norm.weight"])
+        p["ln_f_b"] = _np(sd[dec + "final_layer_norm.bias"])
+        if not config.tie_embeddings:
+            p["unembed"] = _np(sd["lm_head.weight"]).T
+        return p
+
+    # rope/rmsnorm families: llama / mistral / qwen2 / phi3 / mixtral
+    p["embed"] = _np(sd["embed_tokens.weight"])
+    layers: Dict[str, np.ndarray] = {
+        "ln1_w": _stack(sd, "layers.{}.input_layernorm.weight", L),
+        "ln2_w": _stack(sd, "layers.{}.post_attention_layernorm.weight", L),
+    }
+    H, KV, Dh = config.n_heads, config.kv_heads, config.head_dim
+    if family == "phi3":
+        qkv = _stack(sd, "layers.{}.self_attn.qkv_proj.weight", L, transpose=True)
+        q_dim = H * Dh
+        layers["wq"] = qkv[:, :, :q_dim]
+        layers["wk"] = qkv[:, :, q_dim:q_dim + KV * Dh]
+        layers["wv"] = qkv[:, :, q_dim + KV * Dh:]
+        layers["wo"] = _stack(sd, "layers.{}.self_attn.o_proj.weight", L, transpose=True)
+        gate_up = _stack(sd, "layers.{}.mlp.gate_up_proj.weight", L, transpose=True)
+        F = config.ff_dim
+        layers["w_gate"], layers["w_up"] = gate_up[:, :, :F], gate_up[:, :, F:]
+        layers["w_down"] = _stack(sd, "layers.{}.mlp.down_proj.weight", L, transpose=True)
+    else:
+        layers["wq"] = _stack(sd, "layers.{}.self_attn.q_proj.weight", L, transpose=True)
+        layers["wk"] = _stack(sd, "layers.{}.self_attn.k_proj.weight", L, transpose=True)
+        layers["wv"] = _stack(sd, "layers.{}.self_attn.v_proj.weight", L, transpose=True)
+        layers["wo"] = _stack(sd, "layers.{}.self_attn.o_proj.weight", L, transpose=True)
+        if config.attn_qkv_bias:
+            layers["b_q"] = _stack(sd, "layers.{}.self_attn.q_proj.bias", L)
+            layers["b_k"] = _stack(sd, "layers.{}.self_attn.k_proj.bias", L)
+            layers["b_v"] = _stack(sd, "layers.{}.self_attn.v_proj.bias", L)
+        if family == "mixtral":
+            E = config.n_experts
+            layers["moe_gate"] = _stack(sd, "layers.{}.block_sparse_moe.gate.weight", L,
+                                        transpose=True)
+            def experts(fmt):
+                return np.stack([
+                    np.stack([_np(sd[fmt.format(i, e)]).T for e in range(E)])
+                    for i in range(L)])
+            # HF mixtral: w1 = gate, w3 = up, w2 = down
+            layers["moe_w_gate"] = experts("layers.{}.block_sparse_moe.experts.{}.w1.weight")
+            layers["moe_w_up"] = experts("layers.{}.block_sparse_moe.experts.{}.w3.weight")
+            layers["moe_w_down"] = experts("layers.{}.block_sparse_moe.experts.{}.w2.weight")
+        else:
+            layers["w_gate"] = _stack(sd, "layers.{}.mlp.gate_proj.weight", L, transpose=True)
+            layers["w_up"] = _stack(sd, "layers.{}.mlp.up_proj.weight", L, transpose=True)
+            layers["w_down"] = _stack(sd, "layers.{}.mlp.down_proj.weight", L, transpose=True)
+    p["layers"] = layers
+    p["ln_f_w"] = _np(sd["norm.weight"])
+    p["ln_f_b"] = np.zeros_like(p["ln_f_w"])  # rmsnorm has no bias; kept for tree parity
+    if not config.tie_embeddings:
+        p["unembed"] = _np(sd["lm_head.weight"]).T
+    return p
+
+
+def from_hf(model_or_path, dtype=None) -> Tuple[Transformer, Dict[str, Any]]:
+    """(Transformer, params) from a transformers model object, a
+    (config, state_dict) pair, or a local checkpoint directory."""
+    if isinstance(model_or_path, tuple):
+        hf_config, sd = model_or_path
+    elif isinstance(model_or_path, str):
+        import transformers
+
+        hf_config = transformers.AutoConfig.from_pretrained(model_or_path)
+        model = transformers.AutoModelForCausalLM.from_pretrained(model_or_path)
+        sd = model.state_dict()
+    else:
+        hf_config = model_or_path.config
+        sd = model_or_path.state_dict()
+
+    cfg_dict = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    family = _family(cfg_dict)
+    config = config_from_hf(cfg_dict)
+    params = params_from_state_dict(sd, config, family)
+    import jax.numpy as jnp
+
+    if dtype is not None:
+        params = _tree_cast(params, dtype)
+    else:
+        params = _tree_cast(params, jnp.float32)
+    return Transformer(config), params
+
+
+def _tree_cast(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), tree)

@@ -43,6 +43,10 @@ class TransformerConfig:
     rope_theta: float = 500000.0
     tie_embeddings: bool = True
     dropout: float = 0.0
+    norm_eps: float = 1e-5
+    attn_qkv_bias: bool = False                # Qwen2-style q/k/v biases
+    attn_out_bias: bool = False                # GPT-2/OPT-style out-proj bias
+    pos_offset: int = 0                        # OPT offsets positions by 2
     dtype: Any = None                          # compute dtype override (engine usually casts)
     remat: bool = False
     remat_policy: str = "dots_saveable"
@@ -78,12 +82,14 @@ class TransformerConfig:
 
 def gpt2_small() -> TransformerConfig:  # 125M — capability config #1
     return TransformerConfig(vocab_size=50257, d_model=768, n_layers=12, n_heads=12,
-                             max_seq_len=1024, activation="gelu", norm="layernorm", position="learned")
+                             max_seq_len=1024, activation="gelu", norm="layernorm", position="learned",
+                             attn_qkv_bias=True, attn_out_bias=True)
 
 
 def gpt2_large() -> TransformerConfig:
     return TransformerConfig(vocab_size=50257, d_model=1280, n_layers=36, n_heads=20,
-                             max_seq_len=1024, activation="gelu", norm="layernorm", position="learned")
+                             max_seq_len=1024, activation="gelu", norm="layernorm", position="learned",
+                             attn_qkv_bias=True, attn_out_bias=True)
 
 
 def llama3_8b() -> TransformerConfig:  # capability config #2 (north star)
@@ -119,6 +125,17 @@ def tiny_moe(vocab=256, d=64, layers=2, heads=4, seq=64, experts=4, **kw) -> Tra
 # ---------------------------------------------------------------------------
 # Core ops (jnp reference implementations; Pallas kernels swap in via ops/)
 # ---------------------------------------------------------------------------
+
+
+def activation_fn(name: str):
+    """Non-gated activation dispatch ("swiglu" is handled structurally)."""
+    import jax
+
+    try:
+        return {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+                "gelu_new": jax.nn.gelu}[name]
+    except KeyError:
+        raise ValueError(f"Unsupported activation {name!r}; use swiglu/gelu/relu/silu")
 
 
 def _norm(x, weight, bias, kind: str, eps: float = 1e-5):
@@ -207,6 +224,12 @@ class Transformer:
             "wv": stack(next(keys), (D, KV * Dh), D),
             "wo": stack(next(keys), (H * Dh, D), H * Dh, scale=1.0 / math.sqrt(2 * L)),
         }
+        if cfg.attn_qkv_bias:
+            layer["b_q"] = jnp.zeros((L, H * Dh))
+            layer["b_k"] = jnp.zeros((L, KV * Dh))
+            layer["b_v"] = jnp.zeros((L, KV * Dh))
+        if cfg.attn_out_bias:
+            layer["b_o"] = jnp.zeros((L, D))
         if cfg.n_experts > 0:
             import jax.random as jrandom
 
@@ -258,8 +281,8 @@ class Transformer:
                 return P(*lead, None, "tensor")       # column parallel
             if name in ("wo", "w_down"):
                 return P(*lead, "tensor", None)       # row parallel
-            if name in ("b_up",):
-                return P(*lead, "tensor")
+            if name in ("b_up", "b_q", "b_k", "b_v"):
+                return P(*lead, "tensor")  # column-parallel biases
             if name == "embed":
                 return P("tensor", None)              # vocab parallel
             if name == "unembed":
@@ -284,7 +307,7 @@ class Transformer:
         T = input_ids.shape[-1]
         x = jnp.take(params["embed"], input_ids, axis=0)
         if cfg.position == "learned":
-            x = x + params["pos_embed"][:T].astype(x.dtype)
+            x = x + params["pos_embed"][cfg.pos_offset:cfg.pos_offset + T].astype(x.dtype)
             return x, (None, None)
         return x, rope_table(T, cfg.head_dim, cfg.rope_theta)
 
@@ -298,15 +321,22 @@ class Transformer:
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         cos, sin = rope
         dtype = h.dtype
-        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm)
+        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm, eps=cfg.norm_eps)
         q = (y @ lw["wq"]).reshape(B, T, H, Dh)
         k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
         v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
+        if cfg.attn_qkv_bias:
+            q = q + lw["b_q"].astype(dtype).reshape(H, Dh)
+            k = k + lw["b_k"].astype(dtype).reshape(KV, Dh)
+            v = v + lw["b_v"].astype(dtype).reshape(KV, Dh)
         if cfg.position == "rope":
             q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
         attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl).reshape(B, T, H * Dh)
-        h = h + attn @ lw["wo"]
-        y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm)
+        attn_out = attn @ lw["wo"]
+        if cfg.attn_out_bias:
+            attn_out = attn_out + lw["b_o"].astype(dtype)
+        h = h + attn_out
+        y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
         aux = jnp.zeros((), jnp.float32)
         if cfg.n_experts > 0:
             from ..moe.layer import moe_layer
@@ -318,7 +348,8 @@ class Transformer:
         elif cfg.activation == "swiglu":
             ff = (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
         else:
-            ff = (jax.nn.gelu(y @ lw["w_up"] + lw["b_up"].astype(dtype))) @ lw["w_down"] + lw["b_down"].astype(dtype)
+            act = activation_fn(cfg.activation)
+            ff = act(y @ lw["w_up"] + lw["b_up"].astype(dtype)) @ lw["w_down"] + lw["b_down"].astype(dtype)
         h = h + ff
         return h, aux
 
@@ -339,7 +370,8 @@ class Transformer:
         """Final norm + unembed: x [.., T, D] -> logits [.., T, vocab] fp32."""
         import jax.numpy as jnp
 
-        x = _norm(x, params["ln_f_w"], params["ln_f_b"], self.config.norm)
+        x = _norm(x, params["ln_f_w"], params["ln_f_b"], self.config.norm,
+                  eps=self.config.norm_eps)
         if self.config.tie_embeddings:
             return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
         return x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)

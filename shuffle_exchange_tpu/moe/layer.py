@@ -57,7 +57,9 @@ def expert_mlp(params, x, activation: str = "swiglu"):
         gate = jnp.einsum("ecm,emf->ecf", x, params["w_gate"].astype(x.dtype))
         h = jax.nn.silu(gate) * up
     else:
-        h = jax.nn.gelu(up)
+        from ..models.transformer import activation_fn
+
+        h = activation_fn(activation)(up)
     return jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype))
 
 

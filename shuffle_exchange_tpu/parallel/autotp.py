@@ -1,0 +1,126 @@
+"""AutoTP: automatic tensor-parallel sharding inference by parameter name.
+
+Capability parity with the reference's ``module_inject/auto_tp.py:193``
+(AutoTP graph walk that classifies Linears into column-parallel
+``LinearLayer`` vs row-parallel ``LinearAllreduce``) and ``tp_shard.py``
+bookkeeping. TPU-native shape: instead of swapping modules, classify each
+*parameter* by its path name and emit a PartitionSpec over the mesh
+"tensor" axis — XLA then inserts the column/row-parallel collectives the
+reference implements by hand (module_inject/layers.py:388,465).
+
+Works on any pytree (our zoo layouts, HF state dicts, custom models);
+unknown names stay replicated, mirroring AutoTP's conservative fallback.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+# Column-parallel: output features split over "tensor" (last dim of an
+# [in, out] matrix). Reference: qkv + up/gate projections.
+_COL_NAMES = {
+    "wq", "wk", "wv", "w_gate", "w_up", "q_proj", "k_proj", "v_proj",
+    "gate_proj", "up_proj", "qkv_proj", "gate_up_proj", "c_attn", "c_fc",
+    "query", "key", "value", "query_key_value", "dense_h_to_4h", "fc1",
+    "w1", "w3", "in_proj", "wi", "lin1",
+    # zoo column-parallel biases (row-parallel biases apply post-allreduce
+    # and stay replicated, so b_o / b_down are intentionally absent)
+    "b_q", "b_k", "b_v", "b_up",
+}
+# Row-parallel: input features split (first dim); output allreduced.
+_ROW_NAMES = {
+    "wo", "w_down", "o_proj", "down_proj", "out_proj", "c_proj", "dense",
+    "dense_4h_to_h", "fc2", "w2", "wo_proj", "lin2",
+}
+_VOCAB_NAMES = {"embed", "embed_tokens", "wte", "word_embeddings", "tok_embeddings"}
+_UNEMBED_NAMES = {"unembed", "lm_head", "output", "embed_out"}
+_BIAS_PREFIXES = ("b_", "bias")
+
+
+def _leaf_name(path: Sequence[str]) -> str:
+    """Last meaningful component ('layers.0.self_attn.q_proj.weight' -> 'q_proj')."""
+    parts = [p for p in path if p not in ("weight", "bias", "kernel", "w", "b")]
+    return parts[-1] if parts else ""
+
+
+def classify(path: Sequence[str]) -> str:
+    """'column' | 'row' | 'vocab' | 'unembed' | 'replicate' for a param path."""
+    name = _leaf_name(path)
+    base = re.sub(r"\d+$", "", name).rstrip("._")
+    if base in _COL_NAMES or name in _COL_NAMES:
+        return "column"
+    if base in _ROW_NAMES or name in _ROW_NAMES:
+        return "row"
+    if base in _VOCAB_NAMES or name in _VOCAB_NAMES:
+        return "vocab"
+    if base in _UNEMBED_NAMES or name in _UNEMBED_NAMES:
+        return "unembed"
+    return "replicate"
+
+
+def infer_partition_specs(params, tensor_axis: str = "tensor",
+                          stacked_layer_key: str = "layers"):
+    """Pytree of PartitionSpecs for ``params`` (the AutoTP entry point).
+
+    Matrix params classified column/row get ``tensor_axis`` on their
+    out/in-feature dim; vocab embeddings shard the vocab dim; 1-D biases of
+    column-parallel projections shard their only dim; everything else is
+    replicated. Leaves under ``stacked_layer_key`` get a leading None for
+    the scan-stacked layer dim.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(keypath, leaf):
+        path = []
+        for e in keypath:
+            if hasattr(e, "key"):
+                path.append(str(e.key))
+            elif hasattr(e, "idx"):
+                path.append(str(e.idx))
+        kind = classify(path)
+        ndim = leaf.ndim
+        stacked = bool(path) and path[0] == stacked_layer_key
+        lead = (None,) if (stacked and ndim >= 1) else ()
+        eff = ndim - len(lead)
+        if kind == "column":
+            if eff >= 2:
+                return P(*lead, *((None,) * (eff - 1)), tensor_axis)
+            if eff == 1:
+                return P(*lead, tensor_axis)   # column bias shards with outputs
+        elif kind == "row":
+            if eff >= 2:
+                return P(*lead, *((None,) * (eff - 2)), tensor_axis, None)
+            # row-parallel bias is applied post-allreduce: replicate
+        elif kind == "vocab":
+            if eff >= 2:
+                return P(*lead, tensor_axis, *((None,) * (eff - 1)))
+        elif kind == "unembed":
+            if eff >= 2:
+                return P(*lead, *((None,) * (eff - 1)), tensor_axis)
+        return P(*((None,) * ndim))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_sizes(params, specs, axis_sizes: Dict[str, int]) -> Dict[str, Tuple[int, int]]:
+    """Per-leaf (replicated_elems, sharded_elems) bookkeeping (tp_shard.py
+    analog) — lets callers sanity-check what AutoTP decided."""
+    import jax
+    import math
+
+    out = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_leaves(specs)
+    for (keypath, leaf), spec in zip(flat_p, flat_s):
+        name = ".".join(str(getattr(e, "key", getattr(e, "idx", ""))) for e in keypath)
+        n = math.prod(leaf.shape) if leaf.shape else 1
+        div = 1
+        for entry in spec:
+            if entry is None:
+                continue
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                div *= axis_sizes.get(ax, 1)
+        out[name] = (n, n // max(div, 1))
+    return out

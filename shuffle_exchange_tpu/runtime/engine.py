@@ -577,6 +577,7 @@ class Engine:
                 ("Train/Samples/loss_scale", self.loss_scale(), s),
             ])
         self._maybe_swap_out_opt()
+        self._finalize_pending_checkpoint()   # decoupled-writer step-boundary commit
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         return loss
@@ -740,6 +741,7 @@ class Engine:
         import jax
 
         tag = tag or f"global_step{self.global_steps}"
+        self._finalize_pending_checkpoint()   # at most one decoupled save in flight
         self._ensure_opt_resident()
         validate_tag(tag, self.config.checkpoint.tag_validation)
         path = os.path.join(save_dir, tag)
@@ -750,8 +752,7 @@ class Engine:
         eng.save({"opt_state": self.state.opt_state,
                   "loss_scale": self.state.loss_scale,
                   "step": self.state.step}, os.path.join(path, "opt"))
-        eng.commit(tag)
-        # Host-side metadata + tag: single-writer (process 0) on shared storage.
+        # Host-side metadata: single-writer (process 0) on shared storage.
         if jax.process_index() == 0:
             host = self._host_state()
             if client_state:
@@ -759,12 +760,35 @@ class Engine:
             os.makedirs(path, exist_ok=True)
             with open(os.path.join(path, "host_state.json"), "w") as f:
                 json.dump(host, f, default=str)
+        if self.config.checkpoint.writer == "decoupled":
+            # Decoupled writer (reference decoupled_checkpoint_engine.py:68):
+            # writes continue in the background; commit + `latest` tag land
+            # at the next step boundary (engine.py:2431) or next save/load.
+            self._pending_ckpt = (eng, tag, save_dir, path)
+            log_dist(f"checkpoint {path} writing in background (decoupled)", ranks=[0])
+            return path
+        self._commit_checkpoint(eng, tag, save_dir, path)
+        return path
+
+    def _commit_checkpoint(self, eng, tag: str, save_dir: str, path: str) -> None:
+        import jax
+
+        from ..checkpoint.engine import write_latest_tag
+
+        eng.commit(tag)
+        if jax.process_index() == 0:
             write_latest_tag(save_dir, tag)
         from ..parallel import comm as _comm
 
         _comm.barrier("save_checkpoint")
         log_dist(f"saved checkpoint {path}", ranks=[0])
-        return path
+
+    def _finalize_pending_checkpoint(self) -> None:
+        pending = getattr(self, "_pending_ckpt", None)
+        if pending is None:
+            return
+        self._pending_ckpt = None
+        self._commit_checkpoint(*pending)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
@@ -777,6 +801,7 @@ class Engine:
 
         from ..checkpoint.engine import read_latest_tag
 
+        self._finalize_pending_checkpoint()
         tag = tag or read_latest_tag(load_dir)
         if tag is None:
             raise ConfigError(f"No 'latest' tag in {load_dir} and none given")
@@ -822,6 +847,33 @@ class Engine:
         np.savez(out, **{k: np.asarray(v) for k, v in flat.items()})
         log_dist(f"saved 16-bit model to {out}", ranks=[0])
         return out
+
+    # -- tensor-fragment APIs (reference utils/tensor_fragment.py) --------
+
+    def get_full_fp32_param(self, name: str):
+        from ..utils.tensor_fragment import safe_get_full_fp32_param
+
+        return safe_get_full_fp32_param(self, name)
+
+    def set_full_fp32_param(self, name: str, value) -> None:
+        from ..utils.tensor_fragment import safe_set_full_fp32_param
+
+        safe_set_full_fp32_param(self, name, value)
+
+    def get_full_optimizer_state(self, name: str, state_key: str):
+        from ..utils.tensor_fragment import safe_get_full_optimizer_state
+
+        return safe_get_full_optimizer_state(self, name, state_key)
+
+    def set_full_optimizer_state(self, name: str, state_key: str, value) -> None:
+        from ..utils.tensor_fragment import safe_set_full_optimizer_state
+
+        safe_set_full_optimizer_state(self, name, state_key, value)
+
+    def get_full_grad(self, name: str):
+        from ..utils.tensor_fragment import safe_get_full_grad
+
+        return safe_get_full_grad(self, name)
 
     def get_lr(self) -> float:
         try:

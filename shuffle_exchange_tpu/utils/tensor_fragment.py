@@ -1,0 +1,143 @@
+"""Tensor-fragment access: full-param/optimizer-state get/set by name.
+
+Capability parity with the reference's ``utils/tensor_fragment.py``
+``safe_get_full_fp32_param`` / ``safe_set_full_fp32_param`` /
+``safe_get_full_optimizer_state`` / ``safe_set_full_optimizer_state`` /
+``safe_get_full_grad`` APIs (SURVEY.md §2.12): user code addresses a
+parameter by its tree path (``"layers.wq"``) and reads/writes the full
+fp32 master value or a named optimizer-state moment, regardless of how the
+ZeRO policy sharded it. On TPU the "gather the fragments" step is just a
+``device_get`` of the sharded array (XLA assembles the global view);
+set re-places with the existing sharding.
+
+Optimizer-state names accept both the reference's spellings ("exp_avg",
+"exp_avg_sq") and optax's ("mu", "nu").
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+_STATE_ALIASES = {"exp_avg": "mu", "exp_avg_sq": "nu", "momentum": "mu", "variance": "nu"}
+
+
+def _path_str(path) -> str:
+    parts = []
+    for e in path:
+        if hasattr(e, "key"):
+            parts.append(str(e.key))
+        elif hasattr(e, "idx"):
+            parts.append(str(e.idx))
+        elif hasattr(e, "name"):
+            parts.append(str(e.name))
+    return ".".join(parts)
+
+
+def _find_leaf(tree, name: str) -> Tuple[Any, Any]:
+    """(leaf, set_fn) for the leaf whose dotted path equals/ends with name."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    matches = [(p, l) for p, l in flat
+               if _path_str(p) == name or _path_str(p).endswith("." + name)]
+    if not matches:
+        raise KeyError(f"no parameter path matching {name!r}; available: "
+                       f"{[_path_str(p) for p, _ in flat[:20]]}...")
+    if len(matches) > 1:
+        raise KeyError(f"ambiguous name {name!r}: {[_path_str(p) for p, _ in matches]}")
+    return matches[0]
+
+
+def _replace_leaf(tree, target_path, new_value):
+    import jax
+
+    def maybe(path, leaf):
+        if _path_str(path) == _path_str(target_path):
+            arr = np.asarray(new_value).astype(leaf.dtype).reshape(leaf.shape)
+            return jax.device_put(arr, leaf.sharding)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe, tree)
+
+
+def _collapse_replicas(engine, arr: np.ndarray) -> np.ndarray:
+    if engine.ensemble:
+        return arr.mean(axis=0)
+    return arr
+
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Full fp32 master value of parameter ``name`` (consensus average over
+    decentralized replicas)."""
+    import jax
+
+    path, leaf = _find_leaf(engine.state.master, name)
+    return _collapse_replicas(engine, np.asarray(jax.device_get(leaf), np.float32))
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """Overwrite the fp32 master for ``name`` (broadcast to all replicas)."""
+    path, leaf = _find_leaf(engine.state.master, name)
+    value = np.asarray(value, np.float32)
+    if engine.ensemble and value.ndim + 1 == leaf.ndim:
+        value = np.broadcast_to(value, leaf.shape)
+    new_master = _replace_leaf(engine.state.master, path, value)
+    engine.state = engine.state._replace(master=new_master)
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Accumulated gradient for ``name`` (staged forward/backward path);
+    None when no gradients are pending."""
+    import jax
+
+    if engine._accum_grads is None:
+        return None
+    path, leaf = _find_leaf(engine._accum_grads, name)
+    return _collapse_replicas(engine, np.asarray(jax.device_get(leaf), np.float32))
+
+
+def _opt_candidates(opt_state, param_path_str: str, state_key: str) -> List:
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(opt_state)[0]
+    out = []
+    for p, l in flat:
+        s = _path_str(p)
+        if s.endswith("." + param_path_str) or s.endswith("." + param_path_str.split(".")[-1]):
+            if f".{state_key}." in f".{s}.":
+                out.append((p, l))
+    return out
+
+
+def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> np.ndarray:
+    """Named optimizer moment for parameter ``name`` (e.g. "exp_avg"/"mu")."""
+    import jax
+
+    engine._ensure_opt_resident()
+    key = _STATE_ALIASES.get(state_key, state_key)
+    param_path, param_leaf = _find_leaf(engine.state.master, name)
+    cands = [(p, l) for p, l in _opt_candidates(engine.state.opt_state, _path_str(param_path), key)
+             if tuple(l.shape) == tuple(param_leaf.shape)]
+    if not cands:
+        raise KeyError(f"no optimizer state {state_key!r} for param {name!r}")
+    return _collapse_replicas(engine, np.asarray(jax.device_get(cands[0][1]), np.float32))
+
+
+def safe_set_full_optimizer_state(engine, name: str, state_key: str, value) -> None:
+    import jax
+
+    engine._ensure_opt_resident()
+    key = _STATE_ALIASES.get(state_key, state_key)
+    param_path, param_leaf = _find_leaf(engine.state.master, name)
+    cands = [(p, l) for p, l in _opt_candidates(engine.state.opt_state, _path_str(param_path), key)
+             if tuple(l.shape) == tuple(param_leaf.shape)]
+    if not cands:
+        raise KeyError(f"no optimizer state {state_key!r} for param {name!r}")
+    target_path = cands[0][0]
+    value = np.asarray(value, np.float32)
+    if engine.ensemble and value.ndim + 1 == cands[0][1].ndim:
+        value = np.broadcast_to(value, cands[0][1].shape)
+    new_opt = _replace_leaf(engine.state.opt_state, target_path, value)
+    engine.state = engine.state._replace(opt_state=new_opt)

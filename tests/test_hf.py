@@ -1,0 +1,179 @@
+"""HF model import (reference module_inject containers + v2 engine_factory
+arch dispatch) and AutoTP spec inference (module_inject/auto_tp.py).
+
+Parity strategy: build tiny randomly-initialized transformers models on CPU
+torch, convert with models/hf.py, and compare logits against the HF forward
+— a much stronger check than shape tests.
+"""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from shuffle_exchange_tpu.models.hf import config_from_hf, from_hf
+from shuffle_exchange_tpu.parallel.autotp import classify, infer_partition_specs
+
+
+def _compare(hf_model, ids, rtol=2e-3, atol=2e-3):
+    import jax
+
+    hf_model.eval()
+    with torch.no_grad():
+        expected = hf_model(torch.tensor(ids)).logits.float().numpy()
+    model, params = from_hf(hf_model)
+    got = np.asarray(jax.jit(model.apply)(params, ids), np.float32)
+    np.testing.assert_allclose(got, expected, rtol=rtol, atol=atol)
+
+
+def _ids(vocab, b=2, t=16, seed=0):
+    return np.random.default_rng(seed).integers(0, vocab, size=(b, t)).astype(np.int32)
+
+
+def test_llama_logit_parity():
+    cfg = transformers.LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                                   num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=2, max_position_embeddings=64,
+                                   rope_theta=10000.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    _compare(transformers.LlamaForCausalLM(cfg), _ids(96))
+
+
+def test_mistral_logit_parity():
+    cfg = transformers.MistralConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                                     num_hidden_layers=2, num_attention_heads=4,
+                                     num_key_value_heads=2, max_position_embeddings=64,
+                                     sliding_window=None, tie_word_embeddings=False)
+    torch.manual_seed(1)
+    _compare(transformers.MistralForCausalLM(cfg), _ids(96))
+
+
+def test_qwen2_logit_parity_with_qkv_bias():
+    cfg = transformers.Qwen2Config(vocab_size=96, hidden_size=64, intermediate_size=128,
+                                   num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=2, max_position_embeddings=64,
+                                   tie_word_embeddings=False)
+    torch.manual_seed(2)
+    model = transformers.Qwen2ForCausalLM(cfg)
+    # make biases matter
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_proj.bias.normal_(0, 0.1)
+            layer.self_attn.k_proj.bias.normal_(0, 0.1)
+            layer.self_attn.v_proj.bias.normal_(0, 0.1)
+    _compare(model, _ids(96))
+
+
+def test_gpt2_logit_parity():
+    cfg = transformers.GPT2Config(vocab_size=96, n_embd=64, n_layer=2, n_head=4,
+                                  n_positions=64, attn_pdrop=0.0, embd_pdrop=0.0,
+                                  resid_pdrop=0.0)
+    torch.manual_seed(3)
+    _compare(transformers.GPT2LMHeadModel(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_opt_logit_parity():
+    cfg = transformers.OPTConfig(vocab_size=96, hidden_size=64, ffn_dim=128,
+                                 num_hidden_layers=2, num_attention_heads=4,
+                                 max_position_embeddings=64, do_layer_norm_before=True,
+                                 dropout=0.0, activation_function="gelu")
+    torch.manual_seed(4)
+    _compare(transformers.OPTForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_mixtral_logit_parity():
+    cfg = transformers.MixtralConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                                     num_hidden_layers=2, num_attention_heads=4,
+                                     num_key_value_heads=2, max_position_embeddings=64,
+                                     num_local_experts=4, num_experts_per_tok=2,
+                                     tie_word_embeddings=False)
+    torch.manual_seed(5)
+    # small batch so capacity (factor 8) routes without drops
+    _compare(transformers.MixtralForCausalLM(cfg), _ids(96, b=1, t=8), rtol=5e-3, atol=5e-3)
+
+
+def test_phi3_logit_parity():
+    cfg = transformers.Phi3Config(vocab_size=96, hidden_size=64, intermediate_size=128,
+                                  num_hidden_layers=2, num_attention_heads=4,
+                                  num_key_value_heads=2, max_position_embeddings=64,
+                                  tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(6)
+    _compare(transformers.Phi3ForCausalLM(cfg), _ids(96))
+
+
+def test_config_from_hf_rejects_unknown():
+    with pytest.raises(ValueError):
+        config_from_hf({"model_type": "space_transformer", "architectures": ["SpaceLM"]})
+
+
+def test_converted_model_trains(devices8):
+    """An imported HF model drops straight into sxt.initialize."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    cfg = transformers.LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                                   num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=2, max_position_embeddings=32,
+                                   tie_word_embeddings=False)
+    torch.manual_seed(7)
+    model, params = from_hf(transformers.LlamaForCausalLM(cfg))
+    reset_topology()
+    engine, *_ = sxt.initialize(model=model, params=params, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9})
+    batch = {"input_ids": _ids(64, b=8, t=32)}
+    l0 = float(engine.train_batch(batch))
+    l1 = float(engine.train_batch(batch))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_init_inference_accepts_hf_model():
+    import shuffle_exchange_tpu as sxt
+
+    cfg = transformers.LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                                   num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=2, max_position_embeddings=64,
+                                   tie_word_embeddings=False)
+    torch.manual_seed(8)
+    eng = sxt.init_inference(model=transformers.LlamaForCausalLM(cfg),
+                             config={"dtype": "fp32", "max_seq_len": 64})
+    out = eng.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4, temperature=0.0)
+    assert out.shape == (1, 4)  # generate returns the new tokens
+
+
+# ---------------------------------------------------------------------------
+# AutoTP
+# ---------------------------------------------------------------------------
+
+
+def test_classify_names():
+    assert classify(["layers", "0", "self_attn", "q_proj", "weight"]) == "column"
+    assert classify(["layers", "0", "self_attn", "o_proj", "weight"]) == "row"
+    assert classify(["model", "embed_tokens", "weight"]) == "vocab"
+    assert classify(["lm_head", "weight"]) == "unembed"
+    assert classify(["layers", "0", "input_layernorm", "weight"]) == "replicate"
+
+
+def test_infer_partition_specs_on_hf_tree():
+    from jax.sharding import PartitionSpec as P
+
+    tree = {
+        "layers": {
+            "wq": np.zeros((2, 16, 32)),   # stacked column
+            "wo": np.zeros((2, 32, 16)),   # stacked row
+            "b_q": np.zeros((2, 32)),      # column bias
+            "ln1_w": np.zeros((2, 16)),
+        },
+        "embed": np.zeros((100, 16)),
+        "lm_head": np.zeros((16, 100)),
+    }
+    specs = infer_partition_specs(tree)
+    assert specs["layers"]["wq"] == P(None, None, "tensor")
+    assert specs["layers"]["wo"] == P(None, "tensor", None)
+    assert specs["layers"]["b_q"] == P(None, "tensor")
+    assert specs["layers"]["ln1_w"] == P(None, None)
+    assert specs["embed"] == P("tensor", None)
+    assert specs["lm_head"] == P(None, "tensor")
