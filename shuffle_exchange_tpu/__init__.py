@@ -143,6 +143,18 @@ def initialize(
                 "parallel.PipelinedModel (or use a model-zoo Transformer) for real "
                 "pipeline parallelism.", topology.axis_sizes["pipe"])
 
+    # Random-LTD needs BOTH the schedule (engine config) and the model flag
+    # (Transformer random_ltd) — catch the silent half-configured case.
+    de = dict(cfg.data_efficiency or {})
+    ltd_on = dict(de.get("data_routing", {}).get("random_ltd", {})).get("enabled", False)
+    if ltd_on and hasattr(model, "config") and not getattr(model.config, "random_ltd", False):
+        from .utils.logging import logger
+
+        logger.warning(
+            "data_efficiency.data_routing.random_ltd is enabled but the model was built "
+            "with random_ltd=False — no tokens will be dropped. Set "
+            "TransformerConfig(random_ltd=True) to activate it.")
+
     # Resolve model/params/loss.
     resolved_params = params
     partition_specs = None

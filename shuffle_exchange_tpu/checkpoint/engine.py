@@ -200,6 +200,30 @@ class NativeCheckpointEngine(CheckpointEngine):
                 reads.append((li, sm, buf, req))
         for _, _, _, req in reads:
             self.io.wait(req)
+        # Coverage check: distinct shard indices must tile the global shape —
+        # a missing per-process manifest would otherwise leave np.empty
+        # regions as uninitialized memory.
+        import math as _math
+
+        def _span(idx, shape, total):
+            if idx is None:
+                return total
+            n = 1
+            for (a, b, _), dim in zip(idx, shape):
+                a = 0 if a is None else a
+                b = dim if b is None else b   # slice(None) bounds mean the full dim
+                n *= max(0, b - a)
+            return n if idx else 1            # scalar leaves: empty index = 1 elem
+
+        for entry in merged["leaves"]:
+            total = _math.prod(entry["global_shape"]) if entry["global_shape"] else 1
+            distinct = {tuple(map(tuple, sm["index"])) if sm["index"] is not None else None
+                        for sm in entry["shards"]}
+            covered = sum(_span(idx, entry["global_shape"], total) for idx in distinct)
+            if covered < total:
+                raise ValueError(
+                    f"checkpoint {path} is incomplete for leaf {entry['name']!r}: shards "
+                    f"cover {covered}/{total} elements (missing per-process manifests?)")
         arrays = [np.empty(tuple(e["global_shape"]), dtype=np.dtype(e["dtype"]))
                   for e in merged["leaves"]]
         for li, sm, buf, _ in reads:

@@ -63,7 +63,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
         return TransformerConfig(
             vocab_size=cfg["vocab_size"], d_model=cfg["n_embd"], n_layers=cfg["n_layer"],
             n_heads=cfg["n_head"], max_seq_len=cfg.get("n_positions", 1024),
-            activation="gelu", norm="layernorm", position="learned",
+            activation=cfg.get("activation_function", "gelu_new"),
+            norm="layernorm", position="learned",
             norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
             attn_qkv_bias=True, attn_out_bias=True, tie_embeddings=True)
     if family == "opt":

@@ -47,6 +47,14 @@ class TransformerConfig:
     attn_qkv_bias: bool = False                # Qwen2-style q/k/v biases
     attn_out_bias: bool = False                # GPT-2/OPT-style out-proj bias
     pos_offset: int = 0                        # OPT offsets positions by 2
+    # Random-LTD (reference runtime/data_pipeline/data_routing): middle
+    # layers skip a random token subset per step. TPU (static-shape) form:
+    # dropped tokens FREEZE their hidden state through the layer (masked
+    # select) instead of being gathered out — same schedule/regularization,
+    # no dynamic shapes. They remain visible as keys, a documented deviation.
+    random_ltd: bool = False
+    random_ltd_start_layer: int = 1
+    random_ltd_end_layer: int = -1             # exclusive; -1 = n_layers - 1
     dtype: Any = None                          # compute dtype override (engine usually casts)
     remat: bool = False
     remat_policy: str = "dots_saveable"
@@ -128,14 +136,21 @@ def tiny_moe(vocab=256, d=64, layers=2, heads=4, seq=64, experts=4, **kw) -> Tra
 
 
 def activation_fn(name: str):
-    """Non-gated activation dispatch ("swiglu" is handled structurally)."""
+    """Non-gated activation dispatch ("swiglu" is handled structurally).
+
+    "gelu" is the exact (erf) form as in HF; "gelu_new"/"gelu_pytorch_tanh"
+    are the tanh approximation (GPT-2 lineage)."""
+    import functools as _ft
+
     import jax
 
     try:
-        return {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
-                "gelu_new": jax.nn.gelu}[name]
+        return {"gelu": _ft.partial(jax.nn.gelu, approximate=False),
+                "relu": jax.nn.relu, "silu": jax.nn.silu,
+                "gelu_new": _ft.partial(jax.nn.gelu, approximate=True),
+                "gelu_pytorch_tanh": _ft.partial(jax.nn.gelu, approximate=True)}[name]
     except KeyError:
-        raise ValueError(f"Unsupported activation {name!r}; use swiglu/gelu/relu/silu")
+        raise ValueError(f"Unsupported activation {name!r}; use swiglu/gelu/relu/silu/gelu_new")
 
 
 def _norm(x, weight, bias, kind: str, eps: float = 1e-5):
@@ -353,17 +368,37 @@ class Transformer:
         h = h + ff
         return h, aux
 
-    def stack_apply(self, stacked_layers, x, rope):
-        """Scan the (sub)stack of layers over x. Returns (x, summed aux)."""
+    def stack_apply(self, stacked_layers, x, rope, ltd_mask=None):
+        """Scan the (sub)stack of layers over x. Returns (x, summed aux).
+
+        ``ltd_mask`` [B, T] bool (True = keep): random-LTD token freezing
+        for the configured middle layers."""
         import jax
         import jax.numpy as jnp
 
-        def layer_fn(h, lw):
-            return self.layer_apply(lw, h, rope)
+        cfg = self.config
+        if ltd_mask is None:
+            def layer_fn(h, lw):
+                return self.layer_apply(lw, h, rope)
 
-        if self.config.remat:
-            layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(self.config.remat_policy))
-        x, aux_losses = jax.lax.scan(layer_fn, x, stacked_layers)
+            if cfg.remat:
+                layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg.remat_policy))
+            x, aux_losses = jax.lax.scan(layer_fn, x, stacked_layers)
+            return x, jnp.sum(aux_losses)
+
+        L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+        end = cfg.random_ltd_end_layer if cfg.random_ltd_end_layer >= 0 else L - 1
+        active = (jnp.arange(L) >= cfg.random_ltd_start_layer) & (jnp.arange(L) < end)
+
+        def layer_fn(h, xs):
+            lw, act = xs
+            out, aux = self.layer_apply(lw, h, rope)
+            keep = jnp.logical_or(~act, ltd_mask)[..., None]   # [B,T,1]
+            return jnp.where(keep, out, h), aux
+
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg.remat_policy))
+        x, aux_losses = jax.lax.scan(layer_fn, x, (stacked_layers, active))
         return x, jnp.sum(aux_losses)
 
     def head(self, params, x):
@@ -394,24 +429,33 @@ class Transformer:
         """input_ids [B, T] -> logits [B, T, vocab] (fp32)."""
         return self.apply_with_aux(params, input_ids)[0]
 
-    def apply_with_aux(self, params, input_ids):
+    def apply_with_aux(self, params, input_ids, ltd_mask=None):
         """Returns (logits, moe_aux_loss) — aux is 0 for dense models."""
         x, rope = self.embed(params, input_ids)
-        x, aux = self.stack_apply(params["layers"], x, rope)
+        x, aux = self.stack_apply(params["layers"], x, rope, ltd_mask=ltd_mask)
         return self.head(params, x), aux
 
     def loss(self, params, batch, rng=None):
         """Next-token cross entropy. batch: {"input_ids": [B,T]} (+ optional
-        "labels" already shifted, -100 = ignore)."""
+        "labels" already shifted, -100 = ignore; + optional "ltd_keep_prob"
+        [B] for the random-LTD schedule)."""
         import jax.numpy as jnp
 
         ids = batch["input_ids"]
         if "labels" in batch:
             labels = batch["labels"]
-            logits, aux = self.apply_with_aux(params, ids)
+            model_ids = ids
         else:
             labels = ids[:, 1:]
-            logits, aux = self.apply_with_aux(params, ids[:, :-1])
+            model_ids = ids[:, :-1]
+        ltd_mask = None
+        if self.config.random_ltd and "ltd_keep_prob" in batch and rng is not None:
+            import jax
+
+            rng, sub = jax.random.split(rng)
+            keep = batch["ltd_keep_prob"][0]
+            ltd_mask = jax.random.uniform(sub, model_ids.shape) < keep
+        logits, aux = self.apply_with_aux(params, model_ids, ltd_mask=ltd_mask)
         nll_sum, count = self.token_loss(logits, labels)
         ce = nll_sum / jnp.maximum(count, 1)
         return ce + self.config.aux_loss_coef * aux

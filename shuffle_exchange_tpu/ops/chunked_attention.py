@@ -1,0 +1,94 @@
+"""FPDT-style chunked attention: long-context attention in O(chunk²) memory.
+
+Capability parity with the reference's FPDT (Fully Pipelined Distributed
+Transformer) chunked attention (``sequence/fpdt_layer.py:510,971``
+``SequenceChunk`` + online-softmax accumulation with CPU chunk offload,
+SURVEY.md §2.6 long-context row): the sequence is processed in query
+chunks, each scanning the KV prefix chunk-by-chunk with running
+log-sum-exp accumulation, so the [T, S] score matrix never materializes.
+
+TPU-native shape: a ``lax.scan`` over query chunks with an inner scan over
+KV chunks — the scan body is one MXU-shaped block; XLA double-buffers the
+HBM reads, which is the role the reference's explicit CPU double-buffering
+plays. Composes with ring attention (each ring hop can use a chunked local
+scan) and with remat (the scan is a natural checkpoint boundary).
+"""
+
+from __future__ import annotations
+
+from .flash_attention import _repeat_kv
+
+
+def chunked_attention(q, k, v, chunk_size: int = 512, causal: bool = True):
+    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]; fp32 accumulation.
+
+    T and S must be divisible by ``chunk_size`` (pad upstream); GQA via
+    broadcast repeat.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    n_rep = H // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    if T % chunk_size or S % chunk_size:
+        raise ValueError(f"chunked_attention: T={T}, S={S} must divide chunk_size={chunk_size}")
+    nq, nk = T // chunk_size, S // chunk_size
+    scale = D ** -0.5
+
+    q_blocks = q.reshape(B, nq, chunk_size, H, D).transpose(1, 0, 2, 3, 4)
+    k_blocks = k.reshape(B, nk, chunk_size, H, D).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(B, nk, chunk_size, H, D).transpose(1, 0, 2, 3, 4)
+
+    base = jnp.arange(chunk_size)
+
+    def q_chunk_body(_, qi_and_block):
+        qi, q_blk = qi_and_block
+        q32 = q_blk.astype(jnp.float32) * scale          # [B,c,H,D]
+
+        def attend_block(carry, ki, k_blk, v_blk):
+            acc, m_run, l_run = carry
+            logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
+            if causal:
+                q_pos = qi * chunk_size + base
+                kv_pos = ki * chunk_size + base
+                mask = q_pos[:, None] >= kv_pos[None, :]
+                logits = jnp.where(mask[None, None], logits, -jnp.inf)
+            m_blk = jnp.max(logits, axis=-1)
+            m_new = jnp.maximum(m_run, m_blk)
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - m_safe[..., None]), 0.0)
+            corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+            l_new = l_run * corr + p.sum(-1)
+            acc_new = acc * corr[..., None] + jnp.einsum(
+                "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
+            return (acc_new, m_new, l_new)
+
+        def kv_chunk_body(carry, ki_and_kv):
+            ki, k_blk, v_blk = ki_and_kv
+            if not causal:
+                return attend_block(carry, ki, k_blk, v_blk), None
+            # Skip blocks entirely above the diagonal: the scan is
+            # sequential, so the cond's dead branch saves the two einsums —
+            # ~half the block pairs in the long-context regime.
+            return jax.lax.cond(
+                ki <= qi,
+                lambda c: attend_block(c, ki, k_blk, v_blk),
+                lambda c: c,
+                carry), None
+
+        acc0 = jnp.zeros((B, H, chunk_size, D), jnp.float32)
+        m0 = jnp.full((B, H, chunk_size), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, H, chunk_size), jnp.float32)
+        (acc, m_run, l_run), _ = jax.lax.scan(
+            kv_chunk_body, (acc0, m0, l0),
+            (jnp.arange(nk), k_blocks, v_blocks))
+        out = acc / jnp.maximum(l_run[..., None], 1e-30)  # [B,H,c,D]
+        return None, out.transpose(0, 2, 1, 3)            # [B,c,H,D]
+
+    _, out_blocks = jax.lax.scan(q_chunk_body, None, (jnp.arange(nq), q_blocks))
+    # [nq, B, c, H, D] -> [B, T, H, D]
+    out = out_blocks.transpose(1, 0, 2, 3, 4).reshape(B, T, H, D)
+    return out.astype(q.dtype)

@@ -113,9 +113,24 @@ def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
 
 
 def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None):
-    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]."""
+    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D].
+
+    impl: auto | pallas | reference | chunked (FPDT-style scan, long-context
+    memory bound — see ops/chunked_attention.py)."""
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if impl == "chunked":
+        from .chunked_attention import chunked_attention
+
+        if segment_ids is not None:
+            warning_once("chunked attention does not support segment_ids; using reference")
+            return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        chunk = 512
+        while q.shape[1] % chunk or k.shape[1] % chunk:
+            chunk //= 2
+            if chunk < 16:
+                return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return chunked_attention(q, k, v, chunk_size=chunk, causal=causal)
     if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
         try:
             return pallas_attention(q, k, v, causal=causal, segment_ids=segment_ids)

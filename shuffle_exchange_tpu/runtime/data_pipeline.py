@@ -1,0 +1,119 @@
+"""Data-efficiency pipeline: curriculum learning + random-LTD schedules.
+
+Capability parity with the reference's data-efficiency stack
+(``runtime/data_pipeline/``, SURVEY.md §2.11): the curriculum scheduler
+(``curriculum_scheduler.py`` — fixed_linear / fixed_root / fixed_discrete /
+custom difficulty schedules, driven by the engine each step) applied as
+sequence-length truncation of the incoming batch, and the random-LTD
+(layer token drop) schedule (``data_routing/scheduler.py``) that ramps the
+kept-token count from a floor to the full sequence.
+
+TPU-native notes: curriculum truncation changes the batch's static shapes,
+so difficulties are bucketed to ``difficulty_step`` multiples — each bucket
+compiles once and is then cached (the reference pads/truncates per batch
+for the same reason, ``difficulty_step`` doc). Random-LTD's kept ratio
+feeds the model as a *traced* scalar (masked formulation, see
+models/transformer.py) so the schedule never recompiles.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+from ..config.config_utils import ConfigError
+
+
+class CurriculumScheduler:
+    """Difficulty(step) per the reference's schedule types.
+
+    config keys (reference curriculum_scheduler.py): curriculum_type,
+    min_difficulty, max_difficulty, schedule_type +
+    schedule_config{total_curriculum_step, difficulty_step, root_degree,
+    difficulty[], max_step[]}.
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.min = int(config.get("min_difficulty", 8))
+        self.max = int(config.get("max_difficulty", 1 << 30))
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        sc = dict(config.get("schedule_config", {}))
+        self.total_step = int(sc.get("total_curriculum_step", 1000))
+        self.difficulty_step = int(sc.get("difficulty_step", 8))
+        self.root_degree = int(sc.get("root_degree", 2))
+        self.discrete_difficulty = list(sc.get("difficulty", []))
+        self.discrete_max_step = list(sc.get("max_step", []))
+        if self.schedule_type == "fixed_discrete":
+            if len(self.discrete_difficulty) != len(self.discrete_max_step) + 1:
+                raise ConfigError("fixed_discrete needs len(difficulty) == len(max_step) + 1")
+        elif self.schedule_type not in ("fixed_linear", "fixed_root"):
+            raise ConfigError(f"Unknown curriculum schedule_type {self.schedule_type!r}")
+
+    def get_difficulty(self, step: int) -> int:
+        if step >= self.total_step and self.schedule_type != "fixed_discrete":
+            return self.max
+        if self.schedule_type == "fixed_linear":
+            frac = step / max(self.total_step, 1)
+        elif self.schedule_type == "fixed_root":
+            frac = (step / max(self.total_step, 1)) ** (1.0 / self.root_degree)
+        else:  # fixed_discrete
+            for diff, max_step in zip(self.discrete_difficulty, self.discrete_max_step):
+                if step < max_step:
+                    return min(diff, self.max)
+            return min(self.discrete_difficulty[-1], self.max)
+        raw = self.min + frac * (self.max - self.min)
+        # bucket to difficulty_step multiples: one XLA program per bucket
+        bucketed = int(raw // self.difficulty_step) * self.difficulty_step
+        return max(self.min, min(bucketed, self.max))
+
+
+def curriculum_truncate(batch, difficulty: int, seq_keys=("input_ids", "labels",
+                                                         "attention_mask", "position_ids")):
+    """Truncate the sequence dim of known keys to ``difficulty`` tokens
+    (reference legacy curriculum truncation)."""
+    import numpy as np
+
+    def trunc(key, x):
+        if key in seq_keys and hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > difficulty:
+            return x[:, :difficulty]
+        return x
+
+    if isinstance(batch, dict):
+        return {k: trunc(k, v) for k, v in batch.items()}
+    return batch
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule for random layer-token-drop (reference
+    data_routing/scheduler.py): linear ramp from ``start_ratio`` of tokens
+    to 1.0 over ``total_steps``."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.start_ratio = float(config.get("start_ratio", 0.3))
+        self.total_steps = int(config.get("total_steps", config.get("total_layer_token_drop_step", 1000)))
+
+    def keep_prob(self, step: int) -> float:
+        if step >= self.total_steps:
+            return 1.0
+        frac = step / max(self.total_steps, 1)
+        return self.start_ratio + frac * (1.0 - self.start_ratio)
+
+
+def build_curriculum(config) -> Optional[CurriculumScheduler]:
+    """From the engine config: either the top-level ``curriculum_learning``
+    section (legacy) or ``data_efficiency.data_sampling.curriculum_learning``."""
+    cl = dict(config.curriculum_learning or {})
+    if not cl:
+        de = dict(config.data_efficiency or {})
+        cl = dict(de.get("data_sampling", {}).get("curriculum_learning", {}))
+    if not cl or not cl.get("enabled", True):
+        return None
+    return CurriculumScheduler(cl)
+
+
+def build_random_ltd(config) -> Optional[RandomLTDScheduler]:
+    de = dict(config.data_efficiency or {})
+    ltd = dict(de.get("data_routing", {}).get("random_ltd", {}))
+    if not ltd or not ltd.get("enabled", False):
+        return None
+    return RandomLTDScheduler(ltd)

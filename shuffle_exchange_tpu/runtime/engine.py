@@ -272,6 +272,13 @@ class Engine:
 
             self.flops_profiler = FlopsProfiler(config.flops_profiler, params=self.state.master)
 
+        # --- data-efficiency schedules (reference runtime/data_pipeline/) --
+        from .data_pipeline import build_curriculum, build_random_ltd
+
+        self._curriculum = build_curriculum(config)
+        self._ltd = build_random_ltd(config)
+        self._curriculum_difficulty = None
+
         # --- data -------------------------------------------------------
         self.training_dataloader = None
         if training_data is not None:
@@ -549,6 +556,16 @@ class Engine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         self._ensure_opt_resident()
+        if self._curriculum is not None:
+            from .data_pipeline import curriculum_truncate
+
+            self._curriculum_difficulty = self._curriculum.get_difficulty(self.global_steps)
+            batch = curriculum_truncate(batch, self._curriculum_difficulty)
+        if self._ltd is not None:
+            b = len(next(iter(batch.values())))
+            batch = dict(batch)
+            batch["ltd_keep_prob"] = np.full((b,), self._ltd.keep_prob(self.global_steps),
+                                             np.float32)
         shaped = self._reshape_batch(batch)
         mix = self._mix_matrix(advance=True)
         rng = self._next_rng()
@@ -790,6 +807,14 @@ class Engine:
         self._pending_ckpt = None
         self._commit_checkpoint(*pending)
 
+    def __del__(self):
+        # A decoupled save with no subsequent step/save/load still needs its
+        # commit + `latest` tag before the process exits.
+        try:
+            self._finalize_pending_checkpoint()
+        except Exception:
+            pass
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
@@ -874,6 +899,11 @@ class Engine:
         from ..utils.tensor_fragment import safe_get_full_grad
 
         return safe_get_full_grad(self, name)
+
+    def curriculum_difficulty(self):
+        """Current curriculum difficulty (seq length), None if disabled
+        (reference engine curriculum accessors)."""
+        return self._curriculum_difficulty
 
     def get_lr(self) -> float:
         try:

@@ -1,0 +1,105 @@
+"""Data-efficiency pipeline: curriculum schedules + truncation, random-LTD
+(reference runtime/data_pipeline/)."""
+
+import numpy as np
+import pytest
+
+import shuffle_exchange_tpu as sxt
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.parallel import reset_topology
+from shuffle_exchange_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                        RandomLTDScheduler,
+                                                        curriculum_truncate)
+
+
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                             "schedule_type": "fixed_linear",
+                             "schedule_config": {"total_curriculum_step": 100,
+                                                 "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 32  # 8 + 0.5*56 = 36 -> bucket 32
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10**6) == 64
+    # monotone
+    diffs = [s.get_difficulty(t) for t in range(0, 120, 5)]
+    assert all(a <= b for a, b in zip(diffs, diffs[1:]))
+
+
+def test_fixed_root_schedule_faster_early():
+    lin = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 128,
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"total_curriculum_step": 100}})
+    root = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 128,
+                                "schedule_type": "fixed_root",
+                                "schedule_config": {"total_curriculum_step": 100,
+                                                    "root_degree": 2}})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                             "schedule_type": "fixed_discrete",
+                             "schedule_config": {"difficulty": [16, 32, 64],
+                                                 "max_step": [10, 20]}})
+    assert s.get_difficulty(5) == 16
+    assert s.get_difficulty(15) == 32
+    assert s.get_difficulty(25) == 64
+
+
+def test_bad_schedule_type_raises():
+    with pytest.raises(sxt.ConfigError):
+        CurriculumScheduler({"schedule_type": "warp_speed"})
+
+
+def test_curriculum_truncate():
+    batch = {"input_ids": np.zeros((4, 64), np.int32), "labels": np.zeros((4, 64), np.int32),
+             "weights": np.ones((4,), np.float32)}
+    out = curriculum_truncate(batch, 16)
+    assert out["input_ids"].shape == (4, 16) and out["labels"].shape == (4, 16)
+    assert out["weights"].shape == (4,)
+
+
+def test_random_ltd_schedule():
+    s = RandomLTDScheduler({"start_ratio": 0.25, "total_steps": 100})
+    assert s.keep_prob(0) == 0.25
+    assert s.keep_prob(100) == 1.0
+    assert 0.25 < s.keep_prob(50) < 1.0
+
+
+def test_engine_curriculum_integration(devices8):
+    reset_topology()
+    engine, *_ = sxt.initialize(
+        model=Transformer(tiny(vocab=128, d=64, layers=2, heads=4, seq=64)),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "curriculum_learning": {"enabled": True, "min_difficulty": 16,
+                                    "max_difficulty": 64,
+                                    "schedule_type": "fixed_linear",
+                                    "schedule_config": {"total_curriculum_step": 4,
+                                                        "difficulty_step": 16}},
+            "steps_per_print": 10**9})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 128, (8, 64)).astype(np.int32)}
+    assert np.isfinite(float(engine.train_batch(batch)))
+    assert engine.curriculum_difficulty() == 16
+    for _ in range(5):
+        engine.train_batch(batch)
+    assert engine.curriculum_difficulty() == 64
+
+
+def test_engine_random_ltd_integration(devices8):
+    reset_topology()
+    engine, *_ = sxt.initialize(
+        model=Transformer(tiny(vocab=128, d=64, layers=4, heads=4, seq=32, random_ltd=True)),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "data_efficiency": {"data_routing": {"random_ltd": {
+                "enabled": True, "start_ratio": 0.5, "total_steps": 10}}},
+            "steps_per_print": 10**9})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 128, (8, 32)).astype(np.int32)}
+    l0 = float(engine.train_batch(batch))
+    for _ in range(3):
+        l1 = float(engine.train_batch(batch))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0

@@ -1,0 +1,116 @@
+"""Long-context attention ops: FPDT-style chunked attention (reference
+sequence/fpdt_layer.py) and blocksparse attention + sparsity configs
+(reference ops/sparse_attention)."""
+
+import numpy as np
+import pytest
+
+from shuffle_exchange_tpu.ops.chunked_attention import chunked_attention
+from shuffle_exchange_tpu.ops.flash_attention import flash_attention, reference_attention
+from shuffle_exchange_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                       BSLongformerSparsityConfig,
+                                                       DenseSparsityConfig,
+                                                       FixedSparsityConfig,
+                                                       VariableSparsityConfig,
+                                                       sparse_attention)
+
+
+def _qkv(B=2, T=128, H=4, KV=None, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    KV = KV or H
+    q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, T, KV, D)).astype(np.float32)
+    v = rng.standard_normal((B, T, KV, D)).astype(np.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("chunk", [16, 32, 64])
+@pytest.mark.parametrize("causal", [True, False])
+def test_chunked_matches_reference(chunk, causal):
+    q, k, v = _qkv()
+    want = np.asarray(reference_attention(q, k, v, causal=causal))
+    got = np.asarray(chunked_attention(q, k, v, chunk_size=chunk, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_gqa():
+    q, k, v = _qkv(H=8, KV=2)
+    want = np.asarray(reference_attention(q, k, v, causal=True))
+    got = np.asarray(chunked_attention(q, k, v, chunk_size=32, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_under_jit_and_impl_dispatch():
+    import jax
+
+    q, k, v = _qkv(T=64)
+    got = np.asarray(jax.jit(lambda a, b, c: flash_attention(a, b, c, impl="chunked"))(q, k, v))
+    want = np.asarray(reference_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_rejects_indivisible():
+    q, k, v = _qkv(T=96)
+    with pytest.raises(ValueError):
+        chunked_attention(q, k, v, chunk_size=64)
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+
+def test_dense_layout_matches_reference():
+    q, k, v = _qkv(T=64)
+    got = np.asarray(sparse_attention(q, k, v, DenseSparsityConfig(block=16), causal=True))
+    want = np.asarray(reference_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_fixed_layout_structure():
+    cfg = FixedSparsityConfig(block=16, num_local_blocks=2, num_global_blocks=1)
+    lay = cfg.make_layout(128)  # 8x8 blocks
+    assert lay.shape == (8, 8)
+    assert lay[0, 0] and lay[1, 0] and lay[1, 1]
+    # row 4 (stride 2): local [4,5] + global col 1 and 3 (stride tails)
+    assert lay[4, 4] and lay[4, 5] and lay[4, 1] and lay[4, 3]
+    assert not lay[4, 0] and not lay[4, 2]
+
+
+def test_longformer_window_and_global():
+    cfg = BSLongformerSparsityConfig(block=16, num_sliding_window_blocks=3,
+                                     global_block_indices=(0,))
+    lay = cfg.make_layout(128)
+    assert lay[5, 4] and lay[5, 5] and lay[5, 6]  # window
+    assert not lay[5, 2]
+    assert lay[5, 0] and lay[0, 5]                # global both ways
+
+
+def test_bigbird_has_window_global_random():
+    cfg = BigBirdSparsityConfig(block=16, num_random_blocks=2,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    lay = cfg.make_layout(256)
+    n = 16
+    assert lay[:, 0].all() and lay[0, :].all()
+    for qi in range(1, n - 1):
+        assert lay[qi, qi - 1] and lay[qi, qi] and lay[qi, qi + 1]
+    # random adds beyond window+global on most rows
+    extra = lay.sum() > (3 * n - 2) + (2 * n - 1)
+    assert extra
+
+
+def test_sparse_attention_only_attends_layout():
+    """With a pure sliding-window layout, distant tokens must not influence
+    the output: compare against reference attention on the visible window."""
+    q, k, v = _qkv(B=1, T=64, H=2, D=8, seed=3)
+    cfg = VariableSparsityConfig(block=16, num_local_blocks=1, global_block_indices=())
+    got = np.asarray(sparse_attention(q, k, v, cfg, causal=True))
+    # query block 3 (tokens 48..63) attends only its own block
+    want_blk = np.asarray(reference_attention(
+        q[:, 48:, :, :], k[:, 48:, :, :], v[:, 48:, :, :], causal=True))
+    np.testing.assert_allclose(got[:, 48:], want_blk, rtol=2e-4, atol=2e-5)
+
+
+def test_sparsity_config_rejects_bad_seq():
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(block=16).make_layout(100)
