@@ -1,0 +1,60 @@
+"""Elastic agent: supervised training with restart + checkpoint resume.
+
+Capability parity with the reference's ``DSElasticAgent``
+(``elasticity/elastic_agent.py:32``, SURVEY.md §5.3): monitor the training
+worker, and on failure restart it against the (possibly changed) device
+world, with the elasticity batch plan guaranteeing an identical effective
+batch size at the new world size and checkpoint-resume supplying the
+state. Where the reference plugs into torch-elastic's rendezvous, the TPU
+runtime re-forms the pod on process restart — so the agent is a
+supervision loop around the user's train function.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..utils.logging import logger
+
+
+class ElasticAgent:
+    """Run ``train_fn(restart_count)`` with up to ``max_restarts`` retries.
+
+    ``train_fn`` should build its engine fresh (re-reading the device world)
+    and ``load_checkpoint`` from its save dir if present — the agent itself
+    is state-free. ``on_failure(exc, restart_count)`` may veto the restart
+    by returning False (e.g. for config errors that will never succeed).
+    """
+
+    def __init__(self, max_restarts: int = 3, backoff_s: float = 2.0,
+                 on_failure: Optional[Callable] = None):
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.on_failure = on_failure
+        self.restart_count = 0
+
+    def run(self, train_fn: Callable):
+        while True:
+            try:
+                return train_fn(self.restart_count)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                if self.on_failure is not None and self.on_failure(e, self.restart_count) is False:
+                    raise
+                if self.restart_count >= self.max_restarts:
+                    logger.error(f"elastic agent: giving up after {self.restart_count} restarts")
+                    raise
+                self.restart_count += 1
+                delay = min(60.0, self.backoff_s * (2.0 ** (self.restart_count - 1)))
+                logger.warning(f"elastic agent: worker failed ({type(e).__name__}: {e}); "
+                               f"restart {self.restart_count}/{self.max_restarts} in {delay:.0f}s")
+                time.sleep(delay)
+
+
+def run_elastic(train_fn: Callable, max_restarts: int = 3, backoff_s: float = 2.0,
+                on_failure: Optional[Callable] = None):
+    """Functional entry: supervise ``train_fn`` (see ElasticAgent)."""
+    return ElasticAgent(max_restarts=max_restarts, backoff_s=backoff_s,
+                        on_failure=on_failure).run(train_fn)

@@ -1,0 +1,191 @@
+"""Multi-host launcher CLI.
+
+Capability parity with the reference's ``deepspeed`` runner
+(``launcher/runner.py:48,409``, SURVEY.md §1 CLI layer): hostfile parsing
+("host slots=N"), ``--include``/``--exclude`` node filters,
+``--num_nodes``/``--num_gpus``, master addr/port selection, per-job env
+propagation (``.sxt_env``, the ``.deepspeed_env`` analog), elastic restart
+(``--elastic_training`` → supervised relaunch), and per-node process
+launch.
+
+TPU-native shape: instead of one process per GPU wired into
+torch.distributed/NCCL, one process per *host* joins
+``jax.distributed.initialize`` via COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID (consumed by ``parallel/comm.init_distributed``); each host's
+process sees its local chips and the XLA runtime forms the pod. Multinode
+transport is ssh command generation (pdsh-style fan-out without the pdsh
+dependency).
+
+Usage:  python -m shuffle_exchange_tpu.launcher [options] script.py [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+ENV_FILE = ".sxt_env"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="shuffle_exchange_tpu.launcher",
+                                description="Multi-host launcher (reference `deepspeed` runner parity)")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile",
+                   help="path to a hostfile: lines of '<host> slots=<n>'")
+    p.add_argument("-i", "--include", default="",
+                   help="host filter, e.g. 'worker-0@worker-1' or 'worker-0:0,1'")
+    p.add_argument("-e", "--exclude", default="", help="hosts to exclude")
+    p.add_argument("--num_nodes", type=int, default=-1, help="use first N hosts")
+    p.add_argument("--num_gpus", "--num_accelerators", type=int, default=-1, dest="num_gpus",
+                   help="processes per node (TPU: usually 1 per host)")
+    p.add_argument("--master_addr", default=None)
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--launcher", default="ssh", choices=["ssh", "local"],
+                   help="multinode transport")
+    p.add_argument("--ssh_port", type=int, default=None)
+    p.add_argument("--force_multi", action="store_true")
+    p.add_argument("--elastic_training", action="store_true",
+                   help="restart the job on failure (reference DSElasticAgent)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--env", action="append", default=[],
+                   help="extra KEY=VALUE env entries to propagate")
+    p.add_argument("user_script", help="training script to launch")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def parse_hostfile(path_or_lines) -> Dict[str, int]:
+    """'host slots=N' lines -> ordered {host: slots} (reference
+    launcher/runner.py hostfile format)."""
+    if isinstance(path_or_lines, str):
+        if not os.path.isfile(path_or_lines):
+            return {}
+        with open(path_or_lines) as f:
+            lines = f.readlines()
+    else:
+        lines = list(path_or_lines)
+    out: Dict[str, int] = {}
+    for line in lines:
+        line = line.split("#")[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        host = parts[0]
+        slots = 1
+        for tok in parts[1:]:
+            if tok.startswith("slots="):
+                slots = int(tok.split("=", 1)[1])
+        if host in out:
+            raise ValueError(f"Duplicate host {host!r} in hostfile")
+        out[host] = slots
+    return out
+
+
+def filter_hosts(hosts: Dict[str, int], include: str = "", exclude: str = "",
+                 num_nodes: int = -1) -> Dict[str, int]:
+    """Apply --include/--exclude ('h1@h2' separated) and --num_nodes."""
+    def names(spec: str) -> List[str]:
+        return [s.split(":")[0] for s in spec.split("@") if s]
+
+    out = dict(hosts)
+    if include:
+        keep = names(include)
+        missing = [h for h in keep if h not in out]
+        if missing:
+            raise ValueError(f"--include hosts not in hostfile: {missing}")
+        out = {h: out[h] for h in keep}
+    for h in names(exclude):
+        out.pop(h, None)
+    if num_nodes > 0:
+        out = dict(list(out.items())[:num_nodes])
+    if not out:
+        raise ValueError("No hosts left after include/exclude filtering")
+    return out
+
+
+def collect_env(extra: List[str]) -> Dict[str, str]:
+    """Env to propagate: .sxt_env file (reference .deepspeed_env) + --env."""
+    env: Dict[str, str] = {}
+    for candidate in (os.path.join(os.path.expanduser("~"), ENV_FILE), ENV_FILE):
+        if os.path.isfile(candidate):
+            with open(candidate) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#") and "=" in line:
+                        k, v = line.split("=", 1)
+                        env[k] = v
+    for kv in extra:
+        if "=" not in kv:
+            raise ValueError(f"--env expects KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env[k] = v
+    return env
+
+
+def build_commands(hosts: Dict[str, int], args, extra_env: Optional[Dict[str, str]] = None
+                   ) -> List[Tuple[str, List[str]]]:
+    """[(host, argv)] — one launch command per host. PROCESS_ID is the host
+    index; NUM_PROCESSES the host count (jax.distributed convention)."""
+    host_list = list(hosts)
+    master = args.master_addr or host_list[0]
+    coordinator = f"{master}:{args.master_port}"
+    cmds = []
+    env = {"COORDINATOR_ADDRESS": coordinator, "NUM_PROCESSES": str(len(host_list))}
+    env.update(extra_env or {})
+    for idx, host in enumerate(host_list):
+        cmd_env = dict(env, PROCESS_ID=str(idx))
+        envs = [f"{k}={shlex.quote(v)}" for k, v in cmd_env.items()]
+        inner = ["env"] + envs + [sys.executable, args.user_script] + list(args.user_args)
+        if len(host_list) == 1 and not args.force_multi:
+            cmds.append((host, inner))
+        else:
+            ssh = ["ssh"] + (["-p", str(args.ssh_port)] if args.ssh_port else []) + [host]
+            cmds.append((host, ssh + [" ".join(shlex.quote(c) if i > 0 else c
+                                               for i, c in enumerate(inner))]))
+    return cmds
+
+
+def run_commands(cmds: List[Tuple[str, List[str]]]) -> int:
+    """Launch every per-host command; wait; first nonzero exit wins."""
+    procs = [(host, subprocess.Popen(argv)) for host, argv in cmds]
+    code = 0
+    for host, proc in procs:
+        rc = proc.wait()
+        if rc != 0 and code == 0:
+            logger.error(f"host {host} exited with {rc}")
+            code = rc
+    return code
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    hosts = parse_hostfile(args.hostfile)
+    if not hosts:
+        hosts = {"localhost": max(args.num_gpus, 1)}
+    hosts = filter_hosts(hosts, args.include, args.exclude, args.num_nodes)
+    env = collect_env(args.env)
+
+    attempts = args.max_restarts + 1 if args.elastic_training else 1
+    code = 0
+    for attempt in range(attempts):
+        if attempt:
+            logger.warning(f"elastic restart {attempt}/{args.max_restarts}")
+            time.sleep(min(10.0, 2.0 ** attempt))
+        cmds = build_commands(hosts, args, env)
+        for host, argv_ in cmds:
+            logger.info(f"launch [{host}]: {' '.join(map(str, argv_))}")
+        code = run_commands(cmds)
+        if code == 0:
+            break
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
