@@ -1,0 +1,6 @@
+#!/bin/sh
+# Full (nightly) test suite — includes @pytest.mark.slow e2e tests.
+# The fast development gate is: pytest tests/ -q -m "not slow"
+set -e
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q "$@"

@@ -155,12 +155,18 @@ def initialize(
             "with random_ltd=False — no tokens will be dropped. Set "
             "TransformerConfig(random_ltd=True) to activate it.")
 
-    # Resolve model/params/loss.
+    # Resolve model/params/loss. When the model exposes init() and no
+    # concrete params were passed, initialization is DEFERRED (zero.Init
+    # analog, reference runtime/zero/partition_parameters.py:879): the
+    # engine traces init under jit with sharded outputs, so the full model
+    # is never materialized unsharded — bring-up peaks at O(shard).
     resolved_params = params
+    params_init_fn = None
     partition_specs = None
     if model is not None and hasattr(model, "loss"):
         if resolved_params is None:
-            resolved_params = model.init(jax.random.PRNGKey(seed))
+            params_init_fn = model.init
+            resolved_params = jax.eval_shape(model.init, jax.random.PRNGKey(seed))
         loss_fn = loss_fn or model.loss
         if hasattr(model, "partition_specs"):
             partition_specs = model.partition_specs(resolved_params)
@@ -174,6 +180,7 @@ def initialize(
         topology=topology,
         loss_fn=loss_fn,
         params=resolved_params,
+        params_init_fn=params_init_fn,
         optimizer=optimizer,
         lr_scheduler=lr_scheduler,
         model_partition_specs=partition_specs,

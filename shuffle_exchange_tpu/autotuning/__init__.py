@@ -1,0 +1,6 @@
+"""Autotuning subsystem (reference: ``autotuning/autotuner.py``, README
+workflow ``autotuning/README.md:240-245``)."""
+
+from .autotuner import Autotuner, Candidate, autotune, estimate_step_memory
+
+__all__ = ["Autotuner", "Candidate", "autotune", "estimate_step_memory"]

@@ -1,0 +1,41 @@
+"""CLI: ``python -m shuffle_exchange_tpu.autotuning --config ds.json
+--model gpt2_small`` (reference workflow: ``deepspeed --autotuning tune``,
+autotuning/README.md)."""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="shuffle_exchange_tpu.autotuning")
+    ap.add_argument("--config", required=True, help="base DS-style JSON config path")
+    ap.add_argument("--model", default="gpt2_small",
+                    help="model-zoo preset name (models/__init__) or 'tiny'")
+    ap.add_argument("--seq", type=int, default=None, help="profile sequence length")
+    ap.add_argument("--steps", type=int, default=3, help="measured steps per candidate")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from shuffle_exchange_tpu import models as zoo
+    from shuffle_exchange_tpu.autotuning import autotune
+
+    with open(args.config) as f:
+        base = json.load(f)
+    preset = getattr(zoo, args.model)
+    model = zoo.Transformer(preset())
+    seq = args.seq or min(model.config.max_seq_len, 1024)
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(0)
+
+    def batch_fn(global_bs):
+        return {"input_ids": rng.integers(0, vocab, size=(global_bs, seq)).astype(np.int32)}
+
+    tuned, best = autotune(model, base, batch_fn, seq_len=seq, profile_steps=args.steps)
+    print(json.dumps({"best": best.name, "tuned": tuned}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,280 @@
+"""The autotuner: search training configs, measure, emit the best.
+
+Capability analog of the reference autotuner (``autotuning/autotuner.py``,
+2,722 LoC; workflow in ``autotuning/README.md``): given a model and a base
+DS-style config, it explores micro-batch size, gradient-accumulation steps,
+ZeRO stage, and remat policy, prunes candidates with a first-principles
+HBM-memory model (the reference prunes with its ``model_info`` param-count
+estimate), then short-profiles the survivors through the real engine and
+returns/writes the measured-best config (reference result tables:
+``autotuning/README.md:240-245``).
+
+TPU-native differences: no multi-process experiment launcher is needed —
+candidates compile+run in-process through jit; memory pruning uses the known
+HBM capacity per device instead of CUDA allocator probing; "mp_size" maps to
+the mesh's tensor axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..config.config_utils import ConfigError
+from ..utils.logging import log_dist, logger
+
+# bytes per element
+_F32 = 4
+_BF16 = 2
+
+
+def _hbm_bytes_per_device(default: int = 16 * 1024**3) -> int:
+    """Best-effort per-device memory budget (HBM on TPU, heap on CPU)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return default
+
+
+def estimate_step_memory(n_params: int, *, mbs: int, seq_len: int,
+                         d_model: int, n_layers: int, vocab_size: int,
+                         zero_stage: int, world: int, remat: bool,
+                         loss_chunk: int = 256) -> int:
+    """First-principles peak-HBM estimate (bytes) for one fused train step.
+
+    Mirrors the reference autotuner's memory-per-GPU estimate
+    (``autotuning/autotuner.py`` model_info path) with TPU specifics: bf16
+    forward weights + fp32 master/m/v (ZeRO-sharded over ``world`` when
+    stage >= 1), activations ~ per-layer residual+ffn working set (halved
+    by remat to the saved-dots set), chunked-CE logits block.
+    """
+    shard = world if zero_stage >= 1 else 1
+    p_shard = world if zero_stage >= 3 else 1
+    master_opt = 3 * n_params * _F32 // shard          # master + m + v
+    fwd_params = n_params * _BF16 // p_shard           # bf16 forward copy
+    grads = n_params * _F32 // max(1, shard if zero_stage >= 2 else 1)
+    tokens = mbs * seq_len
+    # activation working set per layer: attn qkv+out (4d) + ffn (~8d) in bf16
+    act_per_layer = tokens * d_model * 12 * _BF16
+    acts = act_per_layer * (2 if remat else n_layers)
+    logits = tokens * vocab_size * _F32 if not loss_chunk else mbs * loss_chunk * vocab_size * _F32
+    return master_opt + fwd_params + grads + acts + logits
+
+
+@dataclasses.dataclass
+class Candidate:
+    micro_batch_size: int
+    gradient_accumulation_steps: int
+    zero_stage: int
+    remat: Optional[bool]          # None = leave the model as built
+    est_bytes: int = 0
+    metric_val: float = float("nan")
+    status: str = "pending"        # pending | pruned | ok | oom | error
+
+    @property
+    def name(self) -> str:
+        r = {None: "asis", True: "remat", False: "noremat"}[self.remat]
+        return f"z{self.zero_stage}_mbs{self.micro_batch_size}_gas{self.gradient_accumulation_steps}_{r}"
+
+    def as_config_patch(self) -> Dict[str, Any]:
+        return {
+            "train_micro_batch_size_per_gpu": self.micro_batch_size,
+            "gradient_accumulation_steps": self.gradient_accumulation_steps,
+            "zero_optimization": {"stage": self.zero_stage},
+        }
+
+
+def _merge(base: Dict[str, Any], patch: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class Autotuner:
+    """Searches (mbs, gas, zero stage, remat) for a model + base config.
+
+    ``model`` is a model-zoo Transformer (or any object with ``init``/
+    ``loss`` and a dataclass ``config`` carrying ``remat``); ``batch_fn``
+    makes a host batch for a global batch size: ``batch_fn(global_bs) ->
+    dict``. Candidates that do not fit the per-device memory budget are
+    pruned before compiling anything (reference: experiment pruning by
+    model_info); survivors run ``profile_steps`` measured steps.
+    """
+
+    def __init__(self, model, base_config: Dict[str, Any],
+                 batch_fn: Callable[[int], Dict[str, Any]],
+                 tuning_config=None, world_size: Optional[int] = None,
+                 profile_steps: int = 3, seq_len: Optional[int] = None):
+        import jax
+
+        self.model = model
+        self.base = dict(base_config)
+        self.base.pop("autotuning", None)
+        self.batch_fn = batch_fn
+        self.at = tuning_config
+        self.world = world_size if world_size is not None else len(jax.devices())
+        self.profile_steps = profile_steps
+        mcfg = getattr(model, "config", None)
+        self.seq_len = seq_len or getattr(mcfg, "max_seq_len", 1024)
+        self.results: List[Candidate] = []
+
+    # -- search space --------------------------------------------------
+
+    def candidates(self, mbs_list: Optional[Sequence[int]] = None,
+                   gas_list: Sequence[int] = (1, 2),
+                   stages: Sequence[int] = (1, 3),
+                   remat_opts: Sequence[Optional[bool]] = (False, True)) -> List[Candidate]:
+        if mbs_list is None:
+            lo = self.at.min_train_micro_batch_size_per_gpu if self.at else 1
+            hi = self.at.max_train_micro_batch_size_per_gpu if self.at and \
+                self.at.max_train_micro_batch_size_per_gpu else lo * 8
+            n = self.at.num_tuning_micro_batch_sizes if self.at else 3
+            mbs_list, m = [], lo
+            while m <= hi and len(mbs_list) < n:
+                mbs_list.append(m)
+                m *= 2
+        out = []
+        for mbs, gas, z, r in itertools.product(mbs_list, gas_list, stages, remat_opts):
+            if self.at and self.at.max_train_batch_size and \
+                    mbs * gas * self.world > self.at.max_train_batch_size:
+                continue
+            out.append(Candidate(mbs, gas, z, r))
+        return out
+
+    # -- memory pruning ------------------------------------------------
+
+    def _estimate(self, c: Candidate) -> int:
+        import jax
+
+        import numpy as np
+
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None:
+            return 0  # no model info — skip pruning
+        abstract = jax.eval_shape(self.model.init, jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract))
+        remat = mcfg.remat if c.remat is None else c.remat
+        return estimate_step_memory(
+            n_params, mbs=c.micro_batch_size, seq_len=self.seq_len,
+            d_model=mcfg.d_model, n_layers=mcfg.n_layers, vocab_size=mcfg.vocab_size,
+            zero_stage=c.zero_stage, world=self.world, remat=remat)
+
+    # -- measurement ---------------------------------------------------
+
+    def _run_one(self, c: Candidate) -> float:
+        import jax
+
+        import shuffle_exchange_tpu as sxt
+        from ..parallel import reset_topology
+
+        model = self.model
+        mcfg = getattr(model, "config", None)
+        if c.remat is not None and mcfg is not None and mcfg.remat != c.remat:
+            model = type(model)(dataclasses.replace(mcfg, remat=c.remat))
+        cfg = _merge(self.base, c.as_config_patch())
+        cfg.pop("train_batch_size", None)
+        reset_topology()
+        engine, *_ = sxt.initialize(model=model, config=cfg)
+        global_bs = engine.config.train_batch_size
+        batch = self.batch_fn(global_bs)
+        t_first = time.time()
+        loss = engine.train_batch(batch)
+        float(loss)  # sync (compile included; excluded from the metric)
+        compile_s = time.time() - t_first
+        t0 = time.time()
+        for _ in range(self.profile_steps):
+            loss = engine.train_batch(batch)
+        float(loss)
+        dt = (time.time() - t0) / self.profile_steps
+        tokens = global_bs * self.seq_len
+        log_dist(f"autotuning: {c.name} step={dt*1000:.0f}ms "
+                 f"(compile {compile_s:.0f}s, global_bs={global_bs})", ranks=[0])
+        if self.at and self.at.metric == "latency":
+            return -dt
+        return tokens / dt  # throughput (also the flops proxy at fixed model)
+
+    # -- main loop -----------------------------------------------------
+
+    def tune(self, cands: Optional[List[Candidate]] = None) -> Tuple[Candidate, List[Candidate]]:
+        budget = _hbm_bytes_per_device()
+        cands = list(cands if cands is not None else self.candidates())
+        if not cands:
+            raise ConfigError("autotuning: empty candidate set")
+        early_stop = self.at.tuner_early_stopping if self.at else 0
+        best: Optional[Candidate] = None
+        since_best = 0
+        for c in cands:
+            c.est_bytes = self._estimate(c)
+            if c.est_bytes > budget:
+                c.status = "pruned"
+                log_dist(f"autotuning: {c.name} pruned "
+                         f"({c.est_bytes/1e9:.1f}GB est > {budget/1e9:.1f}GB)", ranks=[0])
+                continue
+            try:
+                c.metric_val = self._run_one(c)
+                c.status = "ok"
+            except Exception as e:  # OOM or compile failure: record and move on
+                c.status = "oom" if "memory" in str(e).lower() else "error"
+                logger.warning(f"autotuning: {c.name} failed ({c.status}): {str(e)[:200]}")
+                continue
+            if best is None or c.metric_val > best.metric_val:
+                best, since_best = c, 0
+            else:
+                since_best += 1
+                if early_stop and since_best >= early_stop:
+                    log_dist(f"autotuning: early stop after {since_best} non-improving", ranks=[0])
+                    break
+        self.results = cands
+        if best is None:
+            raise ConfigError("autotuning: no candidate ran successfully")
+        return best, cands
+
+    # -- output --------------------------------------------------------
+
+    def write_results(self, best: Candidate, results_dir: Optional[str] = None) -> str:
+        results_dir = results_dir or (self.at.results_dir if self.at else "autotuning_results")
+        os.makedirs(results_dir, exist_ok=True)
+        table = [{
+            "name": c.name, "status": c.status, "metric": None if c.metric_val != c.metric_val
+            else c.metric_val, "est_gb": round(c.est_bytes / 1e9, 2),
+            **c.as_config_patch(),
+        } for c in self.results]
+        with open(os.path.join(results_dir, "autotuning_results.json"), "w") as f:
+            json.dump(table, f, indent=2)
+        tuned = _merge(self.base, best.as_config_patch())
+        tuned.pop("train_batch_size", None)
+        path = os.path.join(results_dir, "ds_config_optimal.json")
+        with open(path, "w") as f:
+            json.dump(tuned, f, indent=2)
+        log_dist(f"autotuning: best = {best.name}; tuned config at {path}", ranks=[0])
+        return path
+
+
+def autotune(model, base_config: Dict[str, Any], batch_fn, **kw) -> Tuple[Dict[str, Any], Candidate]:
+    """One-call API: returns (tuned_config_dict, best_candidate) and writes
+    the results dir per the config's ``autotuning`` section."""
+    from ..config import SXConfig
+
+    import jax
+
+    world = kw.pop("world_size", len(jax.devices()))
+    at = SXConfig.load(_merge(base_config, {"train_batch_size": base_config.get(
+        "train_batch_size", world)}), world).autotuning
+    tuner = Autotuner(model, base_config, batch_fn, tuning_config=at,
+                      world_size=world, **kw)
+    best, _ = tuner.tune()
+    tuner.write_results(best)
+    return _merge(tuner.base, best.as_config_patch()), best

@@ -237,6 +237,32 @@ class FlopsProfilerConfig(ConfigModel):
 
 
 @dataclass
+class AutotuningConfig(ConfigModel):
+    """Reference parity: ``autotuning/config.py`` (DeepSpeed autotuner JSON
+    section). Our tuner searches micro-batch size / gradient accumulation /
+    ZeRO stage / remat policy and emits the measured-best config
+    (autotuning/autotuner.py)."""
+
+    enabled: bool = config_field(False)
+    results_dir: str = config_field("autotuning_results")
+    exps_dir: str = config_field("autotuning_exps")
+    overwrite: bool = config_field(True)
+    metric: str = config_field("throughput")  # throughput | latency | flops
+    fast: bool = config_field(True)
+    start_profile_step: int = config_field(3, ge=0)
+    end_profile_step: int = config_field(5, ge=1)
+    tuner_type: str = config_field("model_based")  # model_based | gridsearch | random
+    tuner_early_stopping: int = config_field(5, ge=0)
+    tuner_num_trials: int = config_field(50, ge=1)
+    max_train_batch_size: Optional[int] = config_field(None, gt=0)
+    min_train_micro_batch_size_per_gpu: int = config_field(1, ge=1)
+    max_train_micro_batch_size_per_gpu: Optional[int] = config_field(None, gt=0)
+    num_tuning_micro_batch_sizes: int = config_field(3, ge=1)
+    mp_size: int = config_field(1, ge=1)
+    arg_mappings: Dict[str, Any] = config_field(default_factory=dict)
+
+
+@dataclass
 class CommsLoggerConfig(ConfigModel):
     enabled: bool = config_field(False)
     verbose: bool = config_field(False)
@@ -413,8 +439,9 @@ class SXConfig(ConfigModel):
     sequence_parallel_size: int = config_field(1, ge=1)
     pipeline_parallel_size: int = config_field(1, ge=1)
 
+    autotuning: AutotuningConfig = config_field(default_factory=AutotuningConfig)
+
     # Accepted-but-gated sections (feature handled elsewhere or N/A on TPU).
-    autotuning: Dict[str, Any] = config_field(default_factory=dict)
     compression_training: Dict[str, Any] = config_field(default_factory=dict)
     data_efficiency: Dict[str, Any] = config_field(default_factory=dict)
     curriculum_learning: Dict[str, Any] = config_field(default_factory=dict)

@@ -84,6 +84,33 @@ def decode_attention(q, ck, cv, kv_len):
     return out.reshape(B, 1, H, Dh).astype(q.dtype)
 
 
+def extend_attention(q, ck, cv, start_pos, kv_len):
+    """Chunked-prefill attention: a C-token query chunk against the cache.
+
+    q [B,C,H,Dh]; ck/cv [B,S,KV,Dh] already contain the chunk's own K/V at
+    positions start_pos..start_pos+C-1; start_pos/kv_len [B]. Query i may see
+    cache slots s with s <= start_pos + i and s < kv_len (causal within the
+    chunk, full visibility of the prefix). fp32 softmax.
+    Reference: the ragged "atom" attention over mixed prefill+decode
+    (inference/v2/kernels/ragged_ops/blocked_flash) — decode is C == 1.
+    """
+    import jax.numpy as jnp
+
+    B, S, KV, Dh = ck.shape
+    C, H = q.shape[1], q.shape[2]
+    G = H // KV
+    qf = q.astype(jnp.float32).reshape(B, C, KV, G, Dh)
+    scores = jnp.einsum("bckgd,bskd->bckgs", qf, ck.astype(jnp.float32)) / np.sqrt(Dh)
+    s_idx = jnp.arange(S)[None, None, :]
+    lim = jnp.minimum(start_pos[:, None] + jnp.arange(C)[None, :] + 1, kv_len[:, None])
+    mask = (s_idx < lim[:, :, None])[:, :, None, None, :]
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    w = jnp.exp(scores - scores.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    out = jnp.einsum("bckgs,bskd->bckgd", w, cv.astype(jnp.float32))
+    return out.reshape(B, C, H, Dh).astype(q.dtype)
+
+
 class InferenceEngine:
     """Serve a model: ``forward(ids)`` and ``generate(ids, prompt_lengths)``.
 
@@ -161,8 +188,11 @@ class InferenceEngine:
         T = ids.shape[1]
         positions = pos[:, None] + jnp.arange(T)[None, :]       # [B,T]
         if cfg.position == "learned":
+            # "clip" keeps an out-of-range position (generation running past
+            # max_seq_len) pinned to the last row instead of silently
+            # wrapping via the default fill behavior.
             x = x + jnp.take(params["pos_embed"], positions + cfg.pos_offset,
-                             axis=0).astype(x.dtype)
+                             axis=0, mode="clip").astype(x.dtype)
             return x, (None, None), positions
         cos, sin = rope_table(self.config.max_seq_len, cfg.head_dim, cfg.rope_theta)
         return x, (cos, sin), positions

@@ -122,11 +122,12 @@ def write_prefill_kv(ck, cv, ks, vs, block_table):
 def paged_decode_attention(q, ck, cv, block_table, kv_len):
     """q [B,1,H,Dh] against paged KV (one layer) [nblk,bs,KV,Dh].
 
-    Gather-by-block-table, then the shared dense decode attention (the
-    reference's blocked_flash CUDA kernel equivalent; a fused Pallas variant
-    that skips the materialized gather is the optimization path).
+    On TPU this dispatches to the fused Pallas kernel
+    (``ops/paged_attention.py``): the block table rides in scalar memory and
+    KV blocks stream through VMEM once — no materialized [B,S,KV,Dh] gather
+    (reference blocked_flash + atom_builder). Elsewhere (and as the numerics
+    oracle) it gathers by table and runs dense decode attention.
     """
-    from .engine import decode_attention
+    from ..ops.paged_attention import paged_decode_attention as _dispatch
 
-    k, v = gather_kv(ck, cv, block_table)              # [B, S, KV, Dh]
-    return decode_attention(q, k, v, kv_len)
+    return _dispatch(q, ck, cv, block_table, kv_len)

@@ -64,6 +64,11 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
     attention_impl: str = "auto"
+    # Chunked vocab CE (reference FPDT chunked logits loss,
+    # sequence/fpdt_layer.py:1137): compute the loss in seq chunks under
+    # remat so [B, T, vocab] logits are never materialized. 0 = full logits;
+    # -1 = auto (chunk when T * vocab is large enough to matter).
+    loss_chunk: int = -1
 
     @property
     def kv_heads(self) -> int:
@@ -226,7 +231,11 @@ class Transformer:
             "embed": jax.random.normal(next(keys), (cfg.vocab_size, D), jnp.float32) * 0.02,
         }
         if cfg.position == "learned":
-            params["pos_embed"] = jax.random.normal(next(keys), (cfg.max_seq_len, D), jnp.float32) * 0.02
+            # +pos_offset rows so OPT-style offset indexing stays in bounds
+            # right up to T == max_seq_len (checkpoints for such archs store
+            # the offset rows the same way).
+            params["pos_embed"] = jax.random.normal(
+                next(keys), (cfg.max_seq_len + cfg.pos_offset, D), jnp.float32) * 0.02
         # stacked per-layer weights: leading dim L
         def stack(key, shape, fan_in, scale=1.0):
             return jax.random.normal(key, (L,) + shape, jnp.float32) * (scale / math.sqrt(fan_in))
@@ -423,6 +432,48 @@ class Transformer:
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
         return (nll * mask).sum(), mask.sum()
 
+    def chunked_loss(self, params, x, labels, chunk: int):
+        """Final-norm + unembed + CE, streamed over seq chunks of ``chunk``
+        tokens under remat: peak logits memory is [B, chunk, vocab] instead
+        of [B, T, vocab] (the dominant activation for big-vocab models).
+        Numerically identical to head()+token_loss() — softmax is per-token.
+        Reference capability: chunked logits loss, sequence/fpdt_layer.py:1137.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        B, T, D = x.shape
+        n_chunks = -(-T // chunk)
+        pad = n_chunks * chunk - T
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+            labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=-100)
+        xc = x.reshape(B, n_chunks, chunk, D).transpose(1, 0, 2, 3)
+        lc = labels.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def body(carry, xl):
+            xch, lch = xl
+            logits = self.head(params, xch)
+            nll, cnt = self.token_loss(logits, lch)
+            nll_sum, cnt_sum = carry
+            return (nll_sum + nll, cnt_sum + cnt), None
+
+        (nll_sum, cnt), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (xc, lc))
+        return nll_sum, cnt
+
+    def _loss_chunk(self, B: int, T: int) -> int:
+        """Resolved chunk size: 0 = full logits."""
+        c = self.config.loss_chunk
+        if c >= 0:
+            return 0 if c == 0 else min(c, T)
+        # auto: chunk when the full fp32 logits would exceed ~256MB
+        if B * T * self.config.vocab_size * 4 <= 256 * 1024 * 1024:
+            return 0
+        return min(256, T)
+
     # -- forward -------------------------------------------------------
 
     def apply(self, params, input_ids):
@@ -455,8 +506,18 @@ class Transformer:
             rng, sub = jax.random.split(rng)
             keep = batch["ltd_keep_prob"][0]
             ltd_mask = jax.random.uniform(sub, model_ids.shape) < keep
-        logits, aux = self.apply_with_aux(params, model_ids, ltd_mask=ltd_mask)
-        nll_sum, count = self.token_loss(logits, labels)
+        B, T = model_ids.shape
+        chunk = self._loss_chunk(B, T)
+        if chunk:
+            x, rope = self.embed(params, model_ids)
+            if ltd_mask is not None:
+                x, aux = self.stack_apply(params["layers"], x, rope, ltd_mask=ltd_mask)
+            else:
+                x, aux = self.stack_apply(params["layers"], x, rope)
+            nll_sum, count = self.chunked_loss(params, x, labels, chunk)
+        else:
+            logits, aux = self.apply_with_aux(params, model_ids, ltd_mask=ltd_mask)
+            nll_sum, count = self.token_loss(logits, labels)
         ce = nll_sum / jnp.maximum(count, 1)
         return ce + self.config.aux_loss_coef * aux
 

@@ -1,0 +1,22 @@
+"""Kernel dispatch policy: Pallas on TPU by default.
+
+Round 1 shipped every Pallas path behind an opt-in env var on the theory
+that Mosaic compilation stalls through the tunneled single-chip dev
+environment. That claim was tested and refuted (2026-07-29): a minimal
+``pallas_call`` compiles in ~2s through the tunnel, and the flash-attention
+/ fused-AdamW / rmsnorm kernels all pass parity on the chip. Pallas is now
+the default on TPU; ``SXT_DISABLE_PALLAS=1`` is the kill-switch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pallas_enabled() -> bool:
+    """True when Pallas kernels should be used (TPU backend, not disabled)."""
+    if os.environ.get("SXT_DISABLE_PALLAS"):
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"

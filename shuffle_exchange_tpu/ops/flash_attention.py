@@ -53,23 +53,20 @@ def reference_attention(q, k, v, causal: bool = True, segment_ids=None):
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def _pallas_ok(q, k) -> bool:
-    import os
+def _pallas_ok(q, k, causal: bool = True) -> bool:
+    from .dispatch import pallas_enabled
 
-    import jax
-
-    if jax.default_backend() != "tpu":
-        return False
-    # On the tunneled single-chip dev environment, Mosaic (pallas) kernel
-    # compilation through the remote-compile service stalls indefinitely, so
-    # "auto" only takes the pallas path when explicitly enabled. On a real
-    # pod set SXT_ENABLE_PALLAS=1 (or pass impl="pallas").
-    if not os.environ.get("SXT_ENABLE_PALLAS"):
+    if not pallas_enabled():
         return False
     b, t, h, d = q.shape
     s = k.shape[1]
-    # the kernel wants lane-aligned head_dim and big-enough blocks
-    return d % 128 == 0 and t >= 128 and s >= 128 and t % 128 == 0 and s % 128 == 0
+    # Verified on-chip: the kernel handles head_dim 64 and 128 (fwd+bwd
+    # parity vs the jnp oracle). Ragged seq lengths are padded up to the
+    # 128-wide block inside pallas_attention — but only the causal path can
+    # do that mask-free, so non-causal keeps the exact-multiple requirement.
+    if not (d % 64 == 0 and t >= 128 and s >= 128):
+        return False
+    return causal or (t % 128 == 0 and s % 128 == 0)
 
 
 def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
@@ -89,6 +86,26 @@ def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
+
+    # The kernel blocks the seq dims in 128-wide tiles; ragged lengths (e.g.
+    # T-1 from next-token label shifting) are padded up. Under the causal
+    # mask padded keys sit strictly in the future of every real query, so
+    # real output rows are exact; padded query rows are sliced away. Padded
+    # segment ids get -1 (never equal to a real id), and the q/kv pads match
+    # each other on the diagonal so no row is fully masked.
+    t0, s0 = q.shape[1], k.shape[1]
+    t_pad, s_pad = -t0 % 128, -s0 % 128
+    if t_pad or s_pad:
+        assert causal, "seq padding only valid under the causal mask"
+        import jax.numpy as _jnp
+
+        pad4 = lambda x, p: _jnp.pad(x, ((0, 0), (0, p), (0, 0), (0, 0)))
+        q = pad4(q, t_pad)
+        k, v = pad4(k, s_pad), pad4(v, s_pad)
+        if segment_ids is not None:
+            segment_ids = _jnp.pad(segment_ids, ((0, 0), (0, t_pad)),
+                                   constant_values=-1)
+
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -109,7 +126,8 @@ def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
     seg = SegmentIds(q=segment_ids, kv=segment_ids) if segment_ids is not None else None
     out = _fa(qt, kt, vt, causal=causal, sm_scale=q.shape[-1] ** -0.5,
               segment_ids=seg, block_sizes=block_sizes)
-    return out.transpose(0, 2, 1, 3)
+    out = out.transpose(0, 2, 1, 3)
+    return out[:, :t0] if t_pad else out
 
 
 def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None):
@@ -131,7 +149,7 @@ def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_id
             if chunk < 16:
                 return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
         return chunked_attention(q, k, v, chunk_size=chunk, causal=causal)
-    if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
+    if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k, causal)):
         try:
             return pallas_attention(q, k, v, causal=causal, segment_ids=segment_ids)
         except Exception as e:  # pragma: no cover

@@ -41,13 +41,12 @@ def _pad_to_2d(x, lanes=LANES):
 def fused_adamw_update(p, g, m, v, *, lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0, step=None):
     """Returns (new_p, new_m, new_v). ``step`` is the 1-based step count used
     for bias correction (traced scalar ok)."""
-    import os
-
     import jax
     import jax.numpy as jnp
 
-    if jax.default_backend() != "tpu" or not os.environ.get("SXT_ENABLE_PALLAS"):
-        # See ops/flash_attention._pallas_ok for the SXT_ENABLE_PALLAS gate.
+    from .dispatch import pallas_enabled
+
+    if not pallas_enabled():
         return _reference_update(p, g, m, v, lr=lr, b1=b1, b2=b2, eps=eps,
                                  weight_decay=weight_decay, step=step)
     from jax.experimental import pallas as pl

@@ -45,19 +45,36 @@ def _compile() -> Optional[str]:
         newest_src = max(os.path.getmtime(p) for p in srcs + [hdr])
         if os.path.getmtime(so_path) >= newest_src:
             return so_path
+    # Build to a per-PID temp name and os.rename into place: rename is atomic
+    # on the same filesystem, so concurrent processes (multiple local ranks,
+    # parallel test runs, a shared NFS cache) never dlopen a half-written .so
+    # or clobber each other mid-build.
+    tmp_path = os.path.join(out_dir, f".libsxt_native.{os.getpid()}.tmp.so")
     for archflag in ("-march=native", ""):
         cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-fopenmp"]
         if archflag:
             cmd.append(archflag)
-        cmd += ["-shared", "-o", so_path] + srcs
+        cmd += ["-shared", "-o", tmp_path] + srcs
         try:
             res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         except (OSError, subprocess.TimeoutExpired) as e:
             logger.warning(f"native build failed to launch: {e}")
             return None
         if res.returncode == 0:
+            try:
+                os.rename(tmp_path, so_path)
+            except OSError as e:
+                logger.warning(f"native build rename failed: {e}")
+                if os.path.exists(so_path):  # another process won the race
+                    return so_path
+                return None
             return so_path
         logger.warning(f"native build failed ({' '.join(cmd[:2])}...): {res.stderr[-500:]}")
+    if os.path.exists(tmp_path):
+        try:
+            os.remove(tmp_path)
+        except OSError:
+            pass
     return None
 
 

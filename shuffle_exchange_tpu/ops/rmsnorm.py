@@ -21,19 +21,9 @@ def rmsnorm_reference(x, weight, eps: float = 1e-5):
 
 
 def _use_pallas(x) -> bool:
-    import os
+    from .dispatch import pallas_enabled
 
-    import jax
-
-    # See ops/flash_attention._pallas_ok: pallas compile stalls through the
-    # dev tunnel's remote-compile service; opt in explicitly on real pods.
-    if not os.environ.get("SXT_ENABLE_PALLAS"):
-        return False
-    try:
-        platform = x.devices().pop().platform if hasattr(x, "devices") else jax.default_backend()
-    except Exception:
-        platform = jax.default_backend()
-    return platform == "tpu"
+    return pallas_enabled()
 
 
 def rmsnorm(x, weight, eps: float = 1e-5, residual=None):

@@ -38,17 +38,19 @@ def sign_psum(x, axis_name: str, err=None) -> Tuple["jax.Array", "jax.Array"]:
     combined = x + (err if err is not None else jnp.zeros_like(x))
     scale = jnp.mean(jnp.abs(combined))
     signs = jnp.where(combined >= 0, 1, -1).astype(jnp.int8)
-    local_compressed = signs.astype(jnp.float32) * scale
-    new_err = combined - local_compressed
 
     comms_logger.record("compressed_all_reduce", signs.size + 4, note=axis_name)
     n = jax.lax.psum(1, axis_name)
     # int8 signs summed as int32 (overflow-safe for any axis size), one
-    # scalar psum for the scales; avg = E[sign_i * scale_i] ≈ mean of the
-    # per-worker compressed tensors.
+    # scalar psum for the scales. The transmitted approximation uses the
+    # *mean* scale for every worker (sign_i * mean_scale), so the error
+    # feedback must compensate against exactly that — not against
+    # sign_i * scale_i — or the per-worker scale variance is silently
+    # dropped (reference backends allreduce the exact compressed tensors).
     sign_sum = jax.lax.psum(signs.astype(jnp.int32), axis_name)
-    scale_sum = jax.lax.psum(scale, axis_name)
-    avg = sign_sum.astype(jnp.float32) * (scale_sum / n) / n
+    mean_scale = jax.lax.psum(scale, axis_name) / n
+    new_err = combined - signs.astype(jnp.float32) * mean_scale
+    avg = sign_sum.astype(jnp.float32) * mean_scale / n
     return avg, new_err
 
 
@@ -70,15 +72,32 @@ def quantized_psum(x, axis_name: str, group_size: int = 256):
 
 def quantized_reduce_scatter(x, axis_name: str, group_size: int = 256,
                              scatter_dimension: int = 0):
-    """Quantize locally, reduce-scatter the dequantized payload (grad path:
-    each rank ends with its shard of the quantization-rounded sum)."""
+    """int8-wire reduce-scatter (qgZ grad path, reference
+    coalesced_collectives.py:31): quantize locally, all-to-all the *int8*
+    payload + scales so every hop moves 1 byte/element, then dequantize and
+    sum the received pieces — each rank ends with its shard of the
+    quantization-rounded sum. Requires dim0 divisible by the axis size."""
     import jax
     import jax.numpy as jnp
 
-    q, scales = quantize_int8(x, group_size)
-    deq = dequantize_int8(q, scales, x.shape, jnp.float32)
+    n = jax.lax.psum(1, axis_name)
+    assert scatter_dimension == 0, "grad flats scatter on dim 0"
+    s0 = x.shape[0]
+    assert s0 % n == 0, f"reduce_scatter dim {s0} not divisible by axis size {n}"
+    pieces = x.reshape((n, s0 // n) + x.shape[1:])
+
+    # per-piece quantization (quantize_int8 flattens to [groups, group]), so
+    # the piece dim stays leading for the all-to-all
+    q, scales = jax.vmap(lambda p: quantize_int8(p, group_size))(pieces)
     comms_logger.record("quantized_reduce_scatter", q.size + 4 * scales.size, note=axis_name)
-    return jax.lax.psum_scatter(deq, axis_name, scatter_dimension=scatter_dimension, tiled=True)
+    # all_to_all on the piece dim: the wire payload is the int8 tensor.
+    q_x = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    s_x = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=False)
+
+    def deq_one(qi, si):
+        return dequantize_int8(qi, si, pieces.shape[1:], jnp.float32)
+
+    return jax.vmap(deq_one)(q_x, s_x).sum(axis=0)
 
 
 def quantized_all_gather(x, axis_name: str, group_size: int = 256, axis: int = 0):
@@ -109,23 +128,37 @@ def quantized_all_gather(x, axis_name: str, group_size: int = 256, axis: int = 0
     return moved.reshape(shape)
 
 
-def quantized_hierarchical_reduce(x, intra_axis: str, inter_axis: str,
-                                  group_size: int = 256):
-    """qgZ two-level gradient reduction (reference coalesced_collectives.py:31):
-    quantized reduce within the fast domain (ICI analog), re-quantize the
-    partial sums, then quantized reduce across the slow domain (DCN analog).
-    Returns the full average over both axes."""
+def _int8_wire_allreduce(x, axis_name: str, group_size: int):
+    """Sum over ``axis_name`` where the wire payload is int8: all-gather the
+    quantized tensor + per-group scales, dequantize and sum locally. A plain
+    psum of the dequantized fp32 would let XLA put fp32 on the wire — this
+    form forces the collective operand dtype to s8 (verifiable in HLO)."""
     import jax
     import jax.numpy as jnp
 
+    q, s = quantize_int8(x, group_size)
+    q_g = jax.lax.all_gather(q, axis_name, axis=0, tiled=False)     # s8 wire
+    s_g = jax.lax.all_gather(s, axis_name, axis=0, tiled=False)     # scales: tiny fp32
+
+    def deq_one(qi, si):
+        return dequantize_int8(qi, si, x.shape, jnp.float32)
+
+    return jax.vmap(deq_one)(q_g, s_g).sum(axis=0)
+
+
+def quantized_hierarchical_reduce(x, intra_axis: str, inter_axis: str,
+                                  group_size: int = 256):
+    """qgZ two-level gradient reduction (reference coalesced_collectives.py:31):
+    int8-wire reduce within the fast domain (ICI analog), re-quantize the
+    partial sums, then int8-wire reduce across the slow domain (DCN analog).
+    Returns the full average over both axes. Every cross-device hop carries
+    1 byte/element (+ per-group fp32 scales)."""
+    import jax
+
     n_intra = jax.lax.psum(1, intra_axis)
     n_inter = jax.lax.psum(1, inter_axis)
-    # Level 1: intra-domain quantized sum.
-    q, s = quantize_int8(x, group_size)
-    lvl1 = jax.lax.psum(dequantize_int8(q, s, x.shape, jnp.float32), intra_axis)
-    comms_logger.record("quantized_a2a_lvl1", q.size + 4 * s.size, note=intra_axis)
-    # Level 2: re-quantize the partial sum, reduce across domains.
-    q2, s2 = quantize_int8(lvl1, group_size)
-    lvl2 = jax.lax.psum(dequantize_int8(q2, s2, x.shape, jnp.float32), inter_axis)
-    comms_logger.record("quantized_a2a_lvl2", q2.size + 4 * s2.size, note=inter_axis)
+    comms_logger.record("quantized_a2a_lvl1", x.size, note=intra_axis)
+    lvl1 = _int8_wire_allreduce(x, intra_axis, group_size)
+    comms_logger.record("quantized_a2a_lvl2", x.size, note=inter_axis)
+    lvl2 = _int8_wire_allreduce(lvl1, inter_axis, group_size)
     return lvl2 / (n_intra * n_inter)

@@ -96,7 +96,13 @@ class Engine:
         config: SXConfig,
         topology: MeshTopology,
         loss_fn: Callable,                       # (params, batch, rng) -> scalar loss
-        params: Any,                             # initial params pytree (unsharded ok)
+        params: Any,                             # params pytree — concrete, or abstract
+                                                 # (ShapeDtypeStructs) with params_init_fn
+        params_init_fn: Optional[Callable] = None,  # rng -> params; zero.Init analog:
+                                                 # runs INSIDE jit with sharded outputs,
+                                                 # so the full model is never materialized
+                                                 # on host (reference
+                                                 # runtime/zero/partition_parameters.py:879)
         optimizer=None,                          # optax.GradientTransformation (client override)
         lr_scheduler=None,                       # step -> lr callable (client override)
         model_partition_specs=None,              # pytree of PartitionSpec (TP/model axes)
@@ -165,13 +171,33 @@ class Engine:
         self.repl_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
 
         # --- place master params ---------------------------------------
-        def place_master(p, sh):
-            arr = np.asarray(jax.device_get(p), dtype=np.float32)
-            if self.ensemble:
-                arr = np.broadcast_to(arr, (self.replicas,) + arr.shape)
-            return jax.device_put(arr, sh)
+        if params_init_fn is not None:
+            # zero.Init analog (reference partition_parameters.py:879 Init /
+            # utils/init_on_device.py OnDevice): the init function is traced,
+            # never run eagerly — out_shardings makes each device materialize
+            # only its own master shard, so bring-up cost is O(shard), not
+            # O(model), in host RAM and HBM alike.
+            replicas = self.replicas
+            ensemble = self.ensemble
 
-        master = jax.tree_util.tree_map(place_master, params, self.master_shardings)
+            def init_master(key):
+                p = params_init_fn(key)
+                p = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
+                if ensemble:
+                    p = jax.tree_util.tree_map(
+                        lambda x: jnp.broadcast_to(x[None], (replicas,) + x.shape), p)
+                return p
+
+            master = jax.jit(init_master, out_shardings=self.master_shardings)(
+                jax.random.PRNGKey(seed))
+        else:
+            def place_master(p, sh):
+                arr = np.asarray(jax.device_get(p), dtype=np.float32)
+                if self.ensemble:
+                    arr = np.broadcast_to(arr, (self.replicas,) + arr.shape)
+                return jax.device_put(arr, sh)
+
+            master = jax.tree_util.tree_map(place_master, params, self.master_shardings)
 
         # --- optimizer --------------------------------------------------
         self.client_optimizer = optimizer is not None
@@ -314,9 +340,19 @@ class Engine:
         # the forward numerics carry the same rounding the reference's
         # quantized all-gather does.
         qw = cfg.zero_optimization.zero_quantized_weights
-        # qgZ (reference coalesced_collectives.py:31): gradients carry
-        # blockwise-int8 rounding, matching the quantized two-level reduce.
+        # qgZ (reference coalesced_collectives.py:31): gradient reduction
+        # goes through the REAL int8-wire two-level collective when the step
+        # is a plain data/fsdp program (no ensemble replicas, no tensor/pipe/
+        # expert/seq manual regions to nest inside). Otherwise gradients
+        # carry blockwise-int8 rounding in-step (numerics emulation only).
         qg = cfg.zero_optimization.zero_quantized_gradients
+        axis_sizes = self.topology.axis_sizes
+        qg_real = bool(qg and not ensemble and self.zero_stage <= 2 and all(
+            axis_sizes.get(ax, 1) == 1 for ax in ("tensor", "pipe", "expert", "seq")))
+        if qg and not qg_real:
+            log_dist("zero_quantized_gradients: falling back to in-step "
+                     "quantize-dequantize emulation (ensemble/stage-3/model-"
+                     "parallel step); wire compression inactive", ranks=[0])
         if qw or qg:
             from ..ops.quant import quantize_dequantize
 
@@ -344,7 +380,32 @@ class Engine:
             if ensemble:
                 g, loss = jax.vmap(replica_grads, in_axes=(0, 0, None, None))(p16, micro, rng, scale)
                 return g, jnp.mean(loss)
+            if qg_real:
+                return qg_batch_grads(p16, micro, rng, scale)
             return replica_grads(p16, micro, rng, scale)
+
+        def qg_batch_grads(p16, micro, rng, scale):
+            """qgZ: per-device local grads, then the int8-wire two-level
+            reduce (intra=fsdp ~ fast domain, inter=data ~ slow domain) —
+            the shard_map region the reference implements as the quantized
+            all-to-all in runtime/comm/coalesced_collectives.py:31."""
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.compressed import quantized_hierarchical_reduce
+
+            def inner(p16, micro, rng, scale):
+                g, loss = replica_grads(p16, micro, rng, scale)
+                g = jax.tree_util.tree_map(
+                    lambda t: quantized_hierarchical_reduce(t, "fsdp", "data"), g)
+                loss = jax.lax.pmean(jax.lax.pmean(loss, "data"), "fsdp")
+                return g, loss
+
+            # check_vma off: the all-gather+local-sum reduce makes grads
+            # value-replicated, which the varying-axes checker can't infer.
+            return jax.shard_map(
+                inner, mesh=self.topology.mesh,
+                in_specs=(P(), P(("data", "fsdp")), P(), P()),
+                out_specs=(P(), P()), check_vma=False)(p16, micro, rng, scale)
 
         def accumulate(master, p16, batch, rng, scale):
             """lax.scan over the gas dim of the batch; fp32 accumulation."""
@@ -385,7 +446,8 @@ class Engine:
             if prescale and predivide != 1.0:
                 denom = denom * predivide
             grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
-            if qg:
+            if qg and not qg_real:
+                # numerics emulation only (see qg_real above for the wire path)
                 grads = jax.tree_util.tree_map(
                     lambda g: quantize_dequantize(g, group_size=2048), grads)
             overflow = ls.check_overflow(grads) if fp16_cfg.enabled else jnp.asarray(False)
@@ -505,6 +567,15 @@ class Engine:
 
     def _ensure_opt_resident(self) -> None:
         """Bring swapped-out optimizer state back on device."""
+        if getattr(self, "_offloaded_states", None) is not None:
+            # offload_states() parked master+opt on host; running a step with
+            # state.master=None would die deep inside the jitted step with an
+            # opaque pytree error. Transparent resume matches the reference's
+            # reload_states contract.
+            log_dist("engine state was offloaded (offload_states); reloading "
+                     "before the step — call reload_states() explicitly to "
+                     "avoid the implicit sync", ranks=[0])
+            self.reload_states()
         if self._opt_swapper is not None and not self._opt_resident:
             opt = self._opt_swapper.swap_in(self._opt_dev_shardings)
             self.state = self.state._replace(opt_state=opt)
@@ -604,6 +675,8 @@ class Engine:
         batch so ``backward()`` can compute grads (API parity: the reference
         returns module outputs; our models fold loss into the step)."""
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        if getattr(self, "_offloaded_states", None) is not None:
+            self.reload_states()
         shaped = self._reshape_batch(batch, gas=1)
         micro = self._take_micro(shaped)
         loss = self._eval_step(self.state, micro, self._mix_matrix(), rng or self._next_rng())
@@ -625,6 +698,8 @@ class Engine:
         import jax
 
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if getattr(self, "_offloaded_states", None) is not None:
+            self.reload_states()
         if batch is not None:
             micro = self._take_micro(self._reshape_batch(batch, gas=1))
         elif self._stashed_batch is not None:
@@ -658,6 +733,8 @@ class Engine:
         self.timers(STEP_GLOBAL_TIMER).stop()
 
     def eval_batch(self, batch, rng=None):
+        if getattr(self, "_offloaded_states", None) is not None:
+            self.reload_states()
         shaped = self._reshape_batch(batch, gas=1)
         return self._eval_step(self.state, self._take_micro(shaped), self._mix_matrix(), rng or self._next_rng())
 

@@ -1,0 +1,88 @@
+"""Autotuner (reference autotuning/autotuner.py + README workflow)."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+def _model():
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    return Transformer(tiny(vocab=128, d=64, layers=2, heads=4, seq=32))
+
+
+def _base():
+    return {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10**9,
+    }
+
+
+def _batch_fn(global_bs):
+    return {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(global_bs, 32)).astype(np.int32)}
+
+
+def test_memory_estimate_monotone():
+    from shuffle_exchange_tpu.autotuning import estimate_step_memory
+
+    kw = dict(seq_len=1024, d_model=768, n_layers=12, vocab_size=50257,
+              world=8, remat=False)
+    small = estimate_step_memory(124_000_000, mbs=1, zero_stage=3, **kw)
+    big = estimate_step_memory(124_000_000, mbs=8, zero_stage=3, **kw)
+    unsharded = estimate_step_memory(124_000_000, mbs=1, zero_stage=0, **kw)
+    assert big > small                       # more batch -> more activation
+    assert unsharded > small                 # ZeRO sharding shrinks state
+
+
+def test_tune_picks_measured_best_of_six(devices8, tmp_path):
+    """>= 6 candidates, measured short runs, best-by-metric wins (VERDICT
+    round-1 item #5 'done' criterion)."""
+    from shuffle_exchange_tpu.autotuning import Autotuner, Candidate
+
+    cands = [
+        Candidate(1, 1, 1, False), Candidate(1, 2, 1, False),
+        Candidate(2, 1, 1, False), Candidate(2, 2, 1, False),
+        Candidate(1, 1, 3, False), Candidate(2, 1, 3, False),
+    ]
+    tuner = Autotuner(_model(), _base(), _batch_fn, world_size=8, profile_steps=2,
+                      seq_len=32)
+    best, results = tuner.tune(cands)
+    ran = [c for c in results if c.status == "ok"]
+    assert len(ran) >= 6
+    assert best.metric_val == max(c.metric_val for c in ran)
+    path = tuner.write_results(best, results_dir=str(tmp_path))
+    tuned = json.loads(open(path).read())
+    assert tuned["train_micro_batch_size_per_gpu"] == best.micro_batch_size
+    assert tuned["zero_optimization"]["stage"] == best.zero_stage
+    table = json.loads(open(tmp_path / "autotuning_results.json").read())
+    assert len(table) == len(results)
+
+
+def test_memory_pruning_skips_impossible(devices8):
+    from shuffle_exchange_tpu.autotuning import Autotuner, Candidate
+
+    # absurd micro-batch: the estimate must exceed any device budget
+    cands = [Candidate(1_000_000, 1, 0, False), Candidate(1, 1, 1, False)]
+    tuner = Autotuner(_model(), _base(), _batch_fn, world_size=8, profile_steps=1,
+                      seq_len=32)
+    best, results = tuner.tune(cands)
+    assert results[0].status == "pruned"
+    assert best is results[1]
+
+
+def test_autotuning_config_section_parity():
+    from shuffle_exchange_tpu.config import SXConfig
+
+    cfg = SXConfig.load({
+        "train_batch_size": 8,
+        "autotuning": {"enabled": True, "metric": "latency", "fast": True,
+                       "tuner_type": "gridsearch", "tuner_early_stopping": 3,
+                       "max_train_batch_size": 64},
+    }, 1)
+    at = cfg.autotuning
+    assert at.enabled and at.metric == "latency" and at.tuner_type == "gridsearch"
+    assert at.tuner_early_stopping == 3 and at.max_train_batch_size == 64

@@ -20,6 +20,7 @@ def _train_engine(tmp, steps=3, config_extra=None, **kw):
     return engine, batch
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     engine, batch = _train_engine(tmp_path)
     loss_before = float(engine.eval_batch(batch))

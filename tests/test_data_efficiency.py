@@ -67,6 +67,7 @@ def test_random_ltd_schedule():
     assert 0.25 < s.keep_prob(50) < 1.0
 
 
+@pytest.mark.slow
 def test_engine_curriculum_integration(devices8):
     reset_topology()
     engine, *_ = sxt.initialize(
@@ -88,6 +89,7 @@ def test_engine_curriculum_integration(devices8):
     assert engine.curriculum_difficulty() == 64
 
 
+@pytest.mark.slow
 def test_engine_random_ltd_integration(devices8):
     reset_topology()
     engine, *_ = sxt.initialize(

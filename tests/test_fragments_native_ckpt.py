@@ -83,6 +83,7 @@ def test_unknown_name_raises(devices8):
 
 
 @pytest.mark.parametrize("writer", ["fast", "decoupled"])
+@pytest.mark.slow
 def test_native_writer_roundtrip(tmp_path, writer, devices8):
     engine = _engine(writer=writer)
     l0 = float(engine.train_batch(_batch()))

@@ -31,6 +31,7 @@ def _ids(vocab, b=2, t=16, seed=0):
     return np.random.default_rng(seed).integers(0, vocab, size=(b, t)).astype(np.int32)
 
 
+@pytest.mark.slow
 def test_llama_logit_parity():
     cfg = transformers.LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
                                    num_hidden_layers=2, num_attention_heads=4,

@@ -45,6 +45,7 @@ def test_loss_decreases_training():
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+@pytest.mark.slow
 def test_tensor_parallel_matches_single(devices8):
     """TP=2 via partition_specs must be numerically close to unsharded."""
     import jax

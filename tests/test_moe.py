@@ -54,6 +54,7 @@ def test_moe_layer_matches_dense_single_expert():
     np.testing.assert_allclose(np.asarray(res.output), np.asarray(dense), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_matches_single(devices8):
     """EP over 4 devices == single-device numerics."""
     import jax
@@ -81,6 +82,7 @@ def test_moe_expert_parallel_matches_single(devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(got_single.output), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_mixtral_style_training(devices8):
     from shuffle_exchange_tpu.models import Transformer
     from shuffle_exchange_tpu.models.transformer import tiny_moe

@@ -27,6 +27,7 @@ def _batch(seed=0):
     return {"input_ids": np.random.default_rng(seed).integers(0, 128, size=(8, 32)).astype(np.int32)}
 
 
+@pytest.mark.slow
 def test_cpu_offload_matches_resident(devices8):
     """Host-RAM tier: identical trajectory to the always-resident engine,
     with optimizer state off-device between steps."""
@@ -42,6 +43,7 @@ def test_cpu_offload_matches_resident(devices8):
         assert not e_cpu._opt_resident and e_cpu.state.opt_state is None
 
 
+@pytest.mark.slow
 def test_nvme_swap_roundtrip_matches_resident(tmp_path, devices8):
     """Training with state swapped to disk between steps must match the
     always-resident trajectory bit-for-bit (same jitted program)."""

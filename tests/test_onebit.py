@@ -168,10 +168,15 @@ def test_sign_psum_error_feedback_reduces_bias(devices8):
     avg = np.asarray(avg[0])
     corr = np.corrcoef(avg, exact)[0, 1]
     assert corr > 0.5
-    # error feedback: residual equals what compression lost locally
+    # error feedback: residual compensates against the *transmitted*
+    # approximation, which uses the mean of the per-worker scales for every
+    # worker (the wire carries sign_i and one scalar per worker; the
+    # averaged tensor is sum(sign_i) * mean_scale / n). Compensating against
+    # sign_i * scale_i would silently drop the per-worker scale variance.
     comb = x + err
-    scale = np.abs(comb).mean(axis=1, keepdims=True)
-    np.testing.assert_allclose(np.asarray(err1), comb - np.sign(comb) * scale, rtol=1e-4, atol=1e-5)
+    mean_scale = np.abs(comb).mean(axis=1).mean()
+    np.testing.assert_allclose(np.asarray(err1), comb - np.sign(comb) * mean_scale,
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_quantized_psum_close_to_exact(devices8):
@@ -210,6 +215,31 @@ def test_quantized_all_gather_roundtrip(devices8):
                                        out_specs=P("d", None)))(x))
     # every shard gathered the (quantization-rounded) full tensor
     np.testing.assert_allclose(out[0].reshape(-1), x.reshape(-1), rtol=0.02, atol=0.02)
+
+
+def test_quantized_reduce_scatter_int8_wire(devices8):
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from shuffle_exchange_tpu.parallel.compressed import quantized_reduce_scatter
+
+    mesh = _shard_map_ctx(devices8)
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((8, 16, 32)).astype(np.float32)
+
+    def body(xs):
+        return quantized_reduce_scatter(xs[0], "d", group_size=16)[None]
+
+    jf = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("d"),), out_specs=P("d"),
+                           check_vma=False))
+    out = np.asarray(jf(x))   # [8, 2, 32]: rank i holds shard i of the sum
+    expect = x.sum(axis=0).reshape(8, 2, 32)
+    np.testing.assert_allclose(out, expect, rtol=0.05, atol=0.05)
+    # the wire payload must be int8 (all-to-all of the quantized tensor)
+    hlo = jf.lower(x).compile().as_text()
+    assert any(("all-to-all" in l and "s8" in l) for l in hlo.splitlines()), \
+        "quantized_reduce_scatter wire is not int8"
 
 
 def test_quantized_hierarchical_reduce(devices8):

@@ -1,0 +1,62 @@
+"""Pallas paged decode-attention kernel vs the gather+dense oracle
+(reference blocked_flash + atom_builder, inference/v2/kernels/ragged_ops/;
+VERDICT r1 missing #4). Runs the kernel in CPU interpret mode."""
+
+import numpy as np
+import pytest
+
+
+def _mk(B, H, KV, Dh, bs, nblk, kv_lens, dtype=np.float32, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), dtype)
+    ck = jnp.asarray(rng.standard_normal((nblk, bs, KV, Dh)), dtype)
+    cv = jnp.asarray(rng.standard_normal((nblk, bs, KV, Dh)), dtype)
+    maxblk = max(-(-int(l) // bs) for l in kv_lens)
+    bt = np.full((B, maxblk), -1, np.int32)
+    nxt = iter(range(1, nblk))
+    for b, l in enumerate(kv_lens):
+        for j in range(-(-int(l) // bs)):
+            bt[b, j] = next(nxt)
+    return q, ck, cv, jnp.asarray(bt), jnp.asarray(np.asarray(kv_lens, np.int32))
+
+
+def _oracle(q, ck, cv, bt, kv_len):
+    from shuffle_exchange_tpu.inference.engine import decode_attention
+    from shuffle_exchange_tpu.inference.paged import gather_kv
+
+    k, v = gather_kv(ck, cv, bt)
+    return decode_attention(q, k, v, kv_len)
+
+
+@pytest.mark.parametrize("kv_lens", [[16], [30, 49, 16], [1, 128, 64, 17]])
+def test_interpret_parity_ragged(kv_lens):
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.paged_attention import paged_decode_attention_pallas
+
+    B = len(kv_lens)
+    q, ck, cv, bt, kvl = _mk(B, 8, 8, 64, 16, B * 9 + 1, kv_lens)
+    out = paged_decode_attention_pallas(q, ck, cv, bt, kvl, interpret=True)
+    ref = _oracle(q, ck, cv, bt, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_interpret_parity_gqa():
+    from shuffle_exchange_tpu.ops.paged_attention import paged_decode_attention_pallas
+
+    q, ck, cv, bt, kvl = _mk(2, 8, 2, 64, 16, 12, [33, 47])
+    out = paged_decode_attention_pallas(q, ck, cv, bt, kvl, interpret=True)
+    ref = _oracle(q, ck, cv, bt, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_dispatch_fallback_on_cpu():
+    """auto impl on CPU must silently use the gather oracle."""
+    from shuffle_exchange_tpu.ops.paged_attention import paged_decode_attention
+
+    q, ck, cv, bt, kvl = _mk(2, 4, 4, 32, 16, 8, [20, 10])
+    out = paged_decode_attention(q, ck, cv, bt, kvl)
+    ref = _oracle(q, ck, cv, bt, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)

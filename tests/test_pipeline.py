@@ -45,6 +45,7 @@ def test_loss_matches_dense(pipe_topology):
     assert np.isclose(dense, piped, rtol=1e-5), (dense, piped)
 
 
+@pytest.mark.slow
 def test_grads_match_dense(pipe_topology):
     import jax
 
@@ -76,6 +77,7 @@ def test_layer_divisibility_error(pipe_topology):
         PipelinedModel(model, n_stages=4, micro_batches=2)
 
 
+@pytest.mark.slow
 def test_engine_pipeline_path(devices8):
     """initialize() with mesh.pipe>1 wraps the model and trains."""
     import jax
@@ -98,6 +100,7 @@ def test_engine_pipeline_path(devices8):
     reset_topology()
 
 
+@pytest.mark.slow
 def test_engine_pipeline_matches_dense_engine(devices8):
     """Same seed/config modulo pipe axis -> same first-step loss."""
     import jax

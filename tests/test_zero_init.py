@@ -1,0 +1,100 @@
+"""zero.Init analog: construct-time partitioned initialization.
+
+Reference: ``runtime/zero/partition_parameters.py:879`` (``Init``) and
+``utils/init_on_device.py`` (``OnDevice``) — module construction never
+materializes the full model; parameters come up already partitioned. Our
+form: ``initialize(model=...)`` defers ``model.init`` into a jit with
+``out_shardings`` = the ZeRO policy, so each device materializes only its
+shard and the host never holds the unsharded fp32 tree.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def mesh_cfg():
+    return {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "mesh": {"fsdp": 4, "data": 2},
+        "steps_per_print": 10**9,
+    }
+
+
+def _fresh(model, cfg):
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    reset_topology()
+    return sxt.initialize(model=model, config=cfg)
+
+
+def test_deferred_init_never_materializes_eagerly(devices8, mesh_cfg):
+    """model.init must be *traced* (abstract args), not executed eagerly —
+    that is the whole zero.Init contract."""
+    import jax
+
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=256, d=64, layers=2, heads=4, seq=32))
+    calls = []
+    orig_init = model.init
+
+    def spy_init(rng):
+        calls.append(isinstance(rng, jax.core.Tracer))
+        return orig_init(rng)
+
+    model.init = spy_init
+    engine, *_ = _fresh(model, mesh_cfg)
+    # eval_shape trace + jit trace: every call must have seen abstract args
+    assert calls and all(calls), f"init ran eagerly (traced flags: {calls})"
+    # and the engine state is live + sharded per the ZeRO policy
+    leaves = jax.tree_util.tree_leaves(engine.state.master)
+    sharded = [l for l in leaves if any(e is not None for e in l.sharding.spec)]
+    assert sharded, "no master leaf came up sharded under stage 3 on an 8-dev mesh"
+
+
+def test_deferred_init_matches_eager_numerics(devices8, mesh_cfg):
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=256, d=64, layers=2, heads=4, seq=32))
+    engine, *_ = _fresh(model, mesh_cfg)
+    eager = model.init(jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree_util.tree_leaves(engine.state.master),
+                    jax.tree_util.tree_leaves(eager)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32),
+                                   rtol=0, atol=1e-6)
+
+
+def test_deferred_init_trains(devices8, mesh_cfg):
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=256, d=64, layers=2, heads=4, seq=32))
+    engine, *_ = _fresh(model, mesh_cfg)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (8, 32)).astype(np.int32)}
+    loss0 = float(engine.train_batch(batch))
+    loss1 = float(engine.train_batch(batch))
+    assert np.isfinite(loss0) and np.isfinite(loss1)
+
+
+def test_explicit_params_path_still_works(devices8, mesh_cfg):
+    import jax
+
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=256, d=64, layers=2, heads=4, seq=32))
+    params = model.init(jax.random.PRNGKey(0))
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    reset_topology()
+    engine, *_ = sxt.initialize(model=model, params=params, config=mesh_cfg)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 256, (8, 32)).astype(np.int32)}
+    assert np.isfinite(float(engine.train_batch(batch)))

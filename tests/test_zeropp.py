@@ -44,6 +44,7 @@ def _leaf_axes(tree, topo):
     return axes
 
 
+@pytest.mark.slow
 def test_hpz_mesh_derivation_and_param_gather_group(devices8):
     reset_topology()
     engine, *_ = sxt.initialize(model=_model(),
@@ -57,6 +58,7 @@ def test_hpz_mesh_derivation_and_param_gather_group(devices8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_mics_shards_stay_in_group(devices8):
     reset_topology()
     engine, *_ = sxt.initialize(model=_model(),
@@ -69,6 +71,7 @@ def test_mics_shards_stay_in_group(devices8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_qwz_quantized_weights_close_to_exact(devices8):
     reset_topology()
     e_exact, *_ = sxt.initialize(model=_model(), config=_base_config())
@@ -86,6 +89,7 @@ def test_qwz_quantized_weights_close_to_exact(devices8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_qgz_quantized_gradients_trains(devices8):
     reset_topology()
     engine, *_ = sxt.initialize(model=_model(),
@@ -94,6 +98,36 @@ def test_qgz_quantized_gradients_trains(devices8):
     for _ in range(3):
         l1 = float(engine.train_batch(_batch()))
     assert np.isfinite(l1) and l1 < l0
+
+
+def test_qgz_wire_is_int8(devices8):
+    """qgZ must COMPRESS THE WIRE, not just round the numerics: the compiled
+    train step's gradient reduction collectives carry s8 operands (reference
+    quantized two-level all-to-all, runtime/comm/coalesced_collectives.py:31).
+    """
+    import jax
+
+    reset_topology()
+    engine, *_ = sxt.initialize(model=_model(), config=_base_config(
+        stage=2, zero_quantized_gradients=True))
+    batch = _batch()
+    shaped = engine._reshape_batch(batch)
+    low = engine._train_step.lower(engine.state, shaped, engine._mix_matrix(),
+                                   jax.random.PRNGKey(0))
+    hlo = low.compile().as_text()
+    s8_gathers = [l for l in hlo.splitlines() if "all-gather" in l and "s8" in l]
+    assert s8_gathers, "no s8 all-gather in compiled HLO — qgZ wire compression inactive"
+
+
+def test_qgz_loss_parity_with_exact(devices8):
+    reset_topology()
+    eq, *_ = sxt.initialize(model=_model(),
+                            config=_base_config(stage=2, zero_quantized_gradients=True))
+    losses_q = [float(eq.train_batch(_batch())) for _ in range(4)]
+    reset_topology()
+    ee, *_ = sxt.initialize(model=_model(), config=_base_config(stage=2))
+    losses_e = [float(ee.train_batch(_batch())) for _ in range(4)]
+    np.testing.assert_allclose(losses_q, losses_e, rtol=0.02)
 
 
 def test_hpz_group_must_divide_world(devices8):
