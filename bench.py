@@ -1,20 +1,41 @@
 #!/usr/bin/env python
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
-Metric (BASELINE.json): tokens/sec/chip for the flagship training config on
-the available hardware. On the single tunneled TPU chip this runs a
-GPT-2-small-class model with the full engine path (ZeRO sharding policy,
-bf16, fused jitted train step); on CPU (no TPU) it runs a tiny config so the
-line is always produced.
+Round-3 rewrite (VERDICT r2 weak #1: the r2 number was physically
+impossible — `block_until_ready()` does not reliably synchronize on the
+experimental tunneled 'axon' platform, so step times measured dispatch, not
+execution). Measurement discipline now:
 
-vs_baseline: ratio against the H100-class reference throughput scaled to
-this config — the reference snapshot publishes no rigorous numbers
-(BASELINE.md), so the denominator is a model-FLOPs-derived H100 estimate:
-assume the reference hits 45% MFU on H100 (989 TFLOP/s bf16 dense), i.e.
-tokens/sec = 0.45 * 989e12 / (6 * n_params). The same formula with the
-chip's peak gives our MFU-normalized comparison until real H100 runs exist.
+- **Host-transfer sync**: every timed region ends with `float(scalar)` — a
+  device->host copy of the result, which cannot complete before the program
+  that produced it. `block_until_ready` is never trusted for timing.
+- **Calibration microbench**: a chain of bf16 matmuls of known FLOPs is
+  timed with the same discipline. If the implied FLOP/s exceeds the chip's
+  peak, timing is broken: the line is emitted with `"valid": false` and NO
+  `vs_baseline` (ADVICE r2: the invalidation must be machine-readable).
+- **MFU gate**: any config whose MFU exceeds 100% is marked invalid.
+- **Throughput** is measured over a dependency chain (step N+1 consumes the
+  donated state of step N) with a single final sync, so per-step host RTT
+  through the tunnel is amortized; **p50 step time** is measured with
+  per-step sync and therefore includes one RTT (conservative).
+
+Configs benched (BASELINE.json):
+  #1 GPT-2 125M ZeRO-1 bf16            (bring-up config, round-over-round)
+  #2 Llama-3-style ZeRO-3 + fused Pallas Adam — north star. 8B does not fit
+     one chip (8B * 14 B/param of bf16+master+adam state = 112 GB), so the
+     largest ladder entry that fits this chip's HBM is used and labeled.
+  #5 Paged serving (engine_v2): prefill + decode tokens/s.
+
+Results for all configs are published into BASELINE.json["published"];
+the printed headline line is config #2 when it ran, else #1.
+
+vs_baseline: our MFU / 0.45 — the reference snapshot publishes no rigorous
+numbers (BASELINE.md), so the denominator is the 45% MFU an H100 DeepSpeed
+run is assumed to reach on the same model; MFU-normalizing makes the ratio
+chip-agnostic.
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -22,75 +43,411 @@ import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
 
-def main():
+
+# ---------------------------------------------------------------------------
+# Hardware discovery
+# ---------------------------------------------------------------------------
+
+def chip_peak_flops(dev, platform: str) -> float:
+    """bf16 dense peak FLOP/s for the chip kind."""
+    # device_kind strings are spaced ("TPU v5 lite"); normalize so the
+    # keys match both spellings
+    kind = getattr(dev, "device_kind", "").lower().replace(" ", "")
+    for key, peak in (("v5p", 459e12), ("v6e", 918e12), ("v6lite", 918e12),
+                      ("trillium", 918e12), ("v4", 275e12),
+                      ("v5e", 197e12), ("v5lite", 197e12)):
+        if key in kind:
+            return peak
+    return 197e12 if platform == "tpu" else 50e12
+
+
+def hbm_bytes(dev) -> int:
+    try:
+        stats = dev.memory_stats() or {}
+        return int(stats.get("bytes_limit") or stats.get("bytes_reservable_limit") or 0)
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Timing primitives
+# ---------------------------------------------------------------------------
+
+def _short_err(e: BaseException) -> str:
+    """One line, bounded — multi-KB XLA/Mosaic dumps would otherwise swamp
+    the single-JSON-line contract."""
+    msg = " ".join(str(e).split())
+    return f"{type(e).__name__}: {msg[:300]}"
+
+
+def host_sync(x) -> float:
+    """Device->host transfer of a scalar: the only sync we trust."""
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+def calibrate(peak_flops: float):
+    """Time a known-FLOPs bf16 matmul chain with the same sync discipline.
+
+    Returns (achieved_flops_per_s, rtt_s, ok). ok=False means the
+    measurement pipeline reports more FLOP/s than the chip can do -> timing
+    is broken. The chain is ~17.6 TFLOP (>=90ms even at peak) so the
+    dispatch+sync round trip through the tunnel (measured separately as
+    rtt_s and reported) stays a small fraction of the measurement.
+    """
     import jax
+    import jax.numpy as jnp
 
-    platform = jax.default_backend()
-    on_tpu = platform == "tpu"
+    n, chain = 8192, 16
+
+    @jax.jit
+    def f(a, b):
+        x = a
+        for _ in range(chain):
+            x = jnp.dot(x, b)
+        return x.astype(jnp.float32).sum()
+
+    @jax.jit
+    def noop(a):
+        return a + 1.0
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    # keep magnitudes ~1 through the chain so the sum stays finite
+    b = jax.random.normal(key, (n, n), jnp.bfloat16) * (n ** -0.5)
+    z = jnp.zeros((), jnp.float32)
+    host_sync(f(a, b))  # compile + warm
+    host_sync(noop(z))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        host_sync(noop(z))
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        host_sync(f(a, b))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    achieved = 2.0 * n * n * n * chain / max(best - rtt, 1e-9)
+    return achieved, rtt, achieved <= 1.05 * peak_flops
+
+
+# ---------------------------------------------------------------------------
+# Config #2 model ladder (largest Llama-3-style model that fits one chip)
+# ---------------------------------------------------------------------------
+
+def _param_count(cfg) -> int:
+    d, ff = cfg.d_model, cfg.ff_dim
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    attn = d * d + 2 * d * kv_dim + d * d
+    mlp = 3 * d * ff if cfg.activation == "swiglu" else 2 * d * ff
+    per_layer = attn + mlp + 2 * d
+    embed = cfg.vocab_size * d * (1 if cfg.tie_embeddings else 2)
+    return cfg.n_layers * per_layer + embed + d
+
+
+def pick_config2(hbm: int):
+    """Largest ladder entry with params*14B (bf16 fwd + fp32 master + adam
+    m/v) under 55% of HBM (activations under remat take the rest)."""
+    from shuffle_exchange_tpu.models import TransformerConfig, llama3_8b
+
+    ladder = [
+        ("llama3-8b", llama3_8b()),
+        ("llama3-3b-style", TransformerConfig(
+            vocab_size=128256, d_model=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+            d_ff=8192, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+            position="rope", rope_theta=500000.0, tie_embeddings=False)),
+        ("llama3-1b-style", TransformerConfig(
+            vocab_size=128256, d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+            d_ff=8192, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+            position="rope", rope_theta=500000.0, tie_embeddings=True)),
+        ("llama-750m-style", TransformerConfig(
+            vocab_size=32768, d_model=1536, n_layers=16, n_heads=24, n_kv_heads=8,
+            max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+            position="rope", rope_theta=500000.0, tie_embeddings=True)),
+        ("llama-350m-style", TransformerConfig(
+            vocab_size=32768, d_model=1024, n_layers=16, n_heads=16, n_kv_heads=8,
+            max_seq_len=8192, activation="swiglu", norm="rmsnorm",
+            position="rope", rope_theta=500000.0, tie_embeddings=True)),
+    ]
+    budget = 0.55 * hbm if hbm else 0.55 * 16e9
+    for name, cfg in ladder:
+        if 14 * _param_count(cfg) <= budget:
+            return name, cfg
+    return ladder[-1]
+
+
+# ---------------------------------------------------------------------------
+# Benches
+# ---------------------------------------------------------------------------
+
+def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
+                peak_flops, n_chips):
+    import jax.tree_util as jtu
 
     import shuffle_exchange_tpu as sxt
-    from shuffle_exchange_tpu.models import Transformer, gpt2_small, tiny
 
-    if on_tpu:
-        # No remat: the 125M model + bs=8 activations fit HBM comfortably;
-        # remat here cost ~35% step time for nothing (VERDICT r1 weak #2).
-        model = Transformer(gpt2_small())
-        batch_size, seq_len, steps, warmup = 8, 1024, 20, 3
-    else:
-        model = Transformer(tiny(vocab=512, d=128, layers=2, heads=4, seq=128))
-        batch_size, seq_len, steps, warmup = 8, 128, 5, 1
-
-    cfg = {
-        "train_batch_size": batch_size,
-        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
-        "steps_per_print": 10**9,
-    }
-    engine, *_ = sxt.initialize(model=model, config=cfg)
-
+    engine, *_ = sxt.initialize(model=model, config=ds_config)
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, model.config.vocab_size,
                                        size=(batch_size, seq_len)).astype(np.int32)}
 
     for _ in range(warmup):
-        engine.train_batch(batch).block_until_ready()
-    t0 = time.time()
-    times = []
+        host_sync(engine.train_batch(batch))
+
+    # p50 step time: per-step host sync (includes one tunnel RTT per step)
+    per_step = []
+    for _ in range(max(5, steps // 2)):
+        t0 = time.perf_counter()
+        host_sync(engine.train_batch(batch))
+        per_step.append(time.perf_counter() - t0)
+    p50 = sorted(per_step)[len(per_step) // 2]
+
+    # throughput: donated-state dependency chain, single final sync
+    t0 = time.perf_counter()
+    last = None
     for _ in range(steps):
-        s = time.time()
-        engine.train_batch(batch).block_until_ready()
-        times.append(time.time() - s)
-    total = time.time() - t0
+        last = engine.train_batch(batch)
+    host_sync(last)
+    total = time.perf_counter() - t0
 
-    n_chips = len(jax.devices())
     tokens_per_step = batch_size * (seq_len - 1)
-    tokens_per_sec_chip = tokens_per_step * steps / total / n_chips
-    p50 = sorted(times)[len(times) // 2]
-
-    # Param count + H100-reference estimate (see module docstring).
-    import jax.tree_util as jtu
-
+    tps_chip = tokens_per_step * steps / total / n_chips
     n_params = sum(int(np.prod(l.shape)) for l in jtu.tree_leaves(engine.state.master))
     if engine.ensemble:
         n_params //= engine.replicas
-    # vs_baseline is hardware-normalized: our MFU on this chip vs the 45% MFU
-    # assumed for the reference on its chip (BASELINE.md has no real numbers).
-    peak_flops = {"tpu": 197e12}.get(platform, 50e12)  # v5e bf16 dense peak
-    kind = jax.devices()[0].device_kind.lower()
-    if "v5p" in kind or "v4" in kind:
-        peak_flops = 459e12 if "v5p" in kind else 275e12
-    our_mfu = 6.0 * n_params * tokens_per_sec_chip / peak_flops
-    vs_baseline = our_mfu / 0.45
-
-    result = {
-        "metric": (f"train tokens/sec/chip ({'gpt2-125M' if on_tpu else 'tiny-cpu'} "
-                   f"ZeRO-1 bf16, step p50 {p50*1000:.0f}ms, MFU {our_mfu*100:.1f}%)"),
-        "value": round(tokens_per_sec_chip, 1),
+    mfu = 6.0 * n_params * tps_chip / peak_flops
+    return {
+        "config": label,
+        "params_m": round(n_params / 1e6, 1),
+        "batch_size": batch_size,
+        "seq_len": seq_len,
+        "tokens_per_sec_chip": round(tps_chip, 1),
+        "step_p50_ms": round(p50 * 1000, 2),
+        "mfu_pct": round(mfu * 100, 2),
+        "valid": bool(mfu <= 1.0),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(vs_baseline, 4),
     }
+
+
+def bench_serving(label, model_cfg, peak_flops):
+    """Config #5: engine_v2 paged prefill + decode tokens/s."""
+    import jax
+
+    from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngineV2
+    from shuffle_exchange_tpu.models import Transformer
+
+    cfg = dataclasses.replace(model_cfg, remat=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    n_params = _param_count(cfg)
+
+    bsz, prompt_len, decode_steps = 4, 512, 48
+    icfg = InferenceConfig(dtype="bfloat16", max_seq_len=2048,
+                           kv_block_size=64, num_kv_blocks=4 * (2048 // 64) + 8)
+    eng = InferenceEngineV2(model, params, icfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
+               for _ in range(bsz)]
+    uids = list(range(bsz))
+
+    # warm both programs (prefill bucket + batched decode)
+    logits = eng.put(uids, prompts)              # put() returns host np: syncs
+    nxt = [[int(np.argmax(logits[i]))] for i in range(bsz)]
+    logits = eng.put(uids, nxt)
+
+    t0 = time.perf_counter()
+    eng.flush(uids)
+    logits = eng.put(uids, prompts)
+    prefill_s = time.perf_counter() - t0
+
+    nxt = [[int(np.argmax(logits[i]))] for i in range(bsz)]
+    t0 = time.perf_counter()
+    for _ in range(decode_steps):
+        logits = eng.put(uids, nxt)
+        nxt = [[int(np.argmax(logits[i]))] for i in range(bsz)]
+    decode_s = time.perf_counter() - t0
+
+    decode_tps = bsz * decode_steps / decode_s
+
+    # v1 fused generate: the whole decode loop is ONE on-device program
+    # (lax.scan), so the ~65ms tunnel RTT is paid once, not per token —
+    # this is the serving number the engine can actually sustain; the
+    # put()-loop number above is an API-latency measurement through the
+    # tunnel (each put is a host round trip).
+    from shuffle_exchange_tpu.inference.engine import InferenceEngine
+
+    v1 = InferenceEngine(model, params, icfg)
+    gen_new = 64
+    ids = np.stack([np.asarray(p, np.int32) for p in prompts])
+    v1.generate(ids, max_new_tokens=gen_new)          # compile + warm
+    t0 = time.perf_counter()
+    v1.generate(ids, max_new_tokens=gen_new)          # returns host np: syncs
+    fused_s = time.perf_counter() - t0
+    fused_tps = bsz * gen_new / fused_s
+
+    # decode FLOPs ≈ 2*N per token (fwd only) -> model-bandwidth utilization
+    decode_mfu = 2.0 * n_params * max(decode_tps, fused_tps) / peak_flops
+    return {
+        "config": label,
+        "params_m": round(n_params / 1e6, 1),
+        "batch_size": bsz,
+        "prompt_len": prompt_len,
+        "prefill_tokens_per_sec": round(bsz * prompt_len / prefill_s, 1),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "decode_ms_per_token": round(1000 * decode_s / decode_steps, 2),
+        "fused_generate_tokens_per_sec": round(fused_tps, 1),
+        "valid": bool(decode_mfu <= 1.0),
+        "unit": "tokens/s",
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def publish(rows, calib_record, on_tpu: bool):
+    path = os.path.join(REPO, "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception:
+        doc = {}
+    # merge, don't replace: a CPU smoke run must not clobber the committed
+    # TPU rows (its row keys are distinct, and it has no calibration to offer)
+    published = dict(doc.get("published", {}))
+    if on_tpu:
+        published["calibration"] = calib_record
+    published.update(rows)
+    doc["published"] = published
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+def main():
+    import jax
+
+    import shuffle_exchange_tpu  # noqa: F401  (import check)
+    from shuffle_exchange_tpu.models import Transformer, gpt2_small, tiny
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+    peak = chip_peak_flops(dev, platform)
+    hbm = hbm_bytes(dev)
+
+    rows, errors = {}, {}
+
+    # -- calibration ----------------------------------------------------
+    if on_tpu:
+        try:
+            achieved, rtt, cal_ok = calibrate(peak)
+        except Exception as e:  # pragma: no cover
+            achieved, rtt, cal_ok = 0.0, 0.0, False
+            errors["calibration"] = _short_err(e)
+    else:
+        achieved, rtt, cal_ok = 0.0, 0.0, True  # CPU: no peak model; skip the gate
+    calib_record = {
+        "chip": getattr(dev, "device_kind", platform),
+        "peak_tflops_assumed": round(peak / 1e12, 1),
+        "matmul_chain_tflops": round(achieved / 1e12, 1),
+        "host_sync_rtt_ms": round(rtt * 1000, 2),
+        "hbm_gb": round(hbm / 2**30, 1) if hbm else None,
+        "ok": bool(cal_ok),
+    }
+
+    # -- config #1 ------------------------------------------------------
+    cfg1 = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10**9,
+    }
+    try:
+        if on_tpu:
+            rows["config1_gpt2_125m_zero1"] = bench_train(
+                "gpt2-125M zero1 bf16", Transformer(gpt2_small()), cfg1,
+                batch_size=8, seq_len=1024, steps=15, warmup=3,
+                peak_flops=peak, n_chips=n_chips)
+        else:
+            rows["config1_tiny_cpu"] = bench_train(
+                "tiny-cpu zero1", Transformer(tiny(vocab=512, d=128, layers=2, heads=4, seq=128)),
+                cfg1, batch_size=8, seq_len=128, steps=5, warmup=1,
+                peak_flops=peak, n_chips=n_chips)
+    except Exception as e:
+        errors["config1"] = _short_err(e)
+
+    # -- config #2 (north star, scaled to chip) -------------------------
+    if on_tpu:
+        try:
+            name2, mcfg2 = pick_config2(hbm)
+            # full per-layer remat: dots_saveable keeps every matmul output
+            # (~1.2GB/layer at bs 8 x 4096) and OOMs a 16GB chip; saving only
+            # the residual stream costs ~33% recompute FLOPs and fits
+            mcfg2 = dataclasses.replace(mcfg2, remat=True,
+                                        remat_policy="nothing_saveable",
+                                        max_seq_len=4096)
+            cfg2 = {
+                "train_batch_size": 8,
+                "optimizer": {"type": "FusedAdam",
+                              "params": {"lr": 3e-4, "weight_decay": 0.1}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "steps_per_print": 10**9,
+            }
+            rows["config2_llama3_zero3_fused_adam"] = bench_train(
+                f"{name2} zero3 + pallas fused adam (8B does not fit 1 chip; scaled)",
+                Transformer(mcfg2), cfg2, batch_size=8, seq_len=4096,
+                steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
+        except Exception as e:
+            errors["config2"] = _short_err(e)
+
+        # -- config #5 (serving) ----------------------------------------
+        try:
+            name5, mcfg5 = pick_config2(hbm)
+            rows["config5_paged_serving"] = bench_serving(
+                f"{name5} engine_v2 paged serving", mcfg5, peak)
+        except Exception as e:
+            errors["config5"] = _short_err(e)
+
+    try:
+        publish(rows, calib_record, on_tpu)
+    except OSError as e:  # never break the one-JSON-line contract
+        errors["publish"] = _short_err(e)
+
+    # -- headline line --------------------------------------------------
+    head = rows.get("config2_llama3_zero3_fused_adam") or next(iter(rows.values()), None)
+    if head is None:
+        print(json.dumps({"metric": "bench failed", "value": 0, "unit": "tokens/s/chip",
+                          "valid": False, "errors": errors}))
+        return
+    valid = bool(cal_ok and head.get("valid"))
+    calib_note = (f"calib {calib_record['matmul_chain_tflops']}/"
+                  f"{calib_record['peak_tflops_assumed']} TFLOP/s")
+    if "mfu_pct" in head:   # training row
+        metric = (f"train tokens/sec/chip ({head['config']}, "
+                  f"step p50 {head['step_p50_ms']:.0f}ms, "
+                  f"MFU {head['mfu_pct']:.1f}%, {calib_note})")
+        value = head["tokens_per_sec_chip"]
+    else:                   # serving fallback row
+        metric = (f"serving decode tokens/sec ({head['config']}, "
+                  f"{head['decode_ms_per_token']:.0f}ms/token, {calib_note})")
+        value = head["decode_tokens_per_sec"]
+    result = {
+        "metric": metric,
+        "value": value,
+        "unit": head.get("unit", "tokens/s/chip"),
+        "valid": valid,
+    }
+    if valid and "mfu_pct" in head:
+        result["vs_baseline"] = round(head["mfu_pct"] / 100.0 / 0.45, 4)
+    if errors:
+        result["errors"] = errors
     print(json.dumps(result))
 
 

@@ -126,11 +126,15 @@ class InferenceEngineV2(InferenceEngine):
 
             def attn_fn(q, k, v):
                 KV, Dh = k.shape[2], k.shape[3]
-                kb = k.reshape(P * nblk_pad, bs, KV, Dh).astype(ck.dtype)
-                vb = v.reshape(P * nblk_pad, bs, KV, Dh).astype(cv.dtype)
+
+                def blocks(x):   # [P,tpad,KV,Dh] -> pool blocks [P*nblk,KV,bs,Dh]
+                    return (x.reshape(P, nblk_pad, bs, KV, Dh)
+                            .transpose(0, 1, 3, 2, 4)
+                            .reshape(P * nblk_pad, KV, bs, Dh))
+
                 flat = btables.reshape(-1)
-                ck2 = ck.at[flat].set(kb)
-                cv2 = cv.at[flat].set(vb)
+                ck2 = ck.at[flat].set(blocks(k).astype(ck.dtype))
+                cv2 = cv.at[flat].set(blocks(v).astype(cv.dtype))
                 return flash_attention(q, k, v, causal=True,
                                        impl=self.config.attention_impl), (ck2, cv2)
 
@@ -181,9 +185,11 @@ class InferenceEngineV2(InferenceEngine):
                                           axis=1)                 # [B,C]
                 blk = jnp.where(valid, blk, self._scratch)
                 off = pos % bs
-                ck2 = ck.at[blk.reshape(-1), off.reshape(-1)].set(
+                # [nblk,KV,bs,Dh] pool: advanced (blk, off) around the KV
+                # slice yields [B*C, KV, Dh] rows, matching the new K/V
+                ck2 = ck.at[blk.reshape(-1), :, off.reshape(-1)].set(
                     k.reshape(B * C, *k.shape[2:]).astype(ck.dtype))
-                cv2 = cv.at[blk.reshape(-1), off.reshape(-1)].set(
+                cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
                     v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
                 kg, vg = gather_kv(ck2, cv2, btables)             # [B,S,KV,Dh]
                 out = extend_attention(q, kg, vg, start, start + nnew)

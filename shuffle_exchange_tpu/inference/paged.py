@@ -4,7 +4,7 @@ Capability analog of the reference v2 ragged stack:
   - ``BlockedAllocator`` (ragged/blocked_allocator.py:11) — host-side
     free-list of KV blocks.
   - ``BlockedKVCache`` (ragged/kv_cache.py:40) — here ``PagedKVCache``:
-    per-layer-stacked block pool [L, nblocks, block, KV, Dh] on device.
+    per-layer-stacked block pool [L, nblocks, KV, block, Dh] on device.
   - ``blocked_flash`` + ``atom_builder`` + ``linear_blocked_kv_rotary``
     (inference/v2/kernels/ragged_ops/) — here ``paged_decode_attention``
     (gather-by-block-table attention; the Pallas kernel variant lives in
@@ -54,7 +54,12 @@ class BlockedAllocator:
 
 
 class PagedKVCache(NamedTuple):
-    """Device block pool. k/v: [L, num_blocks, block_size, KV, Dh]."""
+    """Device block pool. k/v: [L, num_blocks, KV, block_size, Dh].
+
+    KV is a LEADING dim (round 3): the Pallas decode kernel DMAs one kv
+    head's block per grid step, which TPU block specs only allow on
+    non-minor dims; {block_size, Dh} minor also makes blocks native
+    (8,128)-tileable."""
 
     k: "object"
     v: "object"
@@ -64,12 +69,12 @@ class PagedKVCache(NamedTuple):
                kv_heads: int, head_dim: int, dtype) -> "PagedKVCache":
         import jax.numpy as jnp
 
-        shape = (n_layers, num_blocks, block_size, kv_heads, head_dim)
+        shape = (n_layers, num_blocks, kv_heads, block_size, head_dim)
         return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def blocks_needed(n_tokens: int, block_size: int) -> int:
@@ -77,45 +82,57 @@ def blocks_needed(n_tokens: int, block_size: int) -> int:
 
 
 def gather_kv(ck, cv, block_table):
-    """ck/cv [nblk, bs, KV, Dh] (one layer), block_table [B, maxblk] (-1 pad)
+    """ck/cv [nblk, KV, bs, Dh] (one layer), block_table [B, maxblk] (-1 pad)
     -> k/v [B, maxblk*bs, KV, Dh]. Padding rows gather block 0; callers mask
     by seq length so the junk never contributes."""
     import jax.numpy as jnp
 
     bt = jnp.maximum(block_table, 0)
     B, M = bt.shape
-    k = jnp.take(ck, bt.reshape(-1), axis=0).reshape(B, M * ck.shape[1], *ck.shape[2:])
-    v = jnp.take(cv, bt.reshape(-1), axis=0).reshape(B, M * cv.shape[1], *cv.shape[2:])
-    return k, v
+
+    def g(c):
+        nblk, KV, bs, Dh = c.shape
+        x = jnp.take(c, bt.reshape(-1), axis=0)          # [B*M, KV, bs, Dh]
+        x = x.reshape(B, M, KV, bs, Dh).transpose(0, 1, 3, 2, 4)
+        return x.reshape(B, M * bs, KV, Dh)
+
+    return g(ck), g(cv)
 
 
 def append_token_kv(ck, cv, newk, newv, block_table, pos):
     """Scatter one new token's K/V per sequence into the block pool.
 
-    ck/cv [nblk, bs, KV, Dh]; newk/newv [B, KV, Dh]; block_table [B, maxblk];
+    ck/cv [nblk, KV, bs, Dh]; newk/newv [B, KV, Dh]; block_table [B, maxblk];
     pos [B] = token index within the sequence (the slot being written).
     Reference: linear_blocked_kv_rotary's KV append half.
     """
     import jax.numpy as jnp
 
-    bs = ck.shape[1]
+    bs = ck.shape[2]
     blk = jnp.take_along_axis(jnp.maximum(block_table, 0), (pos // bs)[:, None], axis=1)[:, 0]
     off = pos % bs
-    ck = ck.at[blk, off].set(newk.astype(ck.dtype))
-    cv = cv.at[blk, off].set(newv.astype(cv.dtype))
+    # advanced indices around the KV slice: result is [B, KV, Dh] (numpy
+    # moves the advanced dims to the front), matching newk/newv exactly
+    ck = ck.at[blk, :, off].set(newk.astype(ck.dtype))
+    cv = cv.at[blk, :, off].set(newv.astype(cv.dtype))
     return ck, cv
 
 
 def write_prefill_kv(ck, cv, ks, vs, block_table):
     """Write a whole prompt's K/V (one sequence) into its blocks.
 
-    ck/cv [nblk, bs, KV, Dh]; ks/vs [Tpad, KV, Dh] with Tpad == nseq_blocks*bs
+    ck/cv [nblk, KV, bs, Dh]; ks/vs [Tpad, KV, Dh] with Tpad == nseq_blocks*bs
     (caller pads); block_table [nseq_blocks] real ids.
     """
-    bs = ck.shape[1]
+    bs = ck.shape[2]
     n = block_table.shape[0]
-    ck = ck.at[block_table].set(ks.reshape(n, bs, *ks.shape[1:]).astype(ck.dtype))
-    cv = cv.at[block_table].set(vs.reshape(n, bs, *vs.shape[1:]).astype(cv.dtype))
+
+    def blocks(x):
+        KV, Dh = x.shape[1], x.shape[2]
+        return x.reshape(n, bs, KV, Dh).transpose(0, 2, 1, 3)
+
+    ck = ck.at[block_table].set(blocks(ks).astype(ck.dtype))
+    cv = cv.at[block_table].set(blocks(vs).astype(cv.dtype))
     return ck, cv
 
 

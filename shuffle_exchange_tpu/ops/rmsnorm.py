@@ -32,10 +32,56 @@ def rmsnorm(x, weight, eps: float = 1e-5, residual=None):
         x = x + residual
     if _use_pallas(x) and x.shape[-1] % 128 == 0:
         try:
-            return _rmsnorm_pallas(x, weight, eps)
+            return _rmsnorm_vjp(x, weight, eps)
         except Exception:  # pragma: no cover - fallback safety
             return rmsnorm_reference(x, weight, eps)
     return rmsnorm_reference(x, weight, eps)
+
+
+_VJP_CACHE = {}
+
+
+def _rmsnorm_vjp(x, weight, eps):
+    """Differentiable wrapper: Pallas forward, analytic jnp backward.
+
+    A raw pallas_call has no VJP rule (round-3 fix: training any rmsnorm
+    model on TPU died in linearization); the backward is a handful of
+    elementwise ops + row reduction that XLA fuses into one pass, so a
+    Pallas bwd kernel would buy nothing. The custom_vjp function is built
+    once (eps is static — a closure per distinct eps, cached) so JAX sees a
+    stable primitive identity across layers and traces.
+    """
+    fn = _VJP_CACHE.get(eps)
+    if fn is None:
+        fn = _build_vjp(eps)
+        _VJP_CACHE[eps] = fn
+    return fn(x, weight)
+
+
+def _build_vjp(eps):
+    import jax
+
+    @jax.custom_vjp
+    def _f(x, w):
+        return _rmsnorm_pallas(x, w, eps)
+
+    def _fwd(x, w):
+        return _rmsnorm_pallas(x, w, eps), (x, w)
+
+    def _bwd(res, g):
+        import jax.numpy as jnp
+
+        x, w = res
+        x32, g32, w32 = (t.astype(jnp.float32) for t in (x, g, w))
+        r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+        xhat = x32 * r
+        gw = g32 * w32
+        dx = r * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+        dw = jnp.sum(g32 * xhat, axis=tuple(range(x.ndim - 1)))
+        return dx.astype(x.dtype), dw.astype(w.dtype)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f
 
 
 def _rmsnorm_pallas(x, weight, eps):

@@ -66,8 +66,18 @@ def build_optimizer(optimizer_config, lr_schedule, gradient_clipping: float = 0.
                              min_coeff=float(params.pop("min_coeff", 0.01)),
                              mask=weight_decay_mask)
     elif lowered in ("adam", "fusedadam", "cpuadam", "adamw"):
-        adam_w_mode = params.pop("adam_w_mode", lowered == "adamw")
-        if adam_w_mode or lowered == "adamw":
+        # reference FusedAdam/DeepSpeedCPUAdam both default adam_w_mode=True
+        adam_w_mode = params.pop("adam_w_mode", lowered in ("adamw", "fusedadam", "cpuadam"))
+        from ..ops.dispatch import pallas_enabled
+
+        if lowered == "fusedadam" and adam_w_mode and weight_decay_mask is None and pallas_enabled():
+            # The reference's FusedAdamBuilder multi-tensor CUDA kernel
+            # (ops/adam/fused_adam.py:15) maps to the Pallas fused pass: one
+            # HBM read/write of p/m/v per step instead of optax's op chain.
+            from ..ops.fused_adam import pallas_adamw
+
+            tx = pallas_adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd)
+        elif adam_w_mode or lowered == "adamw":
             tx = optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd, mask=weight_decay_mask)
         else:
             tx = optax.adam(schedule, b1=b1, b2=b2, eps=eps)

@@ -11,8 +11,8 @@ def _mk(B, H, KV, Dh, bs, nblk, kv_lens, dtype=np.float32, seed=0):
 
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), dtype)
-    ck = jnp.asarray(rng.standard_normal((nblk, bs, KV, Dh)), dtype)
-    cv = jnp.asarray(rng.standard_normal((nblk, bs, KV, Dh)), dtype)
+    ck = jnp.asarray(rng.standard_normal((nblk, KV, bs, Dh)), dtype)
+    cv = jnp.asarray(rng.standard_normal((nblk, KV, bs, Dh)), dtype)
     maxblk = max(-(-int(l) // bs) for l in kv_lens)
     bt = np.full((B, maxblk), -1, np.int32)
     nxt = iter(range(1, nblk))
