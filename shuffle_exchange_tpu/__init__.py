@@ -188,6 +188,18 @@ def initialize(
         collate_fn=collate_fn,
         seed=seed,
     )
+
+    # RLHF hybrid engine (reference runtime/hybrid_engine.py:30, selected by
+    # the hybrid_engine config section): wrap so generate() runs the fused
+    # inference loop on current consensus weights.
+    if dict(cfg.hybrid_engine or {}).get("enabled", False):
+        from .runtime.hybrid_engine import HybridEngine
+
+        if model is None or not hasattr(model, "head"):
+            raise ConfigError("hybrid_engine.enabled requires a model-zoo "
+                              "Transformer model (generate() needs its "
+                              "prefill/decode path)")
+        engine = HybridEngine(engine, model)
     return engine, engine.tx, engine.training_dataloader, engine.lr_schedule
 
 

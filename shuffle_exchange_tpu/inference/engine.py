@@ -125,6 +125,20 @@ class InferenceEngine:
         self.model = model
         self.config = config or InferenceConfig()
         self._mcfg = model.config
+        self._gen_cache: Dict[Tuple, Any] = {}
+        self._fwd = jax.jit(model.apply)
+        self._rng = jax.random.PRNGKey(self.config.seed)
+        self.update_params(params)
+
+    def update_params(self, params) -> None:
+        """Swap in new weights (same tree/shapes) without dropping compiled
+        programs — the hybrid-engine path (reference hybrid_engine.py swaps
+        inference containers in during ``generate()``; here the jitted
+        generate/prefill/decode programs are weight-agnostic, so refreshing
+        the pytree is the whole swap)."""
+        import jax
+        import jax.numpy as jnp
+
         dtype = self.config.jax_dtype()
         params = jax.tree.map(
             lambda p: p.astype(dtype) if hasattr(p, "astype") and jnp.issubdtype(p.dtype, jnp.floating) else p,
@@ -132,9 +146,6 @@ class InferenceEngine:
         if self.config.quantize_weights:
             params = self._quantize(params)
         self.params = self._place(params)
-        self._gen_cache: Dict[Tuple, Any] = {}
-        self._fwd = jax.jit(model.apply)
-        self._rng = jax.random.PRNGKey(self.config.seed)
 
     # -- sharding (AutoTP analog: inference/engine.py:247 TP group create) --
 

@@ -1,0 +1,1 @@
+from .hybrid_engine import HybridEngine  # noqa: F401

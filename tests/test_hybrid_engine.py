@@ -1,0 +1,130 @@
+"""Hybrid engine (RLHF train+generate) — reference runtime/hybrid_engine.py:30.
+
+The RLHF shape: train a few steps -> generate rollouts with the CURRENT
+weights -> train more -> generate again. Generations must match a fresh
+inference engine built from module_weights() (i.e. the swap really uses the
+live training weights, not stale ones), and the whole loop must not
+recompile the generate program after the first call.
+"""
+
+import numpy as np
+import pytest
+
+
+def _build(tmp_path=None, **cfg_extra):
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "hybrid_engine": {"enabled": True, "max_out_tokens": 8,
+                          "inference_config": {"dtype": "float32"}},
+        "steps_per_print": 10**9,
+    }
+    cfg.update(cfg_extra)
+    engine, *_ = sxt.initialize(model=model, config=cfg)
+    return model, engine
+
+
+def _batch(vocab=64, b=8, t=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(b, t)).astype(np.int32)}
+
+
+def test_initialize_returns_hybrid_engine():
+    from shuffle_exchange_tpu.runtime.hybrid_engine import HybridEngine
+
+    _, engine = _build()
+    assert isinstance(engine, HybridEngine)
+    # full engine API delegation
+    assert engine.global_steps == 0
+    assert engine.zero_stage == 1
+
+
+def test_rlhf_loop_generations_track_training_weights():
+    from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngine
+
+    model, engine = _build()
+    prompts = _batch(t=8, seed=1)["input_ids"]
+
+    for _ in range(5):
+        engine.train_batch(_batch(seed=2))
+    out1 = engine.generate(prompts, max_new_tokens=6)
+    assert out1.shape == (8, 6)
+
+    # a fresh engine on the CURRENT consensus weights must agree exactly
+    ref = InferenceEngine(model, engine.module_weights(consensus=True),
+                          InferenceConfig(dtype="float32", max_seq_len=32))
+    np.testing.assert_array_equal(out1, ref.generate(prompts, max_new_tokens=6))
+
+    # train more -> weights moved -> generations refresh (and typically change)
+    for _ in range(3):
+        engine.train_batch(_batch(seed=3))
+    out2 = engine.generate(prompts, max_new_tokens=6)
+    ref2 = InferenceEngine(model, engine.module_weights(consensus=True),
+                           InferenceConfig(dtype="float32", max_seq_len=32))
+    np.testing.assert_array_equal(out2, ref2.generate(prompts, max_new_tokens=6))
+
+    rep = engine.latency_report()
+    assert rep["generate_calls"] == 2
+    assert rep["training_iters"] == 8
+    assert rep["generate_latency_s"] > 0
+    assert rep["gather_latency_s"] > 0
+
+
+def test_generate_reuses_compiled_program():
+    """The persistent inference engine must keep its jit cache across weight
+    refreshes (the whole point of the TPU design: params swap, program
+    stays)."""
+    _, engine = _build()
+    prompts = _batch(t=8, seed=1)["input_ids"]
+    engine.train_batch(_batch(seed=2))
+    engine.generate(prompts, max_new_tokens=4)
+    iengine = engine._iengine
+    cache_after_first = dict(iengine._gen_cache)
+    engine.train_batch(_batch(seed=3))
+    engine.generate(prompts, max_new_tokens=4)
+    assert engine._iengine is iengine, "inference engine must persist"
+    assert dict(iengine._gen_cache) == cache_after_first, "no new compiles"
+
+
+def test_eval_train_flips_and_eval_forward():
+    _, engine = _build()
+    engine.train_batch(_batch(seed=2))
+    assert engine.in_training_mode
+    engine.eval()
+    assert not engine.in_training_mode
+    logits = engine.forward(_batch(t=8, seed=4))
+    assert np.asarray(logits).shape == (8, 8, 64)
+    engine.train()
+    assert engine.in_training_mode
+    # training-mode forward returns the loss path
+    loss = engine.forward(_batch(seed=5))
+    assert np.asarray(loss).shape == ()
+
+
+def test_release_inference_cache():
+    _, engine = _build(hybrid_engine={"enabled": True, "max_out_tokens": 8,
+                                      "release_inference_cache": True,
+                                      "inference_config": {"dtype": "float32"}})
+    prompts = _batch(t=8, seed=1)["input_ids"]
+    engine.generate(prompts, max_new_tokens=4)
+    assert engine._iengine is not None
+    engine.train()
+    assert engine._iengine is None, "release_inference_cache drops the workspace"
+
+
+def test_hybrid_requires_zoo_model():
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.config.config_utils import ConfigError
+
+    with pytest.raises(ConfigError):
+        sxt.initialize(
+            params={"w": np.zeros((2, 2), np.float32)},
+            loss_fn=lambda p, b, rng: (p["w"] ** 2).sum(),
+            config={"train_batch_size": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "hybrid_engine": {"enabled": True}})
