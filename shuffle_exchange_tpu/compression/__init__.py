@@ -1,0 +1,10 @@
+from .compress import (  # noqa: F401
+    CompressionScheduler,
+    build_compression_fn,
+    export_int8,
+    fake_quantize,
+    init_compression,
+    redundancy_clean,
+    student_initialization,
+)
+from .config import CompressionConfig  # noqa: F401

@@ -1,0 +1,401 @@
+"""Compression subsystem: QAT, pruning, layer reduction, export.
+
+Reference: ``compression/compress.py`` (``init_compression`` /
+``redundancy_clean`` / ``student_initialization``) +
+``compression/basic_layer.py`` (``LinearLayer_Compress``: weight/activation
+fake-quant, sparse/row/head pruning masks) + ``compression/scheduler.py``
+(activate techniques at ``schedule_offset``).
+
+TPU-native collapse: the reference swaps ``nn.Linear`` for mask/quant-aware
+modules and drives them with a host-side scheduler. Here the model is a
+pure pytree, so the whole subsystem is ONE differentiable transform
+``fn(params, step) -> params`` applied where the engine builds forward
+weights (runtime/engine.py train_step): schedule gates are ``step >=
+offset`` inside the graph (no recompile at phase flips), masks are
+recomputed from live weight magnitudes each step (the reference's
+pre-``fix_*`` training behavior), and QAT gradients are straight-through
+by construction — the engine computes grads w.r.t. the transformed forward
+weights and applies them to the fp32 master, which IS the STE.
+
+``redundancy_clean`` bakes the final masks/quantization into the params for
+export (the reference's post-training fix + clean pass).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import log_dist
+from .config import CompressionConfig, TechniqueConfig
+
+# ---------------------------------------------------------------------------
+# pytree path utilities
+# ---------------------------------------------------------------------------
+
+
+def _flatten_paths(tree, prefix=()) -> Dict[Tuple[str, ...], Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_paths(v, prefix + (str(k),)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _match(path: Tuple[str, ...], patterns: List[str]) -> bool:
+    dotted = ".".join(path)
+    for pat in patterns:
+        if pat == "*" or re.search(pat, dotted):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# primitive transforms (all differentiable; leading dims agnostic)
+# ---------------------------------------------------------------------------
+
+
+def fake_quantize(w, bits, *, groups: int = 1, symmetric: bool = True):
+    """Quantize-dequantize with a TRACED bit width (annealing start->target
+    bits stays one compiled program). Reference basic_layer.py
+    ``enable_weight_quantization``: the (per-layer) weight flattens into
+    ``quantize_groups`` equal groups, one scale each. Tensors with ndim>=3
+    treat dim 0 as the stacked layer dim (one scale set per layer, matching
+    the reference's per-module quantizers)."""
+    import jax.numpy as jnp
+
+    orig_shape, orig_dtype = w.shape, w.dtype
+    w32 = w.astype(jnp.float32)
+    lead = (w32.shape[0],) if w32.ndim >= 3 else ()
+    flat = w32.reshape(lead + (-1,))
+    n = flat.shape[-1]
+    g = groups if (groups and n % groups == 0) else 1
+    wg = flat.reshape(lead + (g, n // g))
+    bits = jnp.asarray(bits, jnp.float32)
+    if symmetric:
+        qmax = 2.0 ** (bits - 1.0) - 1.0
+        scale = jnp.max(jnp.abs(wg), axis=-1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.round(wg / scale) * scale
+    else:
+        levels = 2.0 ** bits - 1.0
+        lo = jnp.min(wg, axis=-1, keepdims=True)
+        hi = jnp.max(wg, axis=-1, keepdims=True)
+        scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+        q = jnp.round((wg - lo) / scale) * scale + lo
+    return q.reshape(orig_shape).astype(orig_dtype)
+
+
+def _anneal_bits(step, *, start_bits: float, target_bits: float,
+                 offset: int, period: int):
+    """start_bits at ``offset``, minus one bit every ``period`` steps, floored
+    at target_bits (reference quantization_period semantics)."""
+    import jax.numpy as jnp
+
+    steps_in = jnp.maximum(step - offset, 0).astype(jnp.float32)
+    drop = jnp.floor(steps_in / max(period, 1))
+    return jnp.maximum(start_bits - drop, target_bits)
+
+
+def sparse_mask(w, dense_ratio: float, method: str = "l1"):
+    """Elementwise magnitude mask keeping the top ``dense_ratio`` fraction
+    (per layer for stacked [L, ...] weights). l1 and topk reference methods
+    coincide for unstructured magnitude pruning."""
+    import jax.numpy as jnp
+
+    a = jnp.abs(w.astype(jnp.float32))
+    flat = a.reshape(a.shape[0], -1) if w.ndim > 2 else a.reshape(1, -1)
+    thresh = jnp.quantile(flat, 1.0 - dense_ratio, axis=-1)
+    thresh = thresh.reshape((-1,) + (1,) * (w.ndim - 1)) if w.ndim > 2 else thresh.reshape(())
+    return (a >= thresh).astype(w.dtype)
+
+
+def row_mask(w, dense_ratio: float):
+    """Mask keeping the top ``dense_ratio`` fraction of OUTPUT features by
+    L1 (our weights are [..., in, out]; the reference's torch Linear
+    [out, in] 'row' pruning is our last dim). Returns a mask broadcastable
+    to w. ``dense_ratio`` is the KEPT fraction, like sparse_pruning."""
+    import jax.numpy as jnp
+
+    score = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=-2)        # [..., out]
+    n_out = w.shape[-1]
+    keep = max(1, int(round(dense_ratio * n_out)))
+    thresh = -jnp.sort(-score, axis=-1)[..., keep - 1:keep]
+    return (score >= thresh).astype(w.dtype)[..., None, :]
+
+
+def head_mask_from_wo(wo, dense_ratio: float, num_heads: int):
+    """Score heads by the L1 of their wo input slice [..., H*Dh, D]; keep the
+    top ``dense_ratio`` fraction (KEPT fraction, like sparse_pruning).
+    Returns [..., H] 0/1."""
+    import jax.numpy as jnp
+
+    *lead, hdh, d = wo.shape
+    dh = hdh // num_heads
+    s = jnp.abs(wo.astype(jnp.float32)).reshape(*lead, num_heads, dh, d).sum(axis=(-1, -2))
+    keep = max(1, int(round(dense_ratio * num_heads)))
+    thresh = -jnp.sort(-s, axis=-1)[..., keep - 1:keep]
+    return (s >= thresh).astype(wo.dtype)
+
+
+# ---------------------------------------------------------------------------
+# the compression transform
+# ---------------------------------------------------------------------------
+
+
+class _Rule:
+    __slots__ = ("technique", "params", "num_heads")
+
+    def __init__(self, technique: str, params: Dict[str, Any], num_heads: int = 0):
+        self.technique = technique
+        self.params = params
+        self.num_heads = num_heads
+
+
+def _collect_rules(cfg: CompressionConfig, paths, model_config=None) -> Dict[Tuple[str, ...], List[_Rule]]:
+    rules: Dict[Tuple[str, ...], List[_Rule]] = {}
+
+    def add(tech: TechniqueConfig, name: str):
+        if not tech.enabled:
+            return
+        for group in tech.groups:
+            matched = [p for p in paths if _match(p, group.modules)]
+            if not matched:
+                log_dist(f"compression {name}/{group.name}: scopes {group.modules} "
+                         "matched no parameters", ranks=[0])
+            for p in matched:
+                merged = {**tech.shared, **group.params}
+                nh = int(merged.get("num_heads", getattr(model_config, "n_heads", 0) or 0))
+                rules.setdefault(p, []).append(_Rule(name, merged, nh))
+
+    add(cfg.weight_quantization, "weight_quantization")
+    add(cfg.sparse_pruning, "sparse_pruning")
+    add(cfg.row_pruning, "row_pruning")
+    add(cfg.channel_pruning, "channel_pruning")
+    add(cfg.head_pruning, "head_pruning")
+    return rules
+
+
+def build_compression_fn(section: Optional[dict], params_template, model_config=None):
+    """Compile the ``compression_training`` section into a pure
+    ``fn(params, step) -> params`` over matched leaves, or None when no
+    weight-side technique is enabled. ``step`` is a traced int (the engine's
+    TrainState.step), so schedule_offset gating lives inside the graph."""
+    cfg = section if isinstance(section, CompressionConfig) else CompressionConfig.from_dict(section)
+    if not cfg.any_weight_technique():
+        return None
+    paths = list(_flatten_paths(params_template).keys())
+    rules = _collect_rules(cfg, paths, model_config)
+    if not rules:
+        return None
+    log_dist(f"compression: {len(rules)} parameter(s) under "
+             f"{sorted({r.technique for rs in rules.values() for r in rs})}", ranks=[0])
+
+    def apply(params, step):
+        import jax.numpy as jnp
+
+        flat = _flatten_paths(params)
+        out = dict(flat)
+        for path, rs in rules.items():
+            w = flat.get(path)
+            if w is None or w.ndim < 2:
+                continue
+            new_w = w
+            for r in rs:
+                p = r.params
+                offset = int(p.get("schedule_offset", 0))
+                active = (step >= offset)
+                if r.technique == "weight_quantization":
+                    start = float(p.get("start_bits", 8))
+                    target = float(p.get("target_bits", start))
+                    bits = _anneal_bits(step, start_bits=start, target_bits=target,
+                                        offset=offset,
+                                        period=int(p.get("quantization_period", 1)))
+                    qw = fake_quantize(
+                        new_w, bits,
+                        groups=int(p.get("quantize_groups", 1)),
+                        symmetric=p.get("quantization_type", "symmetric") == "symmetric")
+                    new_w = jnp.where(active, qw, new_w)
+                elif r.technique == "sparse_pruning":
+                    m = sparse_mask(new_w, float(p.get("dense_ratio", 0.5)),
+                                    p.get("method", "l1"))
+                    new_w = jnp.where(active, new_w * m, new_w)
+                elif r.technique in ("row_pruning", "channel_pruning"):
+                    m = row_mask(new_w, float(p.get("dense_ratio", p.get("ratio", 0.5))))
+                    new_w = jnp.where(active, new_w * m, new_w)
+                elif r.technique == "head_pruning" and r.num_heads:
+                    hm = head_mask_from_wo(
+                        new_w, float(p.get("dense_ratio", p.get("ratio", 0.5))),
+                        r.num_heads)
+                    dh = new_w.shape[-2] // r.num_heads
+                    m = jnp.repeat(hm, dh, axis=-1)[..., None]
+                    new_w = jnp.where(active, new_w * m, new_w)
+            out[path] = new_w.astype(w.dtype)
+        return _unflatten_like(params, out)
+
+    return apply
+
+
+def _unflatten_like(template, flat: Dict[Tuple[str, ...], Any], prefix=()):
+    if isinstance(template, dict):
+        return {k: _unflatten_like(v, flat, prefix + (str(k),)) for k, v in template.items()}
+    return flat[prefix]
+
+
+# ---------------------------------------------------------------------------
+# layer reduction (knowledge-distillation student init)
+# ---------------------------------------------------------------------------
+
+
+def student_initialization(teacher_model, teacher_params, section: Optional[dict]):
+    """Build the layer-reduced student (reference compress.py
+    ``student_initialization``): student layer i is initialized from teacher
+    layer ``teacher_layer[i]``; embeddings/norms/head copy over. Stacked
+    [L, ...] layer weights make this a gather on the leading dim.
+
+    Returns (student_model, student_params)."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    cfg = section if isinstance(section, CompressionConfig) else CompressionConfig.from_dict(section)
+    lr = cfg.layer_reduction
+    if not lr.enabled:
+        raise ValueError("layer_reduction is not enabled in the config")
+    teacher_layers = list(lr.teacher_layer)
+    keep = lr.keep_number_layer or len(teacher_layers)
+    if len(teacher_layers) != keep:
+        raise ValueError(f"teacher_layer has {len(teacher_layers)} entries but "
+                         f"keep_number_layer={keep}")
+    L = teacher_model.config.n_layers
+    if any(not (0 <= t < L) for t in teacher_layers):
+        raise ValueError(f"teacher_layer indices must be in [0, {L})")
+
+    idx = jnp.asarray(teacher_layers, jnp.int32)
+    student_params = dict(teacher_params)
+    student_params["layers"] = {k: jnp.take(v, idx, axis=0)
+                                for k, v in teacher_params["layers"].items()}
+    student_cfg = _dc.replace(teacher_model.config, n_layers=keep)
+    student_model = type(teacher_model)(student_cfg)
+    log_dist(f"layer_reduction: student {keep} layers from teacher layers "
+             f"{teacher_layers}", ranks=[0])
+    return student_model, student_params
+
+
+def init_compression(model, ds_config, teacher_params=None):
+    """Reference ``init_compression(model, config, teacher_model)`` analog.
+
+    Returns (model, params_or_None, compression_fn, scheduler):
+      - with layer_reduction enabled, ``model``/params are the student built
+        from ``teacher_params`` (required);
+      - ``compression_fn`` is the weight transform for the engine (also
+        applied by ``sxt.initialize`` automatically when the config carries
+        a compression_training section);
+      - ``scheduler`` reports per-technique activation (reference
+        compression/scheduler.py).
+    """
+    section = ds_config.get("compression_training", {}) if isinstance(ds_config, dict) else ds_config
+    cfg = CompressionConfig.from_dict(section)
+    params = None
+    if cfg.layer_reduction.enabled:
+        if teacher_params is None:
+            raise ValueError("layer_reduction requires teacher_params "
+                             "(reference: 'Teacher model is required')")
+        model, params = student_initialization(model, teacher_params, cfg)
+    template = params
+    if template is None:
+        import jax
+
+        template = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    fn = build_compression_fn(cfg, template, getattr(model, "config", None))
+    return model, params, fn, CompressionScheduler(cfg)
+
+
+# ---------------------------------------------------------------------------
+# export / redundancy clean
+# ---------------------------------------------------------------------------
+
+
+def redundancy_clean(params, section, step: Optional[int] = None, model_config=None):
+    """Bake the final compression into the params (reference
+    ``redundancy_clean``: fix masks + quantization after training). ``step``
+    defaults to past every schedule offset so everything is active."""
+    import numpy as np
+
+    cfg = section if isinstance(section, CompressionConfig) else CompressionConfig.from_dict(section)
+    fn = build_compression_fn(cfg, params, model_config)
+    if fn is None:
+        return params
+    if step is None:
+        offsets = [int(r.params.get("schedule_offset", 0))
+                   for rs in _collect_rules(cfg, list(_flatten_paths(params).keys()),
+                                            model_config).values() for r in rs]
+        # +period*32: run the bit annealing all the way down to target_bits
+        step = max(offsets, default=0) + 32 * max(
+            [int(r.params.get("quantization_period", 1))
+             for rs in _collect_rules(cfg, list(_flatten_paths(params).keys()),
+                                      model_config).values() for r in rs] or [1])
+    return fn(params, np.int32(step))
+
+
+def export_int8(params, section, model_config=None):
+    """Weight-quantization export: matched leaves become (int8 q, f32 scale)
+    pairs under ``{"q": ..., "scale": ...}`` (reference's compressed
+    checkpoint for serving); unmatched leaves pass through."""
+    from ..ops.quant import quantize_int8
+
+    cfg = section if isinstance(section, CompressionConfig) else CompressionConfig.from_dict(section)
+    if not cfg.weight_quantization.enabled:
+        return params
+    paths = list(_flatten_paths(params).keys())
+    rules = _collect_rules(cfg, paths, model_config)
+    quant_paths = {p for p, rs in rules.items()
+                   if any(r.technique == "weight_quantization" for r in rs)}
+    flat = _flatten_paths(params)
+    out = dict(flat)
+    for p in quant_paths:
+        w = flat[p]
+        if w.ndim < 2:
+            continue
+        q, scale = quantize_int8(w, group_size=min(2048, w.shape[-1]))
+        out[p] = {"q": q, "scale": scale}
+    return _unflatten_like_loose(params, out)
+
+
+def _unflatten_like_loose(template, flat, prefix=()):
+    if isinstance(template, dict):
+        return {k: _unflatten_like_loose(v, flat, prefix + (str(k),)) for k, v in template.items()}
+    return flat[prefix]
+
+
+# ---------------------------------------------------------------------------
+# scheduler (observability parity)
+# ---------------------------------------------------------------------------
+
+
+class CompressionScheduler:
+    """Host-side view of what is active when (reference
+    compression/scheduler.py drives module flags; our gates live inside the
+    jitted graph, so this object only *reports* — same check_* surface)."""
+
+    def __init__(self, cfg: CompressionConfig):
+        self.cfg = cfg
+        self.global_step = 0
+
+    def step(self, global_step: Optional[int] = None) -> Dict[str, bool]:
+        if global_step is None:
+            self.global_step += 1
+        else:
+            self.global_step = int(global_step)
+        return self.state()
+
+    def state(self) -> Dict[str, bool]:
+        out = {}
+        for tech in ("weight_quantization", "activation_quantization",
+                     "sparse_pruning", "row_pruning", "head_pruning",
+                     "channel_pruning"):
+            t: TechniqueConfig = getattr(self.cfg, tech)
+            out[tech] = bool(t.enabled and self.global_step >= t.schedule_offset)
+        return out

@@ -1,0 +1,108 @@
+"""Compression config parsing — DS-JSON ``compression_training`` section.
+
+Reference: ``compression/config.py`` (``get_compression_config``) +
+``compression/constants.py``: each technique has ``shared_parameters`` and
+``different_groups`` (named groups with ``params`` + ``modules`` regex
+scopes). Key names and defaults below mirror the reference constants; the
+``modules`` regexes match OUR dotted pytree paths (e.g. ``layers.wq``,
+``embed``) instead of torch module names — that is the whole mapping a
+functional framework needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..config.config_utils import ConfigError
+
+
+@dataclasses.dataclass
+class CompressionGroup:
+    name: str
+    modules: List[str]                       # regex scopes over pytree paths
+    related_modules: List[List[str]]         # e.g. QKV for head pruning's O
+    params: Dict[str, Any]
+
+
+@dataclasses.dataclass
+class TechniqueConfig:
+    enabled: bool = False
+    shared: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    groups: List[CompressionGroup] = dataclasses.field(default_factory=list)
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared.get("schedule_offset", 0))
+
+    @property
+    def schedule_offset_end(self) -> Optional[int]:
+        v = self.shared.get("schedule_offset_end")
+        return int(v) if v is not None else None
+
+
+@dataclasses.dataclass
+class LayerReductionConfig:
+    enabled: bool = False
+    keep_number_layer: int = 0
+    teacher_layer: List[int] = dataclasses.field(default_factory=list)
+    module_name_prefix: str = ""             # accepted (torch-ism); unused
+    other_module_name: List[str] = dataclasses.field(default_factory=list)
+
+
+_TECHNIQUES = ("weight_quantization", "activation_quantization",
+               "sparse_pruning", "row_pruning", "head_pruning",
+               "channel_pruning")
+
+
+@dataclasses.dataclass
+class CompressionConfig:
+    layer_reduction: LayerReductionConfig
+    weight_quantization: TechniqueConfig
+    activation_quantization: TechniqueConfig
+    sparse_pruning: TechniqueConfig
+    row_pruning: TechniqueConfig
+    head_pruning: TechniqueConfig
+    channel_pruning: TechniqueConfig
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CompressionConfig":
+        d = dict(d or {})
+        lr_raw = dict(d.pop("layer_reduction", {}) or {})
+        lr = LayerReductionConfig(
+            enabled=bool(lr_raw.pop("enabled", False)),
+            keep_number_layer=int(lr_raw.pop("keep_number_layer", 0)),
+            teacher_layer=list(lr_raw.pop("teacher_layer", [])),
+            module_name_prefix=str(lr_raw.pop("module_name_prefix", "")),
+            other_module_name=list(lr_raw.pop("other_module_name", [])),
+        )
+        techniques: Dict[str, TechniqueConfig] = {}
+        for tech in _TECHNIQUES:
+            raw = dict(d.pop(tech, {}) or {})
+            shared = dict(raw.pop("shared_parameters", {}) or {})
+            groups_raw = dict(raw.pop("different_groups", {}) or {})
+            enabled = bool(shared.get("enabled", False))
+            groups = []
+            for gname, g in groups_raw.items():
+                g = dict(g or {})
+                groups.append(CompressionGroup(
+                    name=gname,
+                    modules=list(g.get("modules", ["*"])),
+                    related_modules=list(g.get("related_modules", []) or []),
+                    params=dict(g.get("params", {}) or {}),
+                ))
+            if enabled and not groups:
+                raise ConfigError(
+                    f"compression_training.{tech} is enabled but has no "
+                    "different_groups (reference requires at least one group)")
+            techniques[tech] = TechniqueConfig(enabled=enabled, shared=shared, groups=groups)
+        if d:
+            from ..utils.logging import logger
+
+            logger.warning("compression_training: ignoring unknown keys %s", sorted(d))
+        return cls(layer_reduction=lr, **techniques)
+
+    def any_weight_technique(self) -> bool:
+        return any(getattr(self, t).enabled for t in
+                   ("weight_quantization", "sparse_pruning", "row_pruning",
+                    "head_pruning", "channel_pruning"))

@@ -305,6 +305,15 @@ class Engine:
         self._ltd = build_random_ltd(config)
         self._curriculum_difficulty = None
 
+        # --- compression (reference compression/compress.py; §2.11) -----
+        self._compression_fn = None
+        if config.compression_training:
+            from ..compression.compress import build_compression_fn
+
+            model_cfg = getattr(getattr(loss_fn, "__self__", None), "config", None)
+            self._compression_fn = build_compression_fn(
+                config.compression_training, params, model_cfg)
+
         # --- data -------------------------------------------------------
         self.training_dataloader = None
         if training_data is not None:
@@ -356,13 +365,22 @@ class Engine:
         if qw or qg:
             from ..ops.quant import quantize_dequantize
 
-        def fwd_weights(master, mix):
+        # Compression subsystem (reference compression/compress.py; SURVEY
+        # §2.11): a differentiable params transform gated in-graph on
+        # state.step — QAT fake-quant + pruning masks become part of the
+        # forward weights, and grads w.r.t. them update the fp32 master
+        # (straight-through estimation by construction).
+        compression_fn = self._compression_fn
+
+        def fwd_weights(master, mix, step):
             p16 = jax.tree_util.tree_map(lambda m: m.astype(dtype), master)
             if qw:
                 p16 = jax.tree_util.tree_map(
                     lambda p: quantize_dequantize(p, group_size=2048).astype(dtype), p16)
             if ensemble:
                 p16 = apply_mixing(p16, mix)
+            if compression_fn is not None:
+                p16 = compression_fn(p16, step)
             return p16
 
         def scaled_loss_fn(p16, micro, rng, scale):
@@ -438,7 +456,7 @@ class Engine:
             return optax.apply_updates(master, updates), new_o
 
         def train_step(state: TrainState, batch, mix, rng):
-            p16 = fwd_weights(state.master, mix)
+            p16 = fwd_weights(state.master, mix, state.step)
             scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
             grads, loss = accumulate(state.master, p16, batch, rng, scale)
             # normalize: mean over gas microbatches + undo loss scale
@@ -464,7 +482,7 @@ class Engine:
         self._train_step = jax.jit(train_step, donate_argnums=donate)
 
         def eval_step(state: TrainState, batch, mix, rng):
-            p16 = fwd_weights(state.master, mix)
+            p16 = fwd_weights(state.master, mix, state.step)
             if ensemble:
                 micro = batch
                 loss = jnp.mean(jax.vmap(self.loss_fn, in_axes=(0, 0, None))(p16, micro, rng))
@@ -475,7 +493,7 @@ class Engine:
         self._eval_step = jax.jit(eval_step)
 
         def grads_only(state: TrainState, micro, mix, rng):
-            p16 = fwd_weights(state.master, mix)
+            p16 = fwd_weights(state.master, mix, state.step)
             scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
             g, loss = batch_grads(p16, micro, rng, scale)
             return g, loss
@@ -497,7 +515,7 @@ class Engine:
         self._apply_only = jax.jit(apply_only, donate_argnums=(0,))
 
         def materialize(state: TrainState, mix):
-            return fwd_weights(state.master, mix)
+            return fwd_weights(state.master, mix, state.step)
 
         self._materialize = jax.jit(materialize)
         self._apply_mixing_jit = jax.jit(apply_mixing)
