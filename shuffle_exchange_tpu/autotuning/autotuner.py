@@ -48,23 +48,28 @@ def _hbm_bytes_per_device(default: int = 16 * 1024**3) -> int:
 def estimate_step_memory(n_params: int, *, mbs: int, seq_len: int,
                          d_model: int, n_layers: int, vocab_size: int,
                          zero_stage: int, world: int, remat: bool,
-                         loss_chunk: int = 256) -> int:
+                         loss_chunk: int = 256, tensor: int = 1,
+                         offload: Optional[str] = None) -> int:
     """First-principles peak-HBM estimate (bytes) for one fused train step.
 
     Mirrors the reference autotuner's memory-per-GPU estimate
     (``autotuning/autotuner.py`` model_info path) with TPU specifics: bf16
     forward weights + fp32 master/m/v (ZeRO-sharded over ``world`` when
     stage >= 1), activations ~ per-layer residual+ffn working set (halved
-    by remat to the saved-dots set), chunked-CE logits block.
+    by remat to the saved-dots set), chunked-CE logits block. ``tensor``
+    divides param/activation terms (mp_size); ``offload`` = "cpu"/"nvme"
+    moves master+moments off device entirely (host-optimizer tier).
     """
     shard = world if zero_stage >= 1 else 1
     p_shard = world if zero_stage >= 3 else 1
-    master_opt = 3 * n_params * _F32 // shard          # master + m + v
-    fwd_params = n_params * _BF16 // p_shard           # bf16 forward copy
-    grads = n_params * _F32 // max(1, shard if zero_stage >= 2 else 1)
+    master_opt = 3 * n_params * _F32 // (shard * tensor)   # master + m + v
+    if offload in ("cpu", "nvme"):
+        master_opt = 0
+    fwd_params = n_params * _BF16 // (p_shard * tensor)    # bf16 forward copy
+    grads = n_params * _F32 // max(1, (shard if zero_stage >= 2 else 1) * tensor)
     tokens = mbs * seq_len
     # activation working set per layer: attn qkv+out (4d) + ffn (~8d) in bf16
-    act_per_layer = tokens * d_model * 12 * _BF16
+    act_per_layer = tokens * d_model * 12 * _BF16 // tensor
     acts = act_per_layer * (2 if remat else n_layers)
     logits = tokens * vocab_size * _F32 if not loss_chunk else mbs * loss_chunk * vocab_size * _F32
     return master_opt + fwd_params + grads + acts + logits
@@ -76,6 +81,9 @@ class Candidate:
     gradient_accumulation_steps: int
     zero_stage: int
     remat: Optional[bool]          # None = leave the model as built
+    tensor: int = 1                # mesh tensor split (reference mp_size)
+    offload: Optional[str] = None  # optimizer offload tier: None | cpu | nvme
+    seq_len: Optional[int] = None  # None = the tuner's base sequence length
     est_bytes: int = 0
     metric_val: float = float("nan")
     status: str = "pending"        # pending | pruned | ok | oom | error
@@ -83,14 +91,26 @@ class Candidate:
     @property
     def name(self) -> str:
         r = {None: "asis", True: "remat", False: "noremat"}[self.remat]
-        return f"z{self.zero_stage}_mbs{self.micro_batch_size}_gas{self.gradient_accumulation_steps}_{r}"
+        n = f"z{self.zero_stage}_mbs{self.micro_batch_size}_gas{self.gradient_accumulation_steps}_{r}"
+        if self.tensor > 1:
+            n += f"_tp{self.tensor}"
+        if self.offload:
+            n += f"_off{self.offload}"
+        if self.seq_len:
+            n += f"_sl{self.seq_len}"
+        return n
 
     def as_config_patch(self) -> Dict[str, Any]:
-        return {
+        patch: Dict[str, Any] = {
             "train_micro_batch_size_per_gpu": self.micro_batch_size,
             "gradient_accumulation_steps": self.gradient_accumulation_steps,
             "zero_optimization": {"stage": self.zero_stage},
         }
+        if self.tensor > 1:
+            patch["mesh"] = {"tensor": self.tensor, "data": -1}
+        if self.offload:
+            patch["zero_optimization"]["offload_optimizer"] = {"device": self.offload}
+        return patch
 
 
 def _merge(base: Dict[str, Any], patch: Dict[str, Any]) -> Dict[str, Any]:
@@ -136,7 +156,10 @@ class Autotuner:
     def candidates(self, mbs_list: Optional[Sequence[int]] = None,
                    gas_list: Sequence[int] = (1, 2),
                    stages: Sequence[int] = (1, 3),
-                   remat_opts: Sequence[Optional[bool]] = (False, True)) -> List[Candidate]:
+                   remat_opts: Sequence[Optional[bool]] = (False, True),
+                   tensor_list: Optional[Sequence[int]] = None,
+                   offload_opts: Sequence[Optional[str]] = (None,),
+                   seq_lens: Sequence[Optional[int]] = (None,)) -> List[Candidate]:
         if mbs_list is None:
             lo = self.at.min_train_micro_batch_size_per_gpu if self.at else 1
             hi = self.at.max_train_micro_batch_size_per_gpu if self.at and \
@@ -146,12 +169,23 @@ class Autotuner:
             while m <= hi and len(mbs_list) < n:
                 mbs_list.append(m)
                 m *= 2
+        if tensor_list is None:
+            # mp_size from the autotuning section (the reference tunes it,
+            # autotuning/README.md); only splits that divide the device
+            # count AND the head count are runnable
+            mp = self.at.mp_size if self.at else 1
+            tensor_list = [1] if mp <= 1 else [1, mp]
+        heads = getattr(getattr(self.model, "config", None), "n_heads", None)
+        tensor_list = [t for t in tensor_list
+                       if self.world % t == 0 and (heads is None or heads % t == 0)]
         out = []
-        for mbs, gas, z, r in itertools.product(mbs_list, gas_list, stages, remat_opts):
+        for mbs, gas, z, r, t, off, sl in itertools.product(
+                mbs_list, gas_list, stages, remat_opts, tensor_list,
+                offload_opts, seq_lens):
             if self.at and self.at.max_train_batch_size and \
-                    mbs * gas * self.world > self.at.max_train_batch_size:
+                    mbs * gas * (self.world // t) > self.at.max_train_batch_size:
                 continue
-            out.append(Candidate(mbs, gas, z, r))
+            out.append(Candidate(mbs, gas, z, r, tensor=t, offload=off, seq_len=sl))
         return out
 
     # -- memory pruning ------------------------------------------------
@@ -168,9 +202,10 @@ class Autotuner:
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract))
         remat = mcfg.remat if c.remat is None else c.remat
         return estimate_step_memory(
-            n_params, mbs=c.micro_batch_size, seq_len=self.seq_len,
+            n_params, mbs=c.micro_batch_size, seq_len=c.seq_len or self.seq_len,
             d_model=mcfg.d_model, n_layers=mcfg.n_layers, vocab_size=mcfg.vocab_size,
-            zero_stage=c.zero_stage, world=self.world, remat=remat)
+            zero_stage=c.zero_stage, world=self.world // c.tensor, remat=remat,
+            tensor=c.tensor, offload=c.offload)
 
     # -- measurement ---------------------------------------------------
 
@@ -189,7 +224,11 @@ class Autotuner:
         reset_topology()
         engine, *_ = sxt.initialize(model=model, config=cfg)
         global_bs = engine.config.train_batch_size
-        batch = self.batch_fn(global_bs)
+        if c.seq_len:
+            # seq-length candidates need a batch_fn(global_bs, seq_len=...)
+            batch = self.batch_fn(global_bs, seq_len=c.seq_len)
+        else:
+            batch = self.batch_fn(global_bs)
         t_first = time.time()
         loss = engine.train_batch(batch)
         float(loss)  # sync (compile included; excluded from the metric)
@@ -199,7 +238,7 @@ class Autotuner:
             loss = engine.train_batch(batch)
         float(loss)
         dt = (time.time() - t0) / self.profile_steps
-        tokens = global_bs * self.seq_len
+        tokens = global_bs * (c.seq_len or self.seq_len)
         log_dist(f"autotuning: {c.name} step={dt*1000:.0f}ms "
                  f"(compile {compile_s:.0f}s, global_bs={global_bs})", ranks=[0])
         if self.at and self.at.metric == "latency":

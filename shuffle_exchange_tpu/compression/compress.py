@@ -361,13 +361,7 @@ def export_int8(params, section, model_config=None):
             continue
         q, scale = quantize_int8(w, group_size=min(2048, w.shape[-1]))
         out[p] = {"q": q, "scale": scale}
-    return _unflatten_like_loose(params, out)
-
-
-def _unflatten_like_loose(template, flat, prefix=()):
-    if isinstance(template, dict):
-        return {k: _unflatten_like_loose(v, flat, prefix + (str(k),)) for k, v in template.items()}
-    return flat[prefix]
+    return _unflatten_like(params, out)
 
 
 # ---------------------------------------------------------------------------

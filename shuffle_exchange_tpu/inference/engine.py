@@ -50,21 +50,31 @@ def _rope_rows(cos, sin, pos):
     return jnp.take(cos, pos, axis=0), jnp.take(sin, pos, axis=0)
 
 
-def _apply_rope_batched(x, cos, sin):
-    """x [B,T,H,D], cos/sin [B,T,D/2] (per-sequence positions)."""
+def _apply_rope_batched(x, cos, sin, interleaved: bool = False):
+    """x [B,T,H,D], cos/sin [B,T,rd/2] (per-sequence positions); partial
+    rotary dims pass through, pairing per ``interleaved`` (see
+    models/transformer.py apply_rope)."""
     import jax.numpy as jnp
 
-    x1, x2 = jnp.split(x, 2, axis=-1)
+    rd = 2 * cos.shape[-1]
+    rot, rest = (x[..., :rd], x[..., rd:]) if rd < x.shape[-1] else (x, None)
     c = cos[:, :, None, :].astype(x.dtype)
     s = sin[:, :, None, :].astype(x.dtype)
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    if interleaved:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).reshape(rot.shape)
+    else:
+        x1, x2 = jnp.split(rot, 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out if rest is None else jnp.concatenate([out, rest], axis=-1)
 
 
-def decode_attention(q, ck, cv, kv_len):
+def decode_attention(q, ck, cv, kv_len, alibi_slopes=None):
     """Single-token attention against a cache.
 
     q [B,1,H,Dh], ck/cv [B,S,KV,Dh], kv_len [B] = #valid cache slots.
     fp32 softmax; GQA via head-group reshape (no materialized repeat).
+    ``alibi_slopes`` [H]: ALiBi bias slope_h * j at key slot j (BLOOM).
     Reference: v1 softmax_context kernel (ops/transformer/inference/op_binding/
     softmax_context.py) and v2 blocked_flash decode path.
     """
@@ -76,6 +86,9 @@ def decode_attention(q, ck, cv, kv_len):
     qf = q.astype(jnp.float32).reshape(B, KV, G, Dh)           # T=1 folded away
     kf = ck.astype(jnp.float32)
     scores = jnp.einsum("bkgd,bskd->bkgs", qf, kf) / np.sqrt(Dh)
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G)
+        scores = scores + slopes[None, :, :, None] * jnp.arange(S, dtype=jnp.float32)
     mask = (jnp.arange(S)[None, :] < kv_len[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     w = jnp.exp(scores - scores.max(-1, keepdims=True))
@@ -84,7 +97,7 @@ def decode_attention(q, ck, cv, kv_len):
     return out.reshape(B, 1, H, Dh).astype(q.dtype)
 
 
-def extend_attention(q, ck, cv, start_pos, kv_len):
+def extend_attention(q, ck, cv, start_pos, kv_len, alibi_slopes=None):
     """Chunked-prefill attention: a C-token query chunk against the cache.
 
     q [B,C,H,Dh]; ck/cv [B,S,KV,Dh] already contain the chunk's own K/V at
@@ -101,6 +114,9 @@ def extend_attention(q, ck, cv, start_pos, kv_len):
     G = H // KV
     qf = q.astype(jnp.float32).reshape(B, C, KV, G, Dh)
     scores = jnp.einsum("bckgd,bskd->bckgs", qf, ck.astype(jnp.float32)) / np.sqrt(Dh)
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G)
+        scores = scores + slopes[None, None, :, :, None] * jnp.arange(S, dtype=jnp.float32)
     s_idx = jnp.arange(S)[None, None, :]
     lim = jnp.minimum(start_pos[:, None] + jnp.arange(C)[None, :] + 1, kv_len[:, None])
     mask = (s_idx < lim[:, :, None])[:, :, None, None, :]
@@ -125,6 +141,13 @@ class InferenceEngine:
         self.model = model
         self.config = config or InferenceConfig()
         self._mcfg = model.config
+        if self._mcfg.position == "alibi":
+            from ..models.transformer import alibi_slopes
+
+            self._alibi = (alibi_slopes(self._mcfg.n_heads)
+                           * self._mcfg.alibi_slope_scale)
+        else:
+            self._alibi = None
         self._gen_cache: Dict[Tuple, Any] = {}
         self._fwd = jax.jit(model.apply)
         self._rng = jax.random.PRNGKey(self.config.seed)
@@ -160,8 +183,21 @@ class InferenceEngine:
         if topo.size("tensor") == 1 or not hasattr(self.model, "partition_specs"):
             return jax.device_put(params)
         specs = self.model.partition_specs(params)
-        return jax.tree.map(
-            lambda p, s: jax.device_put(p, topo.named_sharding(*s)), params, specs)
+
+        def place(p, spec):
+            # replicate any leaf a mesh axis doesn't divide (odd vocab or
+            # head counts must degrade, not crash serving)
+            for dim, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                size = 1
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    size *= topo.size(a)
+                if p.shape[dim] % size:
+                    return jax.device_put(p)
+            return jax.device_put(p, topo.named_sharding(*spec))
+
+        return jax.tree.map(place, params, specs)
 
     def _quantize(self, params):
         """int8 weight-only quantization (reference GroupQuantizer
@@ -196,6 +232,11 @@ class InferenceEngine:
 
         cfg = self._mcfg
         x = jnp.take(params["embed"], ids, axis=0)
+        if cfg.embed_ln:   # BLOOM word_embeddings_layernorm
+            from ..models.transformer import _norm
+
+            x = _norm(x, params["embed_ln_w"], params["embed_ln_b"], cfg.norm,
+                      eps=cfg.norm_eps)
         T = ids.shape[1]
         positions = pos[:, None] + jnp.arange(T)[None, :]       # [B,T]
         if cfg.position == "learned":
@@ -205,7 +246,9 @@ class InferenceEngine:
             x = x + jnp.take(params["pos_embed"], positions + cfg.pos_offset,
                              axis=0, mode="clip").astype(x.dtype)
             return x, (None, None), positions
-        cos, sin = rope_table(self.config.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        if cfg.position == "alibi":
+            return x, (None, None), positions
+        cos, sin = rope_table(self.config.max_seq_len, cfg.rotary_dims, cfg.rope_theta)
         return x, (cos, sin), positions
 
     def _layer_body(self, lw, h, cos, sin, positions, attn_fn):
@@ -228,11 +271,16 @@ class InferenceEngine:
             v = v + lw["b_v"].astype(y.dtype).reshape(KV, Dh)
         if cfg.position == "rope":
             pc, ps = _rope_rows(cos, sin, positions)
-            q, k = _apply_rope_batched(q, pc, ps), _apply_rope_batched(k, pc, ps)
+            q = _apply_rope_batched(q, pc, ps, interleaved=cfg.rope_interleaved)
+            k = _apply_rope_batched(k, pc, ps, interleaved=cfg.rope_interleaved)
         attn, cache_out = attn_fn(q, k, v)
         attn_out = attn.reshape(B, T, H * Dh) @ lw["wo"]
         if cfg.attn_out_bias:
             attn_out = attn_out + lw["b_o"].astype(attn_out.dtype)
+        if cfg.parallel_block:
+            y2 = y if cfg.parallel_shared_ln else _norm(
+                h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
+            return h + attn_out + self._ffn(lw, y2), cache_out
         h = h + attn_out
         y2 = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
         h = h + self._ffn(lw, y2)
@@ -252,7 +300,8 @@ class InferenceEngine:
 
         def layer_fn(h, lw):
             def attn_fn(q, k, v):
-                return flash_attention(q, k, v, causal=True, impl=self.config.attention_impl), (k, v)
+                return flash_attention(q, k, v, causal=True, impl=self.config.attention_impl,
+                                       alibi_slopes=self._alibi), (k, v)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
 
@@ -280,6 +329,8 @@ class InferenceEngine:
         from ..models.transformer import activation_fn
 
         act = activation_fn(cfg.activation)
+        if not cfg.mlp_bias:
+            return act(y @ lw["w_up"]) @ lw["w_down"]
         return act(y @ lw["w_up"] + lw["b_up"].astype(y.dtype)) @ lw["w_down"] + lw["b_down"].astype(y.dtype)
 
     def _decode_step(self, params, cache: KVCache, tok, pos):
@@ -298,7 +349,8 @@ class InferenceEngine:
             def attn_fn(q, k, v):
                 ck2 = ck.at[barange, pos].set(k[:, 0].astype(ck.dtype))
                 cv2 = cv.at[barange, pos].set(v[:, 0].astype(cv.dtype))
-                return decode_attention(q, ck2, cv2, kv_len=pos + 1), (ck2, cv2)
+                return decode_attention(q, ck2, cv2, kv_len=pos + 1,
+                                        alibi_slopes=self._alibi), (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, pos, attn_fn)
 

@@ -24,7 +24,7 @@ import numpy as np
 from ..utils.logging import logger
 from .config import InferenceConfig
 from .engine import (InferenceEngine, _bucket, _rope_rows, _apply_rope_batched,
-                     extend_attention)
+                     decode_attention, extend_attention)
 from .paged import (BlockedAllocator, PagedKVCache, append_token_kv, blocks_needed,
                     paged_decode_attention, write_prefill_kv)
 
@@ -136,7 +136,8 @@ class InferenceEngineV2(InferenceEngine):
                 ck2 = ck.at[flat].set(blocks(k).astype(ck.dtype))
                 cv2 = cv.at[flat].set(blocks(v).astype(cv.dtype))
                 return flash_attention(q, k, v, causal=True,
-                                       impl=self.config.attention_impl), (ck2, cv2)
+                                       impl=self.config.attention_impl,
+                                       alibi_slopes=self._alibi), (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
 
@@ -192,7 +193,8 @@ class InferenceEngineV2(InferenceEngine):
                 cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
                     v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
                 kg, vg = gather_kv(ck2, cv2, btables)             # [B,S,KV,Dh]
-                out = extend_attention(q, kg, vg, start, start + nnew)
+                out = extend_attention(q, kg, vg, start, start + nnew,
+                                       alibi_slopes=self._alibi)
                 return out, (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
@@ -224,6 +226,14 @@ class InferenceEngineV2(InferenceEngine):
 
             def attn_fn(q, k, v):
                 ck2, cv2 = append_token_kv(ck, cv, k[:, 0], v[:, 0], btables, pos)
+                if self._alibi is not None:
+                    # the Pallas decode kernel has no bias operand; ALiBi
+                    # models take the gather path
+                    from .paged import gather_kv
+
+                    kg, vg = gather_kv(ck2, cv2, btables)
+                    return decode_attention(q, kg, vg, kv_len=pos + 1,
+                                            alibi_slopes=self._alibi), (ck2, cv2)
                 return paged_decode_attention(q, ck2, cv2, btables, kv_len=pos + 1), (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, pos, attn_fn)

@@ -35,12 +35,18 @@ _ARCH_FAMILIES = {
     "GPT2LMHeadModel": "gpt2",
     "OPTForCausalLM": "opt",
     "Phi3ForCausalLM": "phi3",
+    "GPTJForCausalLM": "gptj",
+    "GPTNeoXForCausalLM": "gptneox",
+    "FalconForCausalLM": "falcon",
+    "RWForCausalLM": "falcon",            # legacy tiiuae checkpoints
+    "BloomForCausalLM": "bloom",
 }
 
 
 _MODEL_TYPE_FAMILIES = {"llama": "llama", "mistral": "llama", "qwen2": "qwen2",
                         "mixtral": "mixtral", "gpt2": "gpt2", "opt": "opt",
-                        "phi3": "phi3"}
+                        "phi3": "phi3", "gptj": "gptj", "gpt_neox": "gptneox",
+                        "falcon": "falcon", "bloom": "bloom"}
 
 
 def _family(cfg: Dict[str, Any]) -> str:
@@ -79,6 +85,65 @@ def config_from_hf(hf_config) -> TransformerConfig:
             activation=cfg.get("activation_function", "relu"),
             norm="layernorm", position="learned", pos_offset=2,
             attn_qkv_bias=cfg.get("enable_bias", True), attn_out_bias=cfg.get("enable_bias", True),
+            tie_embeddings=cfg.get("tie_word_embeddings", True))
+    if family == "gptj":
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["n_embd"], n_layers=cfg["n_layer"],
+            n_heads=cfg["n_head"], max_seq_len=cfg.get("n_positions", 2048),
+            activation=cfg.get("activation_function", "gelu_new"),
+            norm="layernorm", position="rope", rope_theta=10000.0,
+            rotary_dim=cfg.get("rotary_dim") or 0, rope_interleaved=True,
+            parallel_block=True, parallel_shared_ln=True,
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=cfg.get("tie_word_embeddings", False),
+            unembed_bias=True)
+    if family == "gptneox":
+        head_dim = cfg["hidden_size"] // cfg["num_attention_heads"]
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"], n_heads=cfg["num_attention_heads"],
+            d_ff=cfg.get("intermediate_size"),
+            max_seq_len=cfg.get("max_position_embeddings", 2048),
+            activation=cfg.get("hidden_act", "gelu"),
+            norm="layernorm", position="rope",
+            rope_theta=float(cfg.get("rotary_emb_base", 10000.0)),
+            rotary_dim=int(cfg.get("rotary_pct", 1.0) * head_dim),
+            parallel_block=cfg.get("use_parallel_residual", True),
+            attn_qkv_bias=cfg.get("attention_bias", True),
+            attn_out_bias=cfg.get("attention_bias", True),
+            norm_eps=cfg.get("layer_norm_eps", 1e-5),
+            tie_embeddings=cfg.get("tie_word_embeddings", False))
+    if family == "falcon":
+        H = cfg["num_attention_heads"]
+        new_arch = cfg.get("new_decoder_architecture", False)
+        kv = (cfg.get("num_kv_heads") or H) if new_arch else (
+            1 if cfg.get("multi_query", True) else H)
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"], n_heads=H, n_kv_heads=kv,
+            max_seq_len=cfg.get("max_position_embeddings", 2048),
+            activation="gelu", norm="layernorm",
+            position="alibi" if cfg.get("alibi", False) else "rope",
+            # falcon baddbmm uses beta = inv_norm_factor: alibi is scaled by
+            # 1/sqrt(Dh) (bloom's beta is 1.0 — unscaled)
+            alibi_slope_scale=(cfg["hidden_size"] // H) ** -0.5,
+            d_ff=cfg.get("ffn_hidden_size"),
+            rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            parallel_block=cfg.get("parallel_attn", True),
+            parallel_shared_ln=cfg.get("parallel_attn", True) and not new_arch,
+            attn_qkv_bias=cfg.get("bias", False), attn_out_bias=cfg.get("bias", False),
+            mlp_bias=cfg.get("bias", False),
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=cfg.get("tie_word_embeddings", True))
+    if family == "bloom":
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+            n_layers=cfg["n_layer"], n_heads=cfg["n_head"],
+            max_seq_len=cfg.get("seq_length", 2048),
+            activation="gelu_new",   # BloomGelu is the tanh approximation
+            norm="layernorm", position="alibi", embed_ln=True,
+            attn_qkv_bias=True, attn_out_bias=True,
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=cfg.get("tie_word_embeddings", True))
     # rope/rmsnorm families
     common = dict(
@@ -128,7 +193,8 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
                            family: str) -> Dict[str, Any]:
     """Re-lay an HF state dict into the zoo Transformer's stacked format."""
     L = config.n_layers
-    sd = {k.removeprefix("transformer.").removeprefix("model."): v for k, v in sd.items()}
+    sd = {k.removeprefix("transformer.").removeprefix("model.").removeprefix("gpt_neox."): v
+          for k, v in sd.items()}
     p: Dict[str, Any] = {}
 
     if family == "gpt2":
@@ -179,6 +245,155 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
         }
         p["ln_f_w"] = _np(sd[dec + "final_layer_norm.weight"])
         p["ln_f_b"] = _np(sd[dec + "final_layer_norm.bias"])
+        if not config.tie_embeddings:
+            p["unembed"] = _np(sd["lm_head.weight"]).T
+        return p
+
+    if family == "gptj":
+        p["embed"] = _np(sd["wte.weight"])
+        p["layers"] = {
+            "ln1_w": _stack(sd, "h.{}.ln_1.weight", L),
+            "ln1_b": _stack(sd, "h.{}.ln_1.bias", L),
+            # parallel_shared_ln: no ln2 in GPT-J
+            "wq": _stack(sd, "h.{}.attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, "h.{}.attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, "h.{}.attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, "h.{}.attn.out_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, "h.{}.mlp.fc_in.weight", L, transpose=True),
+            "b_up": _stack(sd, "h.{}.mlp.fc_in.bias", L),
+            "w_down": _stack(sd, "h.{}.mlp.fc_out.weight", L, transpose=True),
+            "b_down": _stack(sd, "h.{}.mlp.fc_out.bias", L),
+        }
+        p["ln_f_w"], p["ln_f_b"] = _np(sd["ln_f.weight"]), _np(sd["ln_f.bias"])
+        p["unembed"] = _np(sd["lm_head.weight"]).T
+        p["unembed_b"] = _np(sd["lm_head.bias"])
+        return p
+
+    if family in ("gptneox", "bloom"):
+        # fused QKV with per-head-interleaved rows: weight [3D, D] is
+        # (H, 3, Dh) on the output dim (GPTNeoXAttention/_split_heads,
+        # BloomAttention view(B,T,H,3,Dh))
+        H, Dh = config.n_heads, config.head_dim
+        D = config.d_model
+
+        def split_qkv(fmt, bias=False):
+            w = _stack(sd, fmt, L)                               # [L, 3D(out)] or [L, 3D, D]
+            if bias:
+                w = w.reshape(L, H, 3, Dh)
+                return w[:, :, 0].reshape(L, H * Dh), w[:, :, 1].reshape(L, H * Dh), \
+                    w[:, :, 2].reshape(L, H * Dh)
+            w = w.reshape(L, H, 3, Dh, D)
+            q = w[:, :, 0].reshape(L, H * Dh, D).transpose(0, 2, 1)
+            k = w[:, :, 1].reshape(L, H * Dh, D).transpose(0, 2, 1)
+            v = w[:, :, 2].reshape(L, H * Dh, D).transpose(0, 2, 1)
+            return q, k, v
+
+        if family == "gptneox":
+            pre = "layers.{}."
+            p["embed"] = _np(sd["embed_in.weight"])
+            wq, wk, wv = split_qkv(pre + "attention.query_key_value.weight")
+            bq, bk, bv = split_qkv(pre + "attention.query_key_value.bias", bias=True)
+            p["layers"] = {
+                "ln1_w": _stack(sd, pre + "input_layernorm.weight", L),
+                "ln1_b": _stack(sd, pre + "input_layernorm.bias", L),
+                "ln2_w": _stack(sd, pre + "post_attention_layernorm.weight", L),
+                "ln2_b": _stack(sd, pre + "post_attention_layernorm.bias", L),
+                "wq": wq, "wk": wk, "wv": wv, "b_q": bq, "b_k": bk, "b_v": bv,
+                "wo": _stack(sd, pre + "attention.dense.weight", L, transpose=True),
+                "b_o": _stack(sd, pre + "attention.dense.bias", L),
+                "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L, transpose=True),
+                "b_up": _stack(sd, pre + "mlp.dense_h_to_4h.bias", L),
+                "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L, transpose=True),
+                "b_down": _stack(sd, pre + "mlp.dense_4h_to_h.bias", L),
+            }
+            p["ln_f_w"] = _np(sd["final_layer_norm.weight"])
+            p["ln_f_b"] = _np(sd["final_layer_norm.bias"])
+            if not config.tie_embeddings:
+                p["unembed"] = _np(sd["embed_out.weight"]).T
+            return p
+
+        pre = "h.{}."
+        p["embed"] = _np(sd["word_embeddings.weight"])
+        p["embed_ln_w"] = _np(sd["word_embeddings_layernorm.weight"])
+        p["embed_ln_b"] = _np(sd["word_embeddings_layernorm.bias"])
+        wq, wk, wv = split_qkv(pre + "self_attention.query_key_value.weight")
+        bq, bk, bv = split_qkv(pre + "self_attention.query_key_value.bias", bias=True)
+        p["layers"] = {
+            "ln1_w": _stack(sd, pre + "input_layernorm.weight", L),
+            "ln1_b": _stack(sd, pre + "input_layernorm.bias", L),
+            "ln2_w": _stack(sd, pre + "post_attention_layernorm.weight", L),
+            "ln2_b": _stack(sd, pre + "post_attention_layernorm.bias", L),
+            "wq": wq, "wk": wk, "wv": wv, "b_q": bq, "b_k": bk, "b_v": bv,
+            "wo": _stack(sd, pre + "self_attention.dense.weight", L, transpose=True),
+            "b_o": _stack(sd, pre + "self_attention.dense.bias", L),
+            "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L, transpose=True),
+            "b_up": _stack(sd, pre + "mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L, transpose=True),
+            "b_down": _stack(sd, pre + "mlp.dense_4h_to_h.bias", L),
+        }
+        p["ln_f_w"], p["ln_f_b"] = _np(sd["ln_f.weight"]), _np(sd["ln_f.bias"])
+        return p
+
+    if family == "falcon":
+        H, KV, Dh = config.n_heads, config.kv_heads, config.head_dim
+        D = config.d_model
+        G = H // KV
+        pre = "h.{}."
+        p["embed"] = _np(sd["word_embeddings.weight"])
+        # fused-QKV layout (modeling_falcon._split_heads): new arch groups
+        # [KV, G q + 1 k + 1 v]; old multi_query is the KV==1 case of the
+        # same grouping; old multi-head (falcon-rw) interleaves [H, 3, Dh].
+        grouped_arch = config.parallel_block and not config.parallel_shared_ln
+
+        def split_qkv_w(w):                      # w [L, out, D]
+            if grouped_arch or KV == 1:
+                g = w.reshape(L, KV, G + 2, Dh, D)
+                return (g[:, :, :G].reshape(L, H * Dh, D).transpose(0, 2, 1),
+                        g[:, :, G].reshape(L, KV * Dh, D).transpose(0, 2, 1),
+                        g[:, :, G + 1].reshape(L, KV * Dh, D).transpose(0, 2, 1))
+            g = w.reshape(L, H, 3, Dh, D)
+            return (g[:, :, 0].reshape(L, H * Dh, D).transpose(0, 2, 1),
+                    g[:, :, 1].reshape(L, H * Dh, D).transpose(0, 2, 1),
+                    g[:, :, 2].reshape(L, H * Dh, D).transpose(0, 2, 1))
+
+        def split_qkv_b(b):                      # b [L, out]
+            if grouped_arch or KV == 1:
+                g = b.reshape(L, KV, G + 2, Dh)
+                return (g[:, :, :G].reshape(L, H * Dh), g[:, :, G].reshape(L, KV * Dh),
+                        g[:, :, G + 1].reshape(L, KV * Dh))
+            g = b.reshape(L, H, 3, Dh)
+            return (g[:, :, 0].reshape(L, H * Dh), g[:, :, 1].reshape(L, H * Dh),
+                    g[:, :, 2].reshape(L, H * Dh))
+
+        wq, wk, wv = split_qkv_w(_stack(sd, pre + "self_attention.query_key_value.weight", L))
+        layers = {
+            "wq": wq, "wk": wk, "wv": wv,
+            "wo": _stack(sd, pre + "self_attention.dense.weight", L, transpose=True),
+            "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L, transpose=True),
+            "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L, transpose=True),
+        }
+        if config.attn_qkv_bias:   # falcon-rw: bias=True
+            layers["b_q"], layers["b_k"], layers["b_v"] = split_qkv_b(
+                _stack(sd, pre + "self_attention.query_key_value.bias", L))
+        if config.attn_out_bias:
+            layers["b_o"] = _stack(sd, pre + "self_attention.dense.bias", L)
+        if config.mlp_bias:
+            layers["b_up"] = _stack(sd, pre + "mlp.dense_h_to_4h.bias", L)
+            layers["b_down"] = _stack(sd, pre + "mlp.dense_4h_to_h.bias", L)
+        if grouped_arch:
+            # new arch (falcon-40b style): two parallel norms
+            layers["ln1_w"] = _stack(sd, pre + "ln_attn.weight", L)
+            layers["ln1_b"] = _stack(sd, pre + "ln_attn.bias", L)
+            layers["ln2_w"] = _stack(sd, pre + "ln_mlp.weight", L)
+            layers["ln2_b"] = _stack(sd, pre + "ln_mlp.bias", L)
+        else:
+            layers["ln1_w"] = _stack(sd, pre + "input_layernorm.weight", L)
+            layers["ln1_b"] = _stack(sd, pre + "input_layernorm.bias", L)
+            if not config.parallel_block:   # sequential old arch (falcon-rw)
+                layers["ln2_w"] = _stack(sd, pre + "post_attention_layernorm.weight", L)
+                layers["ln2_b"] = _stack(sd, pre + "post_attention_layernorm.bias", L)
+        p["layers"] = layers
+        p["ln_f_w"], p["ln_f_b"] = _np(sd["ln_f.weight"]), _np(sd["ln_f.bias"])
         if not config.tie_embeddings:
             p["unembed"] = _np(sd["lm_head.weight"]).T
         return p

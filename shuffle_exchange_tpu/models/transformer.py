@@ -47,6 +47,17 @@ class TransformerConfig:
     attn_qkv_bias: bool = False                # Qwen2-style q/k/v biases
     attn_out_bias: bool = False                # GPT-2/OPT-style out-proj bias
     pos_offset: int = 0                        # OPT offsets positions by 2
+    # Family structure flags (round 3, HF import breadth — reference
+    # module_inject/containers/{gptj,gptneox,bloom}.py + falcon in
+    # inference/v2/engine_factory.py):
+    parallel_block: bool = False               # h + attn(y1) + mlp(y2) (GPT-J/NeoX/Falcon)
+    parallel_shared_ln: bool = False           # y2 = y1, no ln2 (GPT-J, Falcon-7B)
+    rotary_dim: int = 0                        # rope on first rotary_dim dims (0 = all)
+    rope_interleaved: bool = False             # GPT-J rotate-every-two pairs
+    embed_ln: bool = False                     # BLOOM word_embeddings_layernorm
+    alibi_slope_scale: float = 1.0             # falcon scales alibi by 1/sqrt(Dh)
+    mlp_bias: bool = True                      # gelu-path fc biases (False: Falcon)
+    unembed_bias: bool = False                 # GPT-J lm_head bias
     # Random-LTD (reference runtime/data_pipeline/data_routing): middle
     # layers skip a random token subset per step. TPU (static-shape) form:
     # dropped tokens FREEZE their hidden state through the layer (masked
@@ -73,6 +84,10 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
+
+    @property
+    def rotary_dims(self) -> int:
+        return self.rotary_dim or self.head_dim
 
     @property
     def head_dim(self) -> int:
@@ -182,27 +197,56 @@ def rope_table(seq_len: int, head_dim: int, theta: float):
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def apply_rope(x, cos, sin):
-    """x: [B, T, H, D]; rotate pairs (even, odd) halves-interleaved."""
+def apply_rope(x, cos, sin, interleaved: bool = False):
+    """x: [B, T, H, D]. Rotates the first ``2 * cos.shape[-1]`` dims (partial
+    rotary — GPT-NeoX rotary_pct / GPT-J rotary_dim); the rest pass through.
+
+    interleaved=False: llama/NeoX rotate-half pairing (dim i with i + rd/2).
+    interleaved=True:  GPT-J rotate-every-two pairing (dim 2i with 2i+1).
+    """
     import jax.numpy as jnp
 
-    x1, x2 = jnp.split(x, 2, axis=-1)
+    rd = 2 * cos.shape[-1]
+    rot, rest = (x[..., :rd], x[..., rd:]) if rd < x.shape[-1] else (x, None)
     c = cos[None, :, None, :].astype(x.dtype)
     s = sin[None, :, None, :].astype(x.dtype)
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    if interleaved:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        out = out.reshape(rot.shape)
+    else:
+        x1, x2 = jnp.split(rot, 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out if rest is None else jnp.concatenate([out, rest], axis=-1)
 
 
-def causal_attention(q, k, v, attention_impl: str = "auto"):
+def alibi_slopes(n_heads: int):
+    """BLOOM/ALiBi head slopes (press et al.; matches HF build_alibi_tensor)."""
+    import numpy as np
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        s = pow2(n_heads)
+    else:
+        m = 2 ** math.floor(math.log2(n_heads))
+        s = pow2(m) + pow2(2 * m)[0::2][: n_heads - m]
+    return np.asarray(s, np.float32)
+
+
+def causal_attention(q, k, v, attention_impl: str = "auto", alibi=None):
     """q: [B,T,H,D], k/v: [B,T,Hkv,D] → [B,T,H,D]. fp32 softmax.
 
     Dispatches to the Pallas flash kernel on TPU (ops/flash_attention);
-    jnp reference elsewhere.
-    """
+    jnp reference elsewhere. ``alibi`` = per-head slopes [H] (BLOOM)."""
     import jax.numpy as jnp
 
     from ..ops.flash_attention import flash_attention
 
-    return flash_attention(q, k, v, causal=True, impl=attention_impl)
+    return flash_attention(q, k, v, causal=True, impl=attention_impl,
+                           alibi_slopes=alibi)
 
 
 # ---------------------------------------------------------------------------
@@ -242,12 +286,13 @@ class Transformer:
 
         layer = {
             "ln1_w": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
-            "ln2_w": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
             "wq": stack(next(keys), (D, H * Dh), D),
             "wk": stack(next(keys), (D, KV * Dh), D),
             "wv": stack(next(keys), (D, KV * Dh), D),
             "wo": stack(next(keys), (H * Dh, D), H * Dh, scale=1.0 / math.sqrt(2 * L)),
         }
+        if not (cfg.parallel_block and cfg.parallel_shared_ln):
+            layer["ln2_w"], layer["ln2_b"] = jnp.ones((L, D)), jnp.zeros((L, D))
         if cfg.attn_qkv_bias:
             layer["b_q"] = jnp.zeros((L, H * Dh))
             layer["b_k"] = jnp.zeros((L, KV * Dh))
@@ -271,14 +316,19 @@ class Transformer:
             layer["w_down"] = stack(next(keys), (F, D), F, scale=1.0 / math.sqrt(2 * L))
         else:
             layer["w_up"] = stack(next(keys), (D, F), D)
-            layer["b_up"] = jnp.zeros((L, F))
             layer["w_down"] = stack(next(keys), (F, D), F, scale=1.0 / math.sqrt(2 * L))
-            layer["b_down"] = jnp.zeros((L, D))
+            if cfg.mlp_bias:
+                layer["b_up"] = jnp.zeros((L, F))
+                layer["b_down"] = jnp.zeros((L, D))
         params["layers"] = layer
+        if cfg.embed_ln:
+            params["embed_ln_w"], params["embed_ln_b"] = jnp.ones((D,)), jnp.zeros((D,))
         params["ln_f_w"] = jnp.ones((D,))
         params["ln_f_b"] = jnp.zeros((D,))
         if not cfg.tie_embeddings:
             params["unembed"] = jax.random.normal(next(keys), (D, cfg.vocab_size), jnp.float32) * 0.02
+            if cfg.unembed_bias:
+                params["unembed_b"] = jnp.zeros((cfg.vocab_size,))
         return params
 
     # -- partition specs (AutoTP analog) -------------------------------
@@ -330,10 +380,15 @@ class Transformer:
         cfg = self.config
         T = input_ids.shape[-1]
         x = jnp.take(params["embed"], input_ids, axis=0)
+        if cfg.embed_ln:   # BLOOM word_embeddings_layernorm
+            x = _norm(x, params["embed_ln_w"], params["embed_ln_b"], cfg.norm,
+                      eps=cfg.norm_eps)
         if cfg.position == "learned":
             x = x + params["pos_embed"][cfg.pos_offset:cfg.pos_offset + T].astype(x.dtype)
             return x, (None, None)
-        return x, rope_table(T, cfg.head_dim, cfg.rope_theta)
+        if cfg.position == "alibi":
+            return x, (None, None)
+        return x, rope_table(T, cfg.rotary_dims, cfg.rope_theta)
 
     def layer_apply(self, lw, h, rope):
         """One transformer block. h [B, T, D] -> (h, moe_aux)."""
@@ -354,27 +409,47 @@ class Transformer:
             k = k + lw["b_k"].astype(dtype).reshape(KV, Dh)
             v = v + lw["b_v"].astype(dtype).reshape(KV, Dh)
         if cfg.position == "rope":
-            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl).reshape(B, T, H * Dh)
+            q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
+            k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
+        # Name the KV residuals so remat_policy="offload_kv_host" can park
+        # them in host RAM between fwd and bwd (FPDT SequenceChunk offload,
+        # reference sequence/fpdt_layer.py:462; XLA schedules the transfers
+        # and double-buffers the prefetch). No-op under other policies.
+        from jax.ad_checkpoint import checkpoint_name
+
+        k = checkpoint_name(k, "kv")
+        v = checkpoint_name(v, "kv")
+        alibi = (alibi_slopes(H) * cfg.alibi_slope_scale
+                 if cfg.position == "alibi" else None)
+        attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl,
+                                alibi=alibi).reshape(B, T, H * Dh)
         attn_out = attn @ lw["wo"]
         if cfg.attn_out_bias:
             attn_out = attn_out + lw["b_o"].astype(dtype)
-        h = h + attn_out
-        y = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
+        if cfg.parallel_block:
+            # GPT-J/NeoX/Falcon: h + attn(ln1 h) + mlp(ln2 h or ln1 h)
+            y2 = y if cfg.parallel_shared_ln else _norm(
+                h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
+        else:
+            h = h + attn_out
+            y2 = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
         aux = jnp.zeros((), jnp.float32)
         if cfg.n_experts > 0:
             from ..moe.layer import moe_layer
 
             expert_params = {name[4:]: lw[name] for name in lw if name.startswith("moe_") and name != "moe_gate"}
-            res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
+            res = moe_layer(lw["moe_gate"], expert_params, y2, k=cfg.moe_top_k,
                             capacity_factor=cfg.capacity_factor, activation=cfg.activation)
             ff, aux = res.output, res.aux_loss
         elif cfg.activation == "swiglu":
-            ff = (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
+            ff = (jax.nn.silu(y2 @ lw["w_gate"]) * (y2 @ lw["w_up"])) @ lw["w_down"]
+        elif cfg.mlp_bias:
+            act = activation_fn(cfg.activation)
+            ff = act(y2 @ lw["w_up"] + lw["b_up"].astype(dtype)) @ lw["w_down"] + lw["b_down"].astype(dtype)
         else:
             act = activation_fn(cfg.activation)
-            ff = act(y @ lw["w_up"] + lw["b_up"].astype(dtype)) @ lw["w_down"] + lw["b_down"].astype(dtype)
-        h = h + ff
+            ff = act(y2 @ lw["w_up"]) @ lw["w_down"]
+        h = (h + attn_out + ff) if cfg.parallel_block else (h + ff)
         return h, aux
 
     def stack_apply(self, stacked_layers, x, rope, ltd_mask=None):
@@ -418,7 +493,10 @@ class Transformer:
                   eps=self.config.norm_eps)
         if self.config.tie_embeddings:
             return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
-        return x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
+        logits = x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
+        if self.config.unembed_bias:
+            logits = logits + params["unembed_b"].astype(jnp.float32)
+        return logits
 
     @staticmethod
     def token_loss(logits, labels):
@@ -531,5 +609,11 @@ def _remat_policy(name: str):
         "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
         "dots_saveable": jax.checkpoint_policies.dots_saveable,
         "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # FPDT host offload (reference fpdt_layer.py:462,971): per-layer KV
+        # lives in host RAM between fwd and bwd instead of HBM; everything
+        # else recomputes. Max context becomes host-RAM-bound, not HBM-bound.
+        "offload_kv_host": jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[], names_which_can_be_offloaded=["kv"],
+            offload_src="device", offload_dst="pinned_host"),
     }
     return policies.get(name, jax.checkpoint_policies.dots_saveable)

@@ -31,8 +31,13 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, :, None, :], (b, t, h, n_rep, d)).reshape(b, t, h * n_rep, d)
 
 
-def reference_attention(q, k, v, causal: bool = True, segment_ids=None):
-    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]; fp32 softmax."""
+def reference_attention(q, k, v, causal: bool = True, segment_ids=None,
+                        alibi_slopes=None):
+    """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]; fp32 softmax.
+
+    ``alibi_slopes`` [H]: adds slope_h * j to key position j (BLOOM ALiBi;
+    per-query-row softmax shift-invariance makes the absolute form equal to
+    the relative slope_h * (j - i))."""
     import jax
     import jax.numpy as jnp
 
@@ -42,6 +47,10 @@ def reference_attention(q, k, v, causal: bool = True, segment_ids=None):
     scale = q.shape[-1] ** -0.5
 
     logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if alibi_slopes is not None:
+        s = k.shape[1]
+        logits = logits + (jnp.asarray(alibi_slopes, jnp.float32)[None, :, None, None]
+                           * jnp.arange(s, dtype=jnp.float32)[None, None, None, :])
     if causal:
         t, s = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
@@ -130,11 +139,20 @@ def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
     return out[:, :t0] if t_pad else out
 
 
-def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None):
+def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None,
+                    alibi_slopes=None):
     """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D].
 
     impl: auto | pallas | reference | chunked (FPDT-style scan, long-context
     memory bound — see ops/chunked_attention.py)."""
+    if alibi_slopes is not None:
+        # ALiBi needs a per-position bias the stock Pallas kernel does not
+        # take (its `ab` operand materializes [B,H,T,S], defeating flash);
+        # the XLA-fused SDPA is the honest path until a biased kernel lands.
+        if impl in ("pallas", "chunked"):
+            warning_once("alibi attention uses the jnp reference path")
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                   alibi_slopes=alibi_slopes)
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     if impl == "chunked":

@@ -1,0 +1,140 @@
+"""FPDT host-offloaded attention: KV lives in host RAM, HBM holds O(chunk).
+
+Capability parity with the reference's FPDT offload machinery
+(``sequence/fpdt_layer.py:462`` ``SequenceChunk`` pinned-host chunks;
+``:971`` double-buffered prefetch): attention over a context whose KV does
+not fit HBM. Two complementary mechanisms:
+
+- **Training**: ``remat_policy="offload_kv_host"`` (models/transformer.py)
+  parks the per-layer KV residuals in pinned host memory between forward
+  and backward — XLA inserts and overlaps the transfers. Nothing here to
+  call; it's a checkpoint policy.
+
+- **Prefill/serving** (this module): :class:`HostKVCache` stores KV chunks
+  as host NumPy; :func:`offloaded_chunk_attention` runs online-softmax
+  attention per query chunk while DOUBLE-BUFFERING the KV chunk uploads —
+  ``jax.device_put`` is async, so chunk i+1's H2D transfer overlaps chunk
+  i's compute, exactly the reference's prefetch loop. Peak device bytes are
+  tracked (``peak_device_bytes``) so tests can assert the O(chunk) bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class HostKVCache:
+    """Host-RAM chunked KV store (SequenceChunk analog). Chunks are
+    [B, c, KV, Dh] and appended in sequence order."""
+
+    def __init__(self):
+        self.k_chunks: List[np.ndarray] = []
+        self.v_chunks: List[np.ndarray] = []
+
+    def append(self, k_chunk, v_chunk) -> None:
+        self.k_chunks.append(np.asarray(k_chunk))
+        self.v_chunks.append(np.asarray(v_chunk))
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.k_chunks)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(a.nbytes for a in self.k_chunks + self.v_chunks)
+
+
+@functools.lru_cache(maxsize=None)
+def _block_update_jit(causal: bool):
+    import jax
+
+    return jax.jit(functools.partial(_block_update, causal=causal))
+
+
+def _block_update(q32, k_blk, v_blk, acc, m_run, l_run, q_pos0, kv_pos0,
+                  causal: bool = True):
+    """One online-softmax block: q chunk x one KV chunk (fp32)."""
+    import jax.numpy as jnp
+
+    c_q, c_kv = q32.shape[1], k_blk.shape[1]
+    n_rep = q32.shape[2] // k_blk.shape[2]
+    if n_rep > 1:
+        from .flash_attention import _repeat_kv
+
+        k_blk, v_blk = _repeat_kv(k_blk, n_rep), _repeat_kv(v_blk, n_rep)
+    logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
+    if causal:
+        q_pos = q_pos0 + jnp.arange(c_q)
+        kv_pos = kv_pos0 + jnp.arange(c_kv)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    m_blk = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m_run, m_blk)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - m_safe[..., None]), 0.0)
+    corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+    l_new = l_run * corr + p.sum(-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
+def offloaded_chunk_attention(q, kv: HostKVCache, *, causal: bool = True,
+                              q_chunk: Optional[int] = None,
+                              stats: Optional[dict] = None):
+    """Attention of q [B, T, H, Dh] (host or device) against a host-resident
+    chunked KV cache. Returns host np [B, T, H, Dh].
+
+    Per q chunk, KV chunks stream through the device two at a time: the
+    upload of chunk i+1 is issued BEFORE chunk i's block update is consumed
+    (async dispatch -> the H2D copy overlaps compute — the reference's
+    double buffering, fpdt_layer.py:971). ``stats`` (optional dict) gets
+    ``peak_device_bytes`` so callers can assert the O(chunk) HBM bound.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    q_np = np.asarray(q, np.float32)
+    B, T, H, Dh = q_np.shape
+    n = kv.n_chunks
+    if n == 0:
+        raise ValueError("empty HostKVCache")
+    c_kv = kv.k_chunks[0].shape[1]
+    c_q = q_chunk or min(T, c_kv)
+    if T % c_q:
+        raise ValueError(f"q_chunk={c_q} must divide T={T}")
+    scale = Dh ** -0.5
+    out = np.empty((B, T, H, Dh), np.float32)
+    peak = 0
+
+    def put_pair(i):
+        return (jax.device_put(kv.k_chunks[i]), jax.device_put(kv.v_chunks[i]))
+
+    for qi in range(T // c_q):
+        q_dev = jax.device_put(q_np[:, qi * c_q:(qi + 1) * c_q]) * scale
+        acc = jnp.zeros((B, H, c_q, Dh), jnp.float32)
+        m = jnp.full((B, H, c_q), -jnp.inf, jnp.float32)
+        l = jnp.zeros((B, H, c_q), jnp.float32)
+        cur = put_pair(0)
+        live = q_dev.nbytes + acc.nbytes + m.nbytes + l.nbytes
+        for ki in range(n):
+            q_pos0 = qi * c_q
+            kv_pos0 = ki * c_kv
+            if causal and kv_pos0 > q_pos0 + c_q - 1:
+                break  # chunk fully above the diagonal
+            nxt = put_pair(ki + 1) if ki + 1 < n else None
+            # two KV chunks resident at once: cur (computing) + nxt (loading)
+            peak = max(peak, live + cur[0].nbytes + cur[1].nbytes
+                       + (nxt[0].nbytes + nxt[1].nbytes if nxt else 0))
+            acc, m, l = _block_update_jit(causal)(q_dev, cur[0], cur[1], acc, m, l,
+                                                  q_pos0, kv_pos0)
+            cur = nxt
+        o = acc / jnp.maximum(l[..., None], 1e-30)
+        out[:, qi * c_q:(qi + 1) * c_q] = np.asarray(o.transpose(0, 2, 1, 3))
+    if stats is not None:
+        stats["peak_device_bytes"] = peak
+        stats["host_kv_bytes"] = kv.total_bytes
+    return out

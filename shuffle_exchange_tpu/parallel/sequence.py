@@ -35,15 +35,18 @@ from . import comm
 
 
 def seq_to_head_a2a(x, axis_name: str = "seq"):
-    """[B, T/sp, H, D] -> [B, T, H/sp, D] (head-scatter, seq-gather)."""
+    """[B, T/sp, H, D] -> [B, T, H/sp, D] (head-scatter, seq-gather).
+
+    H must divide sp here; :class:`DistributedAttention` handles uneven
+    head counts by padding before calling this (reference
+    ``uneven_heads_all2all``, sequence/layer.py:111)."""
     import jax
 
     sp = jax.lax.axis_size(axis_name)
     if x.shape[2] % sp:
         raise ValueError(
-            f"Ulysses needs head count ({x.shape[2]}) divisible by the sequence-parallel "
-            f"degree ({sp}); use ring_attention for sp > heads (reference supports uneven "
-            "heads via padding — sequence/layer.py:111 — not yet implemented here)")
+            f"head count ({x.shape[2]}) not divisible by the sequence-parallel "
+            f"degree ({sp}); route through DistributedAttention, which pads")
     return comm.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
 
@@ -55,7 +58,15 @@ def head_to_seq_a2a(x, axis_name: str = "seq"):
 class DistributedAttention:
     """Ulysses wrapper around any local attention fn (reference
     ``sequence/layer.py:331``): q/k/v sharded on seq dim in, output sharded
-    on seq dim out."""
+    on seq dim out.
+
+    Uneven head counts (reference ``uneven_heads_all2all``,
+    sequence/layer.py:111): when H (or the GQA kv count) does not divide the
+    sp degree, q/k/v heads are zero-padded up to the next multiple of sp
+    before the all-to-all and the pad heads sliced away after the reverse
+    — GQA kv heads are first expanded to H so every rank's q shard is
+    colocated with its kv heads (contiguous-chunk scatter cannot preserve
+    group alignment under padding otherwise)."""
 
     def __init__(self, local_attention: Callable, sequence_axis: str = "seq",
                  scatter_idx: int = 2, gather_idx: int = 1):
@@ -63,11 +74,26 @@ class DistributedAttention:
         self.axis = sequence_axis
 
     def __call__(self, q, k, v, *args, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        sp = jax.lax.axis_size(self.axis)
+        H, KV = q.shape[2], k.shape[2]
+        even = H % sp == 0 and KV % sp == 0
+        if not even:
+            if KV != H:
+                from ..ops.flash_attention import _repeat_kv
+
+                k, v = _repeat_kv(k, H // KV), _repeat_kv(v, H // KV)
+            hp = -(-H // sp) * sp
+            pad = ((0, 0), (0, 0), (0, hp - H), (0, 0))
+            q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
         qh = seq_to_head_a2a(q, self.axis)
         kh = seq_to_head_a2a(k, self.axis)
         vh = seq_to_head_a2a(v, self.axis)
         out = self.local_attn(qh, kh, vh, *args, **kwargs)
-        return head_to_seq_a2a(out, self.axis)
+        out = head_to_seq_a2a(out, self.axis)
+        return out if even else out[:, :, :H]
 
 
 def ulysses_attention(q, k, v, axis_name: str = "seq", attn_fn: Optional[Callable] = None,

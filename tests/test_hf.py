@@ -103,6 +103,88 @@ def test_phi3_logit_parity():
     _compare(transformers.Phi3ForCausalLM(cfg), _ids(96))
 
 
+def test_gptj_logit_parity():
+    """Parallel block + shared ln + interleaved partial rotary + lm_head bias."""
+    cfg = transformers.GPTJConfig(vocab_size=96, n_embd=64, n_layer=2, n_head=4,
+                                  rotary_dim=8, n_positions=64,
+                                  attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    torch.manual_seed(4)
+    model = transformers.GPTJForCausalLM(cfg)
+    with torch.no_grad():
+        model.lm_head.bias.normal_(0, 0.1)   # make the head bias matter
+    _compare(model, _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_gptneox_logit_parity():
+    """Parallel residual with two norms + rotary_pct partial rope + fused
+    interleaved QKV."""
+    cfg = transformers.GPTNeoXConfig(vocab_size=96, hidden_size=64,
+                                     intermediate_size=128, num_hidden_layers=2,
+                                     num_attention_heads=4, rotary_pct=0.5,
+                                     max_position_embeddings=64,
+                                     use_parallel_residual=True,
+                                     attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(5)
+    _compare(transformers.GPTNeoXForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_gptneox_sequential_variant():
+    cfg = transformers.GPTNeoXConfig(vocab_size=96, hidden_size=64,
+                                     intermediate_size=128, num_hidden_layers=2,
+                                     num_attention_heads=4, rotary_pct=0.25,
+                                     max_position_embeddings=64,
+                                     use_parallel_residual=False,
+                                     attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(6)
+    _compare(transformers.GPTNeoXForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_falcon_logit_parity_multiquery():
+    """Falcon-7B shape: multi-query, parallel attn, shared ln, no biases."""
+    cfg = transformers.FalconConfig(vocab_size=96, hidden_size=64,
+                                    num_hidden_layers=2, num_attention_heads=4,
+                                    multi_query=True, parallel_attn=True,
+                                    new_decoder_architecture=False, bias=False,
+                                    alibi=False, attention_dropout=0.0,
+                                    hidden_dropout=0.0)
+    torch.manual_seed(7)
+    _compare(transformers.FalconForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_falcon_logit_parity_new_arch_gqa():
+    """Falcon-40B shape: new decoder architecture, GQA, ln_attn/ln_mlp."""
+    cfg = transformers.FalconConfig(vocab_size=96, hidden_size=64,
+                                    num_hidden_layers=2, num_attention_heads=4,
+                                    num_kv_heads=2, new_decoder_architecture=True,
+                                    bias=False, alibi=False,
+                                    attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(8)
+    _compare(transformers.FalconForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_falcon_rw_logit_parity():
+    """Falcon-RW shape: sequential block, ALiBi, biases, per-head
+    interleaved fused QKV (r3 review regression: the RW path was rejected
+    by a guard and never loaded its bias tensors)."""
+    cfg = transformers.FalconConfig(vocab_size=96, hidden_size=64,
+                                    num_hidden_layers=2, num_attention_heads=4,
+                                    multi_query=False, parallel_attn=False,
+                                    new_decoder_architecture=False, bias=True,
+                                    alibi=True, attention_dropout=0.0,
+                                    hidden_dropout=0.0)
+    torch.manual_seed(10)
+    _compare(transformers.FalconForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_bloom_logit_parity_alibi():
+    """BLOOM: ALiBi positions, embedding layernorm, fused interleaved QKV."""
+    cfg = transformers.BloomConfig(vocab_size=96, hidden_size=64, n_layer=2,
+                                   n_head=4, attention_dropout=0.0,
+                                   hidden_dropout=0.0)
+    torch.manual_seed(9)
+    _compare(transformers.BloomForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError):
         config_from_hf({"model_type": "space_transformer", "architectures": ["SpaceLM"]})

@@ -114,3 +114,79 @@ def test_sparse_attention_only_attends_layout():
 def test_sparsity_config_rejects_bad_seq():
     with pytest.raises(ValueError):
         FixedSparsityConfig(block=16).make_layout(100)
+
+
+# ---------------------------------------------------------------------------
+# FPDT host chunk offload (reference sequence/fpdt_layer.py:462,971;
+# VERDICT r2 missing #4 / next #8)
+# ---------------------------------------------------------------------------
+
+
+def _host_kv(B=2, S=256, KV=2, Dh=16, chunk=32, seed=0):
+    from shuffle_exchange_tpu.ops.fpdt_offload import HostKVCache
+
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((B, S, KV, Dh)).astype(np.float32)
+    v = rng.standard_normal((B, S, KV, Dh)).astype(np.float32)
+    kv = HostKVCache()
+    for i in range(S // chunk):
+        kv.append(k[:, i * chunk:(i + 1) * chunk], v[:, i * chunk:(i + 1) * chunk])
+    return k, v, kv
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_offloaded_attention_matches_reference(causal):
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+    from shuffle_exchange_tpu.ops.fpdt_offload import offloaded_chunk_attention
+
+    rng = np.random.default_rng(1)
+    q = rng.standard_normal((2, 256, 4, 16)).astype(np.float32)  # GQA 4q/2kv
+    k, v, kv = _host_kv()
+    got = offloaded_chunk_attention(q, kv, causal=causal, q_chunk=32)
+    want = np.asarray(reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_offloaded_attention_device_memory_stays_o_chunk():
+    """The whole point: host KV far exceeds what the device ever holds.
+    With 64 chunks resident on host, the device never sees more than q
+    chunk + 2 KV chunks + accumulators (double buffering)."""
+    from shuffle_exchange_tpu.ops.fpdt_offload import offloaded_chunk_attention
+
+    k, v, kv = _host_kv(B=1, S=128 * 64, KV=4, Dh=64, chunk=64)
+    rng = np.random.default_rng(2)
+    q = rng.standard_normal((1, 64, 4, 64)).astype(np.float32)
+    stats = {}
+    offloaded_chunk_attention(q, kv, causal=False, q_chunk=64, stats=stats)
+    assert stats["host_kv_bytes"] > 8 * 1024 * 1024           # "exceeds budget"
+    assert stats["peak_device_bytes"] < stats["host_kv_bytes"] / 16
+    # bound is chunk-shaped, not context-shaped
+    chunk_bytes = kv.k_chunks[0].nbytes
+    assert stats["peak_device_bytes"] < 24 * chunk_bytes
+
+
+@pytest.mark.slow
+def test_training_with_host_offloaded_kv_matches(devices8):
+    """remat_policy="offload_kv_host": same trajectory as full remat, with
+    KV residuals parked in pinned host memory between fwd and bwd."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    def build(policy):
+        reset_topology()
+        model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=64,
+                                 remat=True, remat_policy=policy))
+        eng, *_ = sxt.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9})
+        return eng
+
+    e_off = build("offload_kv_host")
+    e_ref = build("nothing_saveable")
+    for s in range(3):
+        b = {"input_ids": np.random.default_rng(s).integers(0, 64, size=(8, 64)).astype(np.int32)}
+        l_off = float(e_off.train_batch(b))
+        l_ref = float(e_ref.train_batch(b))
+        assert l_off == pytest.approx(l_ref, rel=1e-5)

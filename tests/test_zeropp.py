@@ -134,3 +134,39 @@ def test_hpz_group_must_divide_world(devices8):
     reset_topology()
     with pytest.raises(sxt.ConfigError):
         sxt.initialize(model=_model(), config=_base_config(zero_hpz_partition_size=3))
+
+
+def test_stage3_wire_is_int8(devices8):
+    """ZeRO-3 real wire compression (round 3, VERDICT r2 #5): with qwZ+qgZ
+    on, the compiled stage-3 step's param gathers AND gradient reductions
+    carry s8 operands — the north-star config no longer falls back to
+    quantize-dequantize emulation (reference partition_parameters.py:824 +
+    coalesced_collectives.py:31)."""
+    import jax
+
+    reset_topology()
+    engine, *_ = sxt.initialize(model=_model(), config=_base_config(
+        stage=3, zero_quantized_weights=True, zero_quantized_gradients=True))
+    batch = _batch()
+    shaped = engine._reshape_batch(batch)
+    low = engine._train_step.lower(engine.state, shaped, engine._mix_matrix(),
+                                   jax.random.PRNGKey(0))
+    hlo = low.compile().as_text()
+    s8_gathers = [l for l in hlo.splitlines() if "all-gather" in l and "s8" in l]
+    s8_a2a = [l for l in hlo.splitlines() if "all-to-all" in l and "s8" in l]
+    assert s8_gathers, "no s8 all-gather — qwZ stage-3 wire inactive"
+    assert s8_a2a, "no s8 all-to-all — qgZ stage-3 reduce-scatter wire inactive"
+
+
+def test_stage3_wire_loss_parity_with_exact(devices8):
+    """The int8-wire stage-3 step trains to ~the same loss as exact stage 3."""
+    reset_topology()
+    eq, *_ = sxt.initialize(model=_model(), config=_base_config(
+        stage=3, zero_quantized_weights=True, zero_quantized_gradients=True))
+    reset_topology()
+    ex, *_ = sxt.initialize(model=_model(), config=_base_config(stage=3))
+    lq = lx = None
+    for s in range(4):
+        b = {"input_ids": np.random.default_rng(s).integers(0, 128, size=(8, 32)).astype(np.int32)}
+        lq, lx = float(eq.train_batch(b)), float(ex.train_batch(b))
+    assert np.isfinite(lq) and abs(lq - lx) / abs(lx) < 0.05
