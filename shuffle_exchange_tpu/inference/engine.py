@@ -162,10 +162,14 @@ class InferenceEngine:
         import jax
         import jax.numpy as jnp
 
+        from ..ops.quant_matmul import QuantizedMatrix
+
         dtype = self.config.jax_dtype()
         params = jax.tree.map(
-            lambda p: p.astype(dtype) if hasattr(p, "astype") and jnp.issubdtype(p.dtype, jnp.floating) else p,
-            params)
+            lambda p: p.astype(dtype) if (not isinstance(p, QuantizedMatrix)
+                                          and hasattr(p, "astype")
+                                          and jnp.issubdtype(p.dtype, jnp.floating)) else p,
+            params, is_leaf=lambda p: isinstance(p, QuantizedMatrix))
         if self.config.quantize_weights:
             params = self._quantize(params)
         self.params = self._place(params)
@@ -179,12 +183,18 @@ class InferenceEngine:
 
         if not topology_is_initialized():
             return jax.device_put(params)
+        from ..ops.quant_matmul import QuantizedMatrix
+
         topo = get_topology()
         if topo.size("tensor") == 1 or not hasattr(self.model, "partition_specs"):
             return jax.device_put(params)
         specs = self.model.partition_specs(params)
 
         def place(p, spec):
+            if isinstance(p, QuantizedMatrix):
+                # TP-sharding the int8 storage needs scale-aware specs;
+                # replicate for now (quantized serving is single-chip-first)
+                return jax.device_put(p)
             # replicate any leaf a mesh axis doesn't divide (odd vocab or
             # head counts must degrade, not crash serving)
             for dim, ax in enumerate(spec):
@@ -197,27 +207,47 @@ class InferenceEngine:
                     return jax.device_put(p)
             return jax.device_put(p, topo.named_sharding(*spec))
 
-        return jax.tree.map(place, params, specs)
+        return jax.tree.map(place, params, specs,
+                            is_leaf=lambda p: isinstance(p, QuantizedMatrix))
 
     def _quantize(self, params):
         """int8 weight-only quantization (reference GroupQuantizer
-        ``module_inject/replace_module.py:44`` / quant config). Matmul weights
-        are rounded through int8 groups; serving dtype is kept for compute so
-        XLA still hits the MXU (a Pallas int8-storage matmul is the upgrade
-        path for HBM savings)."""
+        ``module_inject/replace_module.py:44`` + the mixed_gemm CUTLASS
+        kernels, SURVEY §2.13). Layer matmul weights become int8-STORAGE
+        :class:`QuantizedMatrix` leaves — half the HBM bytes, `y @ w`
+        dispatches to the Pallas quantized matmul on TPU (round 3; was
+        quantize-dequantize emulation). MoE/unembed weights (einsum / fp32
+        head paths) keep the rounding-only emulation."""
         import jax
 
         from ..ops.quant import quantize_dequantize
+        from ..ops.quant_matmul import quantize_weight
+
+        from ..utils.logging import warning_once
 
         gs = self.config.quant_group_size
-        quant_names = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                       "moe_w_gate", "moe_w_up", "moe_w_down", "unembed"}
+        if gs > 256:
+            # the Pallas quantized matmul uses one scale row per K-block
+            # (block = group); 256 is its largest MXU-friendly group
+            warning_once(f"quant_group_size={gs}: int8-STORAGE weights use "
+                         "group_size=256 (kernel K-block bound); the "
+                         "configured value still applies to moe/unembed "
+                         "rounding")
+        storage_names = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+        qdq_names = {"moe_w_gate", "moe_w_up", "moe_w_down", "unembed"}
+        dtype = self.config.jax_dtype()
 
         def walk(tree):
             if isinstance(tree, dict):
-                return {k: (quantize_dequantize(v, group_size=gs).astype(v.dtype)
-                            if k in quant_names else walk(v))
-                        for k, v in tree.items()}
+                out = {}
+                for k, v in tree.items():
+                    if k in storage_names:
+                        out[k] = quantize_weight(v, group_size=min(gs, 256), dtype=dtype)
+                    elif k in qdq_names:
+                        out[k] = quantize_dequantize(v, group_size=gs).astype(v.dtype)
+                    else:
+                        out[k] = walk(v)
+                return out
             return tree
 
         return walk(params)
@@ -322,7 +352,8 @@ class InferenceEngine:
 
             expert_params = {n[4:]: lw[n] for n in lw if n.startswith("moe_") and n != "moe_gate"}
             res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
-                            capacity_factor=cfg.capacity_factor, activation=cfg.activation)
+                            capacity_factor=cfg.capacity_factor, activation=cfg.activation,
+                            impl=cfg.moe_impl)
             return res.output
         if cfg.activation == "swiglu":
             return (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]

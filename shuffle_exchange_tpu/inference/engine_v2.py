@@ -192,9 +192,17 @@ class InferenceEngineV2(InferenceEngine):
                     k.reshape(B * C, *k.shape[2:]).astype(ck.dtype))
                 cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
                     v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
-                kg, vg = gather_kv(ck2, cv2, btables)             # [B,S,KV,Dh]
-                out = extend_attention(q, kg, vg, start, start + nnew,
-                                       alibi_slopes=self._alibi)
+                if self._alibi is not None:
+                    # no bias operand in the Pallas kernel: ALiBi gathers
+                    kg, vg = gather_kv(ck2, cv2, btables)         # [B,S,KV,Dh]
+                    out = extend_attention(q, kg, vg, start, start + nnew,
+                                           alibi_slopes=self._alibi)
+                else:
+                    # paged extend: q chunk attends the pool through the
+                    # block table — no [B, S_max, KV, Dh] gather (r2 weak #7)
+                    from ..ops.paged_attention import paged_extend_attention
+
+                    out = paged_extend_attention(q, ck2, cv2, btables, start, nnew)
                 return out, (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)

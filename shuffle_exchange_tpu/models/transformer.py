@@ -74,6 +74,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
+    moe_impl: str = "auto"                     # auto | capacity | ragged (dropless)
     attention_impl: str = "auto"
     # Chunked vocab CE (reference FPDT chunked logits loss,
     # sequence/fpdt_layer.py:1137): compute the loss in seq chunks under
@@ -439,7 +440,8 @@ class Transformer:
 
             expert_params = {name[4:]: lw[name] for name in lw if name.startswith("moe_") and name != "moe_gate"}
             res = moe_layer(lw["moe_gate"], expert_params, y2, k=cfg.moe_top_k,
-                            capacity_factor=cfg.capacity_factor, activation=cfg.activation)
+                            capacity_factor=cfg.capacity_factor, activation=cfg.activation,
+                            impl=cfg.moe_impl)
             ff, aux = res.output, res.aux_loss
         elif cfg.activation == "swiglu":
             ff = (jax.nn.silu(y2 @ lw["w_gate"]) * (y2 @ lw["w_up"])) @ lw["w_down"]

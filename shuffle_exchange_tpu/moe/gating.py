@@ -30,6 +30,46 @@ def compute_capacity(num_tokens: int, num_experts: int, k: int, capacity_factor:
     return max(cap, min_capacity)
 
 
+def topk_select(logits, k: int, normalize_weights: bool = True,
+                train: bool = False, rng=None, noise_std: float = 0.0):
+    """The ONE top-k routing rule (iterative argmax — ties broken by
+    expert order), shared by the capacity path (topk_gating) and the
+    dropless ragged path (moe/layer.expert_mlp_ragged), so the two can
+    never diverge on selection/noise/aux semantics.
+
+    logits [S, E] -> (idx [S,k] i32, weights [S,k] f32, aux_loss, masks)
+    where masks is the per-choice one-hot list and aux_loss is the
+    reference l_aux on the first choice (moe/sharded_moe.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    E = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    if train and noise_std > 0.0 and rng is not None:
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape, jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    idxs, ws, masks = [], [], []
+    masked = logits
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        idxs.append(idx.astype(jnp.int32))
+        ws.append(jnp.sum(gates * m, axis=-1))
+        masks.append(m)
+        masked = jnp.where(m > 0, -jnp.inf, masked)
+
+    # Aux load-balancing loss on the first choice (reference l_aux):
+    aux_loss = E * jnp.sum(gates.mean(axis=0) * masks[0].mean(axis=0))
+
+    idx = jnp.stack(idxs, axis=1)
+    w = jnp.stack(ws, axis=1)
+    if normalize_weights and k > 1:
+        w = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-9)
+    return idx, w, aux_loss, masks
+
+
 def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
                 train: bool = True, rng=None, noise_std: float = 0.0,
                 normalize_weights: bool = True, drop_tokens: bool = True) -> GateOutput:
@@ -39,25 +79,12 @@ def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: 
     import jax.numpy as jnp
 
     S, E = logits.shape
-    logits = logits.astype(jnp.float32)
-    if train and noise_std > 0.0 and rng is not None:
-        logits = logits + noise_std * jax.random.normal(rng, logits.shape, jnp.float32)
-    gates = jax.nn.softmax(logits, axis=-1)
+    # weights re-normalize AFTER capacity drops below, so take them raw here
+    _, raw_w, aux_loss, masks = topk_select(
+        logits, k, normalize_weights=False, train=train, rng=rng, noise_std=noise_std)
+    gates = raw_w  # per-choice raw gate probabilities [S, k]
 
     capacity = compute_capacity(S, E, k, capacity_factor, min_capacity) if drop_tokens else S
-
-    masks = []
-    masked_logits = logits
-    for _ in range(k):
-        idx = jnp.argmax(masked_logits, axis=-1)
-        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
-        masks.append(m)
-        masked_logits = jnp.where(m > 0, -jnp.inf, masked_logits)
-
-    # Aux load-balancing loss on the first choice (reference l_aux):
-    me = gates.mean(axis=0)                  # mean gate prob per expert
-    ce = masks[0].mean(axis=0)               # fraction of tokens routed (top-1)
-    aux_loss = E * jnp.sum(me * ce)
 
     # Position of each token within its expert's buffer, priority: choice
     # order first (all 1st choices beat 2nd choices), token order second.
@@ -73,8 +100,9 @@ def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: 
         locations.append(loc)
 
     gate_weights = []
-    for m in kept_masks:
-        gate_weights.append(jnp.sum(gates * m, axis=-1))  # [S]
+    for j, m in enumerate(kept_masks):
+        # raw per-choice probability, zeroed when the slot was dropped
+        gate_weights.append(gates[:, j] * m.sum(axis=-1))  # [S]
     if normalize_weights and k > 1:
         denom = sum(gate_weights)
         denom = jnp.maximum(denom, 1e-9)

@@ -63,6 +63,40 @@ def expert_mlp(params, x, activation: str = "swiglu"):
     return jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype))
 
 
+def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
+    """Dropless grouped-GEMM experts (reference cutlass moe_gemm /
+    megablocks, SURVEY §2.13): tokens sort by expert and ``lax.ragged_dot``
+    runs one grouped matmul per projection — no capacity padding slots, no
+    dropped tokens, ragged group sizes straight onto the MXU.
+
+    xs [S, M]; topk_idx [S, k] int32; topk_w [S, k] f32 -> [S, M].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S, M = xs.shape
+    k = topk_idx.shape[1]
+    E = params["w_up"].shape[0]
+    flat_e = topk_idx.reshape(-1)                        # [S*k]
+    order = jnp.argsort(flat_e, stable=True)
+    token_of = order // k
+    xsort = jnp.take(xs, token_of, axis=0)               # [S*k, M]
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    dtype = xs.dtype
+    up = jax.lax.ragged_dot(xsort, params["w_up"].astype(dtype), group_sizes)
+    if activation == "swiglu":
+        gate = jax.lax.ragged_dot(xsort, params["w_gate"].astype(dtype), group_sizes)
+        h = jax.nn.silu(gate) * up
+    else:
+        from ..models.transformer import activation_fn
+
+        h = activation_fn(activation)(up)
+    out_sorted = jax.lax.ragged_dot(h, params["w_down"].astype(dtype), group_sizes)
+    out_flat = jnp.zeros_like(out_sorted).at[order].set(out_sorted)   # unsort
+    return (out_flat.reshape(S, k, M) * topk_w[..., None].astype(dtype)).sum(axis=1)
+
+
 class MoEResult(NamedTuple):
     output: "jax.Array"
     aux_loss: "jax.Array"
@@ -72,11 +106,17 @@ class MoEResult(NamedTuple):
 def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0,
               activation: str = "swiglu", train: bool = True, rng=None,
               noise_std: float = 0.0, min_capacity: int = 4, expert_axis: str = "expert",
-              mesh=None) -> MoEResult:
+              mesh=None, impl: str = "auto") -> MoEResult:
     """x [..., M] -> MoEResult. gate_w [M, E].
 
-    Under jit with a mesh in context, the dispatched [E, C, M] tensor is
-    sharding-constrained to the expert axis (EP all-to-all inserted by XLA).
+    impl:
+      - "capacity": GShard einsum dispatch over [E, C, M] with capacity/drop
+        semantics; the EP path (dispatched tensor sharding-constrained to
+        the expert axis -> XLA inserts the all-to-all pair).
+      - "ragged": dropless grouped-GEMM (``expert_mlp_ragged``) — no
+        capacity padding FLOPs, no drops; the single-device/data-parallel
+        fast path (reference cutlass moe_gemm).
+      - "auto": ragged when the mesh has no expert axis > 1, else capacity.
     """
     import jax
     import jax.numpy as jnp
@@ -86,6 +126,27 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
     xs = x.reshape(-1, M)
     S = xs.shape[0]
     logits = (xs.astype(jnp.float32)) @ gate_w.astype(jnp.float32)   # [S, E]
+
+    if impl == "auto":
+        # the explicit mesh argument wins; fall back to the global topology
+        if mesh is not None:
+            ep = dict(getattr(mesh, "shape", {})).get(expert_axis, 1)
+        else:
+            from ..parallel.mesh import get_topology, topology_is_initialized
+
+            ep = get_topology().size(expert_axis) if topology_is_initialized() else 1
+        impl = "capacity" if ep > 1 else "ragged"
+    if impl == "ragged":
+        from .gating import topk_select
+
+        idx, w, aux, _ = topk_select(logits, k, train=train, rng=rng,
+                                     noise_std=noise_std)
+        out = expert_mlp_ragged(expert_params, xs, idx, w, activation)
+        counts = jnp.bincount(idx.reshape(-1), length=gate_w.shape[1])
+        return MoEResult(out.reshape(orig_shape), aux,
+                         {"expert_counts": counts, "drop_fraction": jnp.zeros(()),
+                          "capacity": S})
+
     gate = topk_gating(logits, k=k, capacity_factor=capacity_factor, train=train,
                        rng=rng, noise_std=noise_std, min_capacity=min_capacity)
 

@@ -1,0 +1,175 @@
+"""int8 weight-only quantized matmul (storage + kernel).
+
+TPU replacement for the reference's mixed-precision GEMMs
+(``inference/v2/kernels/cutlass_ops/mixed_gemm/`` int4/int8-weight x
+fp16-activation CUTLASS kernels, SURVEY.md §2.13): weights are STORED as
+int8 with per-(K-group, column) fp32 scales — half the HBM footprint and
+read bandwidth of bf16 — and the Pallas kernel dequantizes blocks in VMEM
+on the way into the MXU.
+
+The storage format is :class:`QuantizedMatrix`, a pytree node implementing
+``__rmatmul__``: model code written as ``y @ w`` hits the kernel with no
+per-arch surgery (the module_inject analog is one params transform, not a
+module swap). ``lax.scan`` over stacked [L, K, N] layer weights slices the
+children per layer like any other leaf.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+
+class QuantizedMatrix:
+    """int8 weight + per-(group, column) scales; ``x @ qm`` dispatches to
+    the quantized matmul. Supports leading stacked dims ([L, K, N])."""
+
+    def __init__(self, q, scales, group_size: int, dtype):
+        self.q = q                # int8  [..., K, N]
+        self.scales = scales      # f32   [..., K//gs, N]
+        self.group_size = group_size
+        self.dtype = dtype        # compute/output dtype
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def nbytes(self):
+        return self.q.size + 4 * self.scales.size
+
+    def __rmatmul__(self, x):
+        return quant_matmul(x, self)
+
+    def dequantize(self):
+        import jax.numpy as jnp
+
+        gs = self.group_size
+        *lead, K, N = self.q.shape
+        qf = self.q.astype(jnp.float32).reshape(*lead, K // gs, gs, N)
+        w = qf * self.scales[..., :, None, :]
+        return w.reshape(*lead, K, N).astype(self.dtype)
+
+    def astype(self, dtype):
+        # a cast request materializes the dense matrix (callers that cast
+        # don't want the quantized form); keep storage paths on @ only
+        return self.dequantize().astype(dtype)
+
+
+def _qm_flatten(qm):
+    return (qm.q, qm.scales), (qm.group_size, qm.dtype)
+
+
+def _qm_unflatten(aux, children):
+    return QuantizedMatrix(children[0], children[1], aux[0], aux[1])
+
+
+def _register():
+    import jax
+
+    try:
+        jax.tree_util.register_pytree_node(QuantizedMatrix, _qm_flatten, _qm_unflatten)
+    except ValueError:
+        pass  # already registered
+
+
+_register()
+
+
+def quantize_weight(w, group_size: int = 256, dtype=None) -> QuantizedMatrix:
+    """w [..., K, N] -> QuantizedMatrix with per-(K-group, column) scales
+    (symmetric int8). K must divide group_size (weights are MXU-shaped)."""
+    import jax.numpy as jnp
+
+    *lead, K, N = w.shape
+    while K % group_size:
+        group_size //= 2
+    if group_size < 1:
+        raise ValueError(f"no valid group size for K={K}")
+    wg = w.astype(jnp.float32).reshape(*lead, K // group_size, group_size, N)
+    absmax = jnp.max(jnp.abs(wg), axis=-2)                       # [..., Kg, N]
+    scales = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wg / scales[..., :, None, :]), -127, 127).astype(jnp.int8)
+    return QuantizedMatrix(q.reshape(*lead, K, N), scales, group_size,
+                           dtype or w.dtype)
+
+
+def quant_matmul(x, qm: QuantizedMatrix):
+    """x [..., K] @ qm ([K, N]) -> [..., N]. Pallas on TPU (int8 HBM reads,
+    VMEM dequant into the MXU); jnp dequant-matmul elsewhere."""
+    from .dispatch import pallas_enabled
+
+    if qm.ndim != 2:
+        raise ValueError(f"quant_matmul needs a 2D weight, got {qm.shape} "
+                         "(stacked weights are sliced by lax.scan)")
+    K, N = qm.shape
+    if (pallas_enabled() and x.shape[-1] == K and K % qm.group_size == 0
+            and N % 128 == 0 and qm.group_size % 128 == 0):
+        try:
+            return _quant_matmul_pallas(x, qm)
+        except Exception:  # pragma: no cover - fallback safety
+            pass
+    import jax.numpy as jnp
+
+    return (x.astype(jnp.float32) @ qm.dequantize().astype(jnp.float32)).astype(qm.dtype)
+
+
+def _quant_matmul_pallas(x, qm: QuantizedMatrix, block_m: int = 256,
+                         block_n: int = 256, interpret: bool = False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    K, N = qm.shape
+    gs = qm.group_size
+    orig_shape = x.shape
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    bm = min(block_m, max(8, M))
+    bn = min(block_n, N)
+    while N % bn:
+        bn //= 2
+    bk = gs                                                     # one scale row per k-block
+    m_pad = -M % bm
+    if m_pad:
+        x2 = jnp.pad(x2, ((0, m_pad), (0, 0)))
+    Mp = x2.shape[0]
+    nk = K // bk
+
+    def kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
+        k = pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        w = q_ref[...].astype(jnp.float32) * s_ref[...]          # [bk,bn]*[1,bn]
+        acc_ref[...] += jax.lax.dot(
+            x_ref[...].astype(jnp.float32), w,
+            preferred_element_type=jnp.float32)
+
+        @pl.when(k == nk - 1)
+        def _emit():
+            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(Mp // bm, N // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, N), qm.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x2, qm.q, qm.scales)
+    if m_pad:
+        out = out[:M]
+    return out.reshape(*orig_shape[:-1], N)

@@ -99,3 +99,73 @@ def test_mixtral_style_training(devices8):
     batch = {"input_ids": rng.integers(0, 128, size=(8, 32)).astype(np.int32)}
     losses = [float(engine.train_batch(batch)) for _ in range(10)]
     assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------------
+# Dropless ragged grouped-GEMM experts (reference cutlass moe_gemm /
+# megablocks; SURVEY §2.13 — r2 VERDICT missing #6 "grouped GEMM kernels")
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_matches_capacity_when_nothing_drops():
+    """With generous capacity the GShard einsum path and the ragged
+    grouped-GEMM path compute the same mixture (same top-k rule, same
+    normalization)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.moe.layer import init_expert_mlp, moe_layer
+
+    rng = np.random.default_rng(0)
+    E, M, F, S = 4, 32, 64, 24
+    params = init_expert_mlp(jax.random.PRNGKey(0), E, M, F, "swiglu")
+    gate_w = jnp.asarray(rng.standard_normal((M, E)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((S, M)), jnp.float32)
+
+    cap = moe_layer(gate_w, params, x, k=2, capacity_factor=64.0, impl="capacity")
+    rag = moe_layer(gate_w, params, x, k=2, impl="ragged")
+    np.testing.assert_allclose(np.asarray(rag.output), np.asarray(cap.output),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(rag.aux_loss), float(cap.aux_loss), rtol=1e-5)
+    assert float(rag.metadata["drop_fraction"]) == 0.0
+    np.testing.assert_array_equal(np.asarray(rag.metadata["expert_counts"]),
+                                  np.asarray(cap.metadata["expert_counts"]))
+
+
+def test_ragged_never_drops_under_pressure():
+    """At capacity_factor=1 with skewed routing the capacity path drops
+    tokens; ragged keeps them all."""
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.moe.layer import init_expert_mlp, moe_layer
+
+    E, M, F, S = 4, 16, 32, 64
+    params = init_expert_mlp(jax.random.PRNGKey(1), E, M, F, "swiglu")
+    # gate that routes everything to expert 0
+    gate_w = jnp.zeros((M, E), jnp.float32).at[:, 0].set(1.0)
+    x = jnp.abs(jnp.asarray(np.random.default_rng(1).standard_normal((S, M)), jnp.float32))
+    cap = moe_layer(gate_w, params, x, k=1, capacity_factor=1.0, impl="capacity")
+    rag = moe_layer(gate_w, params, x, k=1, impl="ragged")
+    assert float(cap.metadata["drop_fraction"]) > 0.5
+    assert float(rag.metadata["drop_fraction"]) == 0.0
+    assert int(np.asarray(rag.metadata["expert_counts"])[0]) == S
+
+
+def test_moe_model_trains_with_ragged_impl(devices8):
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny_moe
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    reset_topology()
+    model = Transformer(tiny_moe(vocab=64, d=32, layers=2, heads=2, seq=32,
+                                 experts=4, moe_impl="ragged"))
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9})
+    b = {"input_ids": np.random.default_rng(0).integers(0, 64, size=(8, 32)).astype(np.int32)}
+    l0 = float(engine.train_batch(b))
+    for _ in range(3):
+        l1 = float(engine.train_batch(b))
+    assert np.isfinite(l1) and l1 < l0

@@ -139,3 +139,67 @@ def test_rmsnorm_custom_vjp_matches_autodiff(monkeypatch):
     np.testing.assert_allclose(np.asarray(dx_c), np.asarray(dx_r), rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(dw_c), np.asarray(dw_r), rtol=2e-5, atol=2e-5)
     rn._VJP_CACHE.clear()
+
+
+def test_quantized_matrix_matmul_parity():
+    """int8-storage weight matmul (reference cutlass mixed_gemm, SURVEY
+    §2.13): y @ QuantizedMatrix dispatches to the quantized path and tracks
+    the dense product within int8 rounding."""
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.quant_matmul import quantize_weight
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((512, 256)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((3, 7, 512)), jnp.float32)
+    qm = quantize_weight(w, group_size=128)
+    assert qm.nbytes < w.nbytes / 1.9          # the storage win
+    out = jax.jit(lambda x, qm: x @ qm)(x, qm)
+    ref = x @ w
+    denom = float(jnp.abs(ref).max())
+    assert float(jnp.abs(out - ref).max()) / denom < 0.02
+    # dequantize() round-trips the storage exactly
+    np.testing.assert_allclose(np.asarray(x @ qm.dequantize()), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quant_matmul_pallas_interpret_matches_fallback():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.quant_matmul import (_quant_matmul_pallas,
+                                                       quantize_weight)
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((19, 256)), jnp.float32)  # ragged M pads
+    qm = quantize_weight(w, group_size=128)
+    got = _quant_matmul_pallas(x, qm, interpret=True)
+    ref = x @ qm.dequantize()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_serving_generates():
+    """The v1 engine with quantize_weights=True stores int8 layer weights
+    and still generates exactly like an engine fed the dequantized dense
+    weights (same rounding by construction)."""
+    import jax
+
+    from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngine
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.ops.quant_matmul import QuantizedMatrix
+
+    model = Transformer(tiny(vocab=64, d=64, layers=2, heads=4, seq=64))
+    params = model.init(jax.random.PRNGKey(0))
+    eng_q = InferenceEngine(model, params, InferenceConfig(
+        dtype="float32", max_seq_len=64, quantize_weights=True))
+    assert isinstance(eng_q.params["layers"]["wq"], QuantizedMatrix)
+
+    deq = jax.tree.map(
+        lambda p: p.dequantize() if isinstance(p, QuantizedMatrix) else p,
+        eng_q.params, is_leaf=lambda p: isinstance(p, QuantizedMatrix))
+    eng_d = InferenceEngine(model, deq, InferenceConfig(dtype="float32", max_seq_len=64))
+    prompts = np.random.default_rng(2).integers(0, 64, size=(2, 8)).astype(np.int32)
+    out_q = eng_q.generate(prompts, max_new_tokens=6)
+    out_d = eng_d.generate(prompts, max_new_tokens=6)
+    np.testing.assert_array_equal(out_q, out_d)
