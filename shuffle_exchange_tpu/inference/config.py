@@ -35,7 +35,10 @@ class InferenceConfig:
     top_p: float = 1.0                        # 1 = off
     # kernels
     attention_impl: str = "auto"              # reference replace_with_kernel_inject
-    # quantization (reference quant.enabled / FP6): int8 weight-only supported
+    # quantization (reference quant.enabled / FP6): int8 weight-only.
+    # Layer matmul weights use int8 STORAGE (QuantizedMatrix + Pallas
+    # kernel) with groups capped at 256 along K (one scale row per kernel
+    # K-block); larger values apply to the moe/unembed rounding path.
     quantize_weights: bool = False
     quant_group_size: int = 2048
     # v2 paged KV (reference ragged/kv_cache.py BlockedKVCache)

@@ -226,13 +226,11 @@ class InferenceEngine:
         from ..utils.logging import warning_once
 
         gs = self.config.quant_group_size
-        if gs > 256:
-            # the Pallas quantized matmul uses one scale row per K-block
-            # (block = group); 256 is its largest MXU-friendly group
-            warning_once(f"quant_group_size={gs}: int8-STORAGE weights use "
-                         "group_size=256 (kernel K-block bound); the "
-                         "configured value still applies to moe/unembed "
-                         "rounding")
+        # storage weights group along K with one scale row per kernel
+        # K-block; 256 is the largest MXU-friendly group (see
+        # InferenceConfig.quant_group_size docs) — larger configured values
+        # apply to the moe/unembed rounding path only
+        storage_gs = min(gs, 256)
         storage_names = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
         qdq_names = {"moe_w_gate", "moe_w_up", "moe_w_down", "unembed"}
         dtype = self.config.jax_dtype()
@@ -242,7 +240,12 @@ class InferenceEngine:
                 out = {}
                 for k, v in tree.items():
                     if k in storage_names:
-                        out[k] = quantize_weight(v, group_size=min(gs, 256), dtype=dtype)
+                        try:
+                            out[k] = quantize_weight(v, group_size=storage_gs, dtype=dtype)
+                        except ValueError as e:
+                            warning_once(f"weight {k}: {e}; using "
+                                         "quantize-dequantize rounding instead")
+                            out[k] = quantize_dequantize(v, group_size=gs).astype(v.dtype)
                     elif k in qdq_names:
                         out[k] = quantize_dequantize(v, group_size=gs).astype(v.dtype)
                     else:

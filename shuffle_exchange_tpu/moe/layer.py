@@ -136,6 +136,14 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
 
             ep = get_topology().size(expert_axis) if topology_is_initialized() else 1
         impl = "capacity" if ep > 1 else "ragged"
+        if impl == "ragged":
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "moe_impl=auto resolved to the dropless ragged grouped-GEMM "
+                "path (no expert axis > 1): capacity_factor/min_capacity/"
+                "drop semantics do not apply — set moe_impl='capacity' to "
+                "keep GShard capacity/drop behavior")
     if impl == "ragged":
         from .gating import topk_select
 

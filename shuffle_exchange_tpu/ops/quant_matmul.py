@@ -86,10 +86,12 @@ def quantize_weight(w, group_size: int = 256, dtype=None) -> QuantizedMatrix:
     import jax.numpy as jnp
 
     *lead, K, N = w.shape
-    while K % group_size:
+    while K % group_size and group_size >= 64:
         group_size //= 2
-    if group_size < 1:
-        raise ValueError(f"no valid group size for K={K}")
+    if K % group_size:
+        # below 32-wide groups the fp32 scales erase the int8 storage win
+        raise ValueError(f"no MXU-friendly group size divides K={K}; "
+                         "keep this weight dense")
     wg = w.astype(jnp.float32).reshape(*lead, K // group_size, group_size, N)
     absmax = jnp.max(jnp.abs(wg), axis=-2)                       # [..., Kg, N]
     scales = jnp.where(absmax > 0, absmax / 127.0, 1.0)
@@ -106,13 +108,23 @@ def quant_matmul(x, qm: QuantizedMatrix):
     if qm.ndim != 2:
         raise ValueError(f"quant_matmul needs a 2D weight, got {qm.shape} "
                          "(stacked weights are sliced by lax.scan)")
+    from ..utils.logging import warning_once
+
     K, N = qm.shape
-    if (pallas_enabled() and x.shape[-1] == K and K % qm.group_size == 0
-            and N % 128 == 0 and qm.group_size % 128 == 0):
-        try:
-            return _quant_matmul_pallas(x, qm)
-        except Exception:  # pragma: no cover - fallback safety
-            pass
+    if pallas_enabled():
+        if x.shape[-1] == K and K % qm.group_size == 0 and N % 128 == 0 \
+                and qm.group_size % 128 == 0:
+            try:
+                return _quant_matmul_pallas(x, qm)
+            except Exception as e:  # pragma: no cover - fallback safety
+                warning_once(f"quantized matmul kernel failed "
+                             f"({type(e).__name__}); dense-dequant fallback "
+                             f"for [{K}x{N}] weights")
+        else:
+            warning_once(f"quantized matmul [{K}x{N}] gs={qm.group_size} not "
+                         "kernel-eligible (needs N%128==0 and group%128==0); "
+                         "dense-dequant fallback — slower than unquantized "
+                         "serving, consider quantize_weights=False here")
     import jax.numpy as jnp
 
     return (x.astype(jnp.float32) @ qm.dequantize().astype(jnp.float32)).astype(qm.dtype)

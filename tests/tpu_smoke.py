@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""On-chip kernel parity smoke: run every Pallas kernel against its jnp
+oracle on the real TPU (SURVEY.md §4b — the reference's kernel parity tests
+compare fused CUDA ops vs torch).
+
+Run directly (the default platform is the tunneled chip):
+    python tests/tpu_smoke.py
+Exits non-zero on any parity failure; prints one line per kernel.
+"""
+
+import sys
+
+import numpy as np
+
+
+def _check(name, got, want, tol):
+    err = float(np.max(np.abs(np.asarray(got, np.float32) - np.asarray(want, np.float32))))
+    ok = err <= tol
+    print(f"{'PASS' if ok else 'FAIL'} {name}: max abs err {err:.2e} (tol {tol:.0e})")
+    return ok
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print(f"not a TPU backend ({jax.default_backend()}); nothing to smoke")
+        return 0
+    rng = np.random.default_rng(0)
+    ok = True
+
+    # flash attention (MHA, stock kernel) + splash (GQA, unexpanded KV)
+    from shuffle_exchange_tpu.ops.flash_attention import (pallas_attention,
+                                                          reference_attention)
+
+    for (H, KV, label) in [(8, 8, "flash-mha"), (8, 2, "splash-gqa")]:
+        q = jnp.asarray(rng.standard_normal((2, 256, H, 128)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((2, 256, KV, 128)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((2, 256, KV, 128)), jnp.float32)
+        ok &= _check(label, pallas_attention(q, k, v, causal=True),
+                     reference_attention(q, k, v, causal=True), 5e-2)
+        g_p = jax.grad(lambda q, k, v: (pallas_attention(q, k, v) ** 2).sum(),
+                       argnums=1)(q, k, v)
+        g_r = jax.grad(lambda q, k, v: (reference_attention(q, k, v) ** 2).sum(),
+                       argnums=1)(q, k, v)
+        ok &= _check(label + "-dk", g_p, g_r, 5e-1)
+
+    # rmsnorm fwd + custom VJP
+    from shuffle_exchange_tpu.ops.rmsnorm import rmsnorm, rmsnorm_reference
+
+    x = jnp.asarray(rng.standard_normal((4, 256, 512)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((512,)), jnp.float32)
+    ok &= _check("rmsnorm", rmsnorm(x, w), rmsnorm_reference(x, w), 1e-4)
+    gp = jax.grad(lambda x, w: rmsnorm(x, w).sum(), argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: rmsnorm_reference(x, w).sum(), argnums=(0, 1))(x, w)
+    ok &= _check("rmsnorm-dx", gp[0], gr[0], 1e-3)
+    ok &= _check("rmsnorm-dw", gp[1], gr[1], 1e-2)
+
+    # fused AdamW
+    from shuffle_exchange_tpu.ops.fused_adam import _reference_update, fused_adamw_update
+
+    p = jnp.asarray(rng.standard_normal((1000, 300)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((1000, 300)), jnp.float32)
+    m = jnp.zeros_like(p)
+    vv = jnp.zeros_like(p)
+    got = fused_adamw_update(p, g, m, vv, lr=1e-2, weight_decay=0.1, step=3)
+    want = _reference_update(p, g, m, vv, lr=1e-2, b1=0.9, b2=0.999, eps=1e-8,
+                             weight_decay=0.1, step=3)
+    for a, b, nm in zip(got, want, ("p", "m", "v")):
+        ok &= _check(f"fused-adam-{nm}", a, b, 1e-5)
+
+    # paged decode + extend kernels (GQA)
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_paged_attention import _extend_oracle, _mk, _oracle
+
+    from shuffle_exchange_tpu.ops.paged_attention import (
+        paged_decode_attention_pallas, paged_extend_attention_pallas)
+
+    q, ck, cv, bt, kvl = _mk(3, 24, 8, 64, 64, 40, [33, 200, 64])
+    ok &= _check("paged-decode", paged_decode_attention_pallas(q, ck, cv, bt, kvl),
+                 _oracle(q, ck, cv, bt, kvl), 5e-3)
+    starts = jnp.asarray([5, 0, 30], jnp.int32)
+    nnew = jnp.asarray([8, 3, 6], jnp.int32)
+    qc = jnp.asarray(rng.standard_normal((3, 8, 24, 64)), jnp.float32)
+    got = paged_extend_attention_pallas(qc, ck, cv, bt, starts, nnew)
+    want = _extend_oracle(qc, ck, cv, bt, starts, nnew)
+    errs = [float(np.max(np.abs(np.asarray(got)[b, :n] - np.asarray(want)[b, :n])))
+            for b, n in enumerate([8, 3, 6])]
+    ok &= _check("paged-extend", np.asarray(errs), np.zeros(3), 5e-3)
+
+    # int8 quantized matmul
+    from shuffle_exchange_tpu.ops.quant_matmul import _quant_matmul_pallas, quantize_weight
+
+    wd = jnp.asarray(rng.standard_normal((512, 256)), jnp.float32)
+    xq = jnp.asarray(rng.standard_normal((64, 512)), jnp.float32)
+    qm = quantize_weight(wd, group_size=128)
+    ok &= _check("quant-matmul", _quant_matmul_pallas(xq, qm), xq @ qm.dequantize(), 5e-3)
+
+    print("TPU smoke:", "ALL PASS" if ok else "FAILURES")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
