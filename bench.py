@@ -186,6 +186,9 @@ def pick_config2(hbm: int):
 
 def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
                 peak_flops, n_chips):
+    """For MoE models (model.config.n_experts > 0) the 6*N*T FLOPs model
+    bills only the ACTIVATED expert params (top-k routing runs k/E of the
+    expert FLOPs)."""
     import jax.tree_util as jtu
 
     import shuffle_exchange_tpu as sxt
@@ -216,10 +219,19 @@ def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
 
     tokens_per_step = batch_size * (seq_len - 1)
     tps_chip = tokens_per_step * steps / total / n_chips
-    n_params = sum(int(np.prod(l.shape)) for l in jtu.tree_leaves(engine.state.master))
-    if engine.ensemble:
+    master = engine.state.master
+    n_params = sum(int(np.prod(l.shape)) for l in jtu.tree_leaves(master))
+    expert = sum(int(np.prod(l.shape))
+                 for name, l in master.get("layers", {}).items()
+                 if name.startswith("moe_") and name != "moe_gate")
+    if engine.ensemble:   # leading replica dim on every leaf
         n_params //= engine.replicas
-    mfu = 6.0 * n_params * tps_chip / peak_flops
+        expert //= engine.replicas
+    n_active = n_params
+    mcfg = getattr(model, "config", None)
+    if mcfg is not None and getattr(mcfg, "n_experts", 0) > 0:
+        n_active = n_params - expert + expert * mcfg.moe_top_k // mcfg.n_experts
+    mfu = 6.0 * n_active * tps_chip / peak_flops
     return {
         "config": label,
         "params_m": round(n_params / 1e6, 1),
@@ -406,6 +418,32 @@ def main():
                 steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
         except Exception as e:
             errors["config2"] = _short_err(e)
+
+        # -- config #3 (MoE expert-parallel, scaled to one chip) ---------
+        try:
+            from shuffle_exchange_tpu.models import TransformerConfig
+
+            mcfg3 = TransformerConfig(
+                vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+                n_kv_heads=8, max_seq_len=2048, activation="swiglu",
+                norm="rmsnorm", position="rope", tie_embeddings=True,
+                n_experts=8, moe_top_k=2, remat=True,
+                remat_policy="nothing_saveable")
+            cfg3 = {
+                "train_batch_size": 8,
+                "optimizer": {"type": "FusedAdam",
+                              "params": {"lr": 3e-4, "weight_decay": 0.1}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10**9,
+            }
+            rows["config3_moe_8x"] = bench_train(
+                "mixtral-style 8-expert top-2 (scaled; 8x7B does not fit 1 chip)",
+                Transformer(mcfg3), cfg3, batch_size=8, seq_len=2048,
+                steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
+            rows["config3_moe_8x"]["note"] = "mfu bills activated (top-k/E) expert params"
+        except Exception as e:
+            errors["config3"] = _short_err(e)
 
         # -- config #5 (serving) ----------------------------------------
         try:

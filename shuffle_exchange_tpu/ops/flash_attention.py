@@ -74,7 +74,7 @@ def reference_attention(q, k, v, causal: bool = True, segment_ids=None,
 
 
 def splash_attention_gqa(q, k, v, causal: bool = True, segment_ids=None,
-                         interpret: bool = False):
+                         interpret: bool = False, mask_np=None):
     """GQA/MQA flash attention with UNEXPANDED KV (splash MQA kernel).
 
     The stock flash kernel needs KV repeated to H heads; splash's MQA form
@@ -97,8 +97,13 @@ def splash_attention_gqa(q, k, v, causal: bool = True, segment_ids=None,
         block_q=bq, block_kv=bkv, block_kv_compute=bkv,
         block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
         block_q_dq=bq, block_kv_dq=bkv)
-    mask_cls = sa.CausalMask if causal else sa.FullMask
-    mask = sa.MultiHeadMask([mask_cls((T, S)) for _ in range(G)])
+    if mask_np is not None:
+        # arbitrary [T, S] bool mask (blocksparse layouts): splash skips
+        # fully-masked blocks — real block skipping, not just masking
+        head_mask = sa.NumpyMask(mask_np)
+    else:
+        head_mask = (sa.CausalMask((T, S)) if causal else sa.FullMask((T, S)))
+    mask = sa.MultiHeadMask([head_mask for _ in range(G)])
     kernel = sa.make_splash_mqa_single_device(mask, block_sizes=block_sizes,
                                               interpret=interpret)
 

@@ -142,11 +142,14 @@ class VariableSparsityConfig(SparsityConfig):
 
 
 def sparse_attention(q, k, v, config: Optional[SparsityConfig] = None, causal: bool = True,
-                     layout: Optional[np.ndarray] = None):
+                     layout: Optional[np.ndarray] = None, impl: str = "auto"):
     """Blocksparse attention. q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D].
 
     ``config`` builds the layout from T (or pass a precomputed block
     ``layout`` [T/bs, S/bs] bool with its block size in ``config.block``).
+    On TPU the layout routes through the splash kernel as a NumpyMask —
+    fully-masked blocks are SKIPPED (the reference's triton blocksparse
+    win), not just masked; elsewhere the dense fp32-softmax fallback.
     """
     import jax
     import jax.numpy as jnp
@@ -159,15 +162,39 @@ def sparse_attention(q, k, v, config: Optional[SparsityConfig] = None, causal: b
             raise ValueError("sparse_attention with auto layout expects T == S")
         layout = config.make_layout(T)
     bs = config.block
+
+    # Block layout -> element mask (numpy: splash masks are host-built),
+    # + causal inside allowed blocks.
+    elem_np = np.kron(np.asarray(layout, bool), np.ones((bs, bs), bool))[:T, :S]
+    if causal:
+        elem_np = elem_np & np.tril(np.ones((T, S), bool), k=S - T)
+
+    if impl in ("auto", "splash"):
+        from ..utils.logging import warning_once
+        from .dispatch import pallas_enabled
+        from .flash_attention import splash_attention_gqa
+
+        eligible = (D % 64 == 0 and T % 128 == 0 and S % 128 == 0
+                    and elem_np.any(axis=1).all())
+        if impl == "splash" and not eligible:
+            raise ValueError(
+                f"impl='splash' needs D%64==0, T/S%128==0 and no fully-masked "
+                f"query row (got T={T}, S={S}, D={D})")
+        if eligible and (impl == "splash" or pallas_enabled()):
+            try:
+                return splash_attention_gqa(q, k, v, causal=False,
+                                            mask_np=elem_np,
+                                            interpret=impl == "splash" and not pallas_enabled())
+            except Exception as e:  # pragma: no cover - fallback safety
+                if impl == "splash":
+                    raise
+                warning_once(f"splash blocksparse unavailable "
+                             f"({type(e).__name__}); dense-mask fallback")
+
     n_rep = H // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
-
-    # Block layout -> element mask, + causal inside allowed blocks.
-    elem_mask = np.kron(layout, np.ones((bs, bs), bool))[:T, :S]
-    mask = jnp.asarray(elem_mask)
-    if causal:
-        mask = mask & jnp.tril(jnp.ones((T, S), bool), k=S - T)
+    mask = jnp.asarray(elem_np)
 
     scale = D ** -0.5
     logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
