@@ -339,22 +339,120 @@ def publish(rows, calib_record, on_tpu: bool):
         f.write("\n")
 
 
-def main():
-    import jax
-
-    import shuffle_exchange_tpu  # noqa: F401  (import check)
+def _config1(peak, hbm, n_chips, on_tpu):
     from shuffle_exchange_tpu.models import Transformer, gpt2_small, tiny
 
-    platform = jax.default_backend()
-    on_tpu = platform == "tpu"
-    dev = jax.devices()[0]
-    n_chips = len(jax.devices())
-    peak = chip_peak_flops(dev, platform)
-    hbm = hbm_bytes(dev)
+    cfg1 = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10**9,
+    }
+    if on_tpu:
+        return "config1_gpt2_125m_zero1", bench_train(
+            "gpt2-125M zero1 bf16", Transformer(gpt2_small()), cfg1,
+            batch_size=8, seq_len=1024, steps=15, warmup=3,
+            peak_flops=peak, n_chips=n_chips)
+    return "config1_tiny_cpu", bench_train(
+        "tiny-cpu zero1", Transformer(tiny(vocab=512, d=128, layers=2, heads=4, seq=128)),
+        cfg1, batch_size=8, seq_len=128, steps=5, warmup=1,
+        peak_flops=peak, n_chips=n_chips)
 
+
+def _config2(peak, hbm, n_chips, on_tpu):
+    from shuffle_exchange_tpu.models import Transformer
+
+    name2, mcfg2 = pick_config2(hbm)
+    # full per-layer remat: dots_saveable keeps every matmul output
+    # (~1.2GB/layer at bs 8 x 4096) and OOMs a 16GB chip; saving only
+    # the residual stream costs ~33% recompute FLOPs and fits
+    mcfg2 = dataclasses.replace(mcfg2, remat=True,
+                                remat_policy="nothing_saveable",
+                                max_seq_len=4096)
+    cfg2 = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9,
+    }
+    return "config2_llama3_zero3_fused_adam", bench_train(
+        f"{name2} zero3 + pallas fused adam (8B does not fit 1 chip; scaled)",
+        Transformer(mcfg2), cfg2, batch_size=8, seq_len=4096,
+        steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
+
+
+def _config3(peak, hbm, n_chips, on_tpu):
+    from shuffle_exchange_tpu.models import Transformer, TransformerConfig
+
+    mcfg3 = TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=8, max_seq_len=2048, activation="swiglu",
+        norm="rmsnorm", position="rope", tie_embeddings=True,
+        n_experts=8, moe_top_k=2, remat=True,
+        remat_policy="nothing_saveable")
+    cfg3 = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10**9,
+    }
+    row = bench_train(
+        "mixtral-style 8-expert top-2 (scaled; 8x7B does not fit 1 chip)",
+        Transformer(mcfg3), cfg3, batch_size=8, seq_len=2048,
+        steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
+    row["note"] = "mfu bills activated (top-k/E) expert params"
+    return "config3_moe_8x", row
+
+
+def _config5(peak, hbm, n_chips, on_tpu):
+    name5, mcfg5 = pick_config2(hbm)
+    return "config5_paged_serving", bench_serving(
+        f"{name5} engine_v2 paged serving", mcfg5, peak)
+
+
+_CONFIGS = {"1": _config1, "2": _config2, "3": _config3, "5": _config5}
+# per-config wall budgets (compile through the remote tunnel is the risk):
+# a stuck compile must cost one config, not the whole bench
+_BUDGET_S = {"1": 480, "2": 1200, "3": 900, "5": 900}
+
+
+def _hw():
+    import jax
+
+    if os.environ.get("SXT_BENCH_PLATFORM"):
+        # dev override (e.g. =cpu): the image sitecustomize pins the tunneled
+        # platform before argv parsing, so an env knob is the only seam
+        jax.config.update("jax_platforms", os.environ["SXT_BENCH_PLATFORM"])
+    platform = jax.default_backend()
+    dev = jax.devices()[0]
+    return (platform == "tpu", dev, len(jax.devices()),
+            chip_peak_flops(dev, platform), hbm_bytes(dev))
+
+
+def _run_one_config(which: str) -> None:
+    """Subprocess entry: run one config, print ONE {"row_key", "row"} line."""
+    on_tpu, dev, n_chips, peak, hbm = _hw()
+    key, row = _CONFIGS[which](peak, hbm, n_chips, on_tpu)
+    print("SXT_ROW " + json.dumps({"row_key": key, "row": row}), flush=True)
+
+
+def main():
+    import subprocess
+    import sys
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
+        _run_one_config(sys.argv[2])
+        return
+
+    on_tpu, dev, n_chips, peak, hbm = _hw()
     rows, errors = {}, {}
 
-    # -- calibration ----------------------------------------------------
+    # -- calibration (in-process: small, fast, must gate everything) ----
     if on_tpu:
         try:
             achieved, rtt, cal_ok = calibrate(peak)
@@ -364,7 +462,7 @@ def main():
     else:
         achieved, rtt, cal_ok = 0.0, 0.0, True  # CPU: no peak model; skip the gate
     calib_record = {
-        "chip": getattr(dev, "device_kind", platform),
+        "chip": getattr(dev, "device_kind", "cpu"),
         "peak_tflops_assumed": round(peak / 1e12, 1),
         "matmul_chain_tflops": round(achieved / 1e12, 1),
         "host_sync_rtt_ms": round(rtt * 1000, 2),
@@ -372,91 +470,31 @@ def main():
         "ok": bool(cal_ok),
     }
 
-    # -- config #1 ------------------------------------------------------
-    cfg1 = {
-        "train_batch_size": 8,
-        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
-        "steps_per_print": 10**9,
-    }
-    try:
-        if on_tpu:
-            rows["config1_gpt2_125m_zero1"] = bench_train(
-                "gpt2-125M zero1 bf16", Transformer(gpt2_small()), cfg1,
-                batch_size=8, seq_len=1024, steps=15, warmup=3,
-                peak_flops=peak, n_chips=n_chips)
-        else:
-            rows["config1_tiny_cpu"] = bench_train(
-                "tiny-cpu zero1", Transformer(tiny(vocab=512, d=128, layers=2, heads=4, seq=128)),
-                cfg1, batch_size=8, seq_len=128, steps=5, warmup=1,
-                peak_flops=peak, n_chips=n_chips)
-    except Exception as e:
-        errors["config1"] = _short_err(e)
-
-    # -- config #2 (north star, scaled to chip) -------------------------
-    if on_tpu:
+    # -- configs, each in its OWN subprocess with a wall budget ---------
+    # (a hung remote compile or an OOM kills one config, not the bench;
+    # rows publish incrementally so a driver-level timeout keeps them)
+    which = ["1", "2", "3", "5"] if on_tpu else ["1"]
+    for w in which:
         try:
-            name2, mcfg2 = pick_config2(hbm)
-            # full per-layer remat: dots_saveable keeps every matmul output
-            # (~1.2GB/layer at bs 8 x 4096) and OOMs a 16GB chip; saving only
-            # the residual stream costs ~33% recompute FLOPs and fits
-            mcfg2 = dataclasses.replace(mcfg2, remat=True,
-                                        remat_policy="nothing_saveable",
-                                        max_seq_len=4096)
-            cfg2 = {
-                "train_batch_size": 8,
-                "optimizer": {"type": "FusedAdam",
-                              "params": {"lr": 3e-4, "weight_decay": 0.1}},
-                "bf16": {"enabled": True},
-                "zero_optimization": {"stage": 3},
-                "steps_per_print": 10**9,
-            }
-            rows["config2_llama3_zero3_fused_adam"] = bench_train(
-                f"{name2} zero3 + pallas fused adam (8B does not fit 1 chip; scaled)",
-                Transformer(mcfg2), cfg2, batch_size=8, seq_len=4096,
-                steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--config", w],
+                capture_output=True, text=True, timeout=_BUDGET_S[w])
+            line = next((l for l in reversed(proc.stdout.splitlines())
+                         if l.startswith("SXT_ROW ")), None)
+            if proc.returncode == 0 and line:
+                parsed = json.loads(line[len("SXT_ROW "):])
+                rows[parsed["row_key"]] = parsed["row"]
+            else:
+                tail = " ".join((proc.stderr or proc.stdout).split())[-300:]
+                errors[f"config{w}"] = f"rc={proc.returncode}: {tail}"
+        except subprocess.TimeoutExpired:
+            errors[f"config{w}"] = f"timeout after {_BUDGET_S[w]}s (budgeted)"
         except Exception as e:
-            errors["config2"] = _short_err(e)
-
-        # -- config #3 (MoE expert-parallel, scaled to one chip) ---------
+            errors[f"config{w}"] = _short_err(e)
         try:
-            from shuffle_exchange_tpu.models import TransformerConfig
-
-            mcfg3 = TransformerConfig(
-                vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
-                n_kv_heads=8, max_seq_len=2048, activation="swiglu",
-                norm="rmsnorm", position="rope", tie_embeddings=True,
-                n_experts=8, moe_top_k=2, remat=True,
-                remat_policy="nothing_saveable")
-            cfg3 = {
-                "train_batch_size": 8,
-                "optimizer": {"type": "FusedAdam",
-                              "params": {"lr": 3e-4, "weight_decay": 0.1}},
-                "bf16": {"enabled": True},
-                "zero_optimization": {"stage": 2},
-                "steps_per_print": 10**9,
-            }
-            rows["config3_moe_8x"] = bench_train(
-                "mixtral-style 8-expert top-2 (scaled; 8x7B does not fit 1 chip)",
-                Transformer(mcfg3), cfg3, batch_size=8, seq_len=2048,
-                steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
-            rows["config3_moe_8x"]["note"] = "mfu bills activated (top-k/E) expert params"
-        except Exception as e:
-            errors["config3"] = _short_err(e)
-
-        # -- config #5 (serving) ----------------------------------------
-        try:
-            name5, mcfg5 = pick_config2(hbm)
-            rows["config5_paged_serving"] = bench_serving(
-                f"{name5} engine_v2 paged serving", mcfg5, peak)
-        except Exception as e:
-            errors["config5"] = _short_err(e)
-
-    try:
-        publish(rows, calib_record, on_tpu)
-    except OSError as e:  # never break the one-JSON-line contract
-        errors["publish"] = _short_err(e)
+            publish(rows, calib_record, on_tpu)   # incremental
+        except OSError as e:
+            errors["publish"] = _short_err(e)
 
     # -- headline line --------------------------------------------------
     head = rows.get("config2_llama3_zero3_fused_adam") or next(iter(rows.values()), None)
@@ -482,7 +520,7 @@ def main():
         "unit": head.get("unit", "tokens/s/chip"),
         "valid": valid,
     }
-    if valid and "mfu_pct" in head:
+    if valid and on_tpu and "mfu_pct" in head:
         result["vs_baseline"] = round(head["mfu_pct"] / 100.0 / 0.45, 4)
     if errors:
         result["errors"] = errors
