@@ -17,12 +17,9 @@ from __future__ import annotations
 from typing import Tuple
 
 
-def quantize_int8(x, group_size: int = 2048) -> Tuple["jax.Array", "jax.Array"]:
-    """x (any shape) -> (q int8 flat-grouped, scales f32 [groups]).
-
-    The trailing partial group is zero-padded; ``dequantize_int8`` takes the
-    original shape to unpad.
-    """
+def _group_scale(x, group_size: int, max_val: float):
+    """Shared flatten/pad/group/absmax scaffolding: -> (g [groups, group],
+    scale [groups, 1]) with each group's absmax mapped to ``max_val``."""
     import jax.numpy as jnp
 
     flat = x.reshape(-1).astype(jnp.float32)
@@ -33,7 +30,19 @@ def quantize_int8(x, group_size: int = 2048) -> Tuple["jax.Array", "jax.Array"]:
         flat = jnp.pad(flat, (0, pad))
     g = flat.reshape(groups, group_size)
     absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    scale = jnp.where(absmax > 0, absmax / max_val, 1.0)
+    return g, scale
+
+
+def quantize_int8(x, group_size: int = 2048) -> Tuple["jax.Array", "jax.Array"]:
+    """x (any shape) -> (q int8 flat-grouped, scales f32 [groups]).
+
+    The trailing partial group is zero-padded; ``dequantize_int8`` takes the
+    original shape to unpad.
+    """
+    import jax.numpy as jnp
+
+    g, scale = _group_scale(x, group_size, 127.0)
     q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
     return q, scale[:, 0]
 
@@ -53,3 +62,24 @@ def quantize_dequantize(x, group_size: int = 2048):
     """The round-trip used by quantized-collective simulations and tests."""
     q, s = quantize_int8(x, group_size)
     return dequantize_int8(q, s, x.shape, x.dtype)
+
+
+def quantize_fp8(x, group_size: int = 2048, dtype=None):
+    """Group-scaled fp8 quantization — the reference FPQuantizer's FP8 path
+    (``ops/fp_quantizer/quantize.py``, FPQuantizerBuilder, SURVEY.md §2.13).
+    Returns (q fp8 [groups, group], scales f32 [groups]); scales map each
+    group's absmax to the fp8 dtype's max normal (e4m3: 448)."""
+    import jax.numpy as jnp
+
+    fp8 = dtype or jnp.float8_e4m3fn
+    g, scale = _group_scale(x, group_size, float(jnp.finfo(fp8).max))
+    return (g / scale).astype(fp8), scale[:, 0]
+
+
+# same affine reconstruction as int8 (q * scale, unpad to shape)
+dequantize_fp8 = dequantize_int8
+
+
+def quantize_dequantize_fp8(x, group_size: int = 2048):
+    q, s = quantize_fp8(x, group_size)
+    return dequantize_fp8(q, s, x.shape, x.dtype)

@@ -1,9 +1,11 @@
-"""Profiling: flops profiler (reference ``profiling/flops_profiler/``)."""
+"""Profiling: flops profiler (reference ``profiling/flops_profiler/``) +
+XLA trace capture (the external-profiler/NVTX analog, SURVEY §5.1)."""
 
+from .trace import annotate, trace_annotation, xla_trace  # noqa: F401
 from .flops_profiler import (FlopsProfiler, compiled_flops, count_params,
                              flops_to_string, get_model_profile, number_to_string,
                              params_breakdown, params_to_string)
 
 __all__ = ["FlopsProfiler", "compiled_flops", "count_params", "flops_to_string",
            "get_model_profile", "number_to_string", "params_breakdown",
-           "params_to_string"]
+           "params_to_string", "xla_trace", "trace_annotation", "annotate"]

@@ -1,0 +1,58 @@
+"""XLA/TPU program traces (SURVEY §5.1: the reference leans on external
+profilers — nsys/torch profiler + NVTX ranges, ``utils/nvtx.py``; the
+TPU-native equivalent is the XLA profiler's TensorBoard trace, which
+captures device timelines, HLO op breakdowns, and host activity).
+
+Usage::
+
+    from shuffle_exchange_tpu.profiling import xla_trace
+
+    with xla_trace("traces/step100"):
+        engine.train_batch(batch)           # traced end to end
+
+    # or around an annotated region
+    with xla_trace("traces"), trace_annotation("generate"):
+        engine.generate(prompts)
+
+View with TensorBoard's profile plugin pointed at the log dir.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str):
+    """Capture an XLA profiler trace of the enclosed region into
+    ``logdir`` (TensorBoard profile format)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def trace_annotation(name: str):
+    """Named range inside a trace (the reference's ``@instrument_w_nvtx``
+    analog, utils/nvtx.py)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def annotate(name: str):
+    """Decorator form of :func:`trace_annotation`."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with trace_annotation(name):
+                return fn(*a, **k)
+
+        return wrapper
+
+    return deco

@@ -1004,6 +1004,28 @@ class Engine:
         if self.sync is not None:
             self.sync.reset_rings(rings)
 
+    def compile(self, batch=None, backend: Optional[str] = None) -> None:
+        """AOT-compile the fused train step (reference ``engine.compile()``,
+        runtime/engine.py:3970 — torch.compile + DeepCompile). Under XLA
+        every step is compiled anyway; this pays compilation NOW (before
+        step 1) for an example ``batch``, so the first timed step runs at
+        steady state. ``backend`` accepted for signature parity."""
+        if self._host_opt is not None or batch is None:
+            return  # nothing to pre-warm without an example batch
+        shaped = self._reshape_batch(batch)
+        lowered = self._train_step.lower(self.state, shaped, self._mix_matrix(),
+                                         self._next_rng_peek())
+        lowered.compile()
+        log_dist("engine.compile(): train step AOT-compiled", ranks=[0])
+
+    def _next_rng_peek(self):
+        """An rng key with the SAME structure train_batch will pass, without
+        advancing the host stream (compile() must not perturb training)."""
+        state = self._rng.bit_generator.state
+        key = self._next_rng()
+        self._rng.bit_generator.state = state
+        return key
+
     # -- introspection ---------------------------------------------------
 
     def module_weights(self, consensus: bool = True):

@@ -189,3 +189,27 @@ def test_decentralized_consensus_matches_sgd():
         l_ref = float(e_ref.train_batch(batch))
         l_rr = float(e_rr.train_batch(batch))
     np.testing.assert_allclose(l_ref, l_rr, rtol=2e-3)
+
+
+def test_engine_compile_aot_warmup(devices8):
+    """engine.compile(batch) pre-compiles the fused step (reference
+    engine.compile(), runtime/engine.py:3970) without advancing RNG or
+    counters — the subsequent trajectory is identical to not calling it."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    def build():
+        reset_topology()
+        e, *_ = sxt.initialize(model=_toy_model(), config={
+            "train_batch_size": 32,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "steps_per_print": 10**9})
+        return e
+
+    batch = _batch()
+    e1, e2 = build(), build()
+    e1.compile(batch)
+    assert e1.global_steps == 0
+    l1 = [float(e1.train_batch(batch)) for _ in range(2)]
+    l2 = [float(e2.train_batch(batch)) for _ in range(2)]
+    assert l1 == l2
