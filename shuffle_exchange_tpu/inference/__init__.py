@@ -11,7 +11,7 @@ Capability analog of the reference's two inference stacks:
 """
 
 from .config import InferenceConfig
-from .engine import InferenceEngine, init_inference
+from .engine import InferenceEngine, init_inference, load_serving_weights
 from .paged import BlockedAllocator, PagedKVCache
 from .engine_v2 import InferenceEngineV2, SequenceDescriptor
 
@@ -19,6 +19,7 @@ __all__ = [
     "InferenceConfig",
     "InferenceEngine",
     "init_inference",
+    "load_serving_weights",
     "BlockedAllocator",
     "PagedKVCache",
     "InferenceEngineV2",

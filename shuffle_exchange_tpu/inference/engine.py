@@ -487,12 +487,43 @@ class InferenceEngine:
         return jnp.concatenate([tok0[None], rest], axis=0).T  # [B, max_new]
 
 
-def init_inference(model=None, params=None, config=None, **kwargs) -> InferenceEngine:
+def load_serving_weights(ckpt_dir: str, model, tag: Optional[str] = None):
+    """Load the MODEL WEIGHTS item of a training checkpoint for serving
+    (reference: ``init_inference(checkpoint=...)`` + the mp-sharded
+    checkpoint loaders, ``runtime/state_dict_factory.py`` /
+    ``module_inject/load_checkpoint.py``). Works for checkpoints written by
+    either checkpoint engine; the optimizer bytes are never read."""
+    import os
+
+    import jax
+
+    from ..checkpoint.engine import (NativeCheckpointEngine, OrbaxCheckpointEngine,
+                                     read_latest_tag)
+
+    tag = tag or read_latest_tag(ckpt_dir)
+    if tag is None:
+        raise ValueError(f"no 'latest' tag in {ckpt_dir} and none given")
+    path = os.path.join(ckpt_dir, tag, "model")
+    target = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    errors = []
+    for eng in (OrbaxCheckpointEngine(), NativeCheckpointEngine()):
+        try:
+            return eng.load(path, target=target)
+        except Exception as e:
+            errors.append(f"{type(eng).__name__}: {type(e).__name__}")
+    raise ValueError(f"could not load {path} with any checkpoint engine ({errors})")
+
+
+def init_inference(model=None, params=None, config=None, checkpoint: Optional[str] = None,
+                   **kwargs) -> InferenceEngine:
     """Build an InferenceEngine (reference ``deepspeed.init_inference``,
     ``deepspeed/__init__.py:299``). ``config`` is a dict in the reference's
     inference-config format or an InferenceConfig. ``model`` may also be a
     HF checkpoint path or transformers model — the engine-factory dispatch
-    of the reference (inference/v2/engine_factory.py:32) via models/hf.py."""
+    of the reference (inference/v2/engine_factory.py:32) via models/hf.py.
+    ``checkpoint``: a training-checkpoint dir written by
+    ``engine.save_checkpoint`` — its weights item becomes the serving
+    params (the reference's checkpoint-loading serving path)."""
     if not isinstance(config, InferenceConfig):
         cfg_dict = dict(config or {})
         cfg_dict.update(kwargs)
@@ -501,6 +532,10 @@ def init_inference(model=None, params=None, config=None, **kwargs) -> InferenceE
         from ..models.hf import from_hf
 
         model, params = from_hf(model)
+    if checkpoint is not None:
+        if model is None:
+            raise ValueError("init_inference(checkpoint=...) needs the model object")
+        params = load_serving_weights(checkpoint, model)
     if params is None:
         raise ValueError("init_inference requires params (the model weights pytree)")
     log_dist(f"init_inference: dtype={config.dtype} tp={config.tensor_parallel} "

@@ -89,3 +89,33 @@ def test_load_module_only(tmp_path):
     # weights restored though
     np.testing.assert_allclose(
         np.asarray(engine2.state.master["w1"]), np.asarray(engine.state.master["w1"]), rtol=1e-6)
+
+
+def test_init_inference_from_training_checkpoint(tmp_path, devices8):
+    """Serve straight from a training checkpoint (reference
+    init_inference(checkpoint=...) / state_dict_factory loaders): the
+    served generations match the live engine's weights, and the optimizer
+    bytes are never needed."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngine
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    reset_topology()
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 10**9})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, size=(8, 32)).astype(np.int32)}
+    for _ in range(2):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path))
+
+    served = sxt.init_inference(model=model, checkpoint=str(tmp_path),
+                                config={"dtype": "fp32", "max_seq_len": 32})
+    live = InferenceEngine(model, engine.module_weights(),
+                           InferenceConfig(dtype="float32", max_seq_len=32))
+    prompts = np.random.default_rng(1).integers(0, 64, size=(2, 8)).astype(np.int32)
+    np.testing.assert_array_equal(served.generate(prompts, max_new_tokens=5),
+                                  live.generate(prompts, max_new_tokens=5))
