@@ -15,6 +15,7 @@ from typing import Any, Callable, Optional
 __version__ = "0.1.0"
 __version_major__, __version_minor__, __version_patch__ = 0, 1, 0
 
+from . import zero  # noqa: F401  (reference deepspeed.zero surface: Init, GatheredParameters)
 from .config import SXConfig, ConfigError
 from .parallel import comm  # noqa: F401  (dist facade: sxt.comm.psum etc.)
 from .parallel.mesh import MeshTopology, get_topology, initialize_topology, topology_is_initialized

@@ -1004,6 +1004,18 @@ class Engine:
         if self.sync is not None:
             self.sync.reset_rings(rings)
 
+    def no_sync(self):
+        """Reference ``engine.no_sync()`` (runtime/engine.py:2250): skip the
+        per-microbatch gradient sync during accumulation. The fused
+        ``train_batch`` path gets this structurally — the gas loop is a
+        lax.scan INSIDE one program, so the cross-device reduction happens
+        once per optimizer step no matter how many microbatches — hence a
+        no-op context here (the win the reference opts into is the default).
+        """
+        import contextlib
+
+        return contextlib.nullcontext(self)
+
     def compile(self, batch=None, backend: Optional[str] = None) -> None:
         """AOT-compile the fused train step (reference ``engine.compile()``,
         runtime/engine.py:3970 — torch.compile + DeepCompile). Under XLA

@@ -1,0 +1,52 @@
+"""``zero.*`` API surface (reference ``deepspeed.zero``).
+
+The reference's construct-time machinery does not exist here because the
+engine gets it structurally: model init is traced under jit with the ZeRO
+sharding policy as ``out_shardings`` (each device materializes only its
+shard — the ``zero.Init`` capability, see runtime/engine.py params_init_fn),
+and inside jit every array is LOGICALLY full while XLA schedules the
+all-gathers (the ``GatheredParameters`` capability). These shims keep
+reference-shaped user code working unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .utils.logging import log_dist
+
+
+class Init:
+    """Reference ``deepspeed.zero.Init`` (partition_parameters.py:879)
+    context manager. Construct-time partitioning is AUTOMATIC here — pass a
+    model with ``init()`` to :func:`shuffle_exchange_tpu.initialize` and the
+    engine traces it straight into sharded buffers; this context is accepted
+    (with the reference's kwargs) so reference-shaped code runs unchanged.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None, sequence_data_parallel_group=None,
+                 param_swapper=None):
+        self.enabled = enabled
+
+    def __enter__(self):
+        if self.enabled:
+            log_dist("zero.Init: construct-time partitioning is automatic on "
+                     "this engine (deferred jit init with sharded outputs); "
+                     "context accepted for API compatibility", ranks=[0])
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank=None, fwd_module=None, enabled=True):
+    """Reference ``deepspeed.zero.GatheredParameters``
+    (partition_parameters.py:2193): materialize partitioned params inside
+    the context. Our param trees are logically full jax.Arrays whose
+    sharding is a placement detail — read access works anywhere, and XLA
+    inserts the gather if a host transfer or computation needs the full
+    value — so the context simply yields the tree."""
+    yield params
