@@ -190,6 +190,11 @@ def initialize(
         seed=seed,
     )
 
+    if model is not None and hasattr(model, "loss"):
+        # reference engine.module is the wrapped nn.Module; expose the model
+        # object the same way (engine.module.config etc.)
+        engine.module = model
+
     # RLHF hybrid engine (reference runtime/hybrid_engine.py:30, selected by
     # the hybrid_engine config section): wrap so generate() runs the fused
     # inference loop on current consensus weights.

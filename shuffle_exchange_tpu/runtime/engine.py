@@ -1004,6 +1004,14 @@ class Engine:
         if self.sync is not None:
             self.sync.reset_rings(rings)
 
+    def train(self, mode: bool = True):
+        """API parity (the engine wraps an nn.Module in the reference);
+        functional models have no mode state — returns self."""
+        return self
+
+    def eval(self):
+        return self
+
     def no_sync(self):
         """Reference ``engine.no_sync()`` (runtime/engine.py:2250): skip the
         per-microbatch gradient sync during accumulation. The fused
