@@ -457,6 +457,28 @@ def main():
         _run_one_config(sys.argv[2])
         return
 
+    # Device-init watchdog: a dead tunnel hangs jax.devices() forever
+    # (observed in round 3: the terminal process died and every backend
+    # call blocked). Probe in a subprocess so the bench always emits its
+    # one JSON line instead of inheriting the hang.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import os, jax\n"
+             "if os.environ.get('SXT_BENCH_PLATFORM'):\n"
+             "    jax.config.update('jax_platforms', os.environ['SXT_BENCH_PLATFORM'])\n"
+             "jax.devices()"],
+            capture_output=True, text=True, timeout=240)
+        err = None if probe.returncode == 0 else " ".join(
+            (probe.stderr or "").split())[-200:]
+    except subprocess.TimeoutExpired:
+        err = "jax.devices() hung for 240s"
+    if err is not None:
+        print(json.dumps({"metric": "device init failed (tunnel down?)",
+                          "value": 0, "unit": "tokens/s/chip", "valid": False,
+                          "errors": {"device_init": err}}))
+        return
+
     on_tpu, dev, n_chips, peak, hbm = _hw()
     rows, errors = {}, {}
 
