@@ -353,11 +353,19 @@ class InferenceEngine:
         if cfg.n_experts > 0:
             from ..moe.layer import moe_layer
 
-            expert_params = {n[4:]: lw[n] for n in lw if n.startswith("moe_") and n != "moe_gate"}
+            expert_params = {n[4:]: lw[n] for n in lw
+                             if n.startswith("moe_")
+                             and n != "moe_gate" and not n.startswith("moe_shared")}
             res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
                             capacity_factor=cfg.capacity_factor, activation=cfg.activation,
-                            impl=cfg.moe_impl)
-            return res.output
+                            impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk)
+            out = res.output
+            if cfg.moe_shared_expert_ff > 0:
+                shared = (jax.nn.silu(y @ lw["moe_shared_w_gate"])
+                          * (y @ lw["moe_shared_w_up"])) @ lw["moe_shared_w_down"]
+                gate_s = jax.nn.sigmoid(y @ lw["moe_shared_gate"])
+                out = out + gate_s.astype(out.dtype) * shared
+            return out
         if cfg.activation == "swiglu":
             return (jax.nn.silu(y @ lw["w_gate"]) * (y @ lw["w_up"])) @ lw["w_down"]
         from ..models.transformer import activation_fn

@@ -35,6 +35,7 @@ _ARCH_FAMILIES = {
     "GPT2LMHeadModel": "gpt2",
     "OPTForCausalLM": "opt",
     "Phi3ForCausalLM": "phi3",
+    "Qwen2MoeForCausalLM": "qwen2moe",
     "GPTJForCausalLM": "gptj",
     "GPTNeoXForCausalLM": "gptneox",
     "FalconForCausalLM": "falcon",
@@ -46,7 +47,7 @@ _ARCH_FAMILIES = {
 _MODEL_TYPE_FAMILIES = {"llama": "llama", "mistral": "llama", "qwen2": "qwen2",
                         "mixtral": "mixtral", "gpt2": "gpt2", "opt": "opt",
                         "phi3": "phi3", "gptj": "gptj", "gpt_neox": "gptneox",
-                        "falcon": "falcon", "bloom": "bloom"}
+                        "falcon": "falcon", "bloom": "bloom", "qwen2_moe": "qwen2moe"}
 
 
 def _family(cfg: Dict[str, Any]) -> str:
@@ -158,6 +159,18 @@ def config_from_hf(hf_config) -> TransformerConfig:
         tie_embeddings=cfg.get("tie_word_embeddings", False))
     if family == "qwen2":
         return TransformerConfig(attn_qkv_bias=True, **common)
+    if family == "qwen2moe":
+        if cfg.get("decoder_sparse_step", 1) != 1 or cfg.get("mlp_only_layers"):
+            raise ValueError("qwen2-moe with dense interleaved layers "
+                             "(decoder_sparse_step != 1 / mlp_only_layers) is not supported")
+        common["d_ff"] = cfg.get("moe_intermediate_size")
+        return TransformerConfig(
+            attn_qkv_bias=True,
+            n_experts=cfg["num_experts"], moe_top_k=cfg.get("num_experts_per_tok", 4),
+            moe_norm_topk=bool(cfg.get("norm_topk_prob", False)),
+            moe_shared_expert_ff=cfg.get("shared_expert_intermediate_size", 0),
+            aux_loss_coef=cfg.get("router_aux_loss_coef", 0.001),
+            capacity_factor=float(cfg.get("capacity_factor", 8.0)), **common)
     if family == "mixtral":
         return TransformerConfig(
             n_experts=cfg["num_local_experts"], moe_top_k=cfg.get("num_experts_per_tok", 2),
@@ -425,18 +438,34 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
             layers["b_q"] = _stack(sd, "layers.{}.self_attn.q_proj.bias", L)
             layers["b_k"] = _stack(sd, "layers.{}.self_attn.k_proj.bias", L)
             layers["b_v"] = _stack(sd, "layers.{}.self_attn.v_proj.bias", L)
-        if family == "mixtral":
+        if family in ("mixtral", "qwen2moe"):
             E = config.n_experts
-            layers["moe_gate"] = _stack(sd, "layers.{}.block_sparse_moe.gate.weight", L,
-                                        transpose=True)
+
             def experts(fmt):
                 return np.stack([
                     np.stack([_np(sd[fmt.format(i, e)]).T for e in range(E)])
                     for i in range(L)])
-            # HF mixtral: w1 = gate, w3 = up, w2 = down
-            layers["moe_w_gate"] = experts("layers.{}.block_sparse_moe.experts.{}.w1.weight")
-            layers["moe_w_up"] = experts("layers.{}.block_sparse_moe.experts.{}.w3.weight")
-            layers["moe_w_down"] = experts("layers.{}.block_sparse_moe.experts.{}.w2.weight")
+
+            if family == "mixtral":
+                layers["moe_gate"] = _stack(sd, "layers.{}.block_sparse_moe.gate.weight", L,
+                                            transpose=True)
+                # HF mixtral: w1 = gate, w3 = up, w2 = down
+                layers["moe_w_gate"] = experts("layers.{}.block_sparse_moe.experts.{}.w1.weight")
+                layers["moe_w_up"] = experts("layers.{}.block_sparse_moe.experts.{}.w3.weight")
+                layers["moe_w_down"] = experts("layers.{}.block_sparse_moe.experts.{}.w2.weight")
+            else:
+                layers["moe_gate"] = _stack(sd, "layers.{}.mlp.gate.weight", L, transpose=True)
+                layers["moe_w_gate"] = experts("layers.{}.mlp.experts.{}.gate_proj.weight")
+                layers["moe_w_up"] = experts("layers.{}.mlp.experts.{}.up_proj.weight")
+                layers["moe_w_down"] = experts("layers.{}.mlp.experts.{}.down_proj.weight")
+                layers["moe_shared_w_gate"] = _stack(
+                    sd, "layers.{}.mlp.shared_expert.gate_proj.weight", L, transpose=True)
+                layers["moe_shared_w_up"] = _stack(
+                    sd, "layers.{}.mlp.shared_expert.up_proj.weight", L, transpose=True)
+                layers["moe_shared_w_down"] = _stack(
+                    sd, "layers.{}.mlp.shared_expert.down_proj.weight", L, transpose=True)
+                layers["moe_shared_gate"] = _stack(
+                    sd, "layers.{}.mlp.shared_expert_gate.weight", L, transpose=True)
         else:
             layers["w_gate"] = _stack(sd, "layers.{}.mlp.gate_proj.weight", L, transpose=True)
             layers["w_up"] = _stack(sd, "layers.{}.mlp.up_proj.weight", L, transpose=True)

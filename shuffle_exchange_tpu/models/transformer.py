@@ -75,6 +75,9 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
     moe_impl: str = "auto"                     # auto | capacity | ragged (dropless)
+    moe_shared_expert_ff: int = 0              # Qwen2-MoE shared expert (0 = none)
+    moe_norm_topk: bool = True                 # renormalize top-k weights (Mixtral);
+                                               # False = raw softmax probs (Qwen2-MoE)
     attention_impl: str = "auto"
     # Chunked vocab CE (reference FPDT chunked logits loss,
     # sequence/fpdt_layer.py:1137): compute the loss in seq chunks under
@@ -311,6 +314,12 @@ class Transformer:
             layer["moe_gate"] = stack(next(keys), (D, cfg.n_experts), D)
             for name in per_layer[0]:
                 layer[f"moe_{name}"] = jnp.stack([p[name] for p in per_layer])
+            if cfg.moe_shared_expert_ff > 0:
+                Fs = cfg.moe_shared_expert_ff
+                layer["moe_shared_w_gate"] = stack(next(keys), (D, Fs), D)
+                layer["moe_shared_w_up"] = stack(next(keys), (D, Fs), D)
+                layer["moe_shared_w_down"] = stack(next(keys), (Fs, D), Fs)
+                layer["moe_shared_gate"] = jnp.zeros((L, D, 1))
         elif cfg.activation == "swiglu":
             layer["w_gate"] = stack(next(keys), (D, F), D)
             layer["w_up"] = stack(next(keys), (D, F), D)
@@ -344,6 +353,13 @@ class Transformer:
             name = path[-1]
             stacked = path[0] == "layers"
             lead = (None,) if stacked else ()
+            if name.startswith("moe_shared"):
+                # shared expert = a dense MLP: column/row parallel like w_*
+                if name in ("moe_shared_w_gate", "moe_shared_w_up"):
+                    return P(*lead, None, "tensor")
+                if name == "moe_shared_w_down":
+                    return P(*lead, "tensor", None)
+                return P(*lead, None, None)      # the scalar gate
             if name.startswith("moe_") and name != "moe_gate":
                 # single source of truth for expert sharding lives in moe/layer.py
                 from ..moe.layer import expert_partition_specs
@@ -438,11 +454,20 @@ class Transformer:
         if cfg.n_experts > 0:
             from ..moe.layer import moe_layer
 
-            expert_params = {name[4:]: lw[name] for name in lw if name.startswith("moe_") and name != "moe_gate"}
+            expert_params = {name[4:]: lw[name] for name in lw
+                             if name.startswith("moe_")
+                             and name != "moe_gate" and not name.startswith("moe_shared")}
             res = moe_layer(lw["moe_gate"], expert_params, y2, k=cfg.moe_top_k,
                             capacity_factor=cfg.capacity_factor, activation=cfg.activation,
-                            impl=cfg.moe_impl)
+                            impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk)
             ff, aux = res.output, res.aux_loss
+            if cfg.moe_shared_expert_ff > 0:
+                # Qwen2-MoE shared expert: a dense swiglu MLP every token
+                # runs, added with a per-token sigmoid gate
+                shared = (jax.nn.silu(y2 @ lw["moe_shared_w_gate"])
+                          * (y2 @ lw["moe_shared_w_up"])) @ lw["moe_shared_w_down"]
+                gate_s = jax.nn.sigmoid(y2 @ lw["moe_shared_gate"])
+                ff = ff + gate_s.astype(ff.dtype) * shared
         elif cfg.activation == "swiglu":
             ff = (jax.nn.silu(y2 @ lw["w_gate"]) * (y2 @ lw["w_up"])) @ lw["w_down"]
         elif cfg.mlp_bias:

@@ -106,7 +106,7 @@ class MoEResult(NamedTuple):
 def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0,
               activation: str = "swiglu", train: bool = True, rng=None,
               noise_std: float = 0.0, min_capacity: int = 4, expert_axis: str = "expert",
-              mesh=None, impl: str = "auto") -> MoEResult:
+              mesh=None, impl: str = "auto", normalize_weights: bool = True) -> MoEResult:
     """x [..., M] -> MoEResult. gate_w [M, E].
 
     impl:
@@ -147,8 +147,8 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
     if impl == "ragged":
         from .gating import topk_select
 
-        idx, w, aux, _ = topk_select(logits, k, train=train, rng=rng,
-                                     noise_std=noise_std)
+        idx, w, aux, _ = topk_select(logits, k, normalize_weights=normalize_weights,
+                                     train=train, rng=rng, noise_std=noise_std)
         out = expert_mlp_ragged(expert_params, xs, idx, w, activation)
         counts = jnp.bincount(idx.reshape(-1), length=gate_w.shape[1])
         return MoEResult(out.reshape(orig_shape), aux,
@@ -156,7 +156,8 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
                           "capacity": S})
 
     gate = topk_gating(logits, k=k, capacity_factor=capacity_factor, train=train,
-                       rng=rng, noise_std=noise_std, min_capacity=min_capacity)
+                       rng=rng, noise_std=noise_std, min_capacity=min_capacity,
+                       normalize_weights=normalize_weights)
 
     dispatched = jnp.einsum("sec,sm->ecm", gate.dispatch_mask.astype(xs.dtype), xs)
     dispatched = _constrain_expert(dispatched, expert_axis, mesh)

@@ -260,3 +260,17 @@ def test_infer_partition_specs_on_hf_tree():
     assert specs["layers"]["ln1_w"] == P(None, None)
     assert specs["embed"] == P("tensor", None)
     assert specs["lm_head"] == P(None, "tensor")
+
+
+def test_qwen2moe_logit_parity():
+    """Qwen2-MoE (v2 engine_factory's qwen-moe arch): top-4 softmax routing
+    WITHOUT weight renormalization + a sigmoid-gated shared expert."""
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, shared_expert_intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, decoder_sparse_step=1,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attention_dropout=0.0)
+    torch.manual_seed(11)
+    _compare(transformers.Qwen2MoeForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
