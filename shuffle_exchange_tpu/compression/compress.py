@@ -329,14 +329,12 @@ def redundancy_clean(params, section, step: Optional[int] = None, model_config=N
     if fn is None:
         return params
     if step is None:
-        offsets = [int(r.params.get("schedule_offset", 0))
-                   for rs in _collect_rules(cfg, list(_flatten_paths(params).keys()),
+        rules = [r for rs in _collect_rules(cfg, list(_flatten_paths(params).keys()),
                                             model_config).values() for r in rs]
+        offsets = [int(r.params.get("schedule_offset", 0)) for r in rules]
         # +period*32: run the bit annealing all the way down to target_bits
         step = max(offsets, default=0) + 32 * max(
-            [int(r.params.get("quantization_period", 1))
-             for rs in _collect_rules(cfg, list(_flatten_paths(params).keys()),
-                                      model_config).values() for r in rs] or [1])
+            [int(r.params.get("quantization_period", 1)) for r in rules] or [1])
     return fn(params, np.int32(step))
 
 

@@ -19,6 +19,36 @@ from __future__ import annotations
 from .flash_attention import _repeat_kv
 
 
+def online_softmax_block(q32, k_blk, v_blk, acc, m_run, l_run,
+                         q_pos0, kv_pos0, causal: bool):
+    """One online-softmax attention block — the FPDT accumulation step,
+    shared by :func:`chunked_attention` and the host-offload driver
+    (ops/fpdt_offload.py).
+
+    q32 [B,cq,H,D] PRE-SCALED; k/v [B,ck,H,D]; carries acc [B,H,cq,D],
+    m/l [B,H,cq]; q_pos0/kv_pos0 are the chunks' absolute start positions
+    (traced scalars fine). Returns the updated (acc, m, l).
+    """
+    import jax.numpy as jnp
+
+    cq, ck = q32.shape[1], k_blk.shape[1]
+    logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
+    if causal:
+        q_pos = q_pos0 + jnp.arange(cq)
+        kv_pos = kv_pos0 + jnp.arange(ck)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    m_blk = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m_run, m_blk)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - m_safe[..., None]), 0.0)
+    corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+    l_new = l_run * corr + p.sum(-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
 def chunked_attention(q, k, v, chunk_size: int = 512, causal: bool = True):
     """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D]; fp32 accumulation.
 
@@ -42,29 +72,14 @@ def chunked_attention(q, k, v, chunk_size: int = 512, causal: bool = True):
     k_blocks = k.reshape(B, nk, chunk_size, H, D).transpose(1, 0, 2, 3, 4)
     v_blocks = v.reshape(B, nk, chunk_size, H, D).transpose(1, 0, 2, 3, 4)
 
-    base = jnp.arange(chunk_size)
-
     def q_chunk_body(_, qi_and_block):
         qi, q_blk = qi_and_block
         q32 = q_blk.astype(jnp.float32) * scale          # [B,c,H,D]
 
         def attend_block(carry, ki, k_blk, v_blk):
             acc, m_run, l_run = carry
-            logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
-            if causal:
-                q_pos = qi * chunk_size + base
-                kv_pos = ki * chunk_size + base
-                mask = q_pos[:, None] >= kv_pos[None, :]
-                logits = jnp.where(mask[None, None], logits, -jnp.inf)
-            m_blk = jnp.max(logits, axis=-1)
-            m_new = jnp.maximum(m_run, m_blk)
-            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-            p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - m_safe[..., None]), 0.0)
-            corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
-            l_new = l_run * corr + p.sum(-1)
-            acc_new = acc * corr[..., None] + jnp.einsum(
-                "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
-            return (acc_new, m_new, l_new)
+            return online_softmax_block(q32, k_blk, v_blk, acc, m_run, l_run,
+                                        qi * chunk_size, ki * chunk_size, causal)
 
         def kv_chunk_body(carry, ki_and_kv):
             ki, k_blk, v_blk = ki_and_kv

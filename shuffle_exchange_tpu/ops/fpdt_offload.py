@@ -56,30 +56,17 @@ def _block_update_jit(causal: bool):
 
 def _block_update(q32, k_blk, v_blk, acc, m_run, l_run, q_pos0, kv_pos0,
                   causal: bool = True):
-    """One online-softmax block: q chunk x one KV chunk (fp32)."""
-    import jax.numpy as jnp
-
-    c_q, c_kv = q32.shape[1], k_blk.shape[1]
+    """One online-softmax block: q chunk x one KV chunk (fp32). GQA repeat
+    plus the shared FPDT accumulation step (chunked_attention)."""
     n_rep = q32.shape[2] // k_blk.shape[2]
     if n_rep > 1:
         from .flash_attention import _repeat_kv
 
         k_blk, v_blk = _repeat_kv(k_blk, n_rep), _repeat_kv(v_blk, n_rep)
-    logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
-    if causal:
-        q_pos = q_pos0 + jnp.arange(c_q)
-        kv_pos = kv_pos0 + jnp.arange(c_kv)
-        mask = q_pos[:, None] >= kv_pos[None, :]
-        logits = jnp.where(mask[None, None], logits, -jnp.inf)
-    m_blk = jnp.max(logits, axis=-1)
-    m_new = jnp.maximum(m_run, m_blk)
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - m_safe[..., None]), 0.0)
-    corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
-    l_new = l_run * corr + p.sum(-1)
-    acc_new = acc * corr[..., None] + jnp.einsum(
-        "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
-    return acc_new, m_new, l_new
+    from .chunked_attention import online_softmax_block
+
+    return online_softmax_block(q32, k_blk, v_blk, acc, m_run, l_run,
+                                q_pos0, kv_pos0, causal)
 
 
 def offloaded_chunk_attention(q, kv: HostKVCache, *, causal: bool = True,
