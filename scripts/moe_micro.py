@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Time one config3-shaped MoE layer fwd+bwd per impl (ragged vs capacity)
+on the current backend, plus the pieces of the ragged path, to find where
+config3's MFU goes. One JSON line per measurement.
+
+Usage: python scripts/moe_micro.py
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def sync(x) -> float:
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+def timeit(fn, *args, reps=5):
+    sync(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.moe.layer import init_expert_mlp, moe_layer
+
+    S, M, E, K = 8 * 2048, 1024, 8, 2
+    dff_like = None  # default ff sizing from init caller below
+    rng = jax.random.PRNGKey(0)
+    d_ff = 256 * ((int(8 * M / 3) + 255) // 256)
+    params = init_expert_mlp(rng, E, M, d_ff)
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
+    gate_w = jax.random.normal(rng, (M, E), jnp.float32) * 0.02
+    x = jax.random.normal(rng, (S, M), jnp.bfloat16)
+
+    # expert FLOPs actually routed (top-k tokens, no padding): 3 matmuls
+    flops_ragged = 2 * (S * K) * M * d_ff * 3
+    peak = 197e12 if jax.default_backend() == "tpu" else 1e12
+
+    for impl in ("ragged", "capacity"):
+        @jax.jit
+        def step(p, gw, xx, impl=impl):
+            def loss(p_):
+                r = moe_layer(gw, p_, xx, k=K, impl=impl, train=True)
+                return (r.output.astype(jnp.float32) ** 2).mean() + r.aux_loss
+
+            # fold a grad leaf into the output so XLA cannot DCE the backward
+            v, g = jax.value_and_grad(loss)(p)
+            return v + jax.tree_util.tree_reduce(
+                lambda a, b: a + b.astype(jnp.float32).sum(), g, 0.0)
+
+        t = timeit(step, params, gate_w, x)
+        # fwd+bwd ~ 3x fwd flops
+        print(json.dumps({"what": f"moe_layer {impl} fwd+bwd", "ms": round(t * 1e3, 2),
+                          "mxu_pct": round(100 * 3 * flops_ragged / t / peak, 1)}),
+              flush=True)
+
+    # pieces of the ragged path, fwd only
+    from shuffle_exchange_tpu.moe.gating import topk_select
+
+    logits = (x.astype(jnp.float32) @ gate_w)
+
+    @jax.jit
+    def piece_topk(lg):
+        idx, w, aux, _ = topk_select(lg, K)
+        return w.sum()
+
+    print(json.dumps({"what": "topk_select fwd", "ms": round(timeit(piece_topk, logits) * 1e3, 2)}), flush=True)
+
+    idx, w, aux, _ = jax.jit(functools.partial(topk_select, k=K))(logits)
+
+    @jax.jit
+    def piece_sortgather(xx, ii):
+        flat_e = ii.reshape(-1)
+        order = jnp.argsort(flat_e, stable=True)
+        xsort = jnp.take(xx, order // K, axis=0)
+        return xsort.astype(jnp.float32).sum()
+
+    print(json.dumps({"what": "argsort+gather fwd", "ms": round(timeit(piece_sortgather, x, idx) * 1e3, 2)}), flush=True)
+
+    @jax.jit
+    def piece_ragged_dots(xx, ii):
+        flat_e = ii.reshape(-1)
+        order = jnp.argsort(flat_e, stable=True)
+        xsort = jnp.take(xx, order // K, axis=0)
+        gs = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+        up = jax.lax.ragged_dot(xsort, params["w_up"], gs)
+        gatep = jax.lax.ragged_dot(xsort, params["w_gate"], gs)
+        h = jax.nn.silu(gatep) * up
+        out = jax.lax.ragged_dot(h, params["w_down"], gs)
+        return out.astype(jnp.float32).sum()
+
+    t = timeit(piece_ragged_dots, x, idx)
+    print(json.dumps({"what": "sort+3 ragged_dot fwd", "ms": round(t * 1e3, 2),
+                      "mxu_pct": round(100 * flops_ragged / t / peak, 1)}), flush=True)
+
+    # dense batched-einsum equivalent at the same routed token count
+    xcap = jax.random.normal(rng, (E, S * K // E, M), jnp.bfloat16)
+
+    @jax.jit
+    def piece_dense(xc):
+        up = jnp.einsum("ecm,emf->ecf", xc, params["w_up"])
+        g = jnp.einsum("ecm,emf->ecf", xc, params["w_gate"])
+        return jnp.einsum("ecf,efm->ecm", jax.nn.silu(g) * up,
+                          params["w_down"]).astype(jnp.float32).sum()
+
+    t = timeit(piece_dense, xcap)
+    print(json.dumps({"what": "dense batched einsum fwd (same tokens)", "ms": round(t * 1e3, 2),
+                      "mxu_pct": round(100 * flops_ragged / t / peak, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

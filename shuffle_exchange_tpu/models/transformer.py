@@ -431,15 +431,18 @@ class Transformer:
         # Name the KV residuals so remat_policy="offload_kv_host" can park
         # them in host RAM between fwd and bwd (FPDT SequenceChunk offload,
         # reference sequence/fpdt_layer.py:462; XLA schedules the transfers
-        # and double-buffers the prefetch). No-op under other policies.
+        # and double-buffers the prefetch). q joins for the selective-save
+        # policies (save_attn_seams / save_ffn). No-op under other policies.
         from jax.ad_checkpoint import checkpoint_name
 
+        q = checkpoint_name(q, "q")
         k = checkpoint_name(k, "kv")
         v = checkpoint_name(v, "kv")
         alibi = (alibi_slopes(H) * cfg.alibi_slope_scale
                  if cfg.position == "alibi" else None)
         attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl,
                                 alibi=alibi).reshape(B, T, H * Dh)
+        attn = checkpoint_name(attn, "attn")
         attn_out = attn @ lw["wo"]
         if cfg.attn_out_bias:
             attn_out = attn_out + lw["b_o"].astype(dtype)
@@ -469,7 +472,12 @@ class Transformer:
                 gate_s = jax.nn.sigmoid(y2 @ lw["moe_shared_gate"])
                 ff = ff + gate_s.astype(ff.dtype) * shared
         elif cfg.activation == "swiglu":
-            ff = (jax.nn.silu(y2 @ lw["w_gate"]) * (y2 @ lw["w_up"])) @ lw["w_down"]
+            # Tagged so remat_policy="save_ffn" can keep the two big FFN
+            # projections (the bulk of layer FLOPs) out of the backward
+            # recompute; the elementwise silu/mul re-derives from them free.
+            gate = checkpoint_name(y2 @ lw["w_gate"], "ffn_gate")
+            up = checkpoint_name(y2 @ lw["w_up"], "ffn_up")
+            ff = (jax.nn.silu(gate) * up) @ lw["w_down"]
         elif cfg.mlp_bias:
             act = activation_fn(cfg.activation)
             ff = act(y2 @ lw["w_up"] + lw["b_up"].astype(dtype)) @ lw["w_down"] + lw["b_down"].astype(dtype)
@@ -513,14 +521,22 @@ class Transformer:
         return x, jnp.sum(aux_losses)
 
     def head(self, params, x):
-        """Final norm + unembed: x [.., T, D] -> logits [.., T, vocab] fp32."""
+        """Final norm + unembed: x [.., T, D] -> logits [.., T, vocab] fp32.
+
+        The unembed matmul keeps operands in the compute dtype and
+        accumulates in fp32 (``preferred_element_type``): on TPU a bf16
+        MXU matmul with fp32 accumulation, not the ~6x-slower fp32-operand
+        emulation an ``astype(float32)`` on both sides would force. Under
+        the fp32 CPU test path this is bit-identical to the old form."""
         import jax.numpy as jnp
 
         x = _norm(x, params["ln_f_w"], params["ln_f_b"], self.config.norm,
                   eps=self.config.norm_eps)
         if self.config.tie_embeddings:
-            return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
-        logits = x.astype(jnp.float32) @ params["unembed"].astype(jnp.float32)
+            w = params["embed"].astype(x.dtype)
+            return jnp.matmul(x, w.T, preferred_element_type=jnp.float32)
+        logits = jnp.matmul(x, params["unembed"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
         if self.config.unembed_bias:
             logits = logits + params["unembed_b"].astype(jnp.float32)
         return logits
@@ -642,5 +658,15 @@ def _remat_policy(name: str):
         "offload_kv_host": jax.checkpoint_policies.save_and_offload_only_these_names(
             names_which_can_be_saved=[], names_which_can_be_offloaded=["kv"],
             offload_src="device", offload_dst="pinned_host"),
+        # Selective saves between the nothing_saveable / dots_saveable
+        # extremes ([B,T,*]-sized named seams only, never the full dots set):
+        # "save_attn_seams" keeps q/kv/attn (skips the attention-side
+        # recompute in backward, ~1/6 of layer FLOPs at seq 4k);
+        # "save_ffn" also keeps the two big FFN projections (skips ~80% of
+        # the backward recompute; costs 2*T*d_ff bf16 per layer).
+        "save_attn_seams": jax.checkpoint_policies.save_only_these_names(
+            "q", "kv", "attn"),
+        "save_ffn": jax.checkpoint_policies.save_only_these_names(
+            "q", "kv", "attn", "ffn_gate", "ffn_up"),
     }
     return policies.get(name, jax.checkpoint_policies.dots_saveable)

@@ -77,6 +77,33 @@ def test_remat_same_loss():
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
 
+def test_selective_save_remat_policies_same_grads():
+    """The named-seam policies (save_attn_seams / save_ffn) change only WHAT
+    is kept between fwd and bwd, never the math: loss and grads must match
+    full remat."""
+    import dataclasses
+
+    import jax
+
+    base = tiny(vocab=128, d=64, layers=2, heads=4, seq=32,
+                activation="swiglu", norm="rmsnorm", position="rope")
+    b = {"input_ids": _ids(vocab=128)["input_ids"]}
+    p = Transformer(base).init(jax.random.PRNGKey(0))
+
+    def loss_and_grad(policy):
+        m = Transformer(dataclasses.replace(
+            base, remat=True, remat_policy=policy))
+        return jax.value_and_grad(lambda pp: m.loss(pp, b))(p)
+
+    l_ref, g_ref = loss_and_grad("nothing_saveable")
+    for policy in ("save_attn_seams", "save_ffn"):
+        l, g = loss_and_grad(policy)
+        np.testing.assert_allclose(float(l), float(l_ref), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, r: np.testing.assert_allclose(a, r, rtol=1e-5, atol=1e-6),
+            g, g_ref)
+
+
 def test_labels_with_ignore_index():
     import jax
 
