@@ -160,16 +160,20 @@ def pick_config2(hbm: int):
             vocab_size=128256, d_model=3072, n_layers=28, n_heads=24, n_kv_heads=8,
             d_ff=8192, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
             position="rope", rope_theta=500000.0, tie_embeddings=False)),
+        # Scaled entries keep the 8B HEAD GEOMETRY (head_dim 128, GQA group
+        # 4) so the attention kernels measure the north-star's shapes:
+        # Dh-64 scaling ran splash at ~18% MXU (25.5% MFU); Dh 128 / G 4
+        # measured 35.3% MFU on the same d_model/layers (v5e, seq 4096).
         ("llama3-1b-style", TransformerConfig(
-            vocab_size=128256, d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+            vocab_size=128256, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=4,
             d_ff=8192, max_seq_len=8192, activation="swiglu", norm="rmsnorm",
             position="rope", rope_theta=500000.0, tie_embeddings=True)),
         ("llama-750m-style", TransformerConfig(
-            vocab_size=32768, d_model=1536, n_layers=16, n_heads=24, n_kv_heads=8,
+            vocab_size=32768, d_model=1536, n_layers=16, n_heads=12, n_kv_heads=3,
             max_seq_len=8192, activation="swiglu", norm="rmsnorm",
             position="rope", rope_theta=500000.0, tie_embeddings=True)),
         ("llama-350m-style", TransformerConfig(
-            vocab_size=32768, d_model=1024, n_layers=16, n_heads=16, n_kv_heads=8,
+            vocab_size=32768, d_model=1024, n_layers=16, n_heads=8, n_kv_heads=2,
             max_seq_len=8192, activation="swiglu", norm="rmsnorm",
             position="rope", rope_theta=500000.0, tie_embeddings=True)),
     ]
@@ -387,11 +391,16 @@ def _config2(peak, hbm, n_chips, on_tpu):
 def _config3(peak, hbm, n_chips, on_tpu):
     from shuffle_exchange_tpu.models import Transformer, TransformerConfig
 
+    # capacity (GShard dispatch) over ragged: under the layer scan XLA's
+    # ragged_dot ran at ~4% MXU (24ms/call, 12 calls/layer) while the dense
+    # capacity einsums run 2.9x faster end to end — and capacity/drop IS the
+    # reference's gating semantics (sharded_moe.py top2gating). Head geometry
+    # matches Mixtral's Dh=128 / G=4 (same reasoning as the config-2 ladder).
     mcfg3 = TransformerConfig(
-        vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
-        n_kv_heads=8, max_seq_len=2048, activation="swiglu",
+        vocab_size=32768, d_model=1024, n_layers=8, n_heads=8,
+        n_kv_heads=2, max_seq_len=2048, activation="swiglu",
         norm="rmsnorm", position="rope", tie_embeddings=True,
-        n_experts=8, moe_top_k=2, remat=True,
+        n_experts=8, moe_top_k=2, moe_impl="capacity", remat=True,
         remat_policy="nothing_saveable")
     cfg3 = {
         "train_batch_size": 8,

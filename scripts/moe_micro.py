@@ -38,7 +38,6 @@ def main():
     from shuffle_exchange_tpu.moe.layer import init_expert_mlp, moe_layer
 
     S, M, E, K = 8 * 2048, 1024, 8, 2
-    dff_like = None  # default ff sizing from init caller below
     rng = jax.random.PRNGKey(0)
     d_ff = 256 * ((int(8 * M / 3) + 255) // 256)
     params = init_expert_mlp(rng, E, M, d_ff)
@@ -110,12 +109,11 @@ def main():
     # dense batched-einsum equivalent at the same routed token count
     xcap = jax.random.normal(rng, (E, S * K // E, M), jnp.bfloat16)
 
+    from shuffle_exchange_tpu.moe.layer import expert_mlp
+
     @jax.jit
     def piece_dense(xc):
-        up = jnp.einsum("ecm,emf->ecf", xc, params["w_up"])
-        g = jnp.einsum("ecm,emf->ecf", xc, params["w_gate"])
-        return jnp.einsum("ecf,efm->ecm", jax.nn.silu(g) * up,
-                          params["w_down"]).astype(jnp.float32).sum()
+        return expert_mlp(params, xc).astype(jnp.float32).sum()
 
     t = timeit(piece_dense, xcap)
     print(json.dumps({"what": "dense batched einsum fwd (same tokens)", "ms": round(t * 1e3, 2),

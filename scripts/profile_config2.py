@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Capture an XLA trace of one config-2 train step and print the top device
+ops by total self-time (parsed from the profiler's trace.json.gz), so the
+MFU ceiling can be attributed to actual kernels instead of guesses.
+
+Usage: python scripts/profile_config2.py [policy] [bs] [seq]
+"""
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    policy = sys.argv[1] if len(sys.argv) > 1 else "nothing_saveable"
+    bs = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    seq = int(sys.argv[3]) if len(sys.argv) > 3 else 4096
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".cache", "jax-bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import shuffle_exchange_tpu as sxt
+    from bench import hbm_bytes, host_sync, pick_config2
+    from shuffle_exchange_tpu.models import Transformer
+    from shuffle_exchange_tpu.profiling import xla_trace
+
+    name, mcfg = pick_config2(hbm_bytes(jax.devices()[0]))
+    mcfg = dataclasses.replace(mcfg, remat=True, remat_policy=policy,
+                               max_seq_len=seq)
+    engine, *_ = sxt.initialize(model=Transformer(mcfg), config={
+        "train_batch_size": bs,
+        "optimizer": {"type": "FusedAdam", "params": {"lr": 3e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9,
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, mcfg.vocab_size,
+                                       size=(bs, seq)).astype(np.int32)}
+    for _ in range(2):
+        host_sync(engine.train_batch(batch))
+
+    logdir = os.path.join(REPO, ".cache", "trace_config2")
+    os.makedirs(logdir, exist_ok=True)
+    with xla_trace(logdir):
+        host_sync(engine.train_batch(batch))
+
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        print("no trace.json.gz found under", logdir)
+        return
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+
+    # Device-lane complete events ("ph" == "X"); group by op name.
+    # TPU device PIDs are the ones whose process_name mentions TPU/device.
+    pid_names = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev.get("args", {}).get("name", "")
+    dev_pids = {pid for pid, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower() or "XLA" in n}
+    total = defaultdict(float)
+    count = defaultdict(int)
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X" or ev.get("pid") not in dev_pids:
+            continue
+        name_ = ev.get("name", "?")
+        total[name_] += ev.get("dur", 0.0)
+        count[name_] += 1
+    if not total:
+        print("process names seen:", sorted(set(pid_names.values()))[:20])
+        print("no device events matched; dumping top events from ALL pids")
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "X":
+                total[ev.get("name", "?")] += ev.get("dur", 0.0)
+                count[ev.get("name", "?")] += 1
+    step_us = sum(total.values())
+    rows = sorted(total.items(), key=lambda kv: -kv[1])[:25]
+    print(f"\n== top ops ({policy} bs{bs} seq{seq}); total device-op time "
+          f"{step_us/1e3:.1f} ms ==")
+    for name_, us in rows:
+        print(f"{us/1e3:9.2f} ms  {100*us/max(step_us,1):5.1f}%  x{count[name_]:<5d} {name_[:90]}")
+
+
+if __name__ == "__main__":
+    main()

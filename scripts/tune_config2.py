@@ -27,15 +27,12 @@ CANDIDATES = [
 def run_one(policy: str, bs: int, seq: int) -> dict:
     import dataclasses
 
-    import numpy as np
-
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(REPO, ".cache", "jax-bench"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    import shuffle_exchange_tpu as sxt
     from bench import bench_train, chip_peak_flops, hbm_bytes, pick_config2
     from shuffle_exchange_tpu.models import Transformer
 
@@ -59,7 +56,7 @@ def run_one(policy: str, bs: int, seq: int) -> dict:
 
 
 def main():
-    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+    if len(sys.argv) >= 5 and sys.argv[1] == "--one":
         policy, bs, seq = sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
         row = run_one(policy, bs, seq)
         print("TUNE_ROW " + json.dumps(row), flush=True)

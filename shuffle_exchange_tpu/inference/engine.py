@@ -83,9 +83,13 @@ def decode_attention(q, ck, cv, kv_len, alibi_slopes=None):
     B, S, KV, Dh = ck.shape
     H = q.shape[2]
     G = H // KV
-    qf = q.astype(jnp.float32).reshape(B, KV, G, Dh)           # T=1 folded away
-    kf = ck.astype(jnp.float32)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qf, kf) / np.sqrt(Dh)
+    # Operands stay in cache dtype with fp32 ACCUMULATION — an
+    # astype(float32) on ck/cv would materialize a fp32 copy of the whole
+    # cache per layer per token (~2x the decode HBM traffic); softmax runs
+    # on the fp32 scores either way.
+    qf = q.astype(ck.dtype).reshape(B, KV, G, Dh)              # T=1 folded away
+    scores = jnp.einsum("bkgd,bskd->bkgs", qf, ck,
+                        preferred_element_type=jnp.float32) / np.sqrt(Dh)
     if alibi_slopes is not None:
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G)
         scores = scores + slopes[None, :, :, None] * jnp.arange(S, dtype=jnp.float32)
@@ -93,7 +97,8 @@ def decode_attention(q, ck, cv, kv_len, alibi_slopes=None):
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     w = jnp.exp(scores - scores.max(-1, keepdims=True))
     w = w / w.sum(-1, keepdims=True)
-    out = jnp.einsum("bkgs,bskd->bkgd", w, cv.astype(jnp.float32))
+    out = jnp.einsum("bkgs,bskd->bkgd", w.astype(cv.dtype), cv,
+                     preferred_element_type=jnp.float32)
     return out.reshape(B, 1, H, Dh).astype(q.dtype)
 
 
@@ -112,8 +117,10 @@ def extend_attention(q, ck, cv, start_pos, kv_len, alibi_slopes=None):
     B, S, KV, Dh = ck.shape
     C, H = q.shape[1], q.shape[2]
     G = H // KV
-    qf = q.astype(jnp.float32).reshape(B, C, KV, G, Dh)
-    scores = jnp.einsum("bckgd,bskd->bckgs", qf, ck.astype(jnp.float32)) / np.sqrt(Dh)
+    # Same fp32-accumulate / no-cache-cast discipline as decode_attention.
+    qf = q.astype(ck.dtype).reshape(B, C, KV, G, Dh)
+    scores = jnp.einsum("bckgd,bskd->bckgs", qf, ck,
+                        preferred_element_type=jnp.float32) / np.sqrt(Dh)
     if alibi_slopes is not None:
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G)
         scores = scores + slopes[None, None, :, :, None] * jnp.arange(S, dtype=jnp.float32)
@@ -123,7 +130,8 @@ def extend_attention(q, ck, cv, start_pos, kv_len, alibi_slopes=None):
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     w = jnp.exp(scores - scores.max(-1, keepdims=True))
     w = w / w.sum(-1, keepdims=True)
-    out = jnp.einsum("bckgs,bskd->bckgd", w, cv.astype(jnp.float32))
+    out = jnp.einsum("bckgs,bskd->bckgd", w.astype(cv.dtype), cv,
+                     preferred_element_type=jnp.float32)
     return out.reshape(B, C, H, Dh).astype(q.dtype)
 
 
