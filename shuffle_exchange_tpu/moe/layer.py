@@ -65,8 +65,9 @@ def expert_mlp(params, x, activation: str = "swiglu"):
 
 def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
     """Dropless grouped-GEMM experts (reference cutlass moe_gemm /
-    megablocks, SURVEY §2.13): tokens sort by expert and ``lax.ragged_dot``
-    runs one grouped matmul per projection — no capacity padding slots, no
+    megablocks, SURVEY §2.13): tokens sort by expert and one grouped matmul
+    per projection (``ops/grouped_gemm.py``: Pallas megablox ``gmm`` on
+    TPU, ``lax.ragged_dot`` elsewhere) — no capacity padding slots, no
     dropped tokens, ragged group sizes straight onto the MXU.
 
     xs [S, M]; topk_idx [S, k] int32; topk_w [S, k] f32 -> [S, M].
@@ -83,16 +84,18 @@ def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
     xsort = jnp.take(xs, token_of, axis=0)               # [S*k, M]
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
 
+    from ..ops.grouped_gemm import grouped_matmul
+
     dtype = xs.dtype
-    up = jax.lax.ragged_dot(xsort, params["w_up"].astype(dtype), group_sizes)
+    up = grouped_matmul(xsort, params["w_up"].astype(dtype), group_sizes)
     if activation == "swiglu":
-        gate = jax.lax.ragged_dot(xsort, params["w_gate"].astype(dtype), group_sizes)
+        gate = grouped_matmul(xsort, params["w_gate"].astype(dtype), group_sizes)
         h = jax.nn.silu(gate) * up
     else:
         from ..models.transformer import activation_fn
 
         h = activation_fn(activation)(up)
-    out_sorted = jax.lax.ragged_dot(h, params["w_down"].astype(dtype), group_sizes)
+    out_sorted = grouped_matmul(h, params["w_down"].astype(dtype), group_sizes)
     out_flat = jnp.zeros_like(out_sorted).at[order].set(out_sorted)   # unsort
     return (out_flat.reshape(S, k, M) * topk_w[..., None].astype(dtype)).sum(axis=1)
 

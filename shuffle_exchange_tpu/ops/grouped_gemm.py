@@ -1,0 +1,55 @@
+"""Grouped (ragged) GEMM for MoE experts.
+
+TPU counterpart of the reference's CUTLASS grouped per-expert GEMM
+(``inference/v2/kernels/cutlass_ops/moe_gemm/``, SURVEY.md §2.13) and the
+megablocks-style dropless training path: tokens sorted by expert, one
+matmul whose row-groups select per-expert weight matrices.
+
+Dispatch:
+- **TPU**: the Pallas megablox ``gmm`` kernel
+  (``jax.experimental.pallas.ops.tpu.megablox``) — MXU-tiled, skips empty
+  groups, custom VJP (dx via ``gmm(transpose_rhs)``, dw via ``tgmm``).
+  Rows are padded to the 128-row tile and billed to the last group; the
+  pad rows are sliced away by the caller's unsort.
+- **CPU / fallback**: ``jax.lax.ragged_dot`` (also the numerics oracle).
+
+Shape contract: x [N, K] sorted by group, w [E, K, F], group_sizes [E]
+(sum == N) -> [N, F].
+"""
+
+from __future__ import annotations
+
+
+def _gmm_ok(x, w) -> bool:
+    """megablox tiling wants lane-aligned K/F; row padding handles N."""
+    N, K = x.shape
+    E, K2, F = w.shape
+    return K % 128 == 0 and F % 128 == 0
+
+
+def grouped_matmul(x, w, group_sizes):
+    """x [N, K] (rows sorted by group), w [E, K, F], group_sizes [E] int32
+    -> [N, F] in x.dtype with fp32 accumulation semantics on TPU."""
+    from .dispatch import pallas_enabled
+
+    if pallas_enabled() and _gmm_ok(x, w):
+        return _grouped_matmul_gmm(x, w, group_sizes)
+    import jax
+
+    return jax.lax.ragged_dot(x, w, group_sizes)
+
+
+def _grouped_matmul_gmm(x, w, group_sizes):
+    import jax.numpy as jnp
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    N = x.shape[0]
+    pad = -N % 128
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        # bill pad rows to the last group: they multiply real weights but
+        # land in out[N:], which the caller slices away
+        group_sizes = group_sizes.at[-1].add(pad)
+    out = gmm(x, w, group_sizes.astype(jnp.int32),
+              preferred_element_type=x.dtype)
+    return out[:N] if pad else out
