@@ -84,6 +84,9 @@ class TransformerConfig:
     # remat so [B, T, vocab] logits are never materialized. 0 = full logits;
     # -1 = auto (chunk when T * vocab is large enough to matter).
     loss_chunk: int = -1
+    # Pad the chunked-loss unembed to a 128-multiple vocab (MXU lane tile)
+    # with -1e30-masked pad columns. None = auto (TPU, unaligned vocab only).
+    pad_vocab_logits: Optional[bool] = None
 
     @property
     def kv_heads(self) -> int:
@@ -520,6 +523,18 @@ class Transformer:
         x, aux_losses = jax.lax.scan(layer_fn, x, (stacked_layers, active))
         return x, jnp.sum(aux_losses)
 
+    def _unembed(self, params, dtype):
+        """Single source of truth for the unembed projection: (w [D, V],
+        bias [V] fp32 or None). Bias exists only on the untied path
+        (matches init())."""
+        import jax.numpy as jnp
+
+        if self.config.tie_embeddings:
+            return params["embed"].T.astype(dtype), None
+        bias = (params["unembed_b"].astype(jnp.float32)
+                if self.config.unembed_bias else None)
+        return params["unembed"].astype(dtype), bias
+
     def head(self, params, x):
         """Final norm + unembed: x [.., T, D] -> logits [.., T, vocab] fp32.
 
@@ -532,14 +547,9 @@ class Transformer:
 
         x = _norm(x, params["ln_f_w"], params["ln_f_b"], self.config.norm,
                   eps=self.config.norm_eps)
-        if self.config.tie_embeddings:
-            w = params["embed"].astype(x.dtype)
-            return jnp.matmul(x, w.T, preferred_element_type=jnp.float32)
-        logits = jnp.matmul(x, params["unembed"].astype(x.dtype),
-                            preferred_element_type=jnp.float32)
-        if self.config.unembed_bias:
-            logits = logits + params["unembed_b"].astype(jnp.float32)
-        return logits
+        w, bias = self._unembed(params, x.dtype)
+        logits = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+        return logits if bias is None else logits + bias
 
     @staticmethod
     def token_loss(logits, labels):
@@ -553,11 +563,28 @@ class Transformer:
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
         return (nll * mask).sum(), mask.sum()
 
+    def _pad_vocab(self) -> bool:
+        """Pad the unembed to a 128-multiple vocab inside the chunked loss?
+        GPT-2's 50257 is the canonical offender: the MXU tiles lanes in 128s,
+        and an unaligned contraction output pays a remainder pass. Config
+        tri-state: None = auto (TPU only), True/False = forced (tests)."""
+        p = self.config.pad_vocab_logits
+        if p is not None:
+            return bool(p) and self.config.vocab_size % 128 != 0
+        if self.config.vocab_size % 128 == 0:
+            return False
+        import jax
+
+        return jax.default_backend() == "tpu"
+
     def chunked_loss(self, params, x, labels, chunk: int):
         """Final-norm + unembed + CE, streamed over seq chunks of ``chunk``
         tokens under remat: peak logits memory is [B, chunk, vocab] instead
         of [B, T, vocab] (the dominant activation for big-vocab models).
-        Numerically identical to head()+token_loss() — softmax is per-token.
+        Numerically identical to head()+token_loss() — softmax is per-token,
+        and when the vocab is padded to the 128 lane tile (``_pad_vocab``)
+        the pad columns carry a -1e30 additive mask, so their softmax mass
+        underflows to exactly zero.
         Reference capability: chunked logits loss, sequence/fpdt_layer.py:1137.
         """
         import jax
@@ -573,10 +600,30 @@ class Transformer:
         xc = x.reshape(B, n_chunks, chunk, D).transpose(1, 0, 2, 3)
         lc = labels.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
 
+        # Unembed weight/bias built ONCE outside the scan (loop-invariant,
+        # via the same _unembed as head()): the scan body sees an aligned
+        # [D, Vp] matmul; pad columns carry a -1e30 additive mask.
+        V = cfg.vocab_size
+        vpad = (-V % 128) if self._pad_vocab() else 0
+        w, bias = self._unembed(params, x.dtype)
+        extra = None
+        if vpad:
+            w = jnp.pad(w, ((0, 0), (0, vpad)))
+            extra = jnp.where(jnp.arange(V + vpad) < V, 0.0, -1e30
+                              ).astype(jnp.float32)
+            if bias is not None:
+                extra = extra + jnp.pad(bias, (0, vpad))
+        elif bias is not None:
+            extra = bias
+
         @jax.checkpoint
         def body(carry, xl):
             xch, lch = xl
-            logits = self.head(params, xch)
+            xn = _norm(xch, params["ln_f_w"], params["ln_f_b"], cfg.norm,
+                       eps=cfg.norm_eps)
+            logits = jnp.matmul(xn, w, preferred_element_type=jnp.float32)
+            if extra is not None:
+                logits = logits + extra
             nll, cnt = self.token_loss(logits, lch)
             nll_sum, cnt_sum = carry
             return (nll_sum + nll, cnt_sum + cnt), None

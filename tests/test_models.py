@@ -114,3 +114,41 @@ def test_labels_with_ignore_index():
     labels[:, -1] = -100
     l_explicit = float(model.loss(p, {"input_ids": ids, "labels": labels}))
     assert np.isfinite(l_explicit)
+
+
+def test_padded_vocab_chunked_loss_matches_unpadded():
+    """pad_vocab_logits=True (MXU-aligned unembed with -1e30 pad mask) must
+    give the same chunked CE as the unpadded form: the pad columns' softmax
+    mass underflows to exactly zero."""
+    import dataclasses
+
+    import jax
+
+    base = tiny(vocab=131, d=64, layers=2, heads=4, seq=64, loss_chunk=16)
+    b = {"input_ids": _ids(vocab=131, t=64)["input_ids"]}
+    p = Transformer(base).init(jax.random.PRNGKey(0))
+    l_plain = float(Transformer(dataclasses.replace(
+        base, pad_vocab_logits=False)).loss(p, b))
+    l_padded = float(Transformer(dataclasses.replace(
+        base, pad_vocab_logits=True)).loss(p, b))
+    np.testing.assert_allclose(l_padded, l_plain, rtol=1e-6)
+
+    g_plain = jax.grad(lambda pp: Transformer(dataclasses.replace(
+        base, pad_vocab_logits=False)).loss(pp, b))(p)
+    g_padded = jax.grad(lambda pp: Transformer(dataclasses.replace(
+        base, pad_vocab_logits=True)).loss(pp, b))(p)
+    jax.tree_util.tree_map(
+        lambda a, r: np.testing.assert_allclose(a, r, rtol=1e-5, atol=1e-7),
+        g_padded, g_plain)
+
+    # untied + unembed_bias (GPT-J-style head) through the padded chunk path
+    bias_cfg = tiny(vocab=131, d=64, layers=2, heads=4, seq=64, loss_chunk=16,
+                    tie_embeddings=False, unembed_bias=True)
+    pb = Transformer(bias_cfg).init(jax.random.PRNGKey(1))
+    pb["unembed_b"] = np.asarray(
+        np.random.default_rng(2).standard_normal(131), np.float32)
+    l_b_plain = float(Transformer(dataclasses.replace(
+        bias_cfg, pad_vocab_logits=False)).loss(pb, b))
+    l_b_padded = float(Transformer(dataclasses.replace(
+        bias_cfg, pad_vocab_logits=True)).loss(pb, b))
+    np.testing.assert_allclose(l_b_padded, l_b_plain, rtol=1e-6)
