@@ -443,8 +443,7 @@ class Transformer:
         v = checkpoint_name(v, "kv")
         alibi = (alibi_slopes(H) * cfg.alibi_slope_scale
                  if cfg.position == "alibi" else None)
-        attn = causal_attention(q, k, v, attention_impl=cfg.attention_impl,
-                                alibi=alibi).reshape(B, T, H * Dh)
+        attn = self._attention(q, k, v, alibi).reshape(B, T, H * Dh)
         attn = checkpoint_name(attn, "attn")
         attn_out = attn @ lw["wo"]
         if cfg.attn_out_bias:
@@ -490,6 +489,75 @@ class Transformer:
         h = (h + attn_out + ff) if cfg.parallel_block else (h + ff)
         return h, aux
 
+    @staticmethod
+    def _sp_mesh():
+        """(sp_degree, mesh) from the live topology; (1, None) when no
+        sequence-parallel axis is active."""
+        from ..parallel.mesh import get_topology, topology_is_initialized
+
+        if not topology_is_initialized():
+            return 1, None
+        topo = get_topology()
+        return topo.size("seq"), topo.mesh
+
+    def _attention(self, q, k, v, alibi):
+        """Core attention, sequence-parallel when the mesh has a "seq" axis.
+
+        Ulysses (reference DistributedAttention, sequence/layer.py:331)
+        engaged via shard_map inside the jitted step: activations shard
+        [batch over data+fsdp, seq over "seq"], the two all-to-alls swap
+        seq<->head sharding around the local flash kernel. ALiBi keeps the
+        replicated path (per-head slopes don't survive the head scatter)."""
+        cfg = self.config
+        sp, mesh = self._sp_mesh()
+        if sp > 1 and alibi is not None:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "mesh seq > 1 with an ALiBi model: per-head slopes do not "
+                "survive the Ulysses head scatter, so attention stays "
+                "replicated — the seq axis adds layout cost without "
+                "sequence-parallel benefit for this model")
+        if sp > 1 and alibi is None:
+            # The shard_map's batch spec needs the global batch divisible by
+            # the data x fsdp extent; callers outside the training layout
+            # (e.g. a 1-prompt inference forward while a seq mesh is live)
+            # fall back to replicated attention rather than failing to trace.
+            dp = int(mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
+            if q.shape[0] % dp:
+                from ..utils.logging import warning_once
+
+                warning_once(
+                    f"sequence-parallel attention skipped: batch {q.shape[0]} "
+                    f"not divisible by data*fsdp={dp} (replicated fallback)")
+                sp = 1
+        if sp <= 1 or alibi is not None:
+            return causal_attention(q, k, v, attention_impl=cfg.attention_impl,
+                                    alibi=alibi)
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sequence import ulysses_attention
+
+        # Ragged T (e.g. T-1 from next-token label shifting): pad the seq
+        # dim up to a multiple of sp. Padded keys sit at positions past
+        # every real query, so the causal mask zeroes their influence;
+        # padded query rows are sliced away.
+        T0 = q.shape[1]
+        pad = -T0 % sp
+        if pad:
+            p4 = lambda t: jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            q, k, v = p4(q), p4(k), p4(v)
+        local = ft.partial(causal_attention, attention_impl=cfg.attention_impl)
+        spec = P(("data", "fsdp"), "seq", None, None)
+        out = jax.shard_map(
+            ft.partial(ulysses_attention, axis_name="seq", attn_fn=local),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        return out[:, :T0] if pad else out
+
     def stack_apply(self, stacked_layers, x, rope, ltd_mask=None):
         """Scan the (sub)stack of layers over x. Returns (x, summed aux).
 
@@ -499,6 +567,16 @@ class Transformer:
         import jax.numpy as jnp
 
         cfg = self.config
+        # Sequence-parallel activation layout: pin hidden states to
+        # [batch over data+fsdp, seq over "seq"] so per-token compute and
+        # activation memory split across the seq axis (the attention inside
+        # layer_apply handles the seq<->head all-to-alls).
+        sp, mesh = self._sp_mesh()
+        if sp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(("data", "fsdp"), "seq", None)))
         if ltd_mask is None:
             def layer_fn(h, lw):
                 return self.layer_apply(lw, h, rope)

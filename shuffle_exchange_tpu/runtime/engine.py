@@ -131,6 +131,21 @@ class Engine:
         self.gas = config.gradient_accumulation_steps
         self.zero_stage = config.zero_optimization.stage
 
+        # --- sequence parallelism guard --------------------------------
+        # The model's Ulysses shard_map (models/transformer.py _attention)
+        # assumes the standard activation layout [batch over data+fsdp,
+        # seq over "seq"]; the ensemble replica-vmap and the pipeline's
+        # manual "pipe" region use different layouts.
+        if topology.axis_sizes.get("seq", 1) > 1:
+            if config.shuffle_exchange.enabled:
+                raise ConfigError("sequence-parallel mesh axis (seq > 1) is "
+                                  "not supported with the decentralized "
+                                  "ensemble (shuffle_exchange) mode")
+            if topology.axis_sizes.get("pipe", 1) > 1:
+                raise ConfigError("sequence-parallel mesh axis (seq > 1) is "
+                                  "not supported together with pipeline "
+                                  "parallelism (pipe > 1) yet")
+
         # --- decentralized (fork) setup --------------------------------
         self.ensemble = bool(config.shuffle_exchange.enabled)
         self.replicas = topology.axis_sizes["data"] if self.ensemble else 1
@@ -672,15 +687,24 @@ class Engine:
 
         batch = jax.tree_util.tree_map(reshape, batch)
         # Shard: gas dim replicated; replica dim over "data"; batch dim over
-        # fsdp (ensemble) or data+fsdp (standard).
+        # fsdp (ensemble) or data+fsdp (standard); with an active seq axis,
+        # the sequence dim of [gas, micro, T] leaves additionally shards
+        # over "seq" (Ulysses activation layout).
         from jax.sharding import PartitionSpec as P
 
-        if self.ensemble:
-            spec = P(None, "data", "fsdp")
-        else:
-            spec = P(None, ("data", "fsdp"))
-        sharding = jax.sharding.NamedSharding(self.topology.mesh, spec)
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+        sp = self.topology.axis_sizes.get("seq", 1) if not self.ensemble else 1
+        mesh = self.topology.mesh
+
+        def place(x):
+            if self.ensemble:
+                spec = P(None, "data", "fsdp")
+            elif sp > 1 and x.ndim >= 3:
+                spec = P(None, ("data", "fsdp"), "seq")
+            else:
+                spec = P(None, ("data", "fsdp"))
+            return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(place, batch)
 
     def _next_rng(self):
         import jax
