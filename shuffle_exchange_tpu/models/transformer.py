@@ -87,6 +87,11 @@ class TransformerConfig:
     # Pad the chunked-loss unembed to a 128-multiple vocab (MXU lane tile)
     # with -1e30-masked pad columns. None = auto (TPU, unaligned vocab only).
     pad_vocab_logits: Optional[bool] = None
+    # Sequence-parallel attention flavor when the mesh has seq > 1:
+    # "ulysses" (a2a seq<->head reshard around the local kernel) or "ring"
+    # (KV blocks rotate via ppermute — the context-parallel form; activation
+    # memory O(T/sp) with no head-count divisibility requirement).
+    sp_attention: str = "ulysses"
 
     @property
     def kv_heads(self) -> int:
@@ -551,11 +556,21 @@ class Transformer:
         if pad:
             p4 = lambda t: jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
             q, k, v = p4(q), p4(k), p4(v)
-        local = ft.partial(causal_attention, attention_impl=cfg.attention_impl)
         spec = P(("data", "fsdp"), "seq", None, None)
-        out = jax.shard_map(
-            ft.partial(ulysses_attention, axis_name="seq", attn_fn=local),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        if cfg.sp_attention == "ring":
+            from ..parallel.sequence import ring_attention
+
+            sp_fn = ft.partial(ring_attention, axis_name="seq")
+        elif cfg.sp_attention == "ulysses":
+            local = ft.partial(causal_attention,
+                               attention_impl=cfg.attention_impl)
+            sp_fn = ft.partial(ulysses_attention, axis_name="seq",
+                               attn_fn=local)
+        else:
+            raise ValueError(f"Unsupported sp_attention {cfg.sp_attention!r}; "
+                             "use 'ulysses' or 'ring'")
+        out = jax.shard_map(sp_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)(q, k, v)
         return out[:, :T0] if pad else out
 
     def stack_apply(self, stacked_layers, x, rope, ltd_mask=None):

@@ -123,12 +123,10 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True):
     sp = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
+    # GQA: rotate the UN-repeated kv shards (KV-sized ring hops — repeating
+    # first would multiply ppermute bytes by H/KV); expand per block inside
+    # the accumulate step, where the broadcast stays local.
     n_rep = H // k.shape[2]
-    if n_rep > 1:
-        from ..ops.flash_attention import _repeat_kv
-
-        k = _repeat_kv(k, n_rep)
-        v = _repeat_kv(v, n_rep)
     scale = D ** -0.5
     q32 = q.astype(jnp.float32) * scale
 
@@ -137,6 +135,11 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True):
     def partial_attn(carry, kv_and_src):
         acc, m_run, l_run = carry
         (k_blk, v_blk), src_idx = kv_and_src
+        if n_rep > 1:
+            from ..ops.flash_attention import _repeat_kv
+
+            k_blk = _repeat_kv(k_blk, n_rep)
+            v_blk = _repeat_kv(v_blk, n_rep)
         logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
         if causal:
             kv_pos = src_idx * Tq + jnp.arange(Tq)

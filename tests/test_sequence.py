@@ -148,18 +148,18 @@ def test_vocab_parallel_ce_matches_dense(devices8):
     np.testing.assert_allclose(got, dense, rtol=1e-5)
 
 
-def test_engine_sequence_parallel_matches_dp(devices8):
-    """Training with mesh seq=2 (Ulysses inside the jitted step) must track
-    the plain data-parallel loss trajectory: SP changes layout, not math."""
-    import jax
-
+@pytest.mark.parametrize("sp_attention", ["ulysses", "ring"])
+def test_engine_sequence_parallel_matches_dp(devices8, sp_attention):
+    """Training with mesh seq=2 (Ulysses a2a or ring KV-rotation inside the
+    jitted step) must track the plain data-parallel loss trajectory: SP
+    changes layout, not math."""
     import shuffle_exchange_tpu as sxt
     from shuffle_exchange_tpu.models import Transformer, tiny
     from shuffle_exchange_tpu.parallel import reset_topology
 
     mcfg = tiny(vocab=128, d=64, layers=2, heads=4, seq=64,
                 n_kv_heads=2, activation="swiglu", norm="rmsnorm",
-                position="rope")
+                position="rope", sp_attention=sp_attention)
     cfg = {"train_batch_size": 8,
            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
            "zero_optimization": {"stage": 2}}
