@@ -49,6 +49,7 @@ def estimate_step_memory(n_params: int, *, mbs: int, seq_len: int,
                          d_model: int, n_layers: int, vocab_size: int,
                          zero_stage: int, world: int, remat: bool,
                          loss_chunk: int = 256, tensor: int = 1,
+                         seq_par: int = 1,
                          offload: Optional[str] = None) -> int:
     """First-principles peak-HBM estimate (bytes) for one fused train step.
 
@@ -57,8 +58,10 @@ def estimate_step_memory(n_params: int, *, mbs: int, seq_len: int,
     forward weights + fp32 master/m/v (ZeRO-sharded over ``world`` when
     stage >= 1), activations ~ per-layer residual+ffn working set (halved
     by remat to the saved-dots set), chunked-CE logits block. ``tensor``
-    divides param/activation terms (mp_size); ``offload`` = "cpu"/"nvme"
-    moves master+moments off device entirely (host-optimizer tier).
+    divides param/activation terms (mp_size); ``seq_par`` divides only the
+    token-dependent terms (activations/logits — params replicate across the
+    seq axis); ``offload`` = "cpu"/"nvme" moves master+moments off device
+    entirely (host-optimizer tier).
     """
     shard = world if zero_stage >= 1 else 1
     p_shard = world if zero_stage >= 3 else 1
@@ -67,7 +70,7 @@ def estimate_step_memory(n_params: int, *, mbs: int, seq_len: int,
         master_opt = 0
     fwd_params = n_params * _BF16 // (p_shard * tensor)    # bf16 forward copy
     grads = n_params * _F32 // max(1, (shard if zero_stage >= 2 else 1) * tensor)
-    tokens = mbs * seq_len
+    tokens = mbs * seq_len // seq_par
     # activation working set per layer: attn qkv+out (4d) + ffn (~8d) in bf16
     act_per_layer = tokens * d_model * 12 * _BF16 // tensor
     acts = act_per_layer * (2 if remat else n_layers)
@@ -82,6 +85,7 @@ class Candidate:
     zero_stage: int
     remat: Optional[bool]          # None = leave the model as built
     tensor: int = 1                # mesh tensor split (reference mp_size)
+    seq_par: int = 1               # mesh seq split (Ulysses sequence parallel)
     offload: Optional[str] = None  # optimizer offload tier: None | cpu | nvme
     seq_len: Optional[int] = None  # None = the tuner's base sequence length
     est_bytes: int = 0
@@ -94,6 +98,8 @@ class Candidate:
         n = f"z{self.zero_stage}_mbs{self.micro_batch_size}_gas{self.gradient_accumulation_steps}_{r}"
         if self.tensor > 1:
             n += f"_tp{self.tensor}"
+        if self.seq_par > 1:
+            n += f"_sp{self.seq_par}"
         if self.offload:
             n += f"_off{self.offload}"
         if self.seq_len:
@@ -106,8 +112,10 @@ class Candidate:
             "gradient_accumulation_steps": self.gradient_accumulation_steps,
             "zero_optimization": {"stage": self.zero_stage},
         }
-        if self.tensor > 1:
-            patch["mesh"] = {"tensor": self.tensor, "data": -1}
+        # Always emit the full mesh (with explicit 1s): _merge must OVERRIDE
+        # any mesh axes lingering in the base config (e.g. a previously
+        # written optimal-config file), not inherit them.
+        patch["mesh"] = {"data": -1, "tensor": self.tensor, "seq": self.seq_par}
         if self.offload:
             patch["zero_optimization"]["offload_optimizer"] = {"device": self.offload}
         return patch
@@ -159,7 +167,8 @@ class Autotuner:
                    remat_opts: Sequence[Optional[bool]] = (False, True),
                    tensor_list: Optional[Sequence[int]] = None,
                    offload_opts: Sequence[Optional[str]] = (None,),
-                   seq_lens: Sequence[Optional[int]] = (None,)) -> List[Candidate]:
+                   seq_lens: Sequence[Optional[int]] = (None,),
+                   seq_par_list: Sequence[int] = (1,)) -> List[Candidate]:
         if mbs_list is None:
             lo = self.at.min_train_micro_batch_size_per_gpu if self.at else 1
             hi = self.at.max_train_micro_batch_size_per_gpu if self.at and \
@@ -178,14 +187,21 @@ class Autotuner:
         heads = getattr(getattr(self.model, "config", None), "n_heads", None)
         tensor_list = [t for t in tensor_list
                        if self.world % t == 0 and (heads is None or heads % t == 0)]
+        # seq splits must divide the device count and combine with tensor=1
+        # (the engine rejects seq x tensor); batch shards over the remaining
+        # data extent
+        seq_par_list = [s_ for s_ in seq_par_list if self.world % s_ == 0]
         out = []
-        for mbs, gas, z, r, t, off, sl in itertools.product(
+        for mbs, gas, z, r, t, off, sl, sp_ in itertools.product(
                 mbs_list, gas_list, stages, remat_opts, tensor_list,
-                offload_opts, seq_lens):
-            if self.at and self.at.max_train_batch_size and \
-                    mbs * gas * (self.world // t) > self.at.max_train_batch_size:
+                offload_opts, seq_lens, seq_par_list):
+            if sp_ > 1 and t > 1:
                 continue
-            out.append(Candidate(mbs, gas, z, r, tensor=t, offload=off, seq_len=sl))
+            if self.at and self.at.max_train_batch_size and \
+                    mbs * gas * (self.world // (t * sp_)) > self.at.max_train_batch_size:
+                continue
+            out.append(Candidate(mbs, gas, z, r, tensor=t, seq_par=sp_,
+                                 offload=off, seq_len=sl))
         return out
 
     # -- memory pruning ------------------------------------------------
@@ -204,8 +220,8 @@ class Autotuner:
         return estimate_step_memory(
             n_params, mbs=c.micro_batch_size, seq_len=c.seq_len or self.seq_len,
             d_model=mcfg.d_model, n_layers=mcfg.n_layers, vocab_size=mcfg.vocab_size,
-            zero_stage=c.zero_stage, world=self.world // c.tensor, remat=remat,
-            tensor=c.tensor, offload=c.offload)
+            zero_stage=c.zero_stage, world=self.world // (c.tensor * c.seq_par),
+            remat=remat, tensor=c.tensor, seq_par=c.seq_par, offload=c.offload)
 
     # -- measurement ---------------------------------------------------
 

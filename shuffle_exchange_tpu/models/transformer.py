@@ -88,9 +88,13 @@ class TransformerConfig:
     # with -1e30-masked pad columns. None = auto (TPU, unaligned vocab only).
     pad_vocab_logits: Optional[bool] = None
     # Sequence-parallel attention flavor when the mesh has seq > 1:
-    # "ulysses" (a2a seq<->head reshard around the local kernel) or "ring"
-    # (KV blocks rotate via ppermute — the context-parallel form; activation
-    # memory O(T/sp) with no head-count divisibility requirement).
+    # "ulysses" (a2a seq<->head reshard around the local attention_impl
+    # kernel) or "ring" (KV blocks rotate via ppermute — the context-
+    # parallel form; no head-count divisibility requirement). Ring caveats:
+    # it is its own jnp online-softmax (attention_impl is not used), and
+    # each of the sp hops carries [B, H, T/sp, T/sp] fp32 logits that
+    # become autodiff residuals — pair with remat for long-context
+    # training or backward holds O(T^2/sp) per layer.
     sp_attention: str = "ulysses"
 
     @property

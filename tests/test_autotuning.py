@@ -139,3 +139,35 @@ def test_memory_estimate_offload_and_tensor():
     tp = estimate_step_memory(124_000_000, tensor=2, **kw)
     assert off < base          # master+moments leave the device
     assert tp < base           # params/acts split over tensor
+
+
+def test_seq_par_candidates_and_measured_run(devices8, tmp_path):
+    """seq_par joins the search space: the candidate patches a seq mesh,
+    excludes seq x tensor combos, and a measured run works end to end."""
+    from shuffle_exchange_tpu.autotuning import Autotuner, estimate_step_memory
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    tuner = Autotuner(_model(), _base(), _batch_fn, world_size=8, seq_len=32)
+    cands = tuner.candidates(mbs_list=[1], gas_list=(1,), stages=(2,),
+                             remat_opts=(False,), tensor_list=(1, 2),
+                             seq_par_list=(1, 2, 3))
+    names = [c.name for c in cands]
+    assert any("_sp2" in n for n in names)
+    assert not any("_tp2" in n and "_sp2" in n for n in names)  # engine rejects
+    assert not any("_sp3" in n for n in names)                  # 3 !| world
+
+    sp2 = next(c for c in cands if c.seq_par == 2 and c.tensor == 1)
+    # full mesh with explicit 1s: stale base-config mesh axes must be
+    # overridden by the merge, not inherited
+    assert sp2.as_config_patch()["mesh"] == {"data": -1, "tensor": 1, "seq": 2}
+
+    reset_topology()
+    best, results = tuner.tune(cands=[sp2])
+    reset_topology()
+    assert results[0].status == "ok", results[0]
+
+    # activations shrink with seq_par, params don't
+    kw = dict(mbs=1, seq_len=4096, d_model=768, n_layers=12,
+              vocab_size=50257, zero_stage=2, world=4, remat=False, loss_chunk=0)
+    assert estimate_step_memory(124_000_000, seq_par=2, **kw) < \
+        estimate_step_memory(124_000_000, **kw)
