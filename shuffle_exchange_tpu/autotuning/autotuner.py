@@ -112,10 +112,14 @@ class Candidate:
             "gradient_accumulation_steps": self.gradient_accumulation_steps,
             "zero_optimization": {"stage": self.zero_stage},
         }
-        # Always emit the full mesh (with explicit 1s): _merge must OVERRIDE
-        # any mesh axes lingering in the base config (e.g. a previously
-        # written optimal-config file), not inherit them.
+        # Always emit the tuned mesh axes (with explicit 1s) AND the
+        # size-style knobs: _merge must OVERRIDE any parallelism settings
+        # lingering in the base config (e.g. a previously written
+        # optimal-config file), not inherit them. The batch wildcard axis
+        # is placed by the runner (base configs may use fsdp=-1).
         patch["mesh"] = {"data": -1, "tensor": self.tensor, "seq": self.seq_par}
+        patch["sequence_parallel_size"] = self.seq_par
+        patch["tensor_parallel"] = {"tp_size": self.tensor}
         if self.offload:
             patch["zero_optimization"]["offload_optimizer"] = {"device": self.offload}
         return patch
@@ -187,15 +191,13 @@ class Autotuner:
         heads = getattr(getattr(self.model, "config", None), "n_heads", None)
         tensor_list = [t for t in tensor_list
                        if self.world % t == 0 and (heads is None or heads % t == 0)]
-        # seq splits must divide the device count and combine with tensor=1
-        # (the engine rejects seq x tensor); batch shards over the remaining
-        # data extent
-        seq_par_list = [s_ for s_ in seq_par_list if self.world % s_ == 0]
+        # tp x sp combos must jointly divide the device count (batch
+        # shards over the remaining data extent)
         out = []
         for mbs, gas, z, r, t, off, sl, sp_ in itertools.product(
                 mbs_list, gas_list, stages, remat_opts, tensor_list,
                 offload_opts, seq_lens, seq_par_list):
-            if sp_ > 1 and t > 1:
+            if self.world % (t * sp_):
                 continue
             if self.at and self.at.max_train_batch_size and \
                     mbs * gas * (self.world // (t * sp_)) > self.at.max_train_batch_size:
@@ -235,6 +237,8 @@ class Autotuner:
         mcfg = getattr(model, "config", None)
         if c.remat is not None and mcfg is not None and mcfg.remat != c.remat:
             model = type(model)(dataclasses.replace(mcfg, remat=c.remat))
+        # The schema permits the batch wildcard (-1) only on mesh.data, so
+        # the candidate's data=-1 never collides with a base wildcard.
         cfg = _merge(self.base, c.as_config_patch())
         cfg.pop("train_batch_size", None)
         reset_topology()

@@ -560,7 +560,21 @@ class Transformer:
         if pad:
             p4 = lambda t: jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
             q, k, v = p4(q), p4(k), p4(v)
-        spec = P(("data", "fsdp"), "seq", None, None)
+        # With an active tensor axis the head dim stays tensor-sharded
+        # through the manual region: each (seq, tensor) device holds
+        # [B/dp, T/sp, H/tp, D] and the Ulysses a2a over "seq" swaps to
+        # [B/dp, T, H/(tp*sp), D] — TP x SP composition.
+        tp = int(mesh.shape.get("tensor", 1))
+        head_ax = "tensor" if tp > 1 else None
+        if head_ax and (q.shape[2] % tp or k.shape[2] % tp):
+            from ..utils.logging import warning_once
+
+            warning_once(
+                f"seq x tensor attention: heads ({q.shape[2]}/{k.shape[2]} kv) "
+                f"not divisible by tensor={tp}; heads gather across the "
+                "tensor axis inside the attention region (slower, correct)")
+            head_ax = None
+        spec = P(("data", "fsdp"), "seq", head_ax, None)
         if cfg.sp_attention == "ring":
             from ..parallel.sequence import ring_attention
 

@@ -145,12 +145,6 @@ class Engine:
                 raise ConfigError("sequence-parallel mesh axis (seq > 1) is "
                                   "not supported together with pipeline "
                                   "parallelism (pipe > 1) yet")
-            if topology.axis_sizes.get("tensor", 1) > 1:
-                raise ConfigError("sequence-parallel mesh axis (seq > 1) is "
-                                  "not supported together with tensor "
-                                  "parallelism (tensor > 1) yet: the "
-                                  "attention shard_map would all-gather the "
-                                  "tensor-sharded heads every layer")
 
         # --- decentralized (fork) setup --------------------------------
         self.ensemble = bool(config.shuffle_exchange.enabled)

@@ -143,7 +143,7 @@ def test_memory_estimate_offload_and_tensor():
 
 def test_seq_par_candidates_and_measured_run(devices8, tmp_path):
     """seq_par joins the search space: the candidate patches a seq mesh,
-    excludes seq x tensor combos, and a measured run works end to end."""
+    composes with tensor splits, and a measured run works end to end."""
     from shuffle_exchange_tpu.autotuning import Autotuner, estimate_step_memory
     from shuffle_exchange_tpu.parallel import reset_topology
 
@@ -153,8 +153,8 @@ def test_seq_par_candidates_and_measured_run(devices8, tmp_path):
                              seq_par_list=(1, 2, 3))
     names = [c.name for c in cands]
     assert any("_sp2" in n for n in names)
-    assert not any("_tp2" in n and "_sp2" in n for n in names)  # engine rejects
-    assert not any("_sp3" in n for n in names)                  # 3 !| world
+    assert any("_tp2" in n and "_sp2" in n for n in names)  # tp x sp composes
+    assert not any("_sp3" in n for n in names)              # 3 !| world
 
     sp2 = next(c for c in cands if c.seq_par == 2 and c.tensor == 1)
     # full mesh with explicit 1s: stale base-config mesh axes must be
@@ -171,3 +171,24 @@ def test_seq_par_candidates_and_measured_run(devices8, tmp_path):
               vocab_size=50257, zero_stage=2, world=4, remat=False, loss_chunk=0)
     assert estimate_step_memory(124_000_000, seq_par=2, **kw) < \
         estimate_step_memory(124_000_000, **kw)
+
+
+def test_base_config_stale_knobs_overridden(devices8):
+    """Stale size-style knobs (sequence_parallel_size, fixed mesh axes) in
+    the base config are overridden by the candidate rather than re-applied
+    on top of it."""
+    from shuffle_exchange_tpu.autotuning import Autotuner, Candidate
+    from shuffle_exchange_tpu.parallel import get_topology, reset_topology
+
+    base = dict(_base())
+    base["mesh"] = {"seq": 2, "data": -1}     # stale from a prior tune
+    base["sequence_parallel_size"] = 2
+    tuner = Autotuner(_model(), base, _batch_fn, world_size=8,
+                      profile_steps=1, seq_len=32)
+    reset_topology()
+    best, results = tuner.tune(cands=[Candidate(1, 1, 2, False)])
+    topo = get_topology()
+    assert results[0].status == "ok", (results[0].name, results[0].status)
+    assert topo.axis_sizes["seq"] == 1         # stale sp settings neutralized
+    assert topo.axis_sizes["data"] == 8
+    reset_topology()

@@ -196,3 +196,33 @@ def test_engine_seq_axis_rejected_with_ensemble(devices8):
                                "mesh": {"seq": 2, "data": -1}},
                        method="shuffle", rings=2, slice_count=2)
     reset_topology()
+
+
+def test_engine_seq_times_tensor_matches_dp(devices8):
+    """seq=2 x tensor=2 x data=2: the attention shard_map keeps heads
+    tensor-sharded through the manual region (TP x SP composition)."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    mcfg = tiny(vocab=128, d=64, layers=2, heads=4, seq=64,
+                n_kv_heads=2, activation="swiglu", norm="rmsnorm",
+                position="rope")
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 2}}
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(8, 64)).astype(np.int32)}
+
+    reset_topology()
+    e_dp, *_ = sxt.initialize(model=Transformer(mcfg), config=dict(cfg), seed=0)
+    dp_losses = [float(e_dp.train_batch(batch)) for _ in range(3)]
+
+    reset_topology()
+    cfg_sp = dict(cfg)
+    cfg_sp["mesh"] = {"seq": 2, "tensor": 2, "data": -1}
+    e_sp, *_ = sxt.initialize(model=Transformer(mcfg), config=cfg_sp, seed=0)
+    sp_losses = [float(e_sp.train_batch(batch)) for _ in range(3)]
+    reset_topology()
+
+    np.testing.assert_allclose(sp_losses, dp_losses, rtol=2e-3)
