@@ -305,6 +305,22 @@ def bench_serving(label, model_cfg, peak_flops):
     fused_s = time.perf_counter() - t0
     fused_tps = bsz * gen_new / fused_s
 
+    # int8 weight storage (kernel-injection quantization analog): decode is
+    # weight-bandwidth-bound, so halving the bytes should show directly
+    try:
+        icfg8 = dataclasses.replace(icfg, quantize_weights=True)
+        v1q = InferenceEngine(model, params, icfg8)
+        v1q.generate(ids, max_new_tokens=gen_new)     # compile + warm
+        t0 = time.perf_counter()
+        v1q.generate(ids, max_new_tokens=gen_new)
+        fused_int8_tps = bsz * gen_new / (time.perf_counter() - t0)
+    except Exception as e:
+        # quantize_weights is a supported path — a failure here is a real
+        # quantized-serving regression and must be visible in the record
+        print(f"SXT_WARN int8 serving bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        fused_int8_tps = None
+
     # decode FLOPs ≈ 2*N per token (fwd only) -> model-bandwidth utilization
     decode_mfu = 2.0 * n_params * max(decode_tps, fused_tps) / peak_flops
     return {
@@ -316,6 +332,8 @@ def bench_serving(label, model_cfg, peak_flops):
         "decode_tokens_per_sec": round(decode_tps, 1),
         "decode_ms_per_token": round(1000 * decode_s / decode_steps, 2),
         "fused_generate_tokens_per_sec": round(fused_tps, 1),
+        "fused_generate_int8_tokens_per_sec": (
+            round(fused_int8_tps, 1) if fused_int8_tps else None),
         "valid": bool(decode_mfu <= 1.0),
         "unit": "tokens/s",
     }
@@ -530,10 +548,11 @@ def main():
             errors[f"config{w}"] = f"timeout after {_BUDGET_S[w]}s (budgeted)"
         except Exception as e:
             errors[f"config{w}"] = _short_err(e)
-        try:
-            publish(rows, calib_record, on_tpu)   # incremental
-        except OSError as e:
-            errors["publish"] = _short_err(e)
+        if on_tpu:   # a CPU smoke must never write the published baseline
+            try:
+                publish(rows, calib_record, on_tpu)   # incremental
+            except OSError as e:
+                errors["publish"] = _short_err(e)
 
     # -- headline line --------------------------------------------------
     head = rows.get("config2_llama3_zero3_fused_adam") or next(iter(rows.values()), None)
