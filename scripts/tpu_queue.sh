@@ -1,0 +1,9 @@
+#!/bin/sh
+# Pending on-chip validation queue (run when the TPU tunnel is back):
+#  1. kernel parity smoke (incl. the new grouped-GEMM fwd+VJP checks)
+#  2. full benchmark -> BASELINE.json published rows (vocab-pad loss,
+#     decode fp32-cast fixes, int8 serving measurement)
+set -e
+cd "$(dirname "$0")/.."
+echo "== tpu_smoke ==" && timeout 900 python tests/tpu_smoke.py
+echo "== bench ==" && timeout 3600 python bench.py

@@ -226,3 +226,31 @@ def test_engine_seq_times_tensor_matches_dp(devices8):
     reset_topology()
 
     np.testing.assert_allclose(sp_losses, dp_losses, rtol=2e-3)
+
+
+def test_engine_seq_times_expert_moe_matches_dp(devices8):
+    """MoE under a seq x expert mesh: GShard capacity dispatch with the EP
+    all-to-all composes with sequence-parallel attention."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny_moe
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    mcfg = tiny_moe(vocab=128, d=64, layers=2, heads=4, seq=64, experts=4)
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 2}}
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(8, 64)).astype(np.int32)}
+
+    reset_topology()
+    e1, *_ = sxt.initialize(model=Transformer(mcfg), config=dict(cfg), seed=0)
+    l_dp = [float(e1.train_batch(batch)) for _ in range(3)]
+
+    reset_topology()
+    cfg2 = dict(cfg)
+    cfg2["mesh"] = {"seq": 2, "expert": 2, "data": -1}
+    e2, *_ = sxt.initialize(model=Transformer(mcfg), config=cfg2, seed=0)
+    l_sp = [float(e2.train_batch(batch)) for _ in range(3)]
+    reset_topology()
+
+    np.testing.assert_allclose(l_sp, l_dp, rtol=5e-3)
