@@ -146,3 +146,15 @@ def test_agreeing_parallelism_knobs_ok():
     cfg = SXConfig.load({"train_batch_size": 8, "pipeline": {"stages": 2},
                          "mesh": {"pipe": 2, "data": -1}}, world_size=8)
     assert cfg.mesh.pipe == 2
+
+
+def test_env_report_collect_no_device():
+    """ds_report analog (reference env_report.py): collect() without backend
+    bring-up returns rows for deps, kernels, and the native runtime."""
+    from shuffle_exchange_tpu.env_report import collect
+
+    rows = collect(probe_devices=False)
+    names = [r[0] for r in rows]
+    assert "jax" in names and "backend" in names
+    assert any("native runtime" in n for n in names)
+    assert all(len(r) == 3 for r in rows)
