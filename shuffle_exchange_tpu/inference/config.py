@@ -40,6 +40,7 @@ class InferenceConfig:
     # kernel) with groups capped at 256 along K (one scale row per kernel
     # K-block); larger values apply to the moe/unembed rounding path.
     quantize_weights: bool = False
+    quant_bits: int = 8            # 8 (int8) or 4 (packed nibble pairs)
     quant_group_size: int = 2048
     # v2 paged KV (reference ragged/kv_cache.py BlockedKVCache)
     kv_block_size: int = 64
@@ -65,6 +66,8 @@ class InferenceConfig:
             q = d.pop("quant")
             if isinstance(q, dict):
                 d["quantize_weights"] = bool(q.get("enabled", False))
+                if "bits" in q:
+                    d["quant_bits"] = int(q["bits"])
         dtype = d.get("dtype")
         if dtype is not None:
             key = str(dtype).replace("torch.", "")
@@ -77,6 +80,9 @@ class InferenceConfig:
                 raise ConfigError(f"unsupported inference dtype {dtype!r}")
             else:
                 d["dtype"] = _DTYPES[key]
+        if int(d.get("quant_bits", 8)) not in (8, 4):
+            raise ConfigError(
+                f"quant_bits must be 8 or 4, got {d['quant_bits']!r}")
         known = {f.name for f in dataclasses.fields(cls)}
         ignored = {k: d.pop(k) for k in list(d) if k not in known}
         if ignored:

@@ -249,7 +249,8 @@ class InferenceEngine:
                 for k, v in tree.items():
                     if k in storage_names:
                         try:
-                            out[k] = quantize_weight(v, group_size=storage_gs, dtype=dtype)
+                            out[k] = quantize_weight(v, group_size=storage_gs, dtype=dtype,
+                                                      bits=self.config.quant_bits)
                         except ValueError as e:
                             warning_once(f"weight {k}: {e}; using "
                                          "quantize-dequantize rounding instead")

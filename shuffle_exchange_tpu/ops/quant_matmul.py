@@ -1,17 +1,25 @@
-"""int8 weight-only quantized matmul (storage + kernel).
+"""int8 / int4 weight-only quantized matmul (storage + kernel).
 
 TPU replacement for the reference's mixed-precision GEMMs
 (``inference/v2/kernels/cutlass_ops/mixed_gemm/`` int4/int8-weight x
 fp16-activation CUTLASS kernels, SURVEY.md §2.13): weights are STORED as
-int8 with per-(K-group, column) fp32 scales — half the HBM footprint and
-read bandwidth of bf16 — and the Pallas kernel dequantizes blocks in VMEM
-on the way into the MXU.
+int8 — or as int4 nibble-pairs packed two-per-byte — with per-(K-group,
+column) fp32 scales — half (quarter) the HBM footprint and read bandwidth
+of bf16 — and the Pallas kernel dequantizes blocks in VMEM on the way into
+the MXU.
 
 The storage format is :class:`QuantizedMatrix`, a pytree node implementing
 ``__rmatmul__``: model code written as ``y @ w`` hits the kernel with no
 per-arch surgery (the module_inject analog is one params transform, not a
 module swap). ``lax.scan`` over stacked [L, K, N] layer weights slices the
 children per layer like any other leaf.
+
+int4 packing layout: within each K-scale-group of ``gs`` rows, row r
+(r < gs/2) shares a byte with row r + gs/2 — low nibble = first half,
+high = second. Unpacking in the kernel is then a SUBLANE concatenation
+(`concatenate(axis=0)`), which Mosaic lowers cheaply; a column-pair layout
+would need a lane interleave Mosaic can't lower. The K-group scale
+structure and the kernel's k-loop stay identical to int8's.
 """
 
 from __future__ import annotations
@@ -21,17 +29,22 @@ from typing import Tuple
 
 
 class QuantizedMatrix:
-    """int8 weight + per-(group, column) scales; ``x @ qm`` dispatches to
-    the quantized matmul. Supports leading stacked dims ([L, K, N])."""
+    """int8/int4 weight + per-(group, column) scales; ``x @ qm`` dispatches
+    to the quantized matmul. Supports leading stacked dims ([L, K, N])."""
 
-    def __init__(self, q, scales, group_size: int, dtype):
-        self.q = q                # int8  [..., K, N]
+    def __init__(self, q, scales, group_size: int, dtype, bits: int = 8,
+                 n_cols: int = 0):
+        self.q = q                # int8 [..., K, N] | uint8 [..., K//2, N]
         self.scales = scales      # f32   [..., K//gs, N]
         self.group_size = group_size
         self.dtype = dtype        # compute/output dtype
+        self.bits = bits
+        self._n = n_cols or q.shape[-1]
 
     @property
     def shape(self):
+        if self.bits == 4:
+            return (*self.q.shape[:-2], 2 * self.q.shape[-2], self._n)
         return self.q.shape
 
     @property
@@ -49,8 +62,13 @@ class QuantizedMatrix:
         import jax.numpy as jnp
 
         gs = self.group_size
-        *lead, K, N = self.q.shape
-        qf = self.q.astype(jnp.float32).reshape(*lead, K // gs, gs, N)
+        *lead, K, N = self.shape
+        if self.bits == 4:
+            w4 = _unpack_int4(self.q, gs)                  # [..., K, N] int32
+            qf = w4.astype(jnp.float32)
+        else:
+            qf = self.q.astype(jnp.float32)
+        qf = qf.reshape(*lead, K // gs, gs, N)
         w = qf * self.scales[..., :, None, :]
         return w.reshape(*lead, K, N).astype(self.dtype)
 
@@ -61,11 +79,12 @@ class QuantizedMatrix:
 
 
 def _qm_flatten(qm):
-    return (qm.q, qm.scales), (qm.group_size, qm.dtype)
+    return (qm.q, qm.scales), (qm.group_size, qm.dtype, qm.bits, qm._n)
 
 
 def _qm_unflatten(aux, children):
-    return QuantizedMatrix(children[0], children[1], aux[0], aux[1])
+    return QuantizedMatrix(children[0], children[1], aux[0], aux[1],
+                           bits=aux[2], n_cols=aux[3])
 
 
 def _register():
@@ -80,11 +99,41 @@ def _register():
 _register()
 
 
-def quantize_weight(w, group_size: int = 256, dtype=None) -> QuantizedMatrix:
-    """w [..., K, N] -> QuantizedMatrix with per-(K-group, column) scales
-    (symmetric int8). K must divide group_size (weights are MXU-shaped)."""
+def _pack_int4(q, group_size: int):
+    """int32 nibbles in [-7, 7], [..., K, N] -> uint8 [..., K//2, N]: within
+    each group of ``group_size`` rows, row r packs with row r + gs/2 (low /
+    high nibble)."""
     import jax.numpy as jnp
 
+    *lead, K, N = q.shape
+    gs = group_size
+    qg = q.reshape(*lead, K // gs, gs, N)
+    low = qg[..., : gs // 2, :] & 0xF
+    high = qg[..., gs // 2:, :] & 0xF
+    return (low | (high << 4)).astype(jnp.uint8).reshape(*lead, K // 2, N)
+
+
+def _unpack_int4(p, group_size: int):
+    """uint8 [..., K//2, N] -> int32 [..., K, N] with sign extension
+    (inverse of :func:`_pack_int4`; a sublane concat, no lane interleave)."""
+    import jax.numpy as jnp
+
+    *lead, Kh, N = p.shape
+    hg = group_size // 2
+    i = p.reshape(*lead, Kh // hg, hg, N).astype(jnp.int32)
+    low = ((i & 0xF) ^ 8) - 8
+    high = ((i >> 4) ^ 8) - 8
+    return jnp.concatenate([low, high], axis=-2).reshape(*lead, 2 * Kh, N)
+
+
+def quantize_weight(w, group_size: int = 256, dtype=None, bits: int = 8) -> QuantizedMatrix:
+    """w [..., K, N] -> QuantizedMatrix with per-(K-group, column) scales
+    (symmetric int8, or packed int4 with ``bits=4``).
+    K must divide group_size (weights are MXU-shaped)."""
+    import jax.numpy as jnp
+
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
     *lead, K, N = w.shape
     while K % group_size and group_size >= 64:
         group_size //= 2
@@ -94,15 +143,21 @@ def quantize_weight(w, group_size: int = 256, dtype=None) -> QuantizedMatrix:
                          "keep this weight dense")
     wg = w.astype(jnp.float32).reshape(*lead, K // group_size, group_size, N)
     absmax = jnp.max(jnp.abs(wg), axis=-2)                       # [..., Kg, N]
-    scales = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(wg / scales[..., :, None, :]), -127, 127).astype(jnp.int8)
-    return QuantizedMatrix(q.reshape(*lead, K, N), scales, group_size,
+    qmax = 127.0 if bits == 8 else 7.0
+    scales = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(wg / scales[..., :, None, :]), -qmax, qmax)
+    q = q.reshape(*lead, K, N)
+    if bits == 4:
+        packed = _pack_int4(q.astype(jnp.int32), group_size)
+        return QuantizedMatrix(packed, scales, group_size, dtype or w.dtype,
+                               bits=4, n_cols=N)
+    return QuantizedMatrix(q.astype(jnp.int8), scales, group_size,
                            dtype or w.dtype)
 
 
 def quant_matmul(x, qm: QuantizedMatrix):
-    """x [..., K] @ qm ([K, N]) -> [..., N]. Pallas on TPU (int8 HBM reads,
-    VMEM dequant into the MXU); jnp dequant-matmul elsewhere."""
+    """x [..., K] @ qm ([K, N]) -> [..., N]. Pallas on TPU (int8/int4 HBM
+    reads, VMEM dequant into the MXU); jnp dequant-matmul elsewhere."""
     from .dispatch import pallas_enabled
 
     if qm.ndim != 2:
@@ -111,8 +166,9 @@ def quant_matmul(x, qm: QuantizedMatrix):
     from ..utils.logging import warning_once
 
     K, N = qm.shape
+    n_align = 128
     if pallas_enabled():
-        if x.shape[-1] == K and K % qm.group_size == 0 and N % 128 == 0 \
+        if x.shape[-1] == K and K % qm.group_size == 0 and N % n_align == 0 \
                 and qm.group_size % 128 == 0:
             try:
                 return _quant_matmul_pallas(x, qm)
@@ -121,10 +177,11 @@ def quant_matmul(x, qm: QuantizedMatrix):
                              f"({type(e).__name__}); dense-dequant fallback "
                              f"for [{K}x{N}] weights")
         else:
-            warning_once(f"quantized matmul [{K}x{N}] gs={qm.group_size} not "
-                         "kernel-eligible (needs N%128==0 and group%128==0); "
-                         "dense-dequant fallback — slower than unquantized "
-                         "serving, consider quantize_weights=False here")
+            warning_once(f"quantized matmul [{K}x{N}] gs={qm.group_size} "
+                         f"bits={qm.bits} not kernel-eligible (needs "
+                         "N%128==0 and group%128==0); dense-dequant "
+                         "fallback — slower than unquantized serving, "
+                         "consider quantize_weights=False here")
     import jax.numpy as jnp
 
     return (x.astype(jnp.float32) @ qm.dequantize().astype(jnp.float32)).astype(qm.dtype)
@@ -139,6 +196,7 @@ def _quant_matmul_pallas(x, qm: QuantizedMatrix, block_m: int = 256,
 
     K, N = qm.shape
     gs = qm.group_size
+    int4 = qm.bits == 4
     orig_shape = x.shape
     x2 = x.reshape(-1, K)
     M = x2.shape[0]
@@ -160,7 +218,10 @@ def _quant_matmul_pallas(x, qm: QuantizedMatrix, block_m: int = 256,
         def _init():
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
-        w = q_ref[...].astype(jnp.float32) * s_ref[...]          # [bk,bn]*[1,bn]
+        if int4:
+            w = _unpack_int4(q_ref[...], gs).astype(jnp.float32) * s_ref[...]
+        else:
+            w = q_ref[...].astype(jnp.float32) * s_ref[...]      # [bk,bn]*[1,bn]
         acc_ref[...] += jax.lax.dot(
             x_ref[...].astype(jnp.float32), w,
             preferred_element_type=jnp.float32)
@@ -169,12 +230,16 @@ def _quant_matmul_pallas(x, qm: QuantizedMatrix, block_m: int = 256,
         def _emit():
             o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
+    # int4 packs K-row pairs: the q block is bk//2 sublanes tall at the
+    # same lane width; grid offset k lands on the group's packed rows
+    q_spec = (pl.BlockSpec((bk // 2, bn), lambda i, j, k: (k, j)) if int4
+              else pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)))
     out = pl.pallas_call(
         kernel,
         grid=(Mp // bm, N // bn, nk),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
-            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            q_spec,
             pl.BlockSpec((1, bn), lambda i, j, k: (k, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),

@@ -179,10 +179,11 @@ def test_quant_matmul_pallas_interpret_matches_fallback():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
-def test_quantized_serving_generates():
-    """The v1 engine with quantize_weights=True stores int8 layer weights
-    and still generates exactly like an engine fed the dequantized dense
-    weights (same rounding by construction)."""
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantized_serving_generates(bits):
+    """The v1 engine with quantize_weights=True stores int8/int4 layer
+    weights and still generates exactly like an engine fed the dequantized
+    dense weights (same rounding by construction)."""
     import jax
 
     from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngine
@@ -192,8 +193,10 @@ def test_quantized_serving_generates():
     model = Transformer(tiny(vocab=64, d=64, layers=2, heads=4, seq=64))
     params = model.init(jax.random.PRNGKey(0))
     eng_q = InferenceEngine(model, params, InferenceConfig(
-        dtype="float32", max_seq_len=64, quantize_weights=True))
+        dtype="float32", max_seq_len=64, quantize_weights=True,
+        quant_bits=bits))
     assert isinstance(eng_q.params["layers"]["wq"], QuantizedMatrix)
+    assert eng_q.params["layers"]["wq"].bits == bits
 
     deq = jax.tree.map(
         lambda p: p.dequantize() if isinstance(p, QuantizedMatrix) else p,
@@ -256,3 +259,48 @@ def test_fp8_quant_roundtrip():
     # subnormal floor near zero
     ref = np.abs(np.asarray(x)) * 2 ** -4 + float(np.abs(np.asarray(x)).max()) / 448.0
     assert (err <= ref + 1e-7).all()
+
+
+def test_int4_quantized_matrix_parity_and_packing():
+    """int4 nibble-pair storage (reference cutlass mixed_gemm int4 path,
+    SURVEY §2.13): quarter the bytes of bf16, pack/unpack round-trips
+    exactly, and the matmul tracks dense within int4 rounding."""
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.quant_matmul import (_pack_int4,
+                                                       _unpack_int4,
+                                                       quantize_weight)
+
+    rng = np.random.default_rng(0)
+    # pack/unpack is exact over the full nibble range
+    q = jnp.asarray(rng.integers(-7, 8, size=(16, 32)), jnp.int32)
+    np.testing.assert_array_equal(np.asarray(_unpack_int4(_pack_int4(q, 8), 8)),
+                                  np.asarray(q))
+
+    w = jnp.asarray(rng.standard_normal((512, 256)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((3, 7, 512)), jnp.float32)
+    qm = quantize_weight(w, group_size=128, bits=4)
+    assert qm.shape == w.shape and qm.q.shape == (256, 256)
+    assert qm.nbytes < w.nbytes / 3.2          # ~4x storage win minus scales
+    out = jax.jit(lambda x, qm: x @ qm)(x, qm)
+    ref = x @ w
+    denom = float(jnp.abs(ref).max())
+    assert float(jnp.abs(out - ref).max()) / denom < 0.15   # int4 rounding
+    np.testing.assert_allclose(np.asarray(x @ qm.dequantize()), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int4_quant_matmul_pallas_interpret():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.quant_matmul import (_quant_matmul_pallas,
+                                                       quantize_weight)
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((256, 512)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((19, 256)), jnp.float32)  # ragged M pads
+    qm = quantize_weight(w, group_size=128, bits=4)
+    got = _quant_matmul_pallas(x, qm, interpret=True)
+    ref = x @ qm.dequantize()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)

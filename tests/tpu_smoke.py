@@ -96,6 +96,9 @@ def main() -> int:
     xq = jnp.asarray(rng.standard_normal((64, 512)), jnp.float32)
     qm = quantize_weight(wd, group_size=128)
     ok &= _check("quant-matmul", _quant_matmul_pallas(xq, qm), xq @ qm.dequantize(), 5e-3)
+    qm4 = quantize_weight(wd, group_size=128, bits=4)
+    ok &= _check("quant-matmul-int4", _quant_matmul_pallas(xq, qm4),
+                 xq @ qm4.dequantize(), 5e-3)
 
     # grouped GEMM (megablox gmm) vs ragged_dot oracle, uneven groups
     from shuffle_exchange_tpu.ops.grouped_gemm import _grouped_matmul_gmm
