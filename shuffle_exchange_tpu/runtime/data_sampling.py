@@ -1,0 +1,133 @@
+"""Curriculum data sampling: analyzer, metric-driven sampler, variable batch.
+
+Capability parity with the reference's ``runtime/data_pipeline/data_sampling``
+package (SURVEY.md §2.11 data-efficiency):
+
+- :class:`DataAnalyzer` — the offline pass (``data_analyzer.py``): map metric
+  functions over the dataset, write per-sample metric values + a
+  sample-index-sorted-by-metric file so training can sample by difficulty
+  without touching raw data again. TPU-native simplification: metrics land
+  in plain ``.npy`` files (no mmap indexed_dataset machinery — numpy IS the
+  mmap-able index format here).
+- :class:`CurriculumSampler` — the online side (``data_sampler.py``
+  DeepSpeedDataSampler): at each step, the curriculum difficulty value
+  (from ``CurriculumScheduler``) bounds which samples are drawn; below the
+  bound, sampling is shuffled-uniform. This is the *sampling* form of
+  curriculum (the engine's ``curriculum_truncate`` is the seqlen form).
+- :func:`variable_batches` — ``variable_batch_size_and_lr.py``: pack
+  samples into batches of ~equal TOKEN count (long samples -> fewer per
+  batch) and report the batch-size ratio so the caller can scale LR.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class DataAnalyzer:
+    """Offline metric pass over a dataset (reference data_analyzer.py).
+
+    ``metrics`` maps metric name -> fn(sample) -> number. ``run()`` computes
+    all metrics for every sample and (optionally) saves ``<name>_values.npy``
+    and ``<name>_order.npy`` (sample indices sorted ascending by the metric)
+    into ``save_path``.
+    """
+
+    def __init__(self, dataset, metrics: Dict[str, Callable[[Any], float]],
+                 save_path: Optional[str] = None):
+        self.dataset = dataset
+        self.metrics = dict(metrics)
+        self.save_path = save_path
+
+    def run(self) -> Dict[str, np.ndarray]:
+        # single dataset pass regardless of metric count: disk-backed /
+        # lazily-decoded datasets pay one fetch per sample
+        n = len(self.dataset)
+        cols: Dict[str, list] = {name: [] for name in self.metrics}
+        for i in range(n):
+            sample = self.dataset[i]
+            for name, fn in self.metrics.items():
+                cols[name].append(float(fn(sample)))
+        out: Dict[str, np.ndarray] = {}
+        for name in self.metrics:
+            vals = np.asarray(cols[name], np.float64)
+            out[name] = vals
+            if self.save_path:
+                os.makedirs(self.save_path, exist_ok=True)
+                np.save(os.path.join(self.save_path, f"{name}_values.npy"), vals)
+                np.save(os.path.join(self.save_path, f"{name}_order.npy"),
+                        np.argsort(vals, kind="stable"))
+        return out
+
+    @staticmethod
+    def seqlen_metric(key: str = "input_ids"):
+        """The stock difficulty metric: sample sequence length."""
+        def metric(sample):
+            return len(sample[key]) if isinstance(sample, dict) else len(sample)
+
+        return metric
+
+
+def load_metric(save_path: str, name: str) -> np.ndarray:
+    return np.load(os.path.join(save_path, f"{name}_values.npy"))
+
+
+class CurriculumSampler:
+    """Difficulty-bounded sampling (reference DeepSpeedDataSampler).
+
+    ``values`` are per-sample metric values (from :class:`DataAnalyzer`);
+    ``difficulty_fn(step)`` gives the current upper bound (typically
+    ``CurriculumScheduler.get_difficulty``). ``sample(step, batch_size)``
+    returns indices drawn uniformly from the admitted pool; the pool only
+    ever grows, and falls back to the easiest ``min_pool`` samples when the
+    bound admits too few.
+    """
+
+    def __init__(self, values: Sequence[float], difficulty_fn: Callable[[int], float],
+                 seed: int = 0, min_pool: int = 1):
+        self.values = np.asarray(values, np.float64)
+        self.order = np.argsort(self.values, kind="stable")
+        self._sorted = self.values[self.order]
+        self.difficulty_fn = difficulty_fn
+        self.rng = np.random.default_rng(seed)
+        self.min_pool = int(min_pool)
+
+    def pool_size(self, step: int) -> int:
+        bound = float(self.difficulty_fn(step))
+        admitted = int(np.searchsorted(self._sorted, bound, side="right"))
+        return max(admitted, min(self.min_pool, len(self.values)))
+
+    def sample(self, step: int, batch_size: int) -> np.ndarray:
+        pool = self.order[: self.pool_size(step)]
+        return self.rng.choice(pool, size=batch_size, replace=len(pool) < batch_size)
+
+
+def variable_batches(lengths: Sequence[int], max_tokens: int,
+                     order: Optional[Sequence[int]] = None,
+                     base_batch_size: Optional[int] = None) -> List[dict]:
+    """Pack sample indices into batches of <= max_tokens total (reference
+    variable_batch_size_and_lr.py). Returns [{"indices", "tokens",
+    "lr_scale"}]; ``lr_scale`` = len(indices)/base_batch_size (linear LR
+    scaling rule) with base = the mean batch size when not given. Samples
+    longer than ``max_tokens`` get a singleton batch (never dropped)."""
+    lengths = np.asarray(lengths, np.int64)
+    idx = np.asarray(order if order is not None else np.argsort(lengths, kind="stable"))
+    batches: List[List[int]] = []
+    cur: List[int] = []
+    cur_tokens = 0
+    for i in idx:
+        li = int(lengths[i])
+        if cur and cur_tokens + li > max_tokens:
+            batches.append(cur)
+            cur, cur_tokens = [], 0
+        cur.append(int(i))
+        cur_tokens += li
+    if cur:
+        batches.append(cur)
+    base = base_batch_size or max(1.0, float(np.mean([len(b) for b in batches])))
+    return [{"indices": np.asarray(b, np.int64),
+             "tokens": int(lengths[b].sum()),
+             "lr_scale": len(b) / float(base)} for b in batches]

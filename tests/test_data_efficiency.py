@@ -105,3 +105,50 @@ def test_engine_random_ltd_integration(devices8):
     for _ in range(3):
         l1 = float(engine.train_batch(batch))
     assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_data_analyzer_and_curriculum_sampler(tmp_path):
+    """Reference data_sampling capability (data_analyzer.py +
+    DeepSpeedDataSampler): offline metric files drive difficulty-bounded
+    sampling that only ever widens."""
+    from shuffle_exchange_tpu.runtime.data_sampling import (CurriculumSampler,
+                                                            DataAnalyzer,
+                                                            load_metric)
+
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": list(range(rng.integers(4, 40)))} for _ in range(64)]
+    an = DataAnalyzer(data, {"seqlen": DataAnalyzer.seqlen_metric()},
+                      save_path=str(tmp_path))
+    vals = an.run()["seqlen"]
+    assert (load_metric(str(tmp_path), "seqlen") == vals).all()
+    order = np.load(tmp_path / "seqlen_order.npy")
+    assert (np.diff(vals[order]) >= 0).all()
+
+    # difficulty ramps 8 -> 40 over 10 steps
+    diff = lambda step: 8 + 32 * min(step, 10) / 10
+    s = CurriculumSampler(vals, diff, seed=1)
+    early = s.sample(0, 16)
+    late = s.sample(10, 16)
+    assert vals[early].max() <= 8
+    assert s.pool_size(10) == len(data)
+    assert vals[late].max() > 8          # pool actually widened
+    assert (np.diff([s.pool_size(t) for t in range(11)]) >= 0).all()
+
+
+def test_variable_batches_token_budget_and_lr_scale():
+    from shuffle_exchange_tpu.runtime.data_sampling import variable_batches
+
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(10, 200, size=50)
+    batches = variable_batches(lengths, max_tokens=512)
+    covered = np.concatenate([b["indices"] for b in batches])
+    assert sorted(covered.tolist()) == list(range(50))     # every sample once
+    for b in batches:
+        assert b["tokens"] <= 512 or len(b["indices"]) == 1
+        assert b["tokens"] == int(lengths[b["indices"]].sum())
+    # explicit base: a batch of 8 samples at base 4 must scale LR by 2.0
+    fixed = variable_batches(lengths, max_tokens=512, base_batch_size=4)
+    for b in fixed:
+        np.testing.assert_allclose(b["lr_scale"], len(b["indices"]) / 4.0,
+                                   rtol=1e-9)
+    assert any(b["lr_scale"] != 1.0 for b in fixed)
