@@ -99,13 +99,21 @@ class RandomLTDScheduler:
         return self.start_ratio + frac * (1.0 - self.start_ratio)
 
 
-def build_curriculum(config) -> Optional[CurriculumScheduler]:
-    """From the engine config: either the top-level ``curriculum_learning``
-    section (legacy) or ``data_efficiency.data_sampling.curriculum_learning``."""
+def curriculum_section(config) -> dict:
+    """The active curriculum config dict: the top-level
+    ``curriculum_learning`` section (legacy) or
+    ``data_efficiency.data_sampling.curriculum_learning`` — ONE resolution
+    shared by the scheduler, the engine's truncation gate, and the
+    metric-driven sampler."""
     cl = dict(config.curriculum_learning or {})
     if not cl:
         de = dict(config.data_efficiency or {})
         cl = dict(de.get("data_sampling", {}).get("curriculum_learning", {}))
+    return cl
+
+
+def build_curriculum(config) -> Optional[CurriculumScheduler]:
+    cl = curriculum_section(config)
     if not cl or not cl.get("enabled", True):
         return None
     return CurriculumScheduler(cl)

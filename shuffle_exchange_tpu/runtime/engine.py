@@ -338,6 +338,14 @@ class Engine:
         self._curriculum = build_curriculum(config)
         self._ltd = build_random_ltd(config)
         self._curriculum_difficulty = None
+        # difficulty-as-token-count truncation only makes sense for the
+        # seqlen curriculum type; other metrics (rarity, perplexity, ...)
+        # drive SAMPLING only (reference seqlen-specific truncation)
+        from .data_pipeline import curriculum_section
+
+        self._curriculum_truncates = (
+            curriculum_section(config).get("curriculum_type", "seqlen")
+            in ("seqlen", "seq_length"))
 
         # --- compression (reference compression/compress.py; §2.11) -----
         self._compression_fn = None
@@ -350,11 +358,33 @@ class Engine:
 
         # --- data -------------------------------------------------------
         self.training_dataloader = None
+        self._curriculum_sampler = None
         if training_data is not None:
             self.training_dataloader = DataLoader(
                 training_data, batch_size=config.train_batch_size, topology=topology,
                 collate_fn=collate_fn, shuffle=False, seed=config.seed)
             self._data_iter = iter(RepeatingLoader(self.training_dataloader))
+            # Metric-driven curriculum SAMPLING (reference data_sampling/
+            # data_sampler.py): when the curriculum section names an offline
+            # metric file (DataAnalyzer output), batches are drawn
+            # difficulty-bounded from the dataset instead of sequentially.
+            from .data_pipeline import curriculum_section
+
+            metric_path = curriculum_section(config).get("metric_values_path")
+            if self._curriculum is not None and metric_path:
+                from .data_sampling import CurriculumSampler
+
+                values = np.load(metric_path)
+                if len(values) != len(training_data):
+                    raise ConfigError(
+                        f"curriculum metric file {metric_path} has "
+                        f"{len(values)} entries but training_data has "
+                        f"{len(training_data)} samples — re-run DataAnalyzer "
+                        "on this dataset")
+                self._curriculum_sampler = CurriculumSampler(
+                    values, self._curriculum.get_difficulty, seed=config.seed)
+                self._sampled_dataset = training_data
+                self._sampled_collate = self.training_dataloader.collate_fn
         else:
             self._data_iter = None
 
@@ -866,20 +896,27 @@ class Engine:
         ``data_iter`` or the engine's own dataloader (reference
         PipelineEngine.train_batch signature)."""
         if batch is None:
-            it = data_iter or self._data_iter
-            if it is None:
-                raise ConfigError("train_batch needs a batch, a data_iter, or training_data at init")
-            batch = next(it)
+            if data_iter is None and self._curriculum_sampler is not None:
+                idx = self._curriculum_sampler.sample(
+                    self.global_steps, self.config.train_batch_size)
+                batch = self._sampled_collate([self._sampled_dataset[int(i)]
+                                               for i in idx])
+            else:
+                it = data_iter or self._data_iter
+                if it is None:
+                    raise ConfigError("train_batch needs a batch, a data_iter, or training_data at init")
+                batch = next(it)
         if self._host_opt is not None:
             return self._host_train_batch(batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         self._ensure_opt_resident()
         if self._curriculum is not None:
-            from .data_pipeline import curriculum_truncate
-
             self._curriculum_difficulty = self._curriculum.get_difficulty(self.global_steps)
-            batch = curriculum_truncate(batch, self._curriculum_difficulty)
+            if self._curriculum_truncates:
+                from .data_pipeline import curriculum_truncate
+
+                batch = curriculum_truncate(batch, self._curriculum_difficulty)
         if self._ltd is not None:
             b = len(next(iter(batch.values())))
             batch = dict(batch)
@@ -1097,6 +1134,9 @@ class Engine:
             "micro_steps": self.micro_steps,
             "rng_state": self._rng.bit_generator.state,
         }
+        if self._curriculum_sampler is not None:
+            state["curriculum_sampler_rng"] = \
+                self._curriculum_sampler.rng.bit_generator.state
         if self.sync is not None:
             state["sync"] = {
                 "batch_count": self.sync.batch_count,
@@ -1115,6 +1155,9 @@ class Engine:
         self.micro_steps = state.get("micro_steps", 0)
         if "rng_state" in state:
             self._rng.bit_generator.state = state["rng_state"]
+        if self._curriculum_sampler is not None and "curriculum_sampler_rng" in state:
+            self._curriculum_sampler.rng.bit_generator.state = \
+                state["curriculum_sampler_rng"]
         if self.sync is not None and "sync" in state:
             s = state["sync"]
             self.sync.batch_count = s["batch_count"]

@@ -152,3 +152,49 @@ def test_variable_batches_token_budget_and_lr_scale():
         np.testing.assert_allclose(b["lr_scale"], len(b["indices"]) / 4.0,
                                    rtol=1e-9)
     assert any(b["lr_scale"] != 1.0 for b in fixed)
+
+
+def test_engine_metric_driven_curriculum_sampling(tmp_path, devices8):
+    """curriculum_learning with metric_values_path: train_batch draws
+    difficulty-bounded samples from training_data (reference
+    DeepSpeedDataSampler wiring) — early steps see only short sequences."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+    from shuffle_exchange_tpu.runtime.data_sampling import DataAnalyzer
+
+    T = 32
+    rng = np.random.default_rng(0)
+    # all samples padded to T; "difficulty" = true length
+    lengths = rng.integers(4, T + 1, size=64)
+    data = [{"input_ids": np.pad(rng.integers(1, 64, size=l), (0, T - l)
+                                 ).astype(np.int32)} for l in lengths]
+    an = DataAnalyzer(
+        data, {"seqlen": lambda s: int((s["input_ids"] != 0).sum())},
+        save_path=str(tmp_path))
+    an.run()
+
+    reset_topology()
+    engine, *_ = sxt.initialize(
+        model=Transformer(tiny(vocab=64, d=32, layers=1, heads=2, seq=T)),
+        training_data=data,
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": T,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 10,
+                                    "difficulty_step": 1},
+                "metric_values_path": str(tmp_path / "seqlen_values.npy"),
+            },
+            "steps_per_print": 10**9})
+    assert engine._curriculum_sampler is not None
+    # pool at step 0 admits only metric <= min_difficulty
+    vals = np.load(tmp_path / "seqlen_values.npy")
+    pool0 = engine._curriculum_sampler.pool_size(0)
+    assert vals[engine._curriculum_sampler.order[:pool0]].max() <= 8
+    loss = float(engine.train_batch())
+    assert np.isfinite(loss)
+    reset_topology()
