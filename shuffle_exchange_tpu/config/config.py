@@ -211,6 +211,20 @@ class TensorBoardConfig(ConfigModel):
 
 
 @dataclass
+class CometConfig(ConfigModel):
+    # reference monitor/config.py CometConfig: lazy comet_ml experiment
+    enabled: bool = config_field(False)
+    samples_log_interval: int = config_field(100, gt=0)
+    project: Optional[str] = config_field(None)
+    workspace: Optional[str] = config_field(None)
+    api_key: Optional[str] = config_field(None)
+    experiment_name: Optional[str] = config_field(None)
+    experiment_key: Optional[str] = config_field(None)
+    online: Optional[bool] = config_field(None)
+    mode: Optional[str] = config_field(None)
+
+
+@dataclass
 class WandbConfig(ConfigModel):
     enabled: bool = config_field(False)
     group: Optional[str] = config_field(None)
@@ -428,6 +442,7 @@ class SXConfig(ConfigModel):
     tensorboard: TensorBoardConfig = config_field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = config_field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = config_field(default_factory=CSVConfig)
+    comet: CometConfig = config_field(default_factory=CometConfig)
     flops_profiler: FlopsProfilerConfig = config_field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = config_field(default_factory=CommsLoggerConfig)
     elasticity: ElasticityConfig = config_field(default_factory=ElasticityConfig)

@@ -91,6 +91,48 @@ class WandbMonitor(Monitor):
             self._wandb.log({label: float(value)}, step=int(step))
 
 
+class CometMonitor(Monitor):
+    """Comet sink (reference monitor/comet.py): lazy comet_ml experiment;
+    disabled with a warning when comet_ml is not installed."""
+
+    def __init__(self, config):
+        super().__init__(enabled=config.enabled and _rank() == 0)
+        self._experiment = None
+        if not self.enabled:
+            return
+        try:
+            import comet_ml
+        except Exception as e:
+            logger.warning("Comet monitor disabled (import failed: %s)", e)
+            self.enabled = False
+            return
+        kwargs = {k: v for k, v in (
+            ("project", config.project), ("workspace", config.workspace),
+            ("api_key", config.api_key), ("online", config.online),
+            ("mode", config.mode), ("experiment_key", config.experiment_key),
+        ) if v is not None}
+        self._experiment = comet_ml.start(**kwargs)
+        if config.experiment_name:
+            self._experiment.set_name(config.experiment_name)
+        self._log_every = max(1, int(config.samples_log_interval))
+        self._seen = 0
+
+    @property
+    def experiment(self):
+        return self._experiment
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        # samples_log_interval (reference comet config): log every Nth
+        # write_events call to bound Comet API traffic
+        self._seen += 1
+        if (self._seen - 1) % self._log_every:
+            return
+        for label, value, step in event_list:
+            self._experiment.log_metric(label, float(value), step=int(step))
+
+
 class CSVMonitor(Monitor):
     """One CSV file per metric label (reference monitor/csv_monitor.py)."""
 
@@ -122,8 +164,10 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
         self.wandb_monitor = WandbMonitor(monitor_config.wandb)
         self.csv_monitor = CSVMonitor(monitor_config.csv_monitor)
+        self.comet_monitor = CometMonitor(monitor_config.comet)
         self._sinks: List[Monitor] = [m for m in
-                                      (self.tb_monitor, self.wandb_monitor, self.csv_monitor)
+                                      (self.tb_monitor, self.wandb_monitor,
+                                       self.csv_monitor, self.comet_monitor)
                                       if m.enabled]
         self.enabled = bool(self._sinks)
 

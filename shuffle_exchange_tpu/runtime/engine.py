@@ -343,8 +343,9 @@ class Engine:
         # drive SAMPLING only (reference seqlen-specific truncation)
         from .data_pipeline import curriculum_section
 
+        self._curriculum_cfg = curriculum_section(config)
         self._curriculum_truncates = (
-            curriculum_section(config).get("curriculum_type", "seqlen")
+            self._curriculum_cfg.get("curriculum_type", "seqlen")
             in ("seqlen", "seq_length"))
 
         # --- compression (reference compression/compress.py; §2.11) -----
@@ -368,14 +369,19 @@ class Engine:
             # data_sampler.py): when the curriculum section names an offline
             # metric file (DataAnalyzer output), batches are drawn
             # difficulty-bounded from the dataset instead of sequentially.
-            from .data_pipeline import curriculum_section
-
-            metric_path = curriculum_section(config).get("metric_values_path")
+            metric_path = self._curriculum_cfg.get("metric_values_path")
             if self._curriculum is not None and metric_path:
                 from .data_sampling import CurriculumSampler
 
+                try:
+                    n_data = len(training_data)
+                except TypeError:
+                    raise ConfigError(
+                        "curriculum metric_values_path needs an indexable "
+                        "sized training_data (iterable-only datasets cannot "
+                        "be sampled by difficulty)")
                 values = np.load(metric_path)
-                if len(values) != len(training_data):
+                if len(values) != n_data:
                     raise ConfigError(
                         f"curriculum metric file {metric_path} has "
                         f"{len(values)} entries but training_data has "

@@ -84,3 +84,25 @@ def test_engine_monitor_and_profiler_integration(tmp_path):
     assert (csv_dir / "Train_Samples_lr.csv").exists()
     text = open(prof_file).read()
     assert "Flops Profiler" in text and "achieved:" in text and "params:" in text
+
+
+def test_comet_monitor_section_and_graceful_disable(monkeypatch):
+    """Reference monitor/comet.py parity: the comet section parses, and the
+    sink disables itself with a warning when comet_ml import fails (forced
+    here so the test stays deterministic if comet_ml ever gets installed)."""
+    import sys
+
+    from shuffle_exchange_tpu.config import SXConfig
+    from shuffle_exchange_tpu.monitor.monitor import CometMonitor, MonitorMaster
+
+    cfg = SXConfig.load({
+        "train_batch_size": 8,
+        "comet": {"enabled": True, "project": "p", "workspace": "w",
+                  "experiment_name": "run1"},
+    }, 1)
+    assert cfg.comet.enabled and cfg.comet.project == "p"
+    monkeypatch.setitem(sys.modules, "comet_ml", None)   # import -> ImportError
+    mon = CometMonitor(cfg.comet)
+    assert not mon.enabled
+    master = MonitorMaster(cfg)
+    assert master.comet_monitor is not None
