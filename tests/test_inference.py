@@ -291,3 +291,26 @@ class TestV2Paged:
         assert not eng.can_schedule([100, 101, 102], [64, 64, 64])  # 12 blocks > 11
         with pytest.raises(RuntimeError):
             eng.put([100, 101, 102], [list(range(64))] * 3)
+
+
+def test_engine_v2_moe_paged_serving():
+    """engine_v2 (paged/continuous batching) shares the v1 layer body, so
+    MoE models serve through the ragged path too — prefill + decode +
+    multi-token extend all finite."""
+    import jax
+
+    from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngineV2
+
+    cfg = tiny_moe(vocab=64, d=32, layers=2, heads=4, seq=64, experts=4)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(model, params, InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=16, num_kv_blocks=40))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, size=12).tolist() for _ in range(2)]
+    logits = eng.put([0, 1], prompts)
+    assert np.isfinite(logits).all()
+    logits = eng.put([0, 1], [[1], [2]])            # decode
+    assert np.isfinite(logits).all()
+    logits = eng.put([0, 1], [[1, 2, 3], [4, 5, 6]])  # chunked extend
+    assert np.isfinite(logits).all()
