@@ -119,3 +119,38 @@ def test_init_inference_from_training_checkpoint(tmp_path, devices8):
     prompts = np.random.default_rng(1).integers(0, 64, size=(2, 8)).astype(np.int32)
     np.testing.assert_array_equal(served.generate(prompts, max_new_tokens=5),
                                   live.generate(prompts, max_new_tokens=5))
+
+
+def test_checkpoint_reshard_from_sequence_parallel(tmp_path, devices8):
+    """Save under a seq=2 (sequence-parallel) ZeRO-2 mesh, resume under a
+    plain fsdp ZeRO-3 mesh: sharding metadata reshards on load regardless
+    of which axes the run used."""
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    mcfg = tiny(vocab=128, d=64, layers=2, heads=4, seq=64,
+                activation="swiglu", norm="rmsnorm", position="rope")
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(8, 64)).astype(np.int32)}
+    base = {"train_batch_size": 8, "steps_per_print": 10**9,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+
+    reset_topology()
+    cfg = dict(base)
+    cfg["mesh"] = {"seq": 2, "data": -1}
+    cfg["zero_optimization"] = {"stage": 2}
+    e_sp, *_ = sxt.initialize(model=Transformer(mcfg), config=cfg, seed=0)
+    for _ in range(2):
+        e_sp.train_batch(batch)
+    loss_before = float(e_sp.eval_batch(batch))
+    e_sp.save_checkpoint(str(tmp_path / "spck"))
+
+    reset_topology()
+    cfg2 = dict(base)
+    cfg2["mesh"] = {"fsdp": 4, "data": -1}
+    cfg2["zero_optimization"] = {"stage": 3}
+    e_dp, *_ = sxt.initialize(model=Transformer(mcfg), config=cfg2, seed=0)
+    e_dp.load_checkpoint(str(tmp_path / "spck"))
+    reset_topology()
+    np.testing.assert_allclose(float(e_dp.eval_batch(batch)), loss_before,
+                               rtol=1e-4)
