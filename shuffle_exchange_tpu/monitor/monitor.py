@@ -115,7 +115,7 @@ class CometMonitor(Monitor):
         if config.experiment_name:
             self._experiment.set_name(config.experiment_name)
         self._log_every = max(1, int(config.samples_log_interval))
-        self._seen = 0
+        self._last_logged: dict = {}
 
     @property
     def experiment(self):
@@ -124,13 +124,17 @@ class CometMonitor(Monitor):
     def write_events(self, event_list: Sequence[Event]) -> None:
         if not self.enabled:
             return
-        # samples_log_interval (reference comet config): log every Nth
-        # write_events call to bound Comet API traffic
-        self._seen += 1
-        if (self._seen - 1) % self._log_every:
-            return
+        # samples_log_interval (reference comet config + EventsLogScheduler):
+        # per-metric gate on *elapsed samples* — a metric point is logged when
+        # its step (global sample count) has advanced >= interval since the
+        # last logged point of the same metric. The first point always logs.
         for label, value, step in event_list:
-            self._experiment.log_metric(label, float(value), step=int(step))
+            step = int(step)
+            last = self._last_logged.get(label)
+            if last is not None and step - last < self._log_every:
+                continue
+            self._last_logged[label] = step
+            self._experiment.log_metric(label, float(value), step=step)
 
 
 class CSVMonitor(Monitor):

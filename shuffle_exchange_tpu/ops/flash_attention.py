@@ -40,9 +40,17 @@ def _pick_block(n: int, itemsize: int = 2) -> int:
         forced = int(os.environ.get("SXT_ATTN_BLOCK") or 0)
     except ValueError:
         forced = 0
-    if forced and n % forced == 0:
-        return forced
     candidates = (1024, 512, 384, 256, 128) if itemsize <= 2 else (512, 384, 256, 128)
+    if forced > 0 and n % forced == 0:
+        # Clamp the override to the itemsize-dependent VMEM cap: forcing 1024
+        # with fp32 operands recreates the exact overflow the sweep hit.
+        if forced <= candidates[0]:
+            return forced
+        warning_once(
+            f"SXT_ATTN_BLOCK={forced} exceeds the VMEM cap for "
+            f"itemsize={itemsize} (max {candidates[0]}); using {candidates[0]}")
+        if n % candidates[0] == 0:
+            return candidates[0]
     for b in candidates:
         if n % b == 0:
             return b

@@ -102,7 +102,15 @@ class CurriculumSampler:
 
     def sample(self, step: int, batch_size: int) -> np.ndarray:
         pool = self.order[: self.pool_size(step)]
-        return self.rng.choice(pool, size=batch_size, replace=len(pool) < batch_size)
+        if len(pool) >= batch_size:
+            return self.rng.choice(pool, size=batch_size, replace=False)
+        # Pool smaller than the batch (early curriculum): tile shuffled copies
+        # of the whole pool so each sample appears at most ceil(bs/pool) times
+        # (the reference sampler traverses the admitted pool shuffled, without
+        # replacement) instead of drawing i.i.d. with replacement.
+        reps = -(-batch_size // len(pool))
+        tiled = np.concatenate([self.rng.permutation(pool) for _ in range(reps)])
+        return tiled[:batch_size]
 
 
 def variable_batches(lengths: Sequence[int], max_tokens: int,

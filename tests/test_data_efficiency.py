@@ -198,3 +198,22 @@ def test_engine_metric_driven_curriculum_sampling(tmp_path, devices8):
     loss = float(engine.train_batch())
     assert np.isfinite(loss)
     reset_topology()
+
+
+def test_curriculum_small_pool_bounded_duplication():
+    """ADVICE r3: when the admitted pool is smaller than the batch, samples
+    repeat at most ceil(batch/pool) times (shuffled-tile traversal, like the
+    reference sampler) instead of i.i.d. draws with replacement."""
+    from shuffle_exchange_tpu.runtime.data_sampling import CurriculumSampler
+
+    vals = np.arange(32, dtype=np.float64)
+    s = CurriculumSampler(vals, lambda step: 2.5, seed=0, min_pool=1)  # pool={0,1,2}
+    batch = s.sample(0, 16)
+    counts = np.bincount(batch, minlength=3)
+    assert set(batch.tolist()) <= {0, 1, 2}
+    assert counts.max() <= -(-16 // 3)          # ceil(16/3) = 6
+    assert counts.min() >= 16 // 3              # balanced traversal
+    # full-size pool: no duplicates at all
+    s2 = CurriculumSampler(vals, lambda step: 1e9, seed=0)
+    b2 = s2.sample(0, 32)
+    assert len(set(b2.tolist())) == 32

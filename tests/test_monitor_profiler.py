@@ -106,3 +106,42 @@ def test_comet_monitor_section_and_graceful_disable(monkeypatch):
     assert not mon.enabled
     master = MonitorMaster(cfg)
     assert master.comet_monitor is not None
+
+
+def test_comet_per_metric_sample_gating(monkeypatch):
+    """ADVICE r3: the Comet gate is per-metric by elapsed *samples* (the
+    event step), mirroring the reference EventsLogScheduler — not every-Nth
+    write_events call shared across metrics."""
+    import sys
+    import types
+
+    from shuffle_exchange_tpu.config import SXConfig
+    from shuffle_exchange_tpu.monitor.monitor import CometMonitor
+
+    logged = []
+
+    class _Exp:
+        def set_name(self, n):
+            pass
+
+        def log_metric(self, label, value, step=None):
+            logged.append((label, step))
+
+    fake = types.ModuleType("comet_ml")
+    fake.start = lambda **kw: _Exp()
+    monkeypatch.setitem(sys.modules, "comet_ml", fake)
+
+    cfg = SXConfig.load({
+        "train_batch_size": 8,
+        "comet": {"enabled": True, "samples_log_interval": 100},
+    }, 1)
+    mon = CometMonitor(cfg.comet)
+    assert mon.enabled
+    # global-samples steps 0,8,16,...: each call carries two metrics.
+    for step in range(0, 250, 8):
+        mon.write_events([("Train/loss", 1.0, step), ("Train/lr", 0.1, step)])
+    loss_steps = [s for l, s in logged if l == "Train/loss"]
+    lr_steps = [s for l, s in logged if l == "Train/lr"]
+    # First point logs; next logs once >=100 samples elapsed per metric.
+    assert loss_steps == [0, 104, 208]
+    assert lr_steps == [0, 104, 208]

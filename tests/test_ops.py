@@ -304,3 +304,17 @@ def test_int4_quant_matmul_pallas_interpret():
     got = _quant_matmul_pallas(x, qm, interpret=True)
     ref = x @ qm.dequantize()
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_attn_block_override_clamped_to_itemsize_cap(monkeypatch):
+    """ADVICE r3: SXT_ATTN_BLOCK must not bypass the VMEM block cap — forcing
+    1024 with fp32 operands would recreate the documented Mosaic overflow."""
+    from shuffle_exchange_tpu.ops.flash_attention import _pick_block
+
+    monkeypatch.setenv("SXT_ATTN_BLOCK", "1024")
+    assert _pick_block(4096, itemsize=2) == 1024   # within bf16 cap: honored
+    assert _pick_block(4096, itemsize=4) == 512    # fp32: clamped to cap
+    monkeypatch.setenv("SXT_ATTN_BLOCK", "512")
+    assert _pick_block(4096, itemsize=4) == 512
+    monkeypatch.setenv("SXT_ATTN_BLOCK", "333")    # not dividing n: ignored
+    assert _pick_block(4096, itemsize=2) == 1024
