@@ -335,6 +335,45 @@ class CheckpointConfig(ConfigModel):
 
 
 @dataclass
+class LoRASectionConfig(ConfigModel):
+    """LoRA / OptimizedLinear section (reference ``deepspeed/linear``:
+    ``LoRAConfig`` + ``QuantizationConfig``, linear/config.py:13,39 — a
+    python-API config there; exposed here additionally as a DS-JSON
+    section so the engine can own the split/merge wiring).
+
+    ``quantize_base`` stores the frozen base weights int8/int4 grouped
+    (QuantizedParameter analog); ``base_weight_sharding > 1`` shards the
+    frozen base over the ZeRO world even at stage < 3 (reference
+    base_weight_sharding; 0/1 = follow the ZeRO stage).
+    """
+
+    enabled: bool = config_field(False)
+    lora_r: int = config_field(64, ge=1, aliases=("r",))
+    lora_alpha: float = config_field(16.0, aliases=("alpha",))
+    base_weight_sharding: int = config_field(1, ge=0)
+    offload: bool = config_field(False)
+    offload_ratio: float = config_field(0.0, ge=0.0, le=1.0)
+    delay_lora_init: bool = config_field(False)
+    target_mods: List[str] = config_field(default_factory=list)
+    quantize_base: bool = config_field(False)
+    q_bits: int = config_field(8)
+    group_size: int = config_field(512, ge=1)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if not self.enabled:
+            return  # a disabled section carries no constraints
+        if self.q_bits not in (4, 8):
+            raise ConfigError(f"lora.q_bits must be 4 or 8, got {self.q_bits}")
+        if self.delay_lora_init:
+            raise ConfigError(
+                "lora.delay_lora_init is a torch-module-lifecycle knob "
+                "(reference optimized_linear.py:117); params here are "
+                "explicit pytrees, so the factors always exist at "
+                "initialize() time — drop the flag")
+
+
+@dataclass
 class ShuffleExchangeConfig(ConfigModel):
     method: str = config_field("RR")  # RR | shuffle | H-RR | Gossip
     rings: int = config_field(8, ge=1)
@@ -448,6 +487,8 @@ class SXConfig(ConfigModel):
     elasticity: ElasticityConfig = config_field(default_factory=ElasticityConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
 
+    lora: LoRASectionConfig = config_field(default_factory=LoRASectionConfig,
+                                           aliases=("optimized_linear",))
     shuffle_exchange: ShuffleExchangeConfig = config_field(default_factory=ShuffleExchangeConfig)
     mesh: MeshConfig = config_field(default_factory=MeshConfig)
     tensor_parallel: TensorParallelConfig = config_field(default_factory=TensorParallelConfig, aliases=("autotp",))

@@ -1,0 +1,17 @@
+"""LoRA / OptimizedLinear subsystem (reference ``deepspeed/linear``)."""
+
+from .optimized_linear import (DEFAULT_TARGET_MODS, LoRAConfig,
+                               QuantizationConfig, apply_optimized_linear,
+                               dequantize_frozen, encode_frozen, full_weight,
+                               init_optimized_linear, lora_leaf_paths,
+                               lora_merge, lora_split,
+                               lora_split_abstract_init, normalize_targets,
+                               split_specs)
+
+__all__ = [
+    "LoRAConfig", "QuantizationConfig", "DEFAULT_TARGET_MODS",
+    "lora_split", "lora_split_abstract_init", "lora_merge",
+    "encode_frozen", "dequantize_frozen", "full_weight", "lora_leaf_paths",
+    "normalize_targets", "split_specs",
+    "init_optimized_linear", "apply_optimized_linear",
+]

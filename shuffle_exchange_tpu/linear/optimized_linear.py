@@ -1,0 +1,356 @@
+"""LoRA / OptimizedLinear subsystem — TPU-native pytree transforms.
+
+Capability analog of the reference's ``deepspeed/linear`` package
+(``optimized_linear.py:76`` ``LoRAOptimizedLinear``, ``quantization.py``
+``QuantizedParameter``/``QuantizedLinear``, ``config.py`` ``LoRAConfig``/
+``QuantizationConfig``):
+
+* the reference swaps ``nn.Linear`` modules for a ``LoRAOptimizedLinear``
+  that holds a frozen (possibly fp-quantized, possibly world-sharded) base
+  weight plus two trainable bf16 LoRA factors, and adds
+  ``base + (alpha/r) * lora2(lora1(x))`` in forward;
+* here the same split is a **params transform**: target leaves move into a
+  FROZEN pytree (bf16, or int8 :class:`~..ops.quant_matmul.QuantizedMatrix`
+  when quantization is on — the ``QuantizedParameter`` analog) and are
+  replaced in the trainable tree by ``{"lora_a", "lora_b"}`` factor pairs.
+  :func:`lora_merge` fuses ``W + (alpha/r) A @ B`` back into model-structured
+  forward weights INSIDE the differentiated jitted step, so gradients reach
+  A/B by chain rule while the frozen base takes none (``stop_gradient``).
+
+The reference's ``base_weight_sharding`` + ``full_weight()`` manual
+all-gather (optimized_linear.py:183-199) collapses to a sharding spec: the
+frozen tree is placed with ZeRO partition specs and XLA inserts the gather
+where the merge consumes it.
+
+Weight convention matches the model zoo: ``y = x @ W`` with ``W [..., in,
+out]`` and optional stacked leading layer dims, so ``A [..., in, r]``
+(kaiming-uniform, a=sqrt(5), following peft) and ``B [..., r, out]``
+(zeros — the fused weight starts exactly at the base).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+LORA_A = "lora_a"
+LORA_B = "lora_b"
+
+# Reference default target_mods are llama-HF projection names
+# (linear/config.py:34); the model zoo uses its own leaf names. Both spell
+# the same seven matrices.
+TARGET_ALIASES = {
+    "q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo",
+    "gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down",
+}
+DEFAULT_TARGET_MODS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@dataclass
+class LoRAConfig:
+    """Python-API config (field names match reference linear/config.py:13)."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: List[str] = field(default_factory=lambda: list(DEFAULT_TARGET_MODS))
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / self.lora_r
+
+
+@dataclass
+class QuantizationConfig:
+    """Frozen-base quantization (reference linear/config.py:39). The TPU
+    storage is int8/int4 grouped :class:`QuantizedMatrix` (the fp-quantizer
+    CUDA kernels' capability analog); ``mantissa_bits`` is accepted for
+    config parity but the integer codes carry no mantissa split."""
+
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+
+
+def normalize_targets(mods: Optional[Sequence[str]]) -> frozenset:
+    mods = mods or DEFAULT_TARGET_MODS
+    return frozenset(TARGET_ALIASES.get(m, m) for m in mods)
+
+
+def is_lora_pair(node: Any) -> bool:
+    return isinstance(node, dict) and set(node.keys()) == {LORA_A, LORA_B}
+
+
+def _is_target_leaf(name: str, leaf: Any, targets: frozenset) -> bool:
+    return name in targets and getattr(leaf, "ndim", 0) >= 2
+
+
+def _kaiming_bound(fan_in: int) -> float:
+    # kaiming_uniform(a=sqrt(5)): bound = sqrt(6 / ((1 + a^2) * fan_in))
+    return math.sqrt(6.0 / (6.0 * fan_in))
+
+
+def lora_split(params: Dict[str, Any], lora_cfg: LoRAConfig,
+               rng: Optional[np.random.Generator] = None,
+               abstract: bool = False) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Split a params tree into (trainable-with-lora, frozen-base).
+
+    Target leaves ``W [..., in, out]`` are moved (as-is, still fp32 — casting
+    /quantization is :func:`encode_frozen`'s job so it can run inside jit
+    with sharded outputs) into the returned ``frozen`` tree, and replaced by
+    ``{"lora_a": A, "lora_b": B}``. With ``abstract=True`` leaves are
+    ``ShapeDtypeStruct`` templates (the zero.Init deferred-init path).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    targets = normalize_targets(lora_cfg.target_mods)
+    r = int(lora_cfg.lora_r)
+    if r <= 0:
+        raise ValueError(f"lora_r must be positive, got {r}")
+    rng = rng or np.random.default_rng(0)
+    n_found = 0
+
+    def walk(tree):
+        nonlocal n_found
+        out, frozen = {}, {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                o, f = walk(v)
+                out[k] = o
+                if f:
+                    frozen[k] = f
+            elif _is_target_leaf(k, v, targets):
+                n_found += 1
+                *lead, fan_in, fan_out = v.shape
+                a_shape = (*lead, fan_in, r)
+                b_shape = (*lead, r, fan_out)
+                if abstract:
+                    a = jax.ShapeDtypeStruct(a_shape, jnp.float32)
+                    b = jax.ShapeDtypeStruct(b_shape, jnp.float32)
+                else:
+                    bound = _kaiming_bound(fan_in)
+                    a = rng.uniform(-bound, bound, size=a_shape).astype(np.float32)
+                    b = np.zeros(b_shape, np.float32)
+                out[k] = {LORA_A: a, LORA_B: b}
+                frozen[k] = v
+            else:
+                out[k] = v
+        return out, frozen
+
+    new_params, frozen = walk(params)
+    if n_found == 0:
+        raise ValueError(
+            f"lora: no target leaves found for target_mods={sorted(targets)}; "
+            "check the names against the model's parameter leaves")
+    return new_params, frozen
+
+
+def lora_split_abstract_init(params_init_fn, lora_cfg: LoRAConfig):
+    """Wrap a ``rng -> params`` init so it returns ``(params_with_lora,
+    frozen_fp32)`` — traced inside jit with sharded outputs (zero.Init)."""
+    import jax
+    import jax.numpy as jnp
+
+    targets = normalize_targets(lora_cfg.target_mods)
+    r = int(lora_cfg.lora_r)
+
+    def init(key):
+        p = params_init_fn(key)
+        base = jax.random.fold_in(key, 0x10A)
+        n_seen = 0
+
+        def walk(tree):
+            nonlocal n_seen
+            out, frozen = {}, {}
+            for k, v in tree.items():
+                if isinstance(v, dict):
+                    o, f = walk(v)
+                    out[k] = o
+                    if f:
+                        frozen[k] = f
+                elif _is_target_leaf(k, v, targets):
+                    *lead, fan_in, fan_out = v.shape
+                    bound = _kaiming_bound(fan_in)
+                    # fold_in per target index: no cap on the number of
+                    # target leaves (dict walks are deterministic-order)
+                    a = jax.random.uniform(jax.random.fold_in(base, n_seen),
+                                           (*lead, fan_in, r),
+                                           jnp.float32, -bound, bound)
+                    n_seen += 1
+                    out[k] = {LORA_A: a, LORA_B: jnp.zeros((*lead, r, fan_out), jnp.float32)}
+                    frozen[k] = v
+                else:
+                    out[k] = v
+            return out, frozen
+
+        return walk(p)
+
+    return init
+
+
+def encode_frozen(frozen: Dict[str, Any], quant_cfg: Optional[QuantizationConfig],
+                  dtype) -> Dict[str, Any]:
+    """fp32 frozen tree -> storage form: bf16 cast, or int8/int4 grouped
+    QuantizedMatrix when quantization is configured (the QuantizedParameter
+    analog — reference linear/quantization.py:18 quantizes on device
+    placement; here the encode is jit-traceable so it can run sharded)."""
+    from ..ops.quant_matmul import quantize_weight
+
+    def enc(leaf):
+        if quant_cfg is not None:
+            gs = min(quant_cfg.group_size, leaf.shape[-2])
+            # group size must divide K; fall back to a divisor
+            while leaf.shape[-2] % gs:
+                gs -= 1
+            return quantize_weight(leaf, group_size=gs, dtype=dtype,
+                                   bits=quant_cfg.q_bits)
+        return leaf.astype(dtype)
+
+    return _map_frozen(frozen, enc)
+
+
+def _map_frozen(frozen, fn):
+    out = {}
+    for k, v in frozen.items():
+        out[k] = _map_frozen(v, fn) if isinstance(v, dict) else fn(v)
+    return out
+
+
+def dequantize_frozen(frozen: Dict[str, Any], dtype) -> Dict[str, Any]:
+    """Storage form -> dense bf16 forward weights (``full_weight`` analog:
+    reference optimized_linear.py:183 dequantizes + all-gathers; the gather
+    here is XLA's, inserted where the merge consumes the sharded leaf)."""
+    from ..ops.quant_matmul import QuantizedMatrix
+
+    def deq(leaf):
+        if isinstance(leaf, QuantizedMatrix):
+            return leaf.dequantize().astype(dtype)
+        return leaf.astype(dtype)
+
+    return _map_frozen(frozen, deq)
+
+
+def full_weight(frozen_leaf) -> Any:
+    """Dense full weight of one frozen leaf (API parity with reference
+    ``LoRAOptimizedLinear.full_weight``)."""
+    from ..ops.quant_matmul import QuantizedMatrix
+
+    if isinstance(frozen_leaf, QuantizedMatrix):
+        return frozen_leaf.dequantize()
+    return frozen_leaf
+
+
+def lora_merge(params: Dict[str, Any], frozen16: Dict[str, Any],
+               scaling: float) -> Dict[str, Any]:
+    """Fuse ``W + scaling * A @ B`` back into a model-structured tree.
+
+    ``frozen16`` must already be dense (see :func:`dequantize_frozen`) and is
+    ``stop_gradient``-ed: differentiating the result w.r.t. ``params`` gives
+    exact chain-rule gradients for A/B and none for the base — the
+    requires_grad split of reference optimized_linear.py:135-159.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def walk(tree, fro):
+        out = {}
+        for k, v in tree.items():
+            if is_lora_pair(v):
+                base = jax.lax.stop_gradient(fro[k])
+                a, b = v[LORA_A], v[LORA_B]
+                delta = jnp.matmul(a, b) * jnp.asarray(scaling, a.dtype)
+                out[k] = base + delta.astype(base.dtype)
+            elif isinstance(v, dict):
+                out[k] = walk(v, fro.get(k, {}) if isinstance(fro, dict) else {})
+            else:
+                out[k] = v
+        return out
+
+    return walk(params, frozen16)
+
+
+def lora_leaf_paths(params: Dict[str, Any], prefix: str = "") -> List[str]:
+    """Dotted paths of every lora factor leaf (test/introspection helper)."""
+    out = []
+    for k, v in params.items():
+        p = f"{prefix}{k}"
+        if is_lora_pair(v):
+            out += [f"{p}.{LORA_A}", f"{p}.{LORA_B}"]
+        elif isinstance(v, dict):
+            out += lora_leaf_paths(v, p + ".")
+    return out
+
+
+def split_specs(model_specs: Dict[str, Any], frozen_template: Dict[str, Any]):
+    """Transform a model PartitionSpec tree alongside :func:`lora_split`:
+    specs of target leaves move to the frozen-spec tree; the lora pair gets
+    replicated specs (factors are rank-r — sharding them buys nothing, and
+    the fused-weight sharding is decided where the merge output is used)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(spec_tree, fro):
+        out, fro_specs = {}, {}
+        for k, v in spec_tree.items():
+            in_frozen = isinstance(fro, dict) and k in fro
+            if in_frozen and not isinstance(fro[k], dict):
+                out[k] = {LORA_A: P(), LORA_B: P()}
+                fro_specs[k] = v
+            elif isinstance(v, dict):
+                o, f = walk(v, fro.get(k, {}) if isinstance(fro, dict) else {})
+                out[k] = o
+                if f:
+                    fro_specs[k] = f
+            else:
+                out[k] = v
+        return out, fro_specs
+
+    return walk(model_specs, frozen_template)
+
+
+# -- standalone single-matrix API (OptimizedLinear parity) -----------------
+
+def init_optimized_linear(key, input_dim: int, output_dim: int,
+                          lora_config: Optional[LoRAConfig] = None,
+                          quantization_config: Optional[QuantizationConfig] = None,
+                          dtype=None):
+    """Single-matrix analog of reference ``OptimizedLinear.__new__``:
+    returns ``(trainable, frozen)`` for ``y = x @ W``. With no lora config,
+    ``trainable`` is just the dense weight (nn.Linear fallback); with lora,
+    ``trainable`` is the A/B pair and ``frozen`` holds the (possibly
+    quantized) base."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    w = (jax.random.normal(key, (input_dim, output_dim), jnp.float32)
+         / math.sqrt(input_dim))
+    if lora_config is None and quantization_config is None:
+        return {"w": w.astype(dtype)}, {}
+    if lora_config is None:
+        return {}, encode_frozen({"w": w}, quantization_config, dtype)
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    single = LoRAConfig(lora_r=lora_config.lora_r, lora_alpha=lora_config.lora_alpha,
+                        target_mods=["w"])
+    trainable, frozen = lora_split({"w": w}, single,
+                                   rng=np.random.default_rng(seed))
+    return trainable, encode_frozen(frozen, quantization_config, dtype)
+
+
+def apply_optimized_linear(x, trainable, frozen, lora_config: Optional[LoRAConfig] = None):
+    """Forward for :func:`init_optimized_linear` outputs."""
+    if not frozen:
+        return x @ trainable["w"]
+    if not trainable:
+        return x @ full_weight(frozen["w"]).astype(x.dtype)
+    fro16 = dequantize_frozen(frozen, x.dtype)
+    t16 = {k: {LORA_A: v[LORA_A].astype(x.dtype), LORA_B: v[LORA_B].astype(x.dtype)}
+           for k, v in trainable.items()}
+    merged = lora_merge(t16, fro16, (lora_config or LoRAConfig()).scaling)
+    return x @ merged["w"]

@@ -60,6 +60,7 @@ class TrainState(NamedTuple):
     opt_state: Any
     loss_scale: ls.LossScaleState
     step: Any            # i32 scalar
+    frozen: Any = ()     # LoRA frozen base (bf16 / QuantizedMatrix); () when unused
 
 
 def _flatten_dict(tree, prefix=""):
@@ -155,6 +156,57 @@ class Engine:
                 logger.warning("shuffle_exchange enabled but data axis is 1; sync is a no-op")
             self.sync = DecentralizedSync(config.shuffle_exchange, self.replicas, seed=config.seed)
 
+        # --- LoRA / OptimizedLinear split (reference linear/ package) ----
+        # Target weight leaves leave the trainable tree for a frozen base
+        # tree (bf16 or int8 QuantizedMatrix); rank-r factor pairs take
+        # their place. Master/optimizer state then covers ONLY the factors
+        # and the untouched leaves — the reference's requires_grad split +
+        # optimizer-memory win, expressed as two pytrees.
+        self._lora = None
+        self._lora_frozen_specs = None
+        frozen_template = None
+        if config.lora.enabled:
+            from ..linear import optimized_linear as _ol
+
+            if self.ensemble:
+                # The fork's sync mixes WEIGHT trees across replicas; with
+                # lora the trainable tree is rank-r factors, and
+                # mix(A) @ mix(B) != mix(A @ B) — there is no consensus
+                # semantics that is both factor-space and model-space.
+                # Document-and-reject (same policy as seq x ensemble).
+                raise ConfigError(
+                    "lora is not supported with the decentralized ensemble "
+                    "(shuffle_exchange) mode: replica mixing is defined on "
+                    "weight trees, not LoRA factor pairs")
+            lora_cfg = _ol.LoRAConfig(
+                lora_r=config.lora.lora_r, lora_alpha=config.lora.lora_alpha,
+                base_weight_sharding=config.lora.base_weight_sharding,
+                target_mods=(list(config.lora.target_mods)
+                             or list(_ol.DEFAULT_TARGET_MODS)))
+            quant_cfg = (_ol.QuantizationConfig(q_bits=config.lora.q_bits,
+                                                group_size=config.lora.group_size)
+                         if config.lora.quantize_base else None)
+            self._lora = (lora_cfg, quant_cfg)
+            if config.lora.offload:
+                logger.warning(
+                    "lora.offload: the frozen base stays device-resident "
+                    "(its HBM cost is bf16/int8 and XLA gathers it lazily); "
+                    "flag accepted for config parity only")
+            if config.lora.quantize_base and config.lora.base_weight_sharding > 1:
+                logger.warning(
+                    "lora.base_weight_sharding is ignored with quantize_base: "
+                    "the int8 base (already 4x smaller) is replicated — "
+                    "per-(group,col) scales don't reshard cleanly")
+            if params_init_fn is not None:
+                params, frozen_template = _ol.lora_split(params, lora_cfg,
+                                                         abstract=True)
+            else:
+                params, frozen_template = _ol.lora_split(
+                    params, lora_cfg, rng=np.random.default_rng(config.seed))
+            if model_partition_specs is not None:
+                model_partition_specs, self._lora_frozen_specs = _ol.split_specs(
+                    model_partition_specs, frozen_template)
+
         # --- sharding policy -------------------------------------------
         # MiCS (reference runtime/zero/mics.py): optimizer/master shards stay
         # inside the fsdp sub-group; replicas across "data" are plain DP.
@@ -185,7 +237,48 @@ class Engine:
         self.param_shardings = jax.tree_util.tree_map(ens_sharding, param_specs)
         self.repl_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
 
+        # Frozen-base shardings (base_weight_sharding analog). bf16 leaves
+        # follow the model spec + ZeRO axes (master_spec when the reference
+        # knob asks for whole-world sharding, param_spec = follow-the-stage
+        # otherwise); a quantized base is replicated — int8 is already 4x
+        # smaller and per-(group,col) scales don't reshard cleanly.
+        self.frozen_shardings = ()
+        if self._lora is not None:
+            lora_cfg, quant_cfg = self._lora
+
+            def _enc_frozen(tree):
+                return _ol.encode_frozen(tree, quant_cfg, self.train_dtype)
+
+            self._encode_frozen = _enc_frozen
+            enc_shapes = jax.eval_shape(
+                _enc_frozen, jax.tree_util.tree_map(
+                    lambda v: jax.ShapeDtypeStruct(v.shape, jnp.float32),
+                    frozen_template))
+            if quant_cfg is not None:
+                self.frozen_shardings = jax.tree_util.tree_map(
+                    lambda _: self.repl_sharding, enc_shapes)
+            else:
+                spec_fn = (self.policy.master_spec
+                           if lora_cfg.base_weight_sharding > 1
+                           else self.policy.param_spec)
+
+                def fro_specs(tpl, model_specs):
+                    out = {}
+                    for k, v in tpl.items():
+                        s = (model_specs.get(k)
+                             if isinstance(model_specs, dict) else None)
+                        if isinstance(v, dict):
+                            out[k] = fro_specs(v, s if isinstance(s, dict) else {})
+                        else:
+                            out[k] = jax.sharding.NamedSharding(
+                                mesh, spec_fn(v.shape, s))
+                    return out
+
+                self.frozen_shardings = fro_specs(
+                    frozen_template, self._lora_frozen_specs or {})
+
         # --- place master params ---------------------------------------
+        frozen = ()
         if params_init_fn is not None:
             # zero.Init analog (reference partition_parameters.py:879 Init /
             # utils/init_on_device.py OnDevice): the init function is traced,
@@ -194,17 +287,33 @@ class Engine:
             # O(model), in host RAM and HBM alike.
             replicas = self.replicas
             ensemble = self.ensemble
+            if self._lora is not None:
+                split_init = _ol.lora_split_abstract_init(
+                    params_init_fn, self._lora[0])
 
-            def init_master(key):
-                p = params_init_fn(key)
-                p = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
-                if ensemble:
-                    p = jax.tree_util.tree_map(
-                        lambda x: jnp.broadcast_to(x[None], (replicas,) + x.shape), p)
-                return p
+                def init_master_lora(key):
+                    p, fro = split_init(key)
+                    p = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
+                    if ensemble:
+                        p = jax.tree_util.tree_map(
+                            lambda x: jnp.broadcast_to(x[None], (replicas,) + x.shape), p)
+                    return p, self._encode_frozen(fro)
 
-            master = jax.jit(init_master, out_shardings=self.master_shardings)(
-                jax.random.PRNGKey(seed))
+                master, frozen = jax.jit(
+                    init_master_lora,
+                    out_shardings=(self.master_shardings, self.frozen_shardings))(
+                        jax.random.PRNGKey(seed))
+            else:
+                def init_master(key):
+                    p = params_init_fn(key)
+                    p = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
+                    if ensemble:
+                        p = jax.tree_util.tree_map(
+                            lambda x: jnp.broadcast_to(x[None], (replicas,) + x.shape), p)
+                    return p
+
+                master = jax.jit(init_master, out_shardings=self.master_shardings)(
+                    jax.random.PRNGKey(seed))
         else:
             def place_master(p, sh):
                 arr = np.asarray(jax.device_get(p), dtype=np.float32)
@@ -213,6 +322,12 @@ class Engine:
                 return jax.device_put(arr, sh)
 
             master = jax.tree_util.tree_map(place_master, params, self.master_shardings)
+            if self._lora is not None:
+                frozen_host = jax.tree_util.tree_map(
+                    lambda p: np.asarray(jax.device_get(p), dtype=np.float32),
+                    frozen_template)
+                frozen = jax.jit(self._encode_frozen,
+                                 out_shardings=self.frozen_shardings)(frozen_host)
 
         # --- optimizer --------------------------------------------------
         self.client_optimizer = optimizer is not None
@@ -310,7 +425,8 @@ class Engine:
         scale_state = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, self.repl_sharding), ls.init_loss_scale(config.fp16))
         self.state = TrainState(master=master, opt_state=opt_state, loss_scale=scale_state,
-                                step=jax.device_put(jnp.asarray(0, jnp.int32), self.repl_sharding))
+                                step=jax.device_put(jnp.asarray(0, jnp.int32), self.repl_sharding),
+                                frozen=frozen)
         if self._host_opt_wanted:
             self._setup_host_optimizer()
 
@@ -441,6 +557,15 @@ class Engine:
         qz3_real = bool((qg or qw) and not ensemble and self.zero_stage == 3
                         and _no_model_axes
                         and any(axis_sizes.get(a, 1) > 1 for a in ("data", "fsdp")))
+        # LoRA: the manual int8-wire shard_map regions gather/reduce the
+        # MASTER tree; with lora the master is factors-only and the frozen
+        # base follows the auto path — keep the whole step on the auto path
+        # (emulation still applies the wire rounding numerics).
+        if self._lora is not None and (qg_real or qz3_real):
+            log_dist("lora: int8-wire shard_map regions disabled "
+                     "(auto-sharded step; qw/qg numerics via emulation)",
+                     ranks=[0])
+            qg_real = qz3_real = False
         if qg and not (qg_real or qz3_real):
             log_dist("zero_quantized_gradients: falling back to in-step "
                      "quantize-dequantize emulation (ensemble/model-"
@@ -457,7 +582,9 @@ class Engine:
 
         def fwd_weights(master, mix, step):
             p16 = jax.tree_util.tree_map(lambda m: m.astype(dtype), master)
-            if qw and not qz3_real:
+            # With lora, p16 is the factors-only tree — qwZ applies to the
+            # frozen base instead (see fro16_of), not the rank-r factors.
+            if qw and not qz3_real and self._lora is None:
                 p16 = jax.tree_util.tree_map(
                     lambda p: quantize_dequantize(p, group_size=2048).astype(dtype), p16)
             if ensemble:
@@ -466,26 +593,56 @@ class Engine:
                 p16 = compression_fn(p16, step)
             return p16
 
-        def scaled_loss_fn(p16, micro, rng, scale):
-            loss = self.loss_fn(p16, micro, rng)
+        # LoRA merge (reference optimized_linear.py:206 forward): the fused
+        # weights are built INSIDE the differentiated function so A/B take
+        # chain-rule gradients; the frozen base is stop_gradient-ed. fro16
+        # is the dequantized base, threaded through every grad path.
+        lora_on = self._lora is not None
+        if lora_on:
+            from ..linear import optimized_linear as _ol
+
+            _lora_scaling = self._lora[0].scaling
+            _lora_quantized = self._lora[1] is not None
+
+        def model_params(p16, fro16):
+            if not lora_on:
+                return p16
+            return _ol.lora_merge(p16, fro16, _lora_scaling)
+
+        def fro16_of(frozen):
+            if not lora_on:
+                return ()
+            fro16 = _ol.dequantize_frozen(frozen, dtype)
+            if qw and not _lora_quantized:
+                # ZeRO++ qwZ numerics on the tensor it actually gathers —
+                # the frozen base (skip when the base is ALREADY stored
+                # quantized; that rounding is real, not emulated).
+                fro16 = jax.tree_util.tree_map(
+                    lambda p: quantize_dequantize(p, group_size=2048).astype(dtype),
+                    fro16)
+            return fro16
+
+        def scaled_loss_fn(p16, fro16, micro, rng, scale):
+            loss = self.loss_fn(model_params(p16, fro16), micro, rng)
             return loss * scale.astype(loss.dtype), loss
 
-        def replica_grads(p16, micro, rng, scale):
+        def replica_grads(p16, fro16, micro, rng, scale):
             grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
-            g, loss = grad_fn(p16, micro, rng, scale)
+            g, loss = grad_fn(p16, fro16, micro, rng, scale)
             g = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
             return g, loss
 
-        def batch_grads(p16, micro, rng, scale):
+        def batch_grads(p16, fro16, micro, rng, scale):
             """Gradients for one microbatch; vmapped over replicas in ensemble mode."""
             if ensemble:
-                g, loss = jax.vmap(replica_grads, in_axes=(0, 0, None, None))(p16, micro, rng, scale)
+                g, loss = jax.vmap(replica_grads, in_axes=(0, None, 0, None, None))(
+                    p16, fro16, micro, rng, scale)
                 return g, jnp.mean(loss)
             if qz3_real:
                 return qz3_batch_grads(p16, micro, rng, scale)
             if qg_real:
                 return qg_batch_grads(p16, micro, rng, scale)
-            return replica_grads(p16, micro, rng, scale)
+            return replica_grads(p16, fro16, micro, rng, scale)
 
         def qz3_batch_grads(p16, micro, rng, scale):
             """ZeRO-3 with the int8 wire: master-sharded params in, int8
@@ -542,7 +699,7 @@ class Engine:
 
             def inner(p16, micro, rng, scale):
                 p_full = jax.tree_util.tree_map(gather_leaf, p16, specs)
-                g, loss = replica_grads(p_full, micro, rng, scale)
+                g, loss = replica_grads(p_full, (), micro, rng, scale)
                 g = jax.tree_util.tree_map(reduce_leaf, g, specs)
                 for ax in zero_axes:
                     loss = jax.lax.pmean(loss, ax)
@@ -564,7 +721,7 @@ class Engine:
             from ..parallel.compressed import quantized_hierarchical_reduce
 
             def inner(p16, micro, rng, scale):
-                g, loss = replica_grads(p16, micro, rng, scale)
+                g, loss = replica_grads(p16, (), micro, rng, scale)
                 g = jax.tree_util.tree_map(
                     lambda t: quantized_hierarchical_reduce(t, "fsdp", "data"), g)
                 loss = jax.lax.pmean(jax.lax.pmean(loss, "data"), "fsdp")
@@ -577,20 +734,20 @@ class Engine:
                 in_specs=(P(), P(("data", "fsdp")), P(), P()),
                 out_specs=(P(), P()), check_vma=False)(p16, micro, rng, scale)
 
-        def accumulate(master, p16, batch, rng, scale):
+        def accumulate(master, p16, fro16, batch, rng, scale):
             """lax.scan over the gas dim of the batch; fp32 accumulation."""
             zeros = jax.tree_util.tree_map(lambda m: jnp.zeros(m.shape, jnp.float32), master)
 
             def body(acc, micro_and_key):
                 micro, key = micro_and_key
-                g, loss = batch_grads(p16, micro, key, scale)
+                g, loss = batch_grads(p16, fro16, micro, key, scale)
                 acc = jax.tree_util.tree_map(jnp.add, acc, g)
                 return acc, loss
 
             keys = jax.random.split(rng, gas)
             if gas == 1:
                 micro = jax.tree_util.tree_map(lambda x: x[0], batch)
-                g, loss = batch_grads(p16, micro, keys[0], scale)
+                g, loss = batch_grads(p16, fro16, micro, keys[0], scale)
                 return g, loss
             acc, losses = jax.lax.scan(body, zeros, (batch, keys))
             return acc, jnp.mean(losses)
@@ -609,8 +766,9 @@ class Engine:
 
         def train_step(state: TrainState, batch, mix, rng):
             p16 = fwd_weights(state.master, mix, state.step)
+            fro16 = fro16_of(state.frozen)
             scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
-            grads, loss = accumulate(state.master, p16, batch, rng, scale)
+            grads, loss = accumulate(state.master, p16, fro16, batch, rng, scale)
             # normalize: mean over gas microbatches + undo loss scale
             denom = scale * gas
             if prescale and predivide != 1.0:
@@ -626,7 +784,8 @@ class Engine:
             new_opt = _tree_select(overflow, state.opt_state, new_opt)
             new_scale = ls.update(state.loss_scale, overflow, fp16_cfg)
             new_state = TrainState(master=new_master, opt_state=new_opt, loss_scale=new_scale,
-                                   step=state.step + jnp.where(overflow, 0, 1).astype(jnp.int32))
+                                   step=state.step + jnp.where(overflow, 0, 1).astype(jnp.int32),
+                                   frozen=state.frozen)
             grad_norm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree_util.tree_leaves(grads))).real
             return new_state, loss, overflow, grad_norm
 
@@ -635,11 +794,14 @@ class Engine:
 
         def eval_step(state: TrainState, batch, mix, rng):
             p16 = fwd_weights(state.master, mix, state.step)
+            fro16 = fro16_of(state.frozen)
             if ensemble:
                 micro = batch
-                loss = jnp.mean(jax.vmap(self.loss_fn, in_axes=(0, 0, None))(p16, micro, rng))
+                loss = jnp.mean(jax.vmap(
+                    lambda p, m: self.loss_fn(model_params(p, fro16), m, rng),
+                    in_axes=(0, 0))(p16, micro))
             else:
-                loss = self.loss_fn(p16, batch, rng)
+                loss = self.loss_fn(model_params(p16, fro16), batch, rng)
             return loss
 
         self._eval_step = jax.jit(eval_step)
@@ -647,15 +809,16 @@ class Engine:
         def grads_only(state: TrainState, micro, mix, rng):
             p16 = fwd_weights(state.master, mix, state.step)
             scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
-            g, loss = batch_grads(p16, micro, rng, scale)
+            g, loss = batch_grads(p16, fro16_of(state.frozen), micro, rng, scale)
             return g, loss
 
         self._grads_only = jax.jit(grads_only)
 
         def grads_batch(p16, batch, rng):
             """Whole-batch fp32 grads w.r.t. given forward weights (the
-            host-optimizer path: the update happens off device)."""
-            g, loss = accumulate(p16, p16, batch, rng, jnp.asarray(1.0, jnp.float32))
+            host-optimizer path, lora-ineligible: the update happens off
+            device)."""
+            g, loss = accumulate(p16, p16, (), batch, rng, jnp.asarray(1.0, jnp.float32))
             g = jax.tree_util.tree_map(lambda x: x / gas, g)
             return g, loss
 
@@ -671,12 +834,17 @@ class Engine:
             new_opt = _tree_select(overflow, state.opt_state, new_opt)
             new_scale = ls.update(state.loss_scale, overflow, fp16_cfg)
             return TrainState(new_master, new_opt, new_scale,
-                              state.step + jnp.where(overflow, 0, 1).astype(jnp.int32)), overflow
+                              state.step + jnp.where(overflow, 0, 1).astype(jnp.int32),
+                              state.frozen), overflow
 
         self._apply_only = jax.jit(apply_only, donate_argnums=(0,))
 
         def materialize(state: TrainState, mix):
-            return fwd_weights(state.master, mix, state.step)
+            # With lora, module_weights consumers (hybrid engine rollouts,
+            # HF export, inference import) get the FUSED model-structured
+            # weights — the reference's fuse_lora-before-generate.
+            return model_params(fwd_weights(state.master, mix, state.step),
+                                fro16_of(state.frozen))
 
         self._materialize = jax.jit(materialize)
         self._apply_mixing_jit = jax.jit(apply_mixing)
@@ -773,6 +941,8 @@ class Engine:
         # plumbing — the host path would silently drop them
         if cfg.compression_training:
             return "compression_training (in-graph transform)"
+        if self._lora is not None:
+            return "lora (frozen-base merge is an in-graph transform)"
         if cfg.zero_optimization.zero_quantized_weights or cfg.zero_optimization.zero_quantized_gradients:
             return "ZeRO++ quantized weights/gradients"
         from .data_pipeline import build_curriculum, build_random_ltd
@@ -1201,6 +1371,11 @@ class Engine:
             eng.save({"opt_state": self.state.opt_state,
                       "loss_scale": self.state.loss_scale,
                       "step": self.state.step}, os.path.join(path, "opt"))
+        # LoRA frozen base: separate item, droppable (reference
+        # exclude_frozen_parameters, engine.py save_checkpoint) — an
+        # adapter-only checkpoint restores against a base loaded elsewhere.
+        if self._lora is not None and not exclude_frozen_parameters:
+            eng.save(self.state.frozen, os.path.join(path, "frozen"))
         # Host-side metadata: single-writer (process 0) on shared storage.
         if jax.process_index() == 0:
             host = self._host_state()
@@ -1293,7 +1468,13 @@ class Engine:
             opt_state, loss_scale = rest["opt_state"], rest["loss_scale"]
             if load_lr_scheduler_states:
                 step = rest["step"]
-        self.state = TrainState(master=master, opt_state=opt_state, loss_scale=loss_scale, step=step)
+        frozen = self.state.frozen
+        if self._lora is not None and os.path.isdir(os.path.join(path, "frozen")):
+            # absent dir = adapter-only checkpoint (exclude_frozen_parameters):
+            # keep the live base, restore factors/optimizer only.
+            frozen = eng.load(os.path.join(path, "frozen"), target=self.state.frozen)
+        self.state = TrainState(master=master, opt_state=opt_state, loss_scale=loss_scale,
+                                step=step, frozen=frozen)
         host_path = os.path.join(path, "host_state.json")
         client_state = {}
         if os.path.exists(host_path):
