@@ -335,6 +335,16 @@ class CheckpointConfig(ConfigModel):
 
 
 @dataclass
+class PLDConfig(ConfigModel):
+    """Progressive layer drop (reference runtime/progressive_layer_drop.py:10
+    + constants.py:405 "progressive_layer_drop" section: theta/gamma)."""
+
+    enabled: bool = config_field(False)
+    theta: float = config_field(0.5, gt=0.0, le=1.0)
+    gamma: float = config_field(0.001, ge=0.0)
+
+
+@dataclass
 class LoRASectionConfig(ConfigModel):
     """LoRA / OptimizedLinear section (reference ``deepspeed/linear``:
     ``LoRAConfig`` + ``QuantizationConfig``, linear/config.py:13,39 — a
@@ -489,6 +499,7 @@ class SXConfig(ConfigModel):
 
     lora: LoRASectionConfig = config_field(default_factory=LoRASectionConfig,
                                            aliases=("optimized_linear",))
+    progressive_layer_drop: PLDConfig = config_field(default_factory=PLDConfig)
     shuffle_exchange: ShuffleExchangeConfig = config_field(default_factory=ShuffleExchangeConfig)
     mesh: MeshConfig = config_field(default_factory=MeshConfig)
     tensor_parallel: TensorParallelConfig = config_field(default_factory=TensorParallelConfig, aliases=("autotp",))

@@ -591,11 +591,15 @@ class Transformer:
                             out_specs=spec)(q, k, v)
         return out[:, :T0] if pad else out
 
-    def stack_apply(self, stacked_layers, x, rope, ltd_mask=None):
+    def stack_apply(self, stacked_layers, x, rope, ltd_mask=None, layer_keep=None):
         """Scan the (sub)stack of layers over x. Returns (x, summed aux).
 
         ``ltd_mask`` [B, T] bool (True = keep): random-LTD token freezing
-        for the configured middle layers."""
+        for the configured middle layers.
+        ``layer_keep`` [L] bool (True = run): progressive layer drop
+        (reference runtime/progressive_layer_drop.py) — a dropped layer is
+        an identity skip (its aux loss is zeroed too). Both masks are
+        traced, so the anneal never recompiles."""
         import jax
         import jax.numpy as jnp
 
@@ -610,7 +614,7 @@ class Transformer:
 
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P(("data", "fsdp"), "seq", None)))
-        if ltd_mask is None:
+        if ltd_mask is None and layer_keep is None:
             def layer_fn(h, lw):
                 return self.layer_apply(lw, h, rope)
 
@@ -620,18 +624,26 @@ class Transformer:
             return x, jnp.sum(aux_losses)
 
         L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
-        end = cfg.random_ltd_end_layer if cfg.random_ltd_end_layer >= 0 else L - 1
-        active = (jnp.arange(L) >= cfg.random_ltd_start_layer) & (jnp.arange(L) < end)
+        if ltd_mask is not None:
+            end = cfg.random_ltd_end_layer if cfg.random_ltd_end_layer >= 0 else L - 1
+            active = (jnp.arange(L) >= cfg.random_ltd_start_layer) & (jnp.arange(L) < end)
+        else:
+            active = jnp.zeros((L,), bool)
+        keep_layers = (jnp.ones((L,), bool) if layer_keep is None
+                       else jnp.asarray(layer_keep))
 
         def layer_fn(h, xs):
-            lw, act = xs
+            lw, act, keep_l = xs
             out, aux = self.layer_apply(lw, h, rope)
-            keep = jnp.logical_or(~act, ltd_mask)[..., None]   # [B,T,1]
-            return jnp.where(keep, out, h), aux
+            if ltd_mask is not None:
+                keep = jnp.logical_or(~act, ltd_mask)[..., None]   # [B,T,1]
+                out = jnp.where(keep, out, h)
+            out = jnp.where(keep_l, out, h)
+            return out, jnp.where(keep_l, aux, jnp.zeros_like(aux))
 
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg.remat_policy))
-        x, aux_losses = jax.lax.scan(layer_fn, x, (stacked_layers, active))
+        x, aux_losses = jax.lax.scan(layer_fn, x, (stacked_layers, active, keep_layers))
         return x, jnp.sum(aux_losses)
 
     def _unembed(self, params, dtype):
@@ -759,10 +771,11 @@ class Transformer:
         """input_ids [B, T] -> logits [B, T, vocab] (fp32)."""
         return self.apply_with_aux(params, input_ids)[0]
 
-    def apply_with_aux(self, params, input_ids, ltd_mask=None):
+    def apply_with_aux(self, params, input_ids, ltd_mask=None, layer_keep=None):
         """Returns (logits, moe_aux_loss) — aux is 0 for dense models."""
         x, rope = self.embed(params, input_ids)
-        x, aux = self.stack_apply(params["layers"], x, rope, ltd_mask=ltd_mask)
+        x, aux = self.stack_apply(params["layers"], x, rope, ltd_mask=ltd_mask,
+                                  layer_keep=layer_keep)
         return self.head(params, x), aux
 
     def loss(self, params, batch, rng=None):
@@ -785,17 +798,28 @@ class Transformer:
             rng, sub = jax.random.split(rng)
             keep = batch["ltd_keep_prob"][0]
             ltd_mask = jax.random.uniform(sub, model_ids.shape) < keep
+        layer_keep = None
+        if "pld_theta" in batch and rng is not None:
+            # Progressive layer drop (reference progressive_layer_drop.py:10;
+            # arXiv 2010.13369): keep prob anneals to theta_t and drops
+            # deeper layers more: p_l = 1 - (l/L) * (1 - theta_t).
+            import jax
+
+            rng, sub = jax.random.split(rng)
+            theta = jnp.asarray(batch["pld_theta"], jnp.float32).reshape(-1)[0]
+            L = self.config.n_layers
+            p_keep = 1.0 - (jnp.arange(L, dtype=jnp.float32) / L) * (1.0 - theta)
+            layer_keep = jax.random.uniform(sub, (L,)) < p_keep
         B, T = model_ids.shape
         chunk = self._loss_chunk(B, T)
         if chunk:
             x, rope = self.embed(params, model_ids)
-            if ltd_mask is not None:
-                x, aux = self.stack_apply(params["layers"], x, rope, ltd_mask=ltd_mask)
-            else:
-                x, aux = self.stack_apply(params["layers"], x, rope)
+            x, aux = self.stack_apply(params["layers"], x, rope,
+                                      ltd_mask=ltd_mask, layer_keep=layer_keep)
             nll_sum, count = self.chunked_loss(params, x, labels, chunk)
         else:
-            logits, aux = self.apply_with_aux(params, model_ids, ltd_mask=ltd_mask)
+            logits, aux = self.apply_with_aux(params, model_ids, ltd_mask=ltd_mask,
+                                              layer_keep=layer_keep)
             nll_sum, count = self.token_loss(logits, labels)
         ce = nll_sum / jnp.maximum(count, 1)
         return ce + self.config.aux_loss_coef * aux

@@ -139,3 +139,74 @@ def variable_batches(lengths: Sequence[int], max_tokens: int,
     return [{"indices": np.asarray(b, np.int64),
              "tokens": int(lengths[b].sum()),
              "lr_scale": len(b) / float(base)} for b in batches]
+
+
+def dynamic_batching_plan(lengths: Sequence[int], config: Dict[str, Any],
+                          base_batch_size: int, dp_world: int = 1,
+                          seed: int = 0) -> List[dict]:
+    """Batch plan for the reference ``dynamic_batching`` config section
+    (data_pipeline/constants.py:70-83 + variable_batch_size_and_lr.py):
+    ``max_tokens`` packs ~equal-token batches, ``sequence_picking_order``
+    in {dataloader, seqlen, random} orders the stream,
+    ``min_batch_size``/``max_batch_size`` clamp the pack, and
+    ``lr_scaling_method`` in {linear, sqrt, none} gives each batch an LR
+    multiplier relative to ``base_batch_size`` (the linear/sqrt scaling
+    rules the reference's lr_scheduler wrapper applies).
+
+    TPU note: each batch must still shard over the data axes, so index
+    lists are padded up to a multiple of ``dp_world`` by repeating the
+    last entries; ``lr_scale``/``tokens`` are computed from the REAL
+    samples (duplicates only overweight their tokens inside the loss mean,
+    they don't change the step size).
+    """
+    lengths = np.asarray(lengths, np.int64)
+    order_kind = config.get("sequence_picking_order", "dataloader")
+    if order_kind == "seqlen":
+        order = np.argsort(lengths, kind="stable")
+    elif order_kind == "random":
+        order = np.random.default_rng(seed).permutation(len(lengths))
+    elif order_kind == "dataloader":
+        order = np.arange(len(lengths))
+    else:
+        raise ValueError(f"sequence_picking_order must be dataloader|seqlen|random, "
+                         f"got {order_kind!r}")
+    max_tokens = int(config["max_tokens"])
+    batches = variable_batches(lengths, max_tokens, order=order,
+                               base_batch_size=base_batch_size)
+    min_bs = int(config.get("min_batch_size", 1))
+    max_bs = config.get("max_batch_size")
+    method = config.get("lr_scaling_method", "linear")
+    if method not in ("linear", "sqrt", "none"):
+        raise ValueError(f"lr_scaling_method must be linear|sqrt|none, got {method!r}")
+
+    out: List[dict] = []
+    n_dropped = 0
+    for b in batches:
+        idx = b["indices"]
+        chunks = ([idx[i:i + int(max_bs)] for i in range(0, len(idx), int(max_bs))]
+                  if max_bs else [idx])
+        for c in chunks:
+            if len(c) < min_bs:
+                n_dropped += len(c)  # reference drops under-min batches
+                continue
+            tokens = int(lengths[c].sum())
+            ratio = len(c) / float(base_batch_size)
+            scale = {"linear": ratio, "sqrt": float(np.sqrt(ratio)), "none": 1.0}[method]
+            padded = c
+            if dp_world > 1 and len(c) % dp_world:
+                pad = dp_world - len(c) % dp_world
+                # cyclic tiling: pad may exceed len(c) for small tail chunks
+                padded = np.concatenate([c, np.resize(c, pad)])
+            out.append({"indices": np.asarray(padded, np.int64),
+                        "n_real": len(c), "tokens": tokens,
+                        "lr_scale": float(scale)})
+    if not out:
+        raise ValueError("dynamic_batching produced no batches >= min_batch_size")
+    if n_dropped:
+        from ..utils.logging import logger
+
+        logger.warning(
+            "dynamic_batching: %d samples dropped per epoch (chunks under "
+            "min_batch_size=%d after max_batch_size=%s splitting)",
+            n_dropped, min_bs, max_bs)
+    return out

@@ -132,6 +132,19 @@ class Engine:
         self.gas = config.gradient_accumulation_steps
         self.zero_stage = config.zero_optimization.stage
 
+        if config.sparse_gradients:
+            # Reference sparse_gradients (engine.py:2752-2824) swaps the
+            # embedding-grad allreduce for a sparse (index, value) wire — a
+            # torch-DDP bandwidth workaround. Under XLA the embedding grad
+            # is a fused scatter-add into the dense grad buffer before the
+            # psum; there is no sparse collective to route it through, so
+            # accepting the flag would silently change nothing. Reject.
+            raise ConfigError(
+                "sparse_gradients is not supported on the TPU backend: XLA "
+                "reduces dense gradients (the sparse allreduce is a torch-"
+                "DDP embedding optimization with no XLA counterpart) — "
+                "remove the flag")
+
         # --- sequence parallelism guard --------------------------------
         # The model's Ulysses shard_map (models/transformer.py _attention)
         # assumes the standard activation layout [batch over data+fsdp,
@@ -454,6 +467,16 @@ class Engine:
         self._curriculum = build_curriculum(config)
         self._ltd = build_random_ltd(config)
         self._curriculum_difficulty = None
+        # Progressive layer drop (reference engine.py pld wiring +
+        # progressive_layer_drop.py:10): the engine owns the theta schedule,
+        # the model consumes batch["pld_theta"].
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta,
+                gamma=config.progressive_layer_drop.gamma)
         # difficulty-as-token-count truncation only makes sense for the
         # seqlen curriculum type; other metrics (rarity, perplexity, ...)
         # drive SAMPLING only (reference seqlen-specific truncation)
@@ -509,6 +532,50 @@ class Engine:
                 self._sampled_collate = self.training_dataloader.collate_fn
         else:
             self._data_iter = None
+
+        # --- dynamic batching (reference data_pipeline dynamic_batching
+        # section, constants.py:70 + variable_batch_size_and_lr.py):
+        # ~equal-token batches from the seqlen metric, each step's LR scaled
+        # by the batch-size ratio. Shapes vary per bucket, so each distinct
+        # (B, T) compiles once — pick order "seqlen" to keep buckets few.
+        self._dyn_plan = None
+        self._dyn_pos = 0
+        dyn_cfg = dict(dict(config.data_efficiency or {})
+                       .get("data_sampling", {}).get("dynamic_batching", {}))
+        if dyn_cfg.get("enabled", False):
+            if training_data is None:
+                raise ConfigError("dynamic_batching needs training_data at initialize()")
+            if self.gas != 1:
+                raise ConfigError(
+                    "dynamic_batching requires gradient_accumulation_steps == 1 "
+                    "(token-packed batches don't split into fixed microbatches)")
+            if self.ensemble:
+                raise ConfigError("dynamic_batching is not supported with the "
+                                  "decentralized ensemble mode")
+            from .data_sampling import dynamic_batching_plan, load_metric
+
+            metrics_path = dyn_cfg.get("metrics_path")
+            if metrics_path:
+                seqlens = load_metric(metrics_path, "seqlen").astype(np.int64)
+                if len(seqlens) != len(training_data):
+                    raise ConfigError(
+                        f"dynamic_batching seqlen metric ({len(seqlens)} entries) "
+                        f"does not match training_data ({len(training_data)})")
+            else:
+                seqlens = np.asarray(
+                    [len(s["input_ids"] if isinstance(s, dict) else s)
+                     for s in training_data], np.int64)
+            axis_sizes = topology.axis_sizes
+            dp_world = axis_sizes.get("data", 1) * axis_sizes.get("fsdp", 1)
+            self._dyn_plan = dynamic_batching_plan(
+                seqlens, dyn_cfg, base_batch_size=config.train_batch_size,
+                dp_world=dp_world, seed=config.seed)
+            self._dyn_dataset = training_data
+            self._dyn_collate = self.training_dataloader.collate_fn
+            log_dist(f"dynamic_batching: {len(self._dyn_plan)} batches/epoch, "
+                     f"max_tokens={dyn_cfg['max_tokens']}, "
+                     f"lr_scaling={dyn_cfg.get('lr_scaling_method', 'linear')}",
+                     ranks=[0])
 
         # --- jitted programs -------------------------------------------
         self._build_programs()
@@ -752,19 +819,30 @@ class Engine:
             acc, losses = jax.lax.scan(body, zeros, (batch, keys))
             return acc, jnp.mean(losses)
 
-        def apply_update(grads, opt_state, master):
+        def apply_update(grads, opt_state, master, lr_mult=None):
+            # lr_mult: dynamic-batching LR ratio (reference
+            # lr_scheduler_for_variable_batch_size) — the final optax update
+            # is linear in lr, so scaling the update IS scaling the lr.
+            def scale_updates(updates):
+                if lr_mult is None:
+                    return updates
+                return jax.tree_util.tree_map(
+                    lambda u: u * lr_mult.astype(u.dtype), updates)
+
             if ensemble:
                 def upd(g, o, m):
                     updates, new_o = self.tx.update(g, o, m)
+                    updates = scale_updates(updates)
                     return jax.tree_util.tree_map(lambda a, u: a + u, m, updates), new_o
 
                 return jax.vmap(upd)(grads, opt_state, master)
             updates, new_o = self.tx.update(grads, opt_state, master)
+            updates = scale_updates(updates)
             import optax
 
             return optax.apply_updates(master, updates), new_o
 
-        def train_step(state: TrainState, batch, mix, rng):
+        def train_step(state: TrainState, batch, mix, rng, lr_mult):
             p16 = fwd_weights(state.master, mix, state.step)
             fro16 = fro16_of(state.frozen)
             scale = state.loss_scale.scale if fp16_cfg.enabled else jnp.asarray(1.0, jnp.float32)
@@ -779,7 +857,13 @@ class Engine:
                 grads = jax.tree_util.tree_map(
                     lambda g: quantize_dequantize(g, group_size=2048), grads)
             overflow = ls.check_overflow(grads) if fp16_cfg.enabled else jnp.asarray(False)
-            new_master, new_opt = apply_update(grads, state.opt_state, state.master)
+            # lr_mult only participates when dynamic batching is live — the
+            # common path skips the O(params) update rescale entirely
+            # (_build_programs runs after the dyn-plan setup, so this is a
+            # trace-time constant).
+            new_master, new_opt = apply_update(
+                grads, state.opt_state, state.master,
+                lr_mult if self._dyn_plan is not None else None)
             new_master = _tree_select(overflow, state.master, new_master)
             new_opt = _tree_select(overflow, state.opt_state, new_opt)
             new_scale = ls.update(state.loss_scale, overflow, fp16_cfg)
@@ -949,6 +1033,9 @@ class Engine:
 
         if build_curriculum(cfg) is not None or build_random_ltd(cfg) is not None:
             return "curriculum / random-LTD data-efficiency schedules"
+        if dict(cfg.data_efficiency or {}).get("data_sampling", {}).get(
+                "dynamic_batching", {}).get("enabled", False):
+            return "dynamic batching (per-batch LR scale is a device-step input)"
         return None
 
     def _setup_host_optimizer(self) -> None:
@@ -1071,8 +1158,17 @@ class Engine:
         ``batch`` leaves are [train_batch_size, ...]; alternatively pull from
         ``data_iter`` or the engine's own dataloader (reference
         PipelineEngine.train_batch signature)."""
+        lr_mult = 1.0
+        n_samples = None
         if batch is None:
-            if data_iter is None and self._curriculum_sampler is not None:
+            if data_iter is None and self._dyn_plan is not None:
+                entry = self._dyn_plan[self._dyn_pos % len(self._dyn_plan)]
+                self._dyn_pos += 1
+                batch = self._dyn_collate([self._dyn_dataset[int(i)]
+                                           for i in entry["indices"]])
+                lr_mult = entry["lr_scale"]
+                n_samples = entry["n_real"]
+            elif data_iter is None and self._curriculum_sampler is not None:
                 idx = self._curriculum_sampler.sample(
                     self.global_steps, self.config.train_batch_size)
                 batch = self._sampled_collate([self._sampled_dataset[int(i)]
@@ -1098,6 +1194,12 @@ class Engine:
             batch = dict(batch)
             batch["ltd_keep_prob"] = np.full((b,), self._ltd.keep_prob(self.global_steps),
                                              np.float32)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+            b = len(next(iter(batch.values())))
+            batch = dict(batch)
+            batch["pld_theta"] = np.full(
+                (b,), self.progressive_layer_drop.get_theta(), np.float32)
         shaped = self._reshape_batch(batch)
         mix = self._mix_matrix(advance=True)
         rng = self._next_rng()
@@ -1108,16 +1210,20 @@ class Engine:
                 "flops_profiler: profile_step=1 measures the first step, whose wall clock "
                 "includes XLA compilation — set profile_step>=2 for steady-state TFLOPS")
         t0 = time.time() if profiling else 0.0
-        self.state, loss, overflow, grad_norm = self._train_step(self.state, shaped, mix, rng)
+        lr_mult_arr = np.asarray(lr_mult, np.float32)
+        self.state, loss, overflow, grad_norm = self._train_step(
+            self.state, shaped, mix, rng, lr_mult_arr)
         if profiling:
             import jax
 
             jax.block_until_ready(loss)
-            self.flops_profiler.profile(self._train_step, (self.state, shaped, mix, rng),
+            self.flops_profiler.profile(self._train_step,
+                                        (self.state, shaped, mix, rng, lr_mult_arr),
                                         latency_s=time.time() - t0,
-                                        batch_size=self.config.train_batch_size)
+                                        batch_size=(n_samples if n_samples is not None
+                                                    else self.config.train_batch_size))
         self._last_grad_norm = grad_norm
-        self._post_step(overflow)
+        self._post_step(overflow, n_samples=n_samples)
         if self.monitor.enabled:
             s = self.global_samples
             self.monitor.write_events([
@@ -1209,9 +1315,10 @@ class Engine:
             return self._eval16(self._fwd16, self._take_micro(shaped), rng or self._next_rng())
         return self._eval_step(self.state, self._take_micro(shaped), self._mix_matrix(), rng or self._next_rng())
 
-    def _post_step(self, overflow) -> None:
+    def _post_step(self, overflow, n_samples: Optional[int] = None) -> None:
         self.global_steps += 1
-        self.global_samples += self.config.train_batch_size
+        self.global_samples += (n_samples if n_samples is not None
+                                else self.config.train_batch_size)
         if self.sync is not None:
             # Reference calls shuffle_exchange() per batch to drive ring
             # re-randomization (stage_1_and_2.py:694-698).
@@ -1271,7 +1378,8 @@ class Engine:
             return  # nothing to pre-warm without an example batch
         shaped = self._reshape_batch(batch)
         lowered = self._train_step.lower(self.state, shaped, self._mix_matrix(),
-                                         self._next_rng_peek())
+                                         self._next_rng_peek(),
+                                         np.asarray(1.0, np.float32))
         lowered.compile()
         log_dist("engine.compile(): train step AOT-compiled", ranks=[0])
 
@@ -1310,6 +1418,8 @@ class Engine:
             "micro_steps": self.micro_steps,
             "rng_state": self._rng.bit_generator.state,
         }
+        if self._dyn_plan is not None:
+            state["dyn_batch_pos"] = self._dyn_pos
         if self._curriculum_sampler is not None:
             state["curriculum_sampler_rng"] = \
                 self._curriculum_sampler.rng.bit_generator.state
@@ -1334,6 +1444,8 @@ class Engine:
         if self._curriculum_sampler is not None and "curriculum_sampler_rng" in state:
             self._curriculum_sampler.rng.bit_generator.state = \
                 state["curriculum_sampler_rng"]
+        if self._dyn_plan is not None and "dyn_batch_pos" in state:
+            self._dyn_pos = int(state["dyn_batch_pos"])
         if self.sync is not None and "sync" in state:
             s = state["sync"]
             self.sync.batch_count = s["batch_count"]

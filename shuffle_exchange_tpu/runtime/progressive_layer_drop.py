@@ -1,0 +1,43 @@
+"""Progressive Layer Drop (reference runtime/progressive_layer_drop.py:10).
+
+PLD (arXiv 2010.13369): layers are stochastically skipped during training
+with a keep probability that anneals from 1.0 down to ``theta`` following
+``theta_t = (1 - theta) * exp(-gamma * t) + theta``, applied progressively
+with depth (deeper layers dropped more). The reference engine owns only the
+theta schedule and hands ``pld_theta`` to the model each step
+(``get_state``, engine.py pld wiring); the model applies the drop.
+
+TPU note: the model consumes theta as a TRACED scalar and applies the drop
+as an in-graph layer mask (models/transformer.py stack_apply) — like
+random-LTD, this keeps one compiled program across the whole anneal (no
+per-pattern recompiles), trading the reference's skipped-compute wall-clock
+win for the same training dynamics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    """Theta schedule (field/method parity with the reference class)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = ((1.0 - self.theta)
+                              * float(np.exp(-self.gamma * global_step))
+                              + self.theta)

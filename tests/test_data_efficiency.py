@@ -217,3 +217,153 @@ def test_curriculum_small_pool_bounded_duplication():
     s2 = CurriculumSampler(vals, lambda step: 1e9, seed=0)
     b2 = s2.sample(0, 32)
     assert len(set(b2.tolist())) == 32
+
+
+def test_sparse_gradients_flag_rejected():
+    """VERDICT r3 weak #7: sparse_gradients was a silent no-op — it must now
+    be an explicit ConfigError (XLA reduces dense gradients; the sparse
+    allreduce is a torch-DDP embedding optimization, reference
+    engine.py:2752)."""
+    import pytest
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.config import ConfigError
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
+    with pytest.raises(ConfigError, match="sparse_gradients"):
+        sxt.initialize(model=model, config={
+            "train_batch_size": 8, "sparse_gradients": True,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9,
+        })
+
+
+def test_progressive_layer_drop_schedule_and_training():
+    """Reference runtime/progressive_layer_drop.py:10: theta anneals
+    (1-theta)*exp(-gamma*t)+theta; the engine exposes the reference's
+    get_state() surface and training stays finite with layers dropping."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.runtime.progressive_layer_drop import \
+        ProgressiveLayerDrop
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert abs(pld.get_theta() - 1.0) < 1e-9
+    pld.update_state(10**6)
+    assert abs(pld.get_theta() - 0.5) < 1e-6
+    assert pld.get_state()["progressive_layer_drop"] is True
+
+    model = Transformer(tiny(vocab=64, d=32, layers=4, heads=2, seq=32))
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        "steps_per_print": 10**9,
+    })
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, size=(8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # theta advanced off 1.0 as steps accumulated
+    assert engine.progressive_layer_drop.get_theta() < 1.0
+
+
+def test_dynamic_batching_plan_packing_and_lr_scale():
+    from shuffle_exchange_tpu.runtime.data_sampling import dynamic_batching_plan
+
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(8, 64, size=40)
+    plan = dynamic_batching_plan(
+        lengths, {"max_tokens": 256, "sequence_picking_order": "seqlen",
+                  "lr_scaling_method": "linear", "min_batch_size": 1},
+        base_batch_size=4, dp_world=2)
+    covered = np.concatenate([p["indices"][:p["n_real"]] for p in plan])
+    assert sorted(covered.tolist()) == sorted(np.arange(40).tolist())
+    for p in plan:
+        assert lengths[p["indices"][:p["n_real"]]].sum() <= 256 or p["n_real"] == 1
+        assert len(p["indices"]) % 2 == 0              # padded to dp_world
+        assert abs(p["lr_scale"] - p["n_real"] / 4.0) < 1e-9
+    # sqrt + max_batch_size clamp
+    plan2 = dynamic_batching_plan(
+        lengths, {"max_tokens": 256, "lr_scaling_method": "sqrt",
+                  "max_batch_size": 3}, base_batch_size=4)
+    assert all(p["n_real"] <= 3 for p in plan2)
+    assert all(abs(p["lr_scale"] - np.sqrt(p["n_real"] / 4.0)) < 1e-9 for p in plan2)
+
+
+def test_dynamic_batching_engine_end_to_end():
+    """data_efficiency.data_sampling.dynamic_batching drives train_batch():
+    token-packed variable batches from training_data, per-batch LR ratio
+    applied in-step, sample accounting follows real batch sizes."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    rng = np.random.default_rng(0)
+    # fixed-width samples so the default collate stacks cleanly; batch SIZES
+    # still vary through the token budget
+    data = [{"input_ids": rng.integers(0, 64, size=(32,)).astype(np.int32)}
+            for _ in range(64)]
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "data_efficiency": {"data_sampling": {"dynamic_batching": {
+            "enabled": True, "max_tokens": 32 * 6,
+            "sequence_picking_order": "seqlen",
+            "lr_scaling_method": "linear"}}},
+        "steps_per_print": 10**9,
+    }, training_data=data)
+    assert engine._dyn_plan is not None
+    sizes = {p["n_real"] for p in engine._dyn_plan}
+    assert sizes == {6, 4}  # 64 samples at 32 tokens / 192-token budget: 10x6 + 1x4
+    s0 = engine.global_samples
+    l0 = float(engine.train_batch())
+    assert np.isfinite(l0)
+    assert engine.global_samples - s0 == 6  # real samples, not config batch size
+    l1 = float(engine.train_batch())
+    assert np.isfinite(l1)
+
+
+def test_dynamic_batching_rejects_gas_and_missing_data():
+    import pytest
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.config import ConfigError
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "data_efficiency": {"data_sampling": {"dynamic_batching": {
+            "enabled": True, "max_tokens": 128}}},
+        "steps_per_print": 10**9,
+    }
+    with pytest.raises(ConfigError, match="training_data"):
+        sxt.initialize(model=model, config=cfg)
+    data = [{"input_ids": np.zeros((16,), np.int32)} for _ in range(8)]
+    cfg2 = dict(cfg, train_batch_size=64, gradient_accumulation_steps=2,
+                train_micro_batch_size_per_gpu=4)
+    with pytest.raises(ConfigError, match="gradient_accumulation_steps"):
+        sxt.initialize(model=model, config=cfg2, training_data=data)
+
+
+def test_dynamic_batching_pad_exceeding_chunk_len():
+    """Review r4: a tail chunk smaller than dp_world must still pad to a
+    full multiple (cyclic tiling), e.g. 3 samples on an 8-way data mesh."""
+    from shuffle_exchange_tpu.runtime.data_sampling import dynamic_batching_plan
+
+    lengths = np.full(11, 10, np.int64)          # 11 samples, 10 tokens each
+    plan = dynamic_batching_plan(
+        lengths, {"max_tokens": 80}, base_batch_size=8, dp_world=8)
+    for p in plan:
+        assert len(p["indices"]) % 8 == 0, p
+    # tail batch: 3 real samples padded to 8
+    tail = plan[-1]
+    assert tail["n_real"] == 3 and len(tail["indices"]) == 8
+    assert set(tail["indices"]) <= set(range(11))

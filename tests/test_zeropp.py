@@ -113,7 +113,8 @@ def test_qgz_wire_is_int8(devices8):
     batch = _batch()
     shaped = engine._reshape_batch(batch)
     low = engine._train_step.lower(engine.state, shaped, engine._mix_matrix(),
-                                   jax.random.PRNGKey(0))
+                                   jax.random.PRNGKey(0),
+                                   np.asarray(1.0, np.float32))
     hlo = low.compile().as_text()
     s8_gathers = [l for l in hlo.splitlines() if "all-gather" in l and "s8" in l]
     assert s8_gathers, "no s8 all-gather in compiled HLO — qgZ wire compression inactive"
@@ -150,7 +151,8 @@ def test_stage3_wire_is_int8(devices8):
     batch = _batch()
     shaped = engine._reshape_batch(batch)
     low = engine._train_step.lower(engine.state, shaped, engine._mix_matrix(),
-                                   jax.random.PRNGKey(0))
+                                   jax.random.PRNGKey(0),
+                                   np.asarray(1.0, np.float32))
     hlo = low.compile().as_text()
     s8_gathers = [l for l in hlo.splitlines() if "all-gather" in l and "s8" in l]
     s8_a2a = [l for l in hlo.splitlines() if "all-to-all" in l and "s8" in l]
