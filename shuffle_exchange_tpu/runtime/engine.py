@@ -472,6 +472,14 @@ class Engine:
         # the model consumes batch["pld_theta"].
         self.progressive_layer_drop = None
         if config.progressive_layer_drop.enabled:
+            if topology.axis_sizes.get("pipe", 1) > 1:
+                # the pipeline stage_fn drives stack_apply directly and does
+                # not thread pld_theta — reject rather than silently train
+                # dense (same policy as sparse_gradients).
+                raise ConfigError(
+                    "progressive_layer_drop is not supported with pipeline "
+                    "parallelism (pipe > 1): the stage loss does not thread "
+                    "the layer-drop schedule")
             from .progressive_layer_drop import ProgressiveLayerDrop
 
             self.progressive_layer_drop = ProgressiveLayerDrop(
@@ -1036,6 +1044,8 @@ class Engine:
         if dict(cfg.data_efficiency or {}).get("data_sampling", {}).get(
                 "dynamic_batching", {}).get("enabled", False):
             return "dynamic batching (per-batch LR scale is a device-step input)"
+        if cfg.progressive_layer_drop.enabled:
+            return "progressive layer drop (theta is a device-step input)"
         return None
 
     def _setup_host_optimizer(self) -> None:

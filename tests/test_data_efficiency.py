@@ -367,3 +367,33 @@ def test_dynamic_batching_pad_exceeding_chunk_len():
     tail = plan[-1]
     assert tail["n_real"] == 3 and len(tail["indices"]) == 8
     assert set(tail["indices"]) <= set(range(11))
+
+
+def test_pld_rejected_with_pipeline_and_ineligible_for_host_opt():
+    """Review r4: PLD + pipe>1 must reject (the stage loss doesn't thread
+    theta), and PLD makes the host-resident optimizer ineligible."""
+    import pytest
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.config import ConfigError
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=64, d=32, layers=4, heads=2, seq=32))
+    with pytest.raises(ConfigError, match="progressive_layer_drop"):
+        sxt.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"pipe": 2, "data": -1},
+            "gradient_accumulation_steps": 2,
+            "progressive_layer_drop": {"enabled": True},
+            "steps_per_print": 10**9})
+
+    from shuffle_exchange_tpu.parallel import reset_topology
+    reset_topology()
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True},
+        "steps_per_print": 10**9})
+    assert engine._host_opt_ineligible(None) == \
+        "progressive layer drop (theta is a device-step input)"

@@ -254,3 +254,110 @@ def test_engine_seq_times_expert_moe_matches_dp(devices8):
     reset_topology()
 
     np.testing.assert_allclose(l_sp, l_dp, rtol=5e-3)
+
+
+def test_ring_attention_backward_residuals_not_quadratic(devices8):
+    """VERDICT r3 weak #5: ring backward must hold O(T/sp * D) residuals,
+    not [T/sp, T/sp] fp32 score matrices. The vjp closure's saved arrays
+    ARE the residuals — assert none carries a (Tq, Tq) score block."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    topo = _seq_mesh(devices8, sp=4)
+    Tq = 64  # per-device shard: 256 global / sp 4
+    q, k, v = _qkv(t=256, h=4, d=16)
+    spec = P(None, "seq", None, None)
+
+    def local(q, k, v):
+        out = ring_attention(q, k, v, axis_name="seq", causal=True, kv_chunk=32)
+        return out
+
+    f = jax.jit(shard_map(local, mesh=topo.mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec))
+
+    def loss(q, k, v):
+        return f(q, k, v).sum()
+
+    _, vjp_fn = jax.vjp(loss, q, k, v)
+    leaves = jax.tree_util.tree_leaves(vjp_fn)
+    quad = [tuple(l.shape) for l in leaves
+            if hasattr(l, "shape") and l.ndim >= 2
+            and sum(1 for s in l.shape if s == Tq) >= 2]
+    assert not quad, f"quadratic residuals saved for backward: {quad}"
+    # and the gradient is actually correct vs the reference
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    g_ring = jax.grad(loss, argnums=0)(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: reference_attention(q, k, v, causal=True)
+                     .astype(np.float32).sum())(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("h,kvh,sp", [(6, 2, 4), (12, 3, 8), (5, 5, 4)])
+def test_ulysses_uneven_heads_kv_not_expanded(devices8, h, kvh, sp):
+    """VERDICT r3 weak #5 (second half): the uneven-head path must NOT
+    expand GQA KV to H before the all-to-all. The local attention must see
+    the group-aligned UNEXPANDED kv head count (Hp/n_rep per-rank heads on
+    the wire, not H), and the output still matches the reference."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+    from shuffle_exchange_tpu.parallel.sequence import DistributedAttention
+
+    topo = _seq_mesh(devices8, sp=sp)
+    q, k, v = _qkv(t=8 * sp, h=h, kvh=kvh)
+    n_rep = h // kvh
+    hc = -(-h // sp // n_rep) * n_rep      # per-rank q heads
+    seen = {}
+
+    def local(q_, k_, v_):
+        seen["q_heads"], seen["kv_heads"] = q_.shape[2], k_.shape[2]
+        return reference_attention(q_, k_, v_, causal=True)
+
+    spec = P(None, "seq", None, None)
+    fn = shard_map(lambda q, k, v: DistributedAttention(local)(q, k, v),
+                   mesh=topo.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = fn(q, k, v)
+    assert seen["q_heads"] == hc
+    assert seen["kv_heads"] == hc // n_rep  # unexpanded GQA on the wire
+    # wire bytes: kv a2a carries sp * (hc/n_rep) = Hp/n_rep heads total,
+    # strictly fewer than the old expand-to-H path whenever n_rep > 1
+    if n_rep > 1:
+        assert sp * (hc // n_rep) < -(-h // sp) * sp
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_uneven_mqa_falls_back_to_expand(devices8):
+    """Review r4: when ceil(H/sp) < n_rep (MQA-ish KV, large sp), group-
+    aligned padding would inflate q to sp*n_rep heads — the expand path is
+    cheaper there and must be used; output stays correct."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+    from shuffle_exchange_tpu.parallel.sequence import DistributedAttention
+
+    sp, h, kvh = 8, 8, 2   # hc would be 2*? -> hp 32 vs expand hp 8
+    topo = _seq_mesh(devices8, sp=sp)
+    q, k, v = _qkv(t=8 * sp, h=h, kvh=kvh)
+    seen = {}
+
+    def local(q_, k_, v_):
+        seen["q_heads"], seen["kv_heads"] = q_.shape[2], k_.shape[2]
+        return reference_attention(q_, k_, v_, causal=True)
+
+    spec = P(None, "seq", None, None)
+    fn = shard_map(lambda q, k, v: DistributedAttention(local)(q, k, v),
+                   mesh=topo.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = fn(q, k, v)
+    assert seen["q_heads"] == 1          # hp_expand/sp = 8/8
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
