@@ -89,7 +89,10 @@ class DistributedAttention:
             hc = -(-H // sp // n_rep) * n_rep
             hp, kvp = sp * hc, sp * hc // n_rep
             hp_expand = -(-H // sp) * sp   # old path: expand KV to H, pad
-            if hp + 2 * kvp > 3 * hp_expand:
+            # >= : on wire-byte ties the expand path wins — group-aligned
+            # padding always has at least as much q padding, so it costs
+            # strictly more local attention FLOPs for the same bytes.
+            if hp + 2 * kvp >= 3 * hp_expand:
                 # Group-aligned padding loses when ceil(H/sp) < n_rep
                 # (MQA-ish KV with large sp: q pads to sp*n_rep heads).
                 # Fall back to expanding KV to H — total wire heads
@@ -124,10 +127,15 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", attn_fn: Optional[Callabl
 
 
 def _ring_kv_chunk(Tq: int, requested: int = 1024) -> int:
-    """Largest divisor of Tq that is <= requested (flash-style kv tiling)."""
+    """Largest divisor of Tq that is <= requested (flash-style kv tiling).
+    Shard lengths with no usable divisor (prime-ish Tq would otherwise
+    degrade to ck=1 — a Tq-step scan of rank-1 einsums) fall back to one
+    whole-block chunk; remat still bounds backward residuals per hop."""
     c = min(Tq, requested)
     while Tq % c:
         c -= 1
+    if c < min(64, Tq):
+        return Tq
     return c
 
 

@@ -295,7 +295,7 @@ def test_ring_attention_backward_residuals_not_quadratic(devices8):
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("h,kvh,sp", [(6, 2, 4), (12, 3, 8), (5, 5, 4)])
+@pytest.mark.parametrize("h,kvh,sp", [(6, 2, 4), (12, 4, 8), (5, 5, 4)])
 def test_ulysses_uneven_heads_kv_not_expanded(devices8, h, kvh, sp):
     """VERDICT r3 weak #5 (second half): the uneven-head path must NOT
     expand GQA KV to H before the all-to-all. The local attention must see
