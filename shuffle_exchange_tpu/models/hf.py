@@ -41,13 +41,23 @@ _ARCH_FAMILIES = {
     "FalconForCausalLM": "falcon",
     "RWForCausalLM": "falcon",            # legacy tiiuae checkpoints
     "BloomForCausalLM": "bloom",
+    "BertForMaskedLM": "bert",
+    "BertForPreTraining": "bert",
+    "BertModel": "bert",
+    "DistilBertForMaskedLM": "distilbert",
+    "GPTNeoForCausalLM": "gptneo",
+    "InternLMForCausalLM": "internlm",
+    "InternLM2ForCausalLM": "internlm2",
 }
 
 
 _MODEL_TYPE_FAMILIES = {"llama": "llama", "mistral": "llama", "qwen2": "qwen2",
                         "mixtral": "mixtral", "gpt2": "gpt2", "opt": "opt",
                         "phi3": "phi3", "gptj": "gptj", "gpt_neox": "gptneox",
-                        "falcon": "falcon", "bloom": "bloom", "qwen2_moe": "qwen2moe"}
+                        "falcon": "falcon", "bloom": "bloom", "qwen2_moe": "qwen2moe",
+                        "bert": "bert", "distilbert": "distilbert",
+                        "gpt_neo": "gptneo", "internlm": "internlm",
+                        "internlm2": "internlm2"}
 
 
 def _family(cfg: Dict[str, Any]) -> str:
@@ -136,6 +146,53 @@ def config_from_hf(hf_config) -> TransformerConfig:
             mlp_bias=cfg.get("bias", False),
             norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=cfg.get("tie_word_embeddings", True))
+    if family == "bert":
+        # encoder family (reference module_inject/containers/bert.py):
+        # post-LN blocks, bidirectional attention, token types, MLM head
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"], n_heads=cfg["num_attention_heads"],
+            d_ff=cfg.get("intermediate_size"),
+            max_seq_len=cfg.get("max_position_embeddings", 512),
+            activation=cfg.get("hidden_act", "gelu"),
+            norm="layernorm", position="learned",
+            norm_eps=cfg.get("layer_norm_eps", 1e-12),
+            attn_qkv_bias=True, attn_out_bias=True, tie_embeddings=True,
+            causal=False, post_ln=True, embed_ln=True, mlm_head=True,
+            type_vocab_size=cfg.get("type_vocab_size", 2))
+    if family == "distilbert":
+        # distil_bert.py container: bert minus token types, untied projector
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["dim"],
+            n_layers=cfg["n_layers"], n_heads=cfg["n_heads"],
+            d_ff=cfg.get("hidden_dim"),
+            max_seq_len=cfg.get("max_position_embeddings", 512),
+            activation=cfg.get("activation", "gelu"),
+            norm="layernorm", position="learned", norm_eps=1e-12,
+            attn_qkv_bias=True, attn_out_bias=True, tie_embeddings=False,
+            causal=False, post_ln=True, embed_ln=True, mlm_head=True)
+    if family == "gptneo":
+        # containers/gptneo.py: unscaled attention, alternating
+        # global/local layers with a trailing window
+        pattern = tuple(cfg.get("attention_layers")
+                        or [t for grp in cfg.get("attention_types", [[["global"], 1]])
+                            for t in grp[0] * grp[1]])
+        has_local = "local" in pattern
+        return TransformerConfig(
+            vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
+            n_layers=cfg["num_layers"], n_heads=cfg["num_heads"],
+            d_ff=cfg.get("intermediate_size") or 4 * cfg["hidden_size"],
+            max_seq_len=cfg.get("max_position_embeddings", 2048),
+            activation=cfg.get("activation_function", "gelu_new"),
+            norm="layernorm", position="learned",
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+            attn_qkv_bias=False, attn_out_bias=True, tie_embeddings=True,
+            attn_scale=1.0,
+            # all-global checkpoints keep the flash path; the window mask
+            # needs score-level access only when a local layer exists
+            local_attention_window=(cfg.get("window_size", 256) if has_local else 0),
+            attention_pattern=(pattern if has_local else ()),
+            attention_impl=("reference" if has_local else "auto"))
     if family == "bloom":
         return TransformerConfig(
             vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
@@ -159,6 +216,12 @@ def config_from_hf(hf_config) -> TransformerConfig:
         tie_embeddings=cfg.get("tie_word_embeddings", False))
     if family == "qwen2":
         return TransformerConfig(attn_qkv_bias=True, **common)
+    if family in ("internlm", "internlm2"):
+        # internlm v1 = llama wiring + optional qkvo biases
+        # (module_inject/containers/internlm.py); v2 fuses wqkv
+        bias = bool(cfg.get("bias", family == "internlm"))
+        return TransformerConfig(attn_qkv_bias=bias, attn_out_bias=bias,
+                                 **common)
     if family == "qwen2moe":
         if cfg.get("decoder_sparse_step", 1) != 1 or cfg.get("mlp_only_layers"):
             raise ValueError("qwen2-moe with dense interleaved layers "
@@ -206,7 +269,9 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
                            family: str) -> Dict[str, Any]:
     """Re-lay an HF state dict into the zoo Transformer's stacked format."""
     L = config.n_layers
-    sd = {k.removeprefix("transformer.").removeprefix("model.").removeprefix("gpt_neox."): v
+    sd = {k.removeprefix("transformer.").removeprefix("model.")
+           .removeprefix("gpt_neox.").removeprefix("bert.")
+           .removeprefix("distilbert."): v
           for k, v in sd.items()}
     p: Dict[str, Any] = {}
 
@@ -411,7 +476,132 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
             p["unembed"] = _np(sd["lm_head.weight"]).T
         return p
 
-    # rope/rmsnorm families: llama / mistral / qwen2 / phi3 / mixtral
+    if family == "bert":
+        p["embed"] = _np(sd["embeddings.word_embeddings.weight"])
+        p["pos_embed"] = _np(sd["embeddings.position_embeddings.weight"])
+        p["token_type_embed"] = _np(sd["embeddings.token_type_embeddings.weight"])
+        p["embed_ln_w"] = _np(sd["embeddings.LayerNorm.weight"])
+        p["embed_ln_b"] = _np(sd["embeddings.LayerNorm.bias"])
+        enc = "encoder.layer.{}."
+        p["layers"] = {
+            # post-LN: ln1 = attention-output LN, ln2 = ffn-output LN
+            "ln1_w": _stack(sd, enc + "attention.output.LayerNorm.weight", L),
+            "ln1_b": _stack(sd, enc + "attention.output.LayerNorm.bias", L),
+            "ln2_w": _stack(sd, enc + "output.LayerNorm.weight", L),
+            "ln2_b": _stack(sd, enc + "output.LayerNorm.bias", L),
+            "wq": _stack(sd, enc + "attention.self.query.weight", L, transpose=True),
+            "wk": _stack(sd, enc + "attention.self.key.weight", L, transpose=True),
+            "wv": _stack(sd, enc + "attention.self.value.weight", L, transpose=True),
+            "wo": _stack(sd, enc + "attention.output.dense.weight", L, transpose=True),
+            "b_q": _stack(sd, enc + "attention.self.query.bias", L),
+            "b_k": _stack(sd, enc + "attention.self.key.bias", L),
+            "b_v": _stack(sd, enc + "attention.self.value.bias", L),
+            "b_o": _stack(sd, enc + "attention.output.dense.bias", L),
+            "w_up": _stack(sd, enc + "intermediate.dense.weight", L, transpose=True),
+            "b_up": _stack(sd, enc + "intermediate.dense.bias", L),
+            "w_down": _stack(sd, enc + "output.dense.weight", L, transpose=True),
+            "b_down": _stack(sd, enc + "output.dense.bias", L),
+        }
+        if config.mlm_head:
+            p["mlm_dense_w"] = _np(sd["cls.predictions.transform.dense.weight"]).T
+            p["mlm_dense_b"] = _np(sd["cls.predictions.transform.dense.bias"])
+            p["mlm_ln_w"] = _np(sd["cls.predictions.transform.LayerNorm.weight"])
+            p["mlm_ln_b"] = _np(sd["cls.predictions.transform.LayerNorm.bias"])
+            p["mlm_bias"] = _np(sd.get("cls.predictions.bias",
+                                       sd.get("cls.predictions.decoder.bias")))
+        return p
+
+    if family == "distilbert":
+        p["embed"] = _np(sd["embeddings.word_embeddings.weight"])
+        p["pos_embed"] = _np(sd["embeddings.position_embeddings.weight"])
+        p["embed_ln_w"] = _np(sd["embeddings.LayerNorm.weight"])
+        p["embed_ln_b"] = _np(sd["embeddings.LayerNorm.bias"])
+        tl = "transformer.layer.{}." if any(
+            k.startswith("transformer.layer.") for k in sd) else "layer.{}."
+        p["layers"] = {
+            "ln1_w": _stack(sd, tl + "sa_layer_norm.weight", L),
+            "ln1_b": _stack(sd, tl + "sa_layer_norm.bias", L),
+            "ln2_w": _stack(sd, tl + "output_layer_norm.weight", L),
+            "ln2_b": _stack(sd, tl + "output_layer_norm.bias", L),
+            "wq": _stack(sd, tl + "attention.q_lin.weight", L, transpose=True),
+            "wk": _stack(sd, tl + "attention.k_lin.weight", L, transpose=True),
+            "wv": _stack(sd, tl + "attention.v_lin.weight", L, transpose=True),
+            "wo": _stack(sd, tl + "attention.out_lin.weight", L, transpose=True),
+            "b_q": _stack(sd, tl + "attention.q_lin.bias", L),
+            "b_k": _stack(sd, tl + "attention.k_lin.bias", L),
+            "b_v": _stack(sd, tl + "attention.v_lin.bias", L),
+            "b_o": _stack(sd, tl + "attention.out_lin.bias", L),
+            "w_up": _stack(sd, tl + "ffn.lin1.weight", L, transpose=True),
+            "b_up": _stack(sd, tl + "ffn.lin1.bias", L),
+            "w_down": _stack(sd, tl + "ffn.lin2.weight", L, transpose=True),
+            "b_down": _stack(sd, tl + "ffn.lin2.bias", L),
+        }
+        p["mlm_dense_w"] = _np(sd["vocab_transform.weight"]).T
+        p["mlm_dense_b"] = _np(sd["vocab_transform.bias"])
+        p["mlm_ln_w"] = _np(sd["vocab_layer_norm.weight"])
+        p["mlm_ln_b"] = _np(sd["vocab_layer_norm.bias"])
+        p["unembed"] = _np(sd["vocab_projector.weight"]).T
+        p["mlm_bias"] = _np(sd["vocab_projector.bias"])
+        return p
+
+    if family == "gptneo":
+        p["embed"] = _np(sd["wte.weight"])
+        p["pos_embed"] = _np(sd["wpe.weight"])
+        p["layers"] = {
+            "ln1_w": _stack(sd, "h.{}.ln_1.weight", L),
+            "ln1_b": _stack(sd, "h.{}.ln_1.bias", L),
+            "ln2_w": _stack(sd, "h.{}.ln_2.weight", L),
+            "ln2_b": _stack(sd, "h.{}.ln_2.bias", L),
+            "wq": _stack(sd, "h.{}.attn.attention.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, "h.{}.attn.attention.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, "h.{}.attn.attention.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, "h.{}.attn.attention.out_proj.weight", L, transpose=True),
+            "b_o": _stack(sd, "h.{}.attn.attention.out_proj.bias", L),
+            "w_up": _stack(sd, "h.{}.mlp.c_fc.weight", L, transpose=True),
+            "b_up": _stack(sd, "h.{}.mlp.c_fc.bias", L),
+            "w_down": _stack(sd, "h.{}.mlp.c_proj.weight", L, transpose=True),
+            "b_down": _stack(sd, "h.{}.mlp.c_proj.bias", L),
+        }
+        p["ln_f_w"], p["ln_f_b"] = _np(sd["ln_f.weight"]), _np(sd["ln_f.bias"])
+        return p
+
+    if family == "internlm2":
+        # fused wqkv, grouped per kv head: [KV, G + 2, Dh, D] with the G q
+        # rows then k then v inside each group
+        H, KV, Dh = config.n_heads, config.kv_heads, config.head_dim
+        G = H // KV
+        p["embed"] = _np(sd["tok_embeddings.weight"])
+        wqkv = np.stack([_np(sd[f"layers.{i}.attention.wqkv.weight"]) for i in range(L)])
+        wqkv = wqkv.reshape(L, KV, G + 2, Dh, config.d_model)
+        wq = wqkv[:, :, :G].reshape(L, H * Dh, config.d_model)
+        wk = wqkv[:, :, G].reshape(L, KV * Dh, config.d_model)
+        wv = wqkv[:, :, G + 1].reshape(L, KV * Dh, config.d_model)
+        p["layers"] = {
+            "ln1_w": _stack(sd, "layers.{}.attention_norm.weight", L),
+            "ln2_w": _stack(sd, "layers.{}.ffn_norm.weight", L),
+            "wq": wq.transpose(0, 2, 1), "wk": wk.transpose(0, 2, 1),
+            "wv": wv.transpose(0, 2, 1),
+            "wo": _stack(sd, "layers.{}.attention.wo.weight", L, transpose=True),
+            "w_gate": _stack(sd, "layers.{}.feed_forward.w1.weight", L, transpose=True),
+            "w_up": _stack(sd, "layers.{}.feed_forward.w3.weight", L, transpose=True),
+            "w_down": _stack(sd, "layers.{}.feed_forward.w2.weight", L, transpose=True),
+        }
+        if config.attn_qkv_bias:
+            bqkv = np.stack([_np(sd[f"layers.{i}.attention.wqkv.bias"]) for i in range(L)])
+            bqkv = bqkv.reshape(L, KV, G + 2, Dh)
+            p["layers"]["b_q"] = bqkv[:, :, :G].reshape(L, H * Dh)
+            p["layers"]["b_k"] = bqkv[:, :, G].reshape(L, KV * Dh)
+            p["layers"]["b_v"] = bqkv[:, :, G + 1].reshape(L, KV * Dh)
+        if config.attn_out_bias:
+            p["layers"]["b_o"] = np.stack(
+                [_np(sd[f"layers.{i}.attention.wo.bias"]) for i in range(L)])
+        p["ln_f_w"] = _np(sd["norm.weight"])
+        p["ln_f_b"] = np.zeros_like(p["ln_f_w"])
+        if not config.tie_embeddings:
+            p["unembed"] = _np(sd["output.weight"]).T
+        return p
+
+    # rope/rmsnorm families: llama / mistral / qwen2 / phi3 / mixtral / internlm
     p["embed"] = _np(sd["embed_tokens.weight"])
     layers: Dict[str, np.ndarray] = {
         "ln1_w": _stack(sd, "layers.{}.input_layernorm.weight", L),
@@ -438,6 +628,8 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
             layers["b_q"] = _stack(sd, "layers.{}.self_attn.q_proj.bias", L)
             layers["b_k"] = _stack(sd, "layers.{}.self_attn.k_proj.bias", L)
             layers["b_v"] = _stack(sd, "layers.{}.self_attn.v_proj.bias", L)
+        if config.attn_out_bias:   # internlm v1 bias=True
+            layers["b_o"] = _stack(sd, "layers.{}.self_attn.o_proj.bias", L)
         if family in ("mixtral", "qwen2moe"):
             E = config.n_experts
 
@@ -496,6 +688,14 @@ def from_hf(model_or_path, dtype=None) -> Tuple[Transformer, Dict[str, Any]]:
     cfg_dict = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
     family = _family(cfg_dict)
     config = config_from_hf(cfg_dict)
+    if family == "bert" and not any(k.startswith("cls.") for k in sd):
+        # headless BertModel checkpoint: no MLM head to load — the tied
+        # unembed still gives token scores
+        import dataclasses as _dc
+
+        config = _dc.replace(config, mlm_head=False)
+        logger.info("bert: no cls.* keys (headless BertModel); importing "
+                    "without the MLM head")
     params = params_from_state_dict(sd, config, family)
     import jax.numpy as jnp
 

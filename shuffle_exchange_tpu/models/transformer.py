@@ -66,6 +66,20 @@ class TransformerConfig:
     random_ltd: bool = False
     random_ltd_start_layer: int = 1
     random_ltd_end_layer: int = -1             # exclusive; -1 = n_layers - 1
+    # Encoder-family structure (round 4, reference module_inject/containers/
+    # bert.py + distil_bert.py): bidirectional attention, post-LN residual
+    # order, token-type embeddings, and the BERT MLM head
+    # (transform dense + LN + tied decoder with its own bias).
+    causal: bool = True                        # False = bidirectional (BERT)
+    post_ln: bool = False                      # LN(h + sublayer) (BERT)
+    type_vocab_size: int = 0                   # token_type embeddings (BERT)
+    mlm_head: bool = False                     # BertForMaskedLM cls head
+    # GPT-Neo structure (reference module_inject/containers/gptneo.py):
+    # unscaled attention + alternating global/local layers.
+    attn_scale: float = 0.0                    # 0 = 1/sqrt(Dh); GPT-Neo: 1.0
+    local_attention_window: int = 0            # window for "local" layers
+    attention_pattern: Tuple[str, ...] = ()    # per-layer "global"/"local",
+                                               # cycled over n_layers
     dtype: Any = None                          # compute dtype override (engine usually casts)
     remat: bool = False
     remat_policy: str = "dots_saveable"
@@ -252,17 +266,45 @@ def alibi_slopes(n_heads: int):
     return np.asarray(s, np.float32)
 
 
-def causal_attention(q, k, v, attention_impl: str = "auto", alibi=None):
+def causal_attention(q, k, v, attention_impl: str = "auto", alibi=None,
+                     causal: bool = True):
     """q: [B,T,H,D], k/v: [B,T,Hkv,D] → [B,T,H,D]. fp32 softmax.
 
     Dispatches to the Pallas flash kernel on TPU (ops/flash_attention);
-    jnp reference elsewhere. ``alibi`` = per-head slopes [H] (BLOOM)."""
+    jnp reference elsewhere. ``alibi`` = per-head slopes [H] (BLOOM).
+    ``causal=False`` = bidirectional (encoder models)."""
     import jax.numpy as jnp
 
     from ..ops.flash_attention import flash_attention
 
-    return flash_attention(q, k, v, causal=True, impl=attention_impl,
+    return flash_attention(q, k, v, causal=causal, impl=attention_impl,
                            alibi_slopes=alibi)
+
+
+def _windowed_attention(q, k, v, window: int, local_flag):
+    """Causal attention with a conditional trailing window (GPT-Neo local
+    layers, reference containers/gptneo.py). ``local_flag`` is a traced
+    bool — True restricts key j to i - j < window — so global and local
+    layers share one scanned program."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.flash_attention import _repeat_kv
+
+    n_rep = q.shape[2] // k.shape[2]
+    k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    T = q.shape[1]
+    i = jnp.arange(T)[:, None]
+    j = jnp.arange(T)[None, :]
+    mask = j <= i
+    mask = mask & jnp.where(local_flag, (i - j) < window, True)
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -343,10 +385,21 @@ class Transformer:
                 layer["b_up"] = jnp.zeros((L, F))
                 layer["b_down"] = jnp.zeros((L, D))
         params["layers"] = layer
+        if cfg.type_vocab_size > 0:
+            params["token_type_embed"] = jax.random.normal(
+                next(keys), (cfg.type_vocab_size, D), jnp.float32) * 0.02
         if cfg.embed_ln:
             params["embed_ln_w"], params["embed_ln_b"] = jnp.ones((D,)), jnp.zeros((D,))
-        params["ln_f_w"] = jnp.ones((D,))
-        params["ln_f_b"] = jnp.zeros((D,))
+        if not cfg.post_ln:
+            # post-LN encoders (BERT) normalize inside each block and have
+            # no final norm before the head
+            params["ln_f_w"] = jnp.ones((D,))
+            params["ln_f_b"] = jnp.zeros((D,))
+        if cfg.mlm_head:
+            params["mlm_dense_w"] = jax.random.normal(next(keys), (D, D), jnp.float32) / math.sqrt(D)
+            params["mlm_dense_b"] = jnp.zeros((D,))
+            params["mlm_ln_w"], params["mlm_ln_b"] = jnp.ones((D,)), jnp.zeros((D,))
+            params["mlm_bias"] = jnp.zeros((cfg.vocab_size,))
         if not cfg.tie_embeddings:
             params["unembed"] = jax.random.normal(next(keys), (D, cfg.vocab_size), jnp.float32) * 0.02
             if cfg.unembed_bias:
@@ -409,18 +462,26 @@ class Transformer:
         cfg = self.config
         T = input_ids.shape[-1]
         x = jnp.take(params["embed"], input_ids, axis=0)
-        if cfg.embed_ln:   # BLOOM word_embeddings_layernorm
-            x = _norm(x, params["embed_ln_w"], params["embed_ln_b"], cfg.norm,
-                      eps=cfg.norm_eps)
         if cfg.position == "learned":
             x = x + params["pos_embed"][cfg.pos_offset:cfg.pos_offset + T].astype(x.dtype)
-            return x, (None, None)
-        if cfg.position == "alibi":
+        if cfg.type_vocab_size > 0:
+            # token_type row 0 (the HF default when token_type_ids is None)
+            x = x + params["token_type_embed"][0].astype(x.dtype)
+        if cfg.embed_ln:
+            # BLOOM word_embeddings_layernorm; BERT embeddings.LayerNorm
+            # (after the word+pos+type sum — BLOOM has no learned pos, so
+            # the shared placement is exact for both)
+            x = _norm(x, params["embed_ln_w"], params["embed_ln_b"], cfg.norm,
+                      eps=cfg.norm_eps)
+        if cfg.position in ("learned", "alibi"):
             return x, (None, None)
         return x, rope_table(T, cfg.rotary_dims, cfg.rope_theta)
 
-    def layer_apply(self, lw, h, rope):
-        """One transformer block. h [B, T, D] -> (h, moe_aux)."""
+    def layer_apply(self, lw, h, rope, local=None):
+        """One transformer block. h [B, T, D] -> (h, moe_aux).
+
+        ``local`` (traced bool scalar, GPT-Neo): this layer restricts
+        attention to the trailing ``local_attention_window`` positions."""
         import jax
         import jax.numpy as jnp
 
@@ -429,7 +490,10 @@ class Transformer:
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         cos, sin = rope
         dtype = h.dtype
-        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm, eps=cfg.norm_eps)
+        if cfg.post_ln:
+            y = h   # BERT: sublayer input is unnormalized; LN follows the add
+        else:
+            y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm, eps=cfg.norm_eps)
         q = (y @ lw["wq"]).reshape(B, T, H, Dh)
         k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
         v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
@@ -452,12 +516,24 @@ class Transformer:
         v = checkpoint_name(v, "kv")
         alibi = (alibi_slopes(H) * cfg.alibi_slope_scale
                  if cfg.position == "alibi" else None)
-        attn = self._attention(q, k, v, alibi).reshape(B, T, H * Dh)
+        if cfg.attn_scale:
+            # GPT-Neo omits the 1/sqrt(Dh) score scaling; the attention
+            # internals always divide, so pre-multiply q to net attn_scale
+            q = q * jnp.asarray(cfg.attn_scale * math.sqrt(Dh), q.dtype)
+        if cfg.local_attention_window and local is not None:
+            attn = _windowed_attention(q, k, v, cfg.local_attention_window,
+                                       local).reshape(B, T, H * Dh)
+        else:
+            attn = self._attention(q, k, v, alibi).reshape(B, T, H * Dh)
         attn = checkpoint_name(attn, "attn")
         attn_out = attn @ lw["wo"]
         if cfg.attn_out_bias:
             attn_out = attn_out + lw["b_o"].astype(dtype)
-        if cfg.parallel_block:
+        if cfg.post_ln:
+            h = _norm(h + attn_out, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm,
+                      eps=cfg.norm_eps)
+            y2 = h
+        elif cfg.parallel_block:
             # GPT-J/NeoX/Falcon: h + attn(ln1 h) + mlp(ln2 h or ln1 h)
             y2 = y if cfg.parallel_shared_ln else _norm(
                 h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
@@ -495,7 +571,13 @@ class Transformer:
         else:
             act = activation_fn(cfg.activation)
             ff = act(y2 @ lw["w_up"]) @ lw["w_down"]
-        h = (h + attn_out + ff) if cfg.parallel_block else (h + ff)
+        if cfg.post_ln:
+            h = _norm(h + ff, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm,
+                      eps=cfg.norm_eps)
+        elif cfg.parallel_block:
+            h = h + attn_out + ff
+        else:
+            h = h + ff
         return h, aux
 
     @staticmethod
@@ -542,7 +624,7 @@ class Transformer:
                 sp = 1
         if sp <= 1 or alibi is not None:
             return causal_attention(q, k, v, attention_impl=cfg.attention_impl,
-                                    alibi=alibi)
+                                    alibi=alibi, causal=cfg.causal)
         import functools as ft
 
         import jax
@@ -557,6 +639,11 @@ class Transformer:
         # padded query rows are sliced away.
         T0 = q.shape[1]
         pad = -T0 % sp
+        if pad and not cfg.causal:
+            # bidirectional attention would attend INTO pad keys — no mask
+            # hides them without segment ids; keep replicated attention
+            return causal_attention(q, k, v, attention_impl=cfg.attention_impl,
+                                    alibi=alibi, causal=False)
         if pad:
             p4 = lambda t: jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
             q, k, v = p4(q), p4(k), p4(v)
@@ -578,12 +665,14 @@ class Transformer:
         if cfg.sp_attention == "ring":
             from ..parallel.sequence import ring_attention
 
-            sp_fn = ft.partial(ring_attention, axis_name="seq")
+            sp_fn = ft.partial(ring_attention, axis_name="seq",
+                               causal=cfg.causal)
         elif cfg.sp_attention == "ulysses":
             local = ft.partial(causal_attention,
-                               attention_impl=cfg.attention_impl)
+                               attention_impl=cfg.attention_impl,
+                               causal=cfg.causal)
             sp_fn = ft.partial(ulysses_attention, axis_name="seq",
-                               attn_fn=local)
+                               attn_fn=local, causal=cfg.causal)
         else:
             raise ValueError(f"Unsupported sp_attention {cfg.sp_attention!r}; "
                              "use 'ulysses' or 'ring'")
@@ -614,16 +703,31 @@ class Transformer:
 
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P(("data", "fsdp"), "seq", None)))
-        if ltd_mask is None and layer_keep is None:
-            def layer_fn(h, lw):
-                return self.layer_apply(lw, h, rope)
+        L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+        use_local = bool(cfg.local_attention_window and cfg.attention_pattern)
+        local_flags = None
+        if use_local:
+            pat = [cfg.attention_pattern[i % len(cfg.attention_pattern)] == "local"
+                   for i in range(L)]
+            local_flags = jnp.asarray(pat)
 
+        if ltd_mask is None and layer_keep is None:
+            if use_local:
+                def layer_fn(h, xs):
+                    lw, loc = xs
+                    return self.layer_apply(lw, h, rope, local=loc)
+
+                xs = (stacked_layers, local_flags)
+            else:
+                def layer_fn(h, lw):
+                    return self.layer_apply(lw, h, rope)
+
+                xs = stacked_layers
             if cfg.remat:
                 layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg.remat_policy))
-            x, aux_losses = jax.lax.scan(layer_fn, x, stacked_layers)
+            x, aux_losses = jax.lax.scan(layer_fn, x, xs)
             return x, jnp.sum(aux_losses)
 
-        L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
         if ltd_mask is not None:
             end = cfg.random_ltd_end_layer if cfg.random_ltd_end_layer >= 0 else L - 1
             active = (jnp.arange(L) >= cfg.random_ltd_start_layer) & (jnp.arange(L) < end)
@@ -631,10 +735,13 @@ class Transformer:
             active = jnp.zeros((L,), bool)
         keep_layers = (jnp.ones((L,), bool) if layer_keep is None
                        else jnp.asarray(layer_keep))
+        if local_flags is None:
+            local_flags = jnp.zeros((L,), bool)
 
         def layer_fn(h, xs):
-            lw, act, keep_l = xs
-            out, aux = self.layer_apply(lw, h, rope)
+            lw, act, keep_l, loc = xs
+            out, aux = self.layer_apply(lw, h, rope,
+                                        local=(loc if use_local else None))
             if ltd_mask is not None:
                 keep = jnp.logical_or(~act, ltd_mask)[..., None]   # [B,T,1]
                 out = jnp.where(keep, out, h)
@@ -643,7 +750,8 @@ class Transformer:
 
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg.remat_policy))
-        x, aux_losses = jax.lax.scan(layer_fn, x, (stacked_layers, active, keep_layers))
+        x, aux_losses = jax.lax.scan(
+            layer_fn, x, (stacked_layers, active, keep_layers, local_flags))
         return x, jnp.sum(aux_losses)
 
     def _unembed(self, params, dtype):
@@ -666,12 +774,23 @@ class Transformer:
         MXU matmul with fp32 accumulation, not the ~6x-slower fp32-operand
         emulation an ``astype(float32)`` on both sides would force. Under
         the fp32 CPU test path this is bit-identical to the old form."""
+        import jax
         import jax.numpy as jnp
 
-        x = _norm(x, params["ln_f_w"], params["ln_f_b"], self.config.norm,
-                  eps=self.config.norm_eps)
+        cfg = self.config
+        if not cfg.post_ln:
+            x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm,
+                      eps=cfg.norm_eps)
+        if cfg.mlm_head:
+            # BERT cls head: dense + gelu + LN, tied decoder with own bias
+            x = activation_fn("gelu")(x @ params["mlm_dense_w"].astype(x.dtype)
+                                      + params["mlm_dense_b"].astype(x.dtype))
+            x = _norm(x, params["mlm_ln_w"], params["mlm_ln_b"], cfg.norm,
+                      eps=cfg.norm_eps)
         w, bias = self._unembed(params, x.dtype)
         logits = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+        if cfg.mlm_head:
+            logits = logits + params["mlm_bias"].astype(jnp.float32)
         return logits if bias is None else logits + bias
 
     @staticmethod
@@ -757,6 +876,10 @@ class Transformer:
 
     def _loss_chunk(self, B: int, T: int) -> int:
         """Resolved chunk size: 0 = full logits."""
+        if self.config.post_ln or self.config.mlm_head:
+            # chunked_loss runs ln_f + plain unembed per chunk; the encoder
+            # head shape (no final norm / MLM transform) isn't wired there
+            return 0
         c = self.config.loss_chunk
         if c >= 0:
             return 0 if c == 0 else min(c, T)
@@ -785,7 +908,12 @@ class Transformer:
         import jax.numpy as jnp
 
         ids = batch["input_ids"]
-        if "labels" in batch:
+        if not self.config.causal:
+            # encoder (MLM): no next-token shift — labels mark the masked
+            # positions (-100 elsewhere); default to full-token recovery
+            labels = batch.get("labels", ids)
+            model_ids = ids
+        elif "labels" in batch:
             labels = batch["labels"]
             model_ids = ids
         else:

@@ -232,9 +232,15 @@ def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_id
     impl: auto | pallas | reference | chunked (FPDT-style scan, long-context
     memory bound — see ops/chunked_attention.py)."""
     if alibi_slopes is not None:
-        # ALiBi needs a per-position bias the stock Pallas kernel does not
-        # take (its `ab` operand materializes [B,H,T,S], defeating flash);
-        # the XLA-fused SDPA is the honest path until a biased kernel lands.
+        # Fused ALiBi kernel (ops/alibi_attention.py): the per-head bias is
+        # added to the score tile in VMEM inside a from-scratch flash
+        # forward (the stock kernel's `ab` operand would materialize
+        # [B,H,T,S]). segment_ids and non-causal keep the reference path.
+        if segment_ids is None and impl in ("auto", "pallas"):
+            from .alibi_attention import alibi_flash_attention, alibi_kernel_ok
+
+            if alibi_kernel_ok(q, k, causal):
+                return alibi_flash_attention(q, k, v, alibi_slopes, causal)
         if impl in ("pallas", "chunked"):
             warning_once("alibi attention uses the jnp reference path")
         return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,

@@ -274,3 +274,146 @@ def test_qwen2moe_logit_parity():
         attention_dropout=0.0)
     torch.manual_seed(11)
     _compare(transformers.Qwen2MoeForCausalLM(cfg), _ids(96), rtol=5e-3, atol=5e-3)
+
+
+def test_bert_mlm_logit_parity():
+    """Encoder family (reference module_inject/containers/bert.py): post-LN
+    bidirectional blocks + token types + the MLM transform head."""
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(7)
+    _compare(transformers.BertForMaskedLM(cfg), _ids(96))
+
+
+def test_distilbert_mlm_logit_parity():
+    cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(8)
+    _compare(transformers.DistilBertForMaskedLM(cfg), _ids(96))
+
+
+def test_gptneo_local_attention_logit_parity():
+    """GPT-Neo (reference containers/gptneo.py): unscaled attention and the
+    alternating global/local window pattern."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=96, hidden_size=64, num_layers=4, num_heads=4,
+        attention_types=[[["global", "local"], 2]], window_size=8,
+        max_position_embeddings=64, intermediate_size=128,
+        embed_dropout=0.0, attention_dropout=0.0, resid_dropout=0.0)
+    torch.manual_seed(9)
+    # t=24 > window 8 so local layers actually mask
+    _compare(transformers.GPTNeoForCausalLM(cfg), _ids(96, t=24))
+
+
+def test_internlm_family_structural():
+    """InternLM v1 is llama wiring + qkvo biases; no HF class ships in
+    transformers (remote code), so build the state dict by name."""
+    rng = np.random.default_rng(0)
+    L, D, H, KV, F, V = 2, 32, 4, 4, 64, 64
+    Dh = D // H
+    cfg = {"architectures": ["InternLMForCausalLM"], "model_type": "internlm",
+           "vocab_size": V, "hidden_size": D, "num_hidden_layers": L,
+           "num_attention_heads": H, "intermediate_size": F, "bias": True,
+           "max_position_embeddings": 64, "rms_norm_eps": 1e-6,
+           "tie_word_embeddings": False}
+    sd = {"model.embed_tokens.weight": rng.normal(size=(V, D)).astype(np.float32) * 0.02,
+          "model.norm.weight": np.ones((D,), np.float32),
+          "lm_head.weight": rng.normal(size=(V, D)).astype(np.float32) * 0.02}
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        sd[pre + "input_layernorm.weight"] = np.ones((D,), np.float32)
+        sd[pre + "post_attention_layernorm.weight"] = np.ones((D,), np.float32)
+        for nm, shape in (("q_proj", (H * Dh, D)), ("k_proj", (KV * Dh, D)),
+                          ("v_proj", (KV * Dh, D)), ("o_proj", (D, H * Dh))):
+            sd[pre + f"self_attn.{nm}.weight"] = rng.normal(size=shape).astype(np.float32) * 0.05
+            sd[pre + f"self_attn.{nm}.bias"] = rng.normal(size=(shape[0],)).astype(np.float32) * 0.01
+        sd[pre + "mlp.gate_proj.weight"] = rng.normal(size=(F, D)).astype(np.float32) * 0.05
+        sd[pre + "mlp.up_proj.weight"] = rng.normal(size=(F, D)).astype(np.float32) * 0.05
+        sd[pre + "mlp.down_proj.weight"] = rng.normal(size=(D, F)).astype(np.float32) * 0.05
+    import jax
+
+    model, params = from_hf((cfg, sd))
+    assert model.config.attn_qkv_bias and model.config.attn_out_bias
+    logits = jax.jit(model.apply)(params, _ids(V, t=16))
+    assert np.isfinite(np.asarray(logits)).all()
+    assert logits.shape == (2, 16, V)
+
+
+def test_internlm2_fused_wqkv_grouping():
+    """InternLM2 fuses wqkv grouped per kv head (G q rows, then k, then v):
+    verify the split against an equivalent hand-built llama state dict."""
+    rng = np.random.default_rng(1)
+    L, D, H, KV, F, V = 2, 32, 4, 2, 64, 64
+    Dh = D // H
+    G = H // KV
+    # build per-head projections, then fuse them the internlm2 way
+    wq = rng.normal(size=(L, H * Dh, D)).astype(np.float32) * 0.05
+    wk = rng.normal(size=(L, KV * Dh, D)).astype(np.float32) * 0.05
+    wv = rng.normal(size=(L, KV * Dh, D)).astype(np.float32) * 0.05
+    sd = {"model.tok_embeddings.weight": rng.normal(size=(V, D)).astype(np.float32) * 0.02,
+          "model.norm.weight": np.ones((D,), np.float32),
+          "output.weight": rng.normal(size=(V, D)).astype(np.float32) * 0.02}
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        fused = np.concatenate([
+            np.concatenate([wq[i].reshape(KV, G, Dh, D)[j],
+                            wk[i].reshape(KV, 1, Dh, D)[j],
+                            wv[i].reshape(KV, 1, Dh, D)[j]], axis=0)
+            for j in range(KV)], axis=0).reshape((G + 2) * KV * Dh, D)
+        sd[pre + "attention.wqkv.weight"] = fused
+        sd[pre + "attention.wo.weight"] = rng.normal(size=(D, H * Dh)).astype(np.float32) * 0.05
+        sd[pre + "attention_norm.weight"] = np.ones((D,), np.float32)
+        sd[pre + "ffn_norm.weight"] = np.ones((D,), np.float32)
+        sd[pre + "feed_forward.w1.weight"] = rng.normal(size=(F, D)).astype(np.float32) * 0.05
+        sd[pre + "feed_forward.w3.weight"] = rng.normal(size=(F, D)).astype(np.float32) * 0.05
+        sd[pre + "feed_forward.w2.weight"] = rng.normal(size=(D, F)).astype(np.float32) * 0.05
+    cfg = {"architectures": ["InternLM2ForCausalLM"], "model_type": "internlm2",
+           "vocab_size": V, "hidden_size": D, "num_hidden_layers": L,
+           "num_attention_heads": H, "num_key_value_heads": KV,
+           "intermediate_size": F, "bias": False,
+           "max_position_embeddings": 64, "rms_norm_eps": 1e-6,
+           "tie_word_embeddings": False}
+    import jax
+
+    model, params = from_hf((cfg, sd))
+    np.testing.assert_allclose(np.asarray(params["layers"]["wq"]),
+                               wq.transpose(0, 2, 1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(params["layers"]["wk"]),
+                               wk.transpose(0, 2, 1), rtol=1e-6)
+    logits = jax.jit(model.apply)(params, _ids(V, t=16))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_headless_bert_model_imports():
+    """Review r4: a BertModel checkpoint (no cls.* MLM head) must import —
+    the MLM head is dropped and the tied unembed scores tokens."""
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    torch.manual_seed(10)
+    import jax
+
+    model, params = from_hf(transformers.BertModel(cfg))
+    assert not model.config.mlm_head
+    logits = jax.jit(model.apply)(params, _ids(96))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_gptneo_all_global_keeps_flash_path():
+    """Review r4: an all-global GPT-Neo must not be routed through the
+    quadratic windowed reference path."""
+    from shuffle_exchange_tpu.models.hf import config_from_hf
+
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=96, hidden_size=64, num_layers=2, num_heads=4,
+        attention_types=[[["global"], 2]], window_size=256,
+        max_position_embeddings=64, intermediate_size=128)
+    c = config_from_hf(cfg.to_dict())
+    assert c.local_attention_window == 0 and c.attention_pattern == ()
+    assert c.attention_impl == "auto"

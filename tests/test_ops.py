@@ -318,3 +318,48 @@ def test_attn_block_override_clamped_to_itemsize_cap(monkeypatch):
     assert _pick_block(4096, itemsize=4) == 512
     monkeypatch.setenv("SXT_ATTN_BLOCK", "333")    # not dividing n: ignored
     assert _pick_block(4096, itemsize=2) == 1024
+
+
+def test_alibi_flash_kernel_parity_interpret():
+    """Fused ALiBi flash kernel (ops/alibi_attention.py; reference applies
+    ALiBi inside the fused inference softmax, ds_attention.py:16): interpret-
+    mode forward matches the jnp reference, and the custom_vjp backward
+    replays the reference VJP exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.models.transformer import alibi_slopes
+    from shuffle_exchange_tpu.ops.alibi_attention import alibi_flash_attention
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 256, 4, 64
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    s = jnp.asarray(alibi_slopes(H), jnp.float32)
+    out = alibi_flash_attention(q, k, v, s, True, True)
+    ref = reference_attention(q, k, v, causal=True, alibi_slopes=s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    g1 = jax.grad(lambda q: alibi_flash_attention(q, k, v, s, True, True).sum())(q)
+    g2 = jax.grad(lambda q: reference_attention(q, k, v, causal=True,
+                                                alibi_slopes=s).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=2e-5)
+
+
+def test_noncausal_reference_attention_bidirectional():
+    """Encoder support: causal=False attends both directions."""
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    out_bi = reference_attention(q, k, v, causal=False)
+    out_c = reference_attention(q, k, v, causal=True)
+    # last position sees every key under both masks
+    np.testing.assert_allclose(np.asarray(out_bi[:, -1]), np.asarray(out_c[:, -1]),
+                               rtol=1e-5)
+    assert not np.allclose(np.asarray(out_bi[:, :-1]), np.asarray(out_c[:, :-1]))

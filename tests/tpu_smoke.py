@@ -123,6 +123,21 @@ def main() -> int:
     ok &= _check("grouped-gemm-dx", gx.astype(np.float32), rx.astype(np.float32), 5e-2)
     ok &= _check("grouped-gemm-dw", gw.astype(np.float32), rw.astype(np.float32), 5e-2)
 
+    # ALiBi fused flash kernel (round 4): compiled on-chip vs jnp reference
+    from shuffle_exchange_tpu.models.transformer import alibi_slopes
+    from shuffle_exchange_tpu.ops.alibi_attention import alibi_flash_attention
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    Ba, Ta, Ha, Da = 2, 512, 4, 128
+    qa = jnp.asarray(rng.standard_normal((Ba, Ta, Ha, Da)), jnp.bfloat16)
+    ka = jnp.asarray(rng.standard_normal((Ba, Ta, Ha, Da)), jnp.bfloat16)
+    va = jnp.asarray(rng.standard_normal((Ba, Ta, Ha, Da)), jnp.bfloat16)
+    sl = jnp.asarray(alibi_slopes(Ha), jnp.float32)
+    got_a = jax.jit(lambda q, k, v: alibi_flash_attention(q, k, v, sl, True, False))(
+        qa, ka, va).astype(np.float32)
+    want_a = reference_attention(qa, ka, va, causal=True, alibi_slopes=sl).astype(np.float32)
+    ok &= _check("alibi-flash", got_a, want_a, 5e-2)
+
     print("TPU smoke:", "ALL PASS" if ok else "FAILURES")
     return 0 if ok else 1
 
