@@ -40,7 +40,7 @@ class InferenceConfig:
     # kernel) with groups capped at 256 along K (one scale row per kernel
     # K-block); larger values apply to the moe/unembed rounding path.
     quantize_weights: bool = False
-    quant_bits: int = 8            # 8 (int8) or 4 (packed nibble pairs)
+    quant_bits: Any = 8            # 8 (int8), 4 (packed nibbles), "fp8" (e4m3)
     quant_group_size: int = 2048
     # v2 paged KV (reference ragged/kv_cache.py BlockedKVCache)
     kv_block_size: int = 64
@@ -67,7 +67,7 @@ class InferenceConfig:
             if isinstance(q, dict):
                 d["quantize_weights"] = bool(q.get("enabled", False))
                 if "bits" in q:
-                    d["quant_bits"] = int(q["bits"])
+                    d["quant_bits"] = q["bits"]   # normalized/validated below
         dtype = d.get("dtype")
         if dtype is not None:
             key = str(dtype).replace("torch.", "")
@@ -80,9 +80,18 @@ class InferenceConfig:
                 raise ConfigError(f"unsupported inference dtype {dtype!r}")
             else:
                 d["dtype"] = _DTYPES[key]
-        if int(d.get("quant_bits", 8)) not in (8, 4):
-            raise ConfigError(
-                f"quant_bits must be 8 or 4, got {d['quant_bits']!r}")
+        qb = d.get("quant_bits", 8)
+        if str(qb).strip().lower() == "fp8":
+            d["quant_bits"] = "fp8"
+        else:
+            try:
+                qb_int = int(qb)
+            except (TypeError, ValueError):
+                qb_int = None
+            if qb_int not in (8, 4):
+                raise ConfigError(
+                    f"quant_bits must be 8, 4 or \"fp8\", got {qb!r}")
+            d["quant_bits"] = qb_int
         known = {f.name for f in dataclasses.fields(cls)}
         ignored = {k: d.pop(k) for k in list(d) if k not in known}
         if ignored:

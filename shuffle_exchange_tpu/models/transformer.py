@@ -104,11 +104,10 @@ class TransformerConfig:
     # Sequence-parallel attention flavor when the mesh has seq > 1:
     # "ulysses" (a2a seq<->head reshard around the local attention_impl
     # kernel) or "ring" (KV blocks rotate via ppermute — the context-
-    # parallel form; no head-count divisibility requirement). Ring caveats:
-    # it is its own jnp online-softmax (attention_impl is not used), and
-    # each of the sp hops carries [B, H, T/sp, T/sp] fp32 logits that
-    # become autodiff residuals — pair with remat for long-context
-    # training or backward holds O(T^2/sp) per layer.
+    # parallel form; no head-count divisibility requirement). Ring is its
+    # own chunked online-softmax (attention_impl is not used); each hop is
+    # checkpointed, so backward residuals are O(T/sp * D) per layer
+    # (score tiles are recomputed hop by hop, never saved).
     sp_attention: str = "ulysses"
 
     @property

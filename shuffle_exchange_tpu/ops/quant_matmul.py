@@ -29,8 +29,9 @@ from typing import Tuple
 
 
 class QuantizedMatrix:
-    """int8/int4 weight + per-(group, column) scales; ``x @ qm`` dispatches
-    to the quantized matmul. Supports leading stacked dims ([L, K, N])."""
+    """int8/int4/fp8(e4m3) weight + per-(group, column) scales; ``x @ qm``
+    dispatches to the quantized matmul. Supports leading stacked dims
+    ([L, K, N])."""
 
     def __init__(self, q, scales, group_size: int, dtype, bits: int = 8,
                  n_cols: int = 0):
@@ -126,14 +127,17 @@ def _unpack_int4(p, group_size: int):
     return jnp.concatenate([low, high], axis=-2).reshape(*lead, 2 * Kh, N)
 
 
-def quantize_weight(w, group_size: int = 256, dtype=None, bits: int = 8) -> QuantizedMatrix:
+def quantize_weight(w, group_size: int = 256, dtype=None, bits=8) -> QuantizedMatrix:
     """w [..., K, N] -> QuantizedMatrix with per-(K-group, column) scales
-    (symmetric int8, or packed int4 with ``bits=4``).
+    (symmetric int8, packed int4 with ``bits=4``, or e4m3 with
+    ``bits="fp8"`` — the reference FP-quantizer serving GEMM's storage,
+    ops/fp_quantizer/quantize.py; same byte footprint as int8 but a
+    non-uniform code with ~2 decimal digits near zero).
     K must divide group_size (weights are MXU-shaped)."""
     import jax.numpy as jnp
 
-    if bits not in (8, 4):
-        raise ValueError(f"bits must be 8 or 4, got {bits}")
+    if bits not in (8, 4, "fp8"):
+        raise ValueError(f"bits must be 8, 4 or \"fp8\", got {bits}")
     *lead, K, N = w.shape
     while K % group_size and group_size >= 64:
         group_size //= 2
@@ -143,6 +147,13 @@ def quantize_weight(w, group_size: int = 256, dtype=None, bits: int = 8) -> Quan
                          "keep this weight dense")
     wg = w.astype(jnp.float32).reshape(*lead, K // group_size, group_size, N)
     absmax = jnp.max(jnp.abs(wg), axis=-2)                       # [..., Kg, N]
+    if bits == "fp8":
+        fp8 = jnp.float8_e4m3fn
+        qmax = float(jnp.finfo(fp8).max)                          # 448
+        scales = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = (wg / scales[..., :, None, :]).astype(fp8)
+        return QuantizedMatrix(q.reshape(*lead, K, N), scales, group_size,
+                               dtype or w.dtype, bits="fp8")
     qmax = 127.0 if bits == 8 else 7.0
     scales = jnp.where(absmax > 0, absmax / qmax, 1.0)
     q = jnp.clip(jnp.round(wg / scales[..., :, None, :]), -qmax, qmax)

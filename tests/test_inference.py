@@ -314,3 +314,21 @@ def test_engine_v2_moe_paged_serving():
     assert np.isfinite(logits).all()
     logits = eng.put([0, 1], [[1, 2, 3], [4, 5, 6]])  # chunked extend
     assert np.isfinite(logits).all()
+
+
+def test_quant_bits_config_validation_messages():
+    """Review r4: any invalid quant_bits (including string typos like
+    'fp6') must raise ConfigError with the helpful message, never a raw
+    ValueError from int()."""
+    import pytest
+
+    from shuffle_exchange_tpu.config import ConfigError
+    from shuffle_exchange_tpu.inference import InferenceConfig
+
+    assert InferenceConfig.from_dict(
+        {"quant": {"enabled": True, "bits": "FP8 "}}).quant_bits == "fp8"
+    assert InferenceConfig.from_dict(
+        {"quant": {"enabled": True, "bits": "4"}}).quant_bits == 4
+    for bad in ("fp6", 6, "e4m3", None):
+        with pytest.raises(ConfigError, match="quant_bits"):
+            InferenceConfig.from_dict({"quant_bits": bad})

@@ -99,6 +99,9 @@ def main() -> int:
     qm4 = quantize_weight(wd, group_size=128, bits=4)
     ok &= _check("quant-matmul-int4", _quant_matmul_pallas(xq, qm4),
                  xq @ qm4.dequantize(), 5e-3)
+    qm8f = quantize_weight(wd, group_size=128, bits="fp8")
+    ok &= _check("quant-matmul-fp8", _quant_matmul_pallas(xq, qm8f),
+                 xq @ qm8f.dequantize(), 5e-3)
 
     # grouped GEMM (megablox gmm) vs ragged_dot oracle, uneven groups
     from shuffle_exchange_tpu.ops.grouped_gemm import _grouped_matmul_gmm
