@@ -57,7 +57,8 @@ _MODEL_TYPE_FAMILIES = {"llama": "llama", "mistral": "llama", "qwen2": "qwen2",
                         "falcon": "falcon", "bloom": "bloom", "qwen2_moe": "qwen2moe",
                         "bert": "bert", "distilbert": "distilbert",
                         "gpt_neo": "gptneo", "internlm": "internlm",
-                        "internlm2": "internlm2"}
+                        "internlm2": "internlm2", "megatron": "megatron",
+                        "megatron-gpt": "megatron", "megatron_gpt": "megatron"}
 
 
 def _family(cfg: Dict[str, Any]) -> str:
@@ -193,6 +194,32 @@ def config_from_hf(hf_config) -> TransformerConfig:
             local_attention_window=(cfg.get("window_size", 256) if has_local else 0),
             attention_pattern=(pattern if has_local else ()),
             attention_impl=("reference" if has_local else "auto"))
+    if family == "megatron":
+        # Megatron-LM GPT (reference module_inject/containers/
+        # megatron_gpt.py + megatron_gpt_moe.py): GPT-2-style blocks with
+        # the fused query_key_value projection; config uses Megatron arg
+        # names (no HF config class exists)
+        D, H = cfg["hidden_size"], cfg["num_attention_heads"]
+        ne = cfg.get("num_experts", 0) or 0
+        if isinstance(ne, (list, tuple)):     # Megatron --num-experts is nargs='+'
+            ne = ne[0] if ne else 0
+        c = TransformerConfig(
+            vocab_size=cfg.get("padded_vocab_size") or cfg["vocab_size"],
+            d_model=D, n_layers=cfg["num_layers"], n_heads=H,
+            d_ff=cfg.get("ffn_hidden_size") or 4 * D,
+            max_seq_len=cfg.get("max_position_embeddings", 2048),
+            activation="gelu", norm="layernorm", position="learned",
+            attn_qkv_bias=True, attn_out_bias=True,
+            tie_embeddings=not cfg.get("untie_embeddings_and_output_weights", False),
+            norm_eps=cfg.get("layernorm_epsilon", 1e-5),
+            n_experts=int(ne),
+            moe_top_k=int(cfg.get("moe_top_k", cfg.get("topk", 2)) or 2))
+        # v0 fused-qkv layout selector ("megatron_v2": false for pre-v2
+        # checkpoints) rides the config dict, not the weights. The
+        # TransformerConfig dataclass is frozen; this loader-only breadcrumb
+        # is not a model field, so it bypasses the freeze.
+        object.__setattr__(c, "_megatron_v2", bool(cfg.get("megatron_v2", True)))
+        return c
     if family == "bloom":
         return TransformerConfig(
             vocab_size=cfg["vocab_size"], d_model=cfg["hidden_size"],
@@ -599,6 +626,90 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
         p["ln_f_b"] = np.zeros_like(p["ln_f_w"])
         if not config.tie_embeddings:
             p["unembed"] = _np(sd["output.weight"]).T
+        return p
+
+    if family == "megatron":
+        # strip the megatron module nesting left after the generic prefixes
+        sd = {k.removeprefix("language_model.").removeprefix("encoder."): v
+              for k, v in sd.items()}
+        config_megatron_v2 = getattr(config, "_megatron_v2", True)
+        D = config.d_model
+        H, Dh = config.n_heads, config.head_dim
+        p["embed"] = _np(sd["embedding.word_embeddings.weight"])[:config.vocab_size]
+        p["pos_embed"] = _np(sd["embedding.position_embeddings.weight"])
+        attn = ("self_attention"
+                if "layers.0.self_attention.query_key_value.weight" in sd
+                else "attention")
+        qkv_w = np.stack([_np(sd[f"layers.{i}.{attn}.query_key_value.weight"])
+                          for i in range(L)])                    # [L, 3D, D]
+        qkv_b = np.stack([_np(sd[f"layers.{i}.{attn}.query_key_value.bias"])
+                          for i in range(L)])                    # [L, 3D]
+        # megatron_v2 interleaves per head ([H, 3, Dh] rows); v0 groups by
+        # kind ([3, H, Dh]) — reference MegatronContainer.transpose().
+        # Selected via the CONFIG dict ("megatron_v2": false for old
+        # checkpoints), matching the reference policy's megatron_v2 flag.
+        v2 = bool(config_megatron_v2)
+        if v2:
+            qw = qkv_w.reshape(L, H, 3, Dh, D)
+            qb = qkv_b.reshape(L, H, 3, Dh)
+            get_w = lambda j: qw[:, :, j].reshape(L, H * Dh, D)
+            get_b = lambda j: qb[:, :, j].reshape(L, H * Dh)
+        else:
+            qw = qkv_w.reshape(L, 3, H, Dh, D)
+            qb = qkv_b.reshape(L, 3, H, Dh)
+            get_w = lambda j: qw[:, j].reshape(L, H * Dh, D)
+            get_b = lambda j: qb[:, j].reshape(L, H * Dh)
+        layers = {
+            "ln1_w": _stack(sd, "layers.{}.input_layernorm.weight", L),
+            "ln1_b": _stack(sd, "layers.{}.input_layernorm.bias", L),
+            "ln2_w": _stack(sd, "layers.{}.post_attention_layernorm.weight", L),
+            "ln2_b": _stack(sd, "layers.{}.post_attention_layernorm.bias", L),
+            "wq": get_w(0).transpose(0, 2, 1), "wk": get_w(1).transpose(0, 2, 1),
+            "wv": get_w(2).transpose(0, 2, 1),
+            "b_q": get_b(0), "b_k": get_b(1), "b_v": get_b(2),
+            "wo": _stack(sd, "layers.{}." + attn + ".dense.weight", L, transpose=True),
+            "b_o": _stack(sd, "layers.{}." + attn + ".dense.bias", L),
+        }
+        if config.n_experts > 0:
+            E = config.n_experts
+            moe = "layers.{}.mlp.deepspeed_moe.experts.deepspeed_experts.{}."
+            moe_layers = [i for i in range(L)
+                          if moe.format(i, 0) + "dense_h_to_4h.weight" in sd]
+            if len(moe_layers) != L:
+                raise ValueError(
+                    f"megatron MoE: only layers {moe_layers} carry experts "
+                    f"(of {L}) — interleaved dense layers (--expert-interval) "
+                    "are not supported; the TPU model stacks one MoE FFN per "
+                    "layer")
+            for kind, ours in (("dense_h_to_4h", "moe_w_up"),
+                               ("dense_4h_to_h", "moe_w_down")):
+                layers[ours] = np.stack([
+                    np.stack([_np(sd[moe.format(i, e) + kind + ".weight"]).T
+                              for e in range(E)]) for i in range(L)])
+                for i in range(L):
+                    for e in range(E):
+                        bk = moe.format(i, e) + kind + ".bias"
+                        if bk in sd and np.abs(_np(sd[bk])).max() > 0:
+                            raise ValueError(
+                                f"megatron MoE expert bias {bk} is nonzero — "
+                                "not representable in the TPU expert MLP "
+                                "(bias-free stacked experts); fold or drop "
+                                "biases before import")
+            layers["moe_gate"] = _stack(sd, "layers.{}.mlp.deepspeed_moe.gate.wg.weight",
+                                        L, transpose=True)
+        else:
+            layers["w_up"] = _stack(sd, "layers.{}.mlp.dense_h_to_4h.weight", L,
+                                    transpose=True)
+            layers["b_up"] = _stack(sd, "layers.{}.mlp.dense_h_to_4h.bias", L)
+            layers["w_down"] = _stack(sd, "layers.{}.mlp.dense_4h_to_h.weight", L,
+                                      transpose=True)
+            layers["b_down"] = _stack(sd, "layers.{}.mlp.dense_4h_to_h.bias", L)
+        p["layers"] = layers
+        p["ln_f_w"] = _np(sd["final_layernorm.weight"])
+        p["ln_f_b"] = _np(sd["final_layernorm.bias"])
+        if not config.tie_embeddings:
+            # --untie-embeddings-and-output-weights
+            p["unembed"] = _np(sd["output_layer.weight"])[:config.vocab_size].T
         return p
 
     # rope/rmsnorm families: llama / mistral / qwen2 / phi3 / mixtral / internlm
