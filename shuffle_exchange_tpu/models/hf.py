@@ -214,11 +214,6 @@ def config_from_hf(hf_config) -> TransformerConfig:
             norm_eps=cfg.get("layernorm_epsilon", 1e-5),
             n_experts=int(ne),
             moe_top_k=int(cfg.get("moe_top_k", cfg.get("topk", 2)) or 2))
-        # v0 fused-qkv layout selector ("megatron_v2": false for pre-v2
-        # checkpoints) rides the config dict, not the weights. The
-        # TransformerConfig dataclass is frozen; this loader-only breadcrumb
-        # is not a model field, so it bypasses the freeze.
-        object.__setattr__(c, "_megatron_v2", bool(cfg.get("megatron_v2", True)))
         return c
     if family == "bloom":
         return TransformerConfig(
@@ -293,7 +288,7 @@ def _stack(sd: Dict[str, Any], fmt: str, L: int, transpose: bool = False) -> np.
 
 
 def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
-                           family: str) -> Dict[str, Any]:
+                           family: str, megatron_v2: bool = True) -> Dict[str, Any]:
     """Re-lay an HF state dict into the zoo Transformer's stacked format."""
     L = config.n_layers
     sd = {k.removeprefix("transformer.").removeprefix("model.")
@@ -632,23 +627,33 @@ def params_from_state_dict(sd: Dict[str, Any], config: TransformerConfig,
         # strip the megatron module nesting left after the generic prefixes
         sd = {k.removeprefix("language_model.").removeprefix("encoder."): v
               for k, v in sd.items()}
-        config_megatron_v2 = getattr(config, "_megatron_v2", True)
         D = config.d_model
         H, Dh = config.n_heads, config.head_dim
         p["embed"] = _np(sd["embedding.word_embeddings.weight"])[:config.vocab_size]
+        if "embedding.position_embeddings.weight" not in sd:
+            raise ValueError(
+                "megatron import supports learned positions only; this "
+                "checkpoint has no position_embeddings (rotary/--use-rotary-"
+                "position-embeddings runs are not mapped yet)")
         p["pos_embed"] = _np(sd["embedding.position_embeddings.weight"])
         attn = ("self_attention"
                 if "layers.0.self_attention.query_key_value.weight" in sd
                 else "attention")
+        if f"layers.0.{attn}.query_key_value.bias" not in sd:
+            raise ValueError(
+                "megatron import expects biased projections (the classic "
+                "GPT recipe); this checkpoint looks like a "
+                "--disable-bias-linear run — import it through the llama "
+                "family layout instead")
         qkv_w = np.stack([_np(sd[f"layers.{i}.{attn}.query_key_value.weight"])
                           for i in range(L)])                    # [L, 3D, D]
         qkv_b = np.stack([_np(sd[f"layers.{i}.{attn}.query_key_value.bias"])
                           for i in range(L)])                    # [L, 3D]
         # megatron_v2 interleaves per head ([H, 3, Dh] rows); v0 groups by
         # kind ([3, H, Dh]) — reference MegatronContainer.transpose().
-        # Selected via the CONFIG dict ("megatron_v2": false for old
-        # checkpoints), matching the reference policy's megatron_v2 flag.
-        v2 = bool(config_megatron_v2)
+        # Selected via the config dict ("megatron_v2": false for old
+        # checkpoints), threaded explicitly through from_hf.
+        v2 = bool(megatron_v2)
         if v2:
             qw = qkv_w.reshape(L, H, 3, Dh, D)
             qb = qkv_b.reshape(L, H, 3, Dh)
@@ -807,7 +812,8 @@ def from_hf(model_or_path, dtype=None) -> Tuple[Transformer, Dict[str, Any]]:
         config = _dc.replace(config, mlm_head=False)
         logger.info("bert: no cls.* keys (headless BertModel); importing "
                     "without the MLM head")
-    params = params_from_state_dict(sd, config, family)
+    megatron_v2 = bool(cfg_dict.get("megatron_v2", True))
+    params = params_from_state_dict(sd, config, family, megatron_v2=megatron_v2)
     import jax.numpy as jnp
 
     if dtype is not None:

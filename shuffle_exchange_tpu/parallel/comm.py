@@ -242,3 +242,15 @@ def broadcast_one_to_all(x, is_source: Optional[bool] = None):
     out = multihost_utils.broadcast_one_to_all(x, is_source=is_source)
     comms_logger.record("broadcast", _nbytes(x), elapsed=time.time() - t0)
     return out
+
+
+def process_allgather(x):
+    """Eager host-level all-gather: every process receives every process's
+    value, stacked on a leading process dim (reference: dist.all_gather on
+    host tensors for cross-rank consistency checks)."""
+    from jax.experimental import multihost_utils
+
+    t0 = time.time()
+    out = multihost_utils.process_allgather(x)
+    comms_logger.record("all_gather", _nbytes(x), elapsed=time.time() - t0)
+    return out

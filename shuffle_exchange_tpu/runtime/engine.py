@@ -585,6 +585,12 @@ class Engine:
                      f"lr_scaling={dyn_cfg.get('lr_scaling_method', 'linear')}",
                      ranks=[0])
 
+        # --- cross-host config consistency (SURVEY §5.2: the reference's
+        # closest race guards are cross-rank consistency asserts; here a
+        # config-hash compare across hosts catches mismatched launch
+        # configs before the first collective deadlocks on them) ---------
+        self._assert_cross_host_config()
+
         # --- jitted programs -------------------------------------------
         self._build_programs()
 
@@ -1366,6 +1372,36 @@ class Engine:
             return self._eval16(self._fwd16, self._take_micro(shaped), rng or self._next_rng())
         return self._eval_step(self.state, self._take_micro(shaped), self._mix_matrix(), rng or self._next_rng())
 
+    def _config_fingerprint(self) -> bytes:
+        """Stable digest of the resolved config + mesh layout."""
+        import hashlib
+        import json as _json
+
+        doc = {"config": self.config.to_dict(),
+               "mesh": dict(self.topology.axis_sizes)}
+        return hashlib.sha256(
+            _json.dumps(doc, sort_keys=True, default=str).encode()).digest()[:16]
+
+    def _assert_cross_host_config(self) -> None:
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        from ..parallel import comm as _comm
+
+        # all-gather (not broadcast) so EVERY process — including the
+        # leader — sees the mismatch and fails fast, instead of host 0
+        # proceeding into the first collective and deadlocking.
+        mine = np.frombuffer(self._config_fingerprint(), np.uint8)
+        all_fp = np.asarray(_comm.process_allgather(mine))
+        bad = [i for i in range(all_fp.shape[0])
+               if not np.array_equal(all_fp[i], all_fp[0])]
+        if bad:
+            raise ConfigError(
+                f"config mismatch across hosts: processes {bad} resolved a "
+                "different config/mesh than process 0 — all hosts must "
+                "launch with identical configs")
+
     def _post_step(self, overflow, n_samples: Optional[int] = None) -> None:
         self.global_steps += 1
         self.global_samples += (n_samples if n_samples is not None
@@ -1380,6 +1416,15 @@ class Engine:
                      f"(loss scale -> {self.loss_scale()})", ranks=[0])
         if self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} loss_scale={self.loss_scale()}", ranks=[0])
+            if self.config.wall_clock_breakdown:
+                self.timers.log([TRAIN_BATCH_TIMER],
+                                memory_breakdown=self.config.memory_breakdown)
+            elif self.config.memory_breakdown:
+                # reference see_memory_usage breadcrumbs (runtime/utils.py)
+                from ..utils.timer import SynchronizedWallClockTimer
+
+                log_dist(f"step={self.global_steps} "
+                         f"{SynchronizedWallClockTimer.memory_usage()}", ranks=[0])
 
     # -- fork control surface (reference stage_1_and_2.py:692-734) ------
 
@@ -1547,6 +1592,18 @@ class Engine:
             os.makedirs(path, exist_ok=True)
             with open(os.path.join(path, "host_state.json"), "w") as f:
                 json.dump(host, f, default=str)
+            # recovery breadcrumb (reference engine.py writes a recovery
+            # script into checkpoints): everything a restart needs
+            with open(os.path.join(path, "recovery.json"), "w") as f:
+                json.dump({
+                    "load_dir": os.path.abspath(save_dir), "tag": tag,
+                    "global_steps": self.global_steps,
+                    "world_size": int(jax.device_count()),
+                    "mesh": dict(self.topology.axis_sizes),
+                    "config_fingerprint": self._config_fingerprint().hex(),
+                    "resume": "sxt.initialize(...same config...); "
+                              "engine.load_checkpoint(load_dir, tag)",
+                }, f, indent=1)
         if self.config.checkpoint.writer == "decoupled":
             # Decoupled writer (reference decoupled_checkpoint_engine.py:68):
             # writes continue in the background; commit + `latest` tag land

@@ -242,3 +242,75 @@ def jax_leaves(tree):
     import jax
 
     return jax.tree_util.tree_leaves(tree)
+
+
+def test_memory_breakdown_logs_and_config_fingerprint():
+    """memory_breakdown was parse-only (same silent-flag class as
+    sparse_gradients): steps_per_print now emits HBM stats. The config
+    fingerprint is stable across engines with identical configs and
+    differs when the config differs (cross-host consistency guard)."""
+    import logging
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    def build(**extra):
+        reset_topology()
+        cfg = {"train_batch_size": 8,
+               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+               "steps_per_print": 1, **extra}
+        e, *_ = sxt.initialize(
+            model=Transformer(tiny(vocab=64, d=32, layers=1, heads=2, seq=32)),
+            config=cfg)
+        return e
+
+    engine = build(memory_breakdown=True)
+    batch = {"input_ids": np.zeros((8, 32), np.int32)}
+    from shuffle_exchange_tpu.utils.logging import logger as sxt_logger
+
+    records = []
+
+    class _Catch(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = _Catch()
+    old_level = sxt_logger.level
+    sxt_logger.addHandler(h)
+    sxt_logger.setLevel(logging.INFO)
+    try:
+        engine.train_batch(batch)
+    finally:
+        sxt_logger.removeHandler(h)
+        sxt_logger.setLevel(old_level)
+    assert any("mem" in m for m in records), records[-5:]
+
+    fp1 = engine._config_fingerprint()
+    engine2 = build(memory_breakdown=True)
+    assert engine2._config_fingerprint() == fp1
+    engine3 = build(memory_breakdown=True, gradient_clipping=1.0)
+    assert engine3._config_fingerprint() != fp1
+
+
+def test_checkpoint_recovery_breadcrumb(tmp_path):
+    """Reference engine.py writes a recovery script into checkpoints; the
+    analog recovery.json carries the resume coordinates."""
+    import json
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    reset_topology()
+    engine, *_ = sxt.initialize(
+        model=Transformer(tiny(vocab=64, d=32, layers=1, heads=2, seq=32)),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 10**9})
+    engine.train_batch({"input_ids": np.zeros((8, 32), np.int32)})
+    path = engine.save_checkpoint(str(tmp_path))
+    rec = json.load(open(f"{path}/recovery.json"))
+    assert rec["tag"] == "global_step1" and rec["global_steps"] == 1
+    assert rec["mesh"]["data"] >= 1
+    assert rec["config_fingerprint"] == engine._config_fingerprint().hex()
