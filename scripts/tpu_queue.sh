@@ -1,9 +1,11 @@
 #!/bin/sh
 # Pending on-chip validation queue (run when the TPU tunnel is back):
-#  1. kernel parity smoke (incl. the new grouped-GEMM fwd+VJP checks)
-#  2. full benchmark -> BASELINE.json published rows (vocab-pad loss,
-#     decode fp32-cast fixes, int8 serving measurement)
+#  1. kernel parity smoke (grouped-GEMM fwd+VJP, ALiBi fused, fp8 matmul)
+#  2. config-2 tuning sweep (remat x batch x attention fwd/bwd blocks)
+#  3. full benchmark -> BASELINE.json published rows (vocab-pad loss,
+#     decode fp32-cast fixes, int8/int4/fp8 serving measurement)
 set -e
 cd "$(dirname "$0")/.."
 echo "== tpu_smoke ==" && timeout 900 python tests/tpu_smoke.py
+echo "== tune_config2 ==" && timeout 9000 python scripts/tune_config2.py
 echo "== bench ==" && timeout 3600 python bench.py

@@ -15,12 +15,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 CANDIDATES = [
-    # (remat_policy, batch_size, seq_len)
-    ("nothing_saveable", 8, 4096),      # current bench default (baseline)
-    ("save_attn_seams", 8, 4096),
-    ("save_ffn", 8, 4096),
-    ("save_ffn", 4, 4096),
-    ("save_attn_seams", 16, 4096),
+    # (remat_policy, batch_size, seq_len, env)
+    ("nothing_saveable", 8, 4096, {}),      # current bench default (baseline)
+    ("save_attn_seams", 8, 4096, {}),
+    ("save_ffn", 8, 4096, {}),
+    ("save_ffn", 4, 4096, {}),
+    ("save_attn_seams", 16, 4096, {}),
+    # attention-BACKWARD block sweep (VERDICT r3 #3: an unexplored axis —
+    # the dkv/dq passes hold more VMEM residents than forward)
+    ("nothing_saveable", 8, 4096, {"SXT_ATTN_BLOCK_BWD": "512"}),
+    ("nothing_saveable", 8, 4096, {"SXT_ATTN_BLOCK_BWD": "256"}),
+    ("save_attn_seams", 8, 4096, {"SXT_ATTN_BLOCK_BWD": "512"}),
+    # forward block x bwd block interaction
+    ("nothing_saveable", 8, 4096, {"SXT_ATTN_BLOCK": "512",
+                                   "SXT_ATTN_BLOCK_BWD": "512"}),
 ]
 
 
@@ -64,28 +72,31 @@ def main():
 
     cands = CANDIDATES[:3] if "--quick" in sys.argv else CANDIDATES
     best = None
-    for policy, bs, seq in cands:
+    for policy, bs, seq, env_extra in cands:
         t0 = time.time()
         try:
+            env = dict(os.environ, **env_extra)
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--one",
                  policy, str(bs), str(seq)],
-                capture_output=True, text=True, timeout=900)
+                capture_output=True, text=True, timeout=900, env=env)
             line = next((l for l in reversed(proc.stdout.splitlines())
                          if l.startswith("TUNE_ROW ")), None)
             if proc.returncode == 0 and line:
                 row = json.loads(line[len("TUNE_ROW "):])
                 row["wall_s"] = round(time.time() - t0, 1)
+                if env_extra:
+                    row["env"] = env_extra
                 print(json.dumps(row), flush=True)
                 if best is None or row["tokens_per_sec_chip"] > best["tokens_per_sec_chip"]:
                     best = row
             else:
                 tail = " ".join((proc.stderr or proc.stdout).split())[-200:]
-                print(json.dumps({"config": f"{policy} bs{bs}", "error": tail}),
-                      flush=True)
+                print(json.dumps({"config": f"{policy} bs{bs}", "env": env_extra,
+                                  "error": tail}), flush=True)
         except subprocess.TimeoutExpired:
-            print(json.dumps({"config": f"{policy} bs{bs}", "error": "timeout 900s"}),
-                  flush=True)
+            print(json.dumps({"config": f"{policy} bs{bs}", "env": env_extra,
+                              "error": "timeout 900s"}), flush=True)
     print("WINNER " + json.dumps(best), flush=True)
 
 

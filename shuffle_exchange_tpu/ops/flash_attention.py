@@ -117,10 +117,32 @@ def splash_attention_gqa(q, k, v, causal: bool = True, segment_ids=None,
     G = H // KV
 
     bq, bkv = _pick_block(T, q.dtype.itemsize), _pick_block(S, q.dtype.itemsize)
+    # Backward blocks are independently tunable: the dkv/dq passes hold
+    # extra residual tiles in VMEM, so their sweet spot can sit below the
+    # forward's (the VERDICT r3 MFU item names attention-backward blocks as
+    # an unexplored axis). Same clamp discipline as SXT_ATTN_BLOCK.
+    import os as _os
+
+    try:
+        forced_bwd = int(_os.environ.get("SXT_ATTN_BLOCK_BWD") or 0)
+    except ValueError:
+        forced_bwd = 0
+    cap = 1024 if q.dtype.itemsize <= 2 else 512
+    bq_b, bkv_b = bq, bkv
+    if forced_bwd > 0:
+        use = min(forced_bwd, cap)
+        if use < forced_bwd:
+            warning_once(f"SXT_ATTN_BLOCK_BWD={forced_bwd} exceeds the VMEM "
+                         f"cap for itemsize={q.dtype.itemsize}; using {use}")
+        if T % use == 0 and S % use == 0:
+            bq_b = bkv_b = use
+        else:
+            warning_once(f"SXT_ATTN_BLOCK_BWD={use} does not divide "
+                         f"T={T}/S={S}; keeping forward blocks for backward")
     block_sizes = sa.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkv,
-        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
-        block_q_dq=bq, block_kv_dq=bkv)
+        block_q_dkv=bq_b, block_kv_dkv=bkv_b, block_kv_dkv_compute=bkv_b,
+        block_q_dq=bq_b, block_kv_dq=bkv_b)
     if mask_np is not None:
         # arbitrary [T, S] bool mask (blocksparse layouts): splash skips
         # fully-masked blocks — real block skipping, not just masking

@@ -1421,8 +1421,6 @@ class Engine:
                                 memory_breakdown=self.config.memory_breakdown)
             elif self.config.memory_breakdown:
                 # reference see_memory_usage breadcrumbs (runtime/utils.py)
-                from ..utils.timer import SynchronizedWallClockTimer
-
                 log_dist(f"step={self.global_steps} "
                          f"{SynchronizedWallClockTimer.memory_usage()}", ranks=[0])
 

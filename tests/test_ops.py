@@ -391,3 +391,24 @@ def test_fp8_quantized_matrix_serving_path():
     got_k = _quant_matmul_pallas(x, qm, interpret=True)
     np.testing.assert_allclose(np.asarray(got_k, np.float32),
                                np.asarray(want, np.float32), rtol=2e-2, atol=2e-2)
+
+
+def test_attn_bwd_block_override(monkeypatch):
+    """SXT_ATTN_BLOCK_BWD tunes the splash dkv/dq blocks independently of
+    the forward blocks (clamped like SXT_ATTN_BLOCK); interpret-mode parity
+    is unchanged under the override."""
+    import jax
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.flash_attention import (reference_attention,
+                                                          splash_attention_gqa)
+
+    monkeypatch.setenv("SXT_ATTN_BLOCK_BWD", "128")
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 256, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
+    out = splash_attention_gqa(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
