@@ -24,6 +24,32 @@ import functools
 from ..utils.logging import warning_once
 
 
+def _forced_block(env_var: str, n: int, itemsize: int) -> int:
+    """Parse + clamp a block-size override env var: 0 when unset/invalid/
+    not dividing n; otherwise the forced value clamped to the itemsize-
+    dependent VMEM cap (with a warning when clamped). Shared by the
+    forward (SXT_ATTN_BLOCK) and backward (SXT_ATTN_BLOCK_BWD) knobs."""
+    import os
+
+    try:
+        forced = int(os.environ.get(env_var) or 0)
+    except ValueError:
+        return 0
+    if forced <= 0:
+        return 0
+    cap = 1024 if itemsize <= 2 else 512
+    if forced > cap:
+        # Forcing past the cap recreates the exact VMEM overflow the block
+        # sweep hit (a 1024x1024 fp32 scores tile is the 4MB that blew up).
+        warning_once(f"{env_var}={forced} exceeds the VMEM cap for "
+                     f"itemsize={itemsize} (max {cap}); using {cap}")
+        forced = cap
+    if n % forced:
+        warning_once(f"{env_var}={forced} does not divide seq {n}; ignored")
+        return 0
+    return forced
+
+
 def _pick_block(n: int, itemsize: int = 2) -> int:
     """Largest MXU-friendly block dividing n (the kernels assert
     seq % block == 0); n itself when nothing divides. Swept on a v5e
@@ -32,25 +58,12 @@ def _pick_block(n: int, itemsize: int = 2) -> int:
     online-softmax rescale and fill the MXU pipeline. fp32 operands keep
     the 512 cap — a 1024x1024 fp32 scores tile is the same 4MB that
     overflowed VMEM in the 2048-bf16 sweep point.
-    ``SXT_ATTN_BLOCK`` forces a specific block (tuning knob; ignored when
-    unparseable or not dividing n)."""
-    import os
-
-    try:
-        forced = int(os.environ.get("SXT_ATTN_BLOCK") or 0)
-    except ValueError:
-        forced = 0
+    ``SXT_ATTN_BLOCK`` forces a specific block (tuning knob; clamped to the
+    cap, ignored when unparseable or not dividing n)."""
+    forced = _forced_block("SXT_ATTN_BLOCK", n, itemsize)
+    if forced:
+        return forced
     candidates = (1024, 512, 384, 256, 128) if itemsize <= 2 else (512, 384, 256, 128)
-    if forced > 0 and n % forced == 0:
-        # Clamp the override to the itemsize-dependent VMEM cap: forcing 1024
-        # with fp32 operands recreates the exact overflow the sweep hit.
-        if forced <= candidates[0]:
-            return forced
-        warning_once(
-            f"SXT_ATTN_BLOCK={forced} exceeds the VMEM cap for "
-            f"itemsize={itemsize} (max {candidates[0]}); using {candidates[0]}")
-        if n % candidates[0] == 0:
-            return candidates[0]
     for b in candidates:
         if n % b == 0:
             return b

@@ -307,3 +307,52 @@ def test_lora_with_qw_emulation_targets_base_not_factors():
     assert abs(l_plain - l_qw) < 0.2
     losses = [float(eng_qw.train_batch(b)) for _ in range(4)]
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("mesh", [{"tensor": 2, "data": -1},
+                                  {"seq": 2, "data": -1}])
+def test_lora_composes_with_model_axes(devices8, mesh):
+    """LoRA x tensor and LoRA x sequence parallelism track the plain-DP
+    LoRA trajectory exactly (the merge happens at the params level before
+    the model's sharded compute, so model axes are orthogonal)."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    def run(m):
+        reset_topology()
+        model = Transformer(tiny(vocab=64, d=64, layers=2, heads=4, seq=32,
+                                 n_kv_heads=2))
+        engine, *_ = sxt.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "mesh": m, "lora": {"enabled": True, "r": 4, "alpha": 8},
+            "steps_per_print": 10**9})
+        b = _batch()
+        return [float(engine.train_batch(b)) for _ in range(3)]
+
+    np.testing.assert_allclose(run(mesh), run({"data": -1}), rtol=5e-3)
+
+
+def test_lora_composes_with_pipeline(devices8):
+    """LoRA x pipeline parallelism: the fused weights thread through the
+    pipe stage loss unchanged — exact DP parity."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    def run(m):
+        reset_topology()
+        model = Transformer(tiny(vocab=64, d=32, layers=4, heads=2, seq=32))
+        engine, *_ = sxt.initialize(model=model, config={
+            "train_batch_size": 32, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "mesh": m, "lora": {"enabled": True, "r": 4},
+            "steps_per_print": 10**9})
+        b = _batch(b=32)
+        return [float(engine.train_batch(b)) for _ in range(3)]
+
+    np.testing.assert_allclose(run({"pipe": 2, "data": -1}),
+                               run({"data": -1}), rtol=5e-3)
