@@ -141,6 +141,32 @@ def main() -> int:
     want_a = reference_attention(qa, ka, va, causal=True, alibi_slopes=sl).astype(np.float32)
     ok &= _check("alibi-flash", got_a, want_a, 5e-2)
 
+    # ... and the round-5 from-scratch Pallas backward (dq + dkv kernels):
+    # BLOOM-style TRAINING path, nothing [B,H,T,S]-shaped in memory
+    def _aloss(fn):
+        return lambda q, k, v, s: (fn(q, k, v, s).astype(jnp.float32) ** 2).mean()
+
+    # grads wrt slopes too: the dslope path is the riskiest Mosaic construct
+    # (a revisited per-kv-block f32 output) and must compile on real silicon
+    ga = jax.jit(jax.grad(_aloss(lambda q, k, v, s: alibi_flash_attention(
+        q, k, v, s, True, False)), argnums=(0, 1, 2, 3)))(qa, ka, va, sl)
+    ra = jax.grad(_aloss(lambda q, k, v, s: reference_attention(
+        q, k, v, causal=True, alibi_slopes=s)), argnums=(0, 1, 2, 3))(
+            qa, ka, va, sl)
+    for gg, rr, nm in zip(ga, ra, ("dq", "dk", "dv", "dslopes")):
+        ok &= _check(f"alibi-flash-bwd-{nm}", gg.astype(np.float32),
+                     rr.astype(np.float32), 5e-2)
+
+    # long-context fwd smoke: 32k context through the streamed-KV kernel —
+    # the pre-round-5 kernel would have fallen back (8MB whole-S cap)
+    q32 = jnp.asarray(rng.standard_normal((1, 32768, 2, 128)), jnp.bfloat16)
+    o32 = jax.jit(lambda q, k, v: alibi_flash_attention(
+        q, k, v, jnp.asarray(alibi_slopes(2), jnp.float32), True, False))(
+            q32, q32, q32)
+    fin32 = bool(np.isfinite(np.asarray(o32.astype(np.float32))).all())
+    ok &= fin32
+    print("alibi-32k-fwd:", "ok" if fin32 else "FAIL")
+
     print("TPU smoke:", "ALL PASS" if ok else "FAILURES")
     return 0 if ok else 1
 
