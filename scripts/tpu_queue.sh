@@ -7,5 +7,9 @@
 set -e
 cd "$(dirname "$0")/.."
 echo "== tpu_smoke ==" && timeout 900 python tests/tpu_smoke.py
+echo "== ring_hop bench ==" && timeout 1800 python scripts/bench_ring_hop.py
 echo "== tune_config2 ==" && timeout 9000 python scripts/tune_config2.py
 echo "== bench ==" && timeout 3600 python bench.py
+# Multi-chip only (run on a pod slice when one is available): ring-vs-
+# Ulysses tokens/s at seq >= 32k through the engine (mesh {seq: N},
+# sp_attention ring|ulysses) — single-chip proxy is bench_ring_hop.py.

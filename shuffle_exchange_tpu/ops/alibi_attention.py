@@ -33,6 +33,30 @@ def _blk(ref):
     return ref[...].reshape(ref.shape[-2], ref.shape[-1]).astype(jnp.float32)
 
 
+def _vma_of(*arrs):
+    """Union of the inputs' varying-manual-axes sets (empty outside
+    shard_map) — pallas_call out_shapes must carry it when the caller runs
+    under a vma-checked shard_map (ring attention hops do)."""
+    import jax
+
+    vma = frozenset()
+    for a in arrs:
+        try:
+            vma = vma | jax.typeof(a).vma
+        except Exception:
+            pass
+    return vma
+
+
+def _block_visible(qi, ki, bq, bkv, off, causal):
+    """Does kv block ki contribute to q block qi? (the grid-level half of
+    the causal mask — shared by fwd/dq/dkv so the three kernels can never
+    disagree with each other or with _score_grads' element mask)."""
+    if not causal:
+        return qi >= 0
+    return qi * bq + bq - 1 + off >= ki * bkv
+
+
 def _alibi_fwd_kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *,
                       bq: int, bkv: int, off: int, scale: float,
@@ -55,7 +79,7 @@ def _alibi_fwd_kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # causal block skip: this kv block contributes iff its first key is
     # visible from the q block's last row (query i sees keys j <= i + off)
-    @pl.when((qi * bq + bq - 1 + off >= ki * bkv) if causal else (qi >= 0))
+    @pl.when(_block_visible(qi, ki, bq, bkv, off, causal))
     def _compute():
         q = _blk(q_ref) * scale
         kb = _blk(k_ref)
@@ -137,7 +161,7 @@ def _alibi_dq_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when((qi * bq + bq - 1 + off >= ki * bkv) if causal else (qi >= 0))
+    @pl.when(_block_visible(qi, ki, bq, bkv, off, causal))
     def _compute():
         _, kb, _, _, ds, _ = _score_grads(
             slope_ref[0, 0], q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -171,7 +195,7 @@ def _alibi_dkv_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         # accumulate across q blocks only — the kv grid dim stays parallel
         dslope_ref[...] = jnp.zeros_like(dslope_ref)
 
-    @pl.when((qi * bq + bq - 1 + off >= ki * bkv) if causal else (qi >= 0))
+    @pl.when(_block_visible(qi, ki, bq, bkv, off, causal))
     def _compute():
         q, _, do, p, ds, kv_pos_f = _score_grads(
             slope_ref[0, 0], q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -204,9 +228,12 @@ def _grid_setup(q, k, bwd: bool = False):
         # the splash backward honors, flash_attention.py:140)
         fq = _forced_block("SXT_ATTN_BLOCK_BWD", T, q.dtype.itemsize)
         fk = _forced_block("SXT_ATTN_BLOCK_BWD", S, q.dtype.itemsize)
-        # halving an already-dividing power-of-two pick preserves divisibility
-        bq = fq or (bq if bq <= 512 else bq // 2)
-        bkv = fk or (bkv if bkv <= 512 else bkv // 2)
+        def half(b, n):
+            # halve oversized picks only when the half still divides n
+            # (_pick_block's n-itself fallback can be odd)
+            return b if (b <= 512 or n % (b // 2)) else b // 2
+        bq = fq or half(bq, T)
+        bkv = fk or half(bkv, S)
     return B, T, H, D, S, bq, bkv, S - T
 
 
@@ -247,8 +274,8 @@ def _alibi_flash_fwd_impl(q, k, v, slopes, causal: bool, interpret: bool):
             pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype, vma=_vma_of(q, k, v)),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32, vma=_vma_of(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -279,7 +306,11 @@ def _fwd(q, k, v, slopes, causal, interpret):
     return out, (q, k, v, slopes, out, lse)
 
 
-def _bwd(causal, interpret, res, g):
+def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret):
+    """Shared dq/dkv-kernel backward. ``g_lse`` (cotangent of the emitted
+    logsumexp, used by :func:`flash_attention_lse` consumers like ring
+    attention's hop merge) folds into delta: dL/ds = p*(dp - delta) +
+    g_lse*p = p*(dp - (delta - g_lse))."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -287,7 +318,6 @@ def _bwd(causal, interpret, res, g):
 
     from .flash_attention import _repeat_kv
 
-    q, k, v, slopes, out, lse = res
     n_rep = q.shape[2] // k.shape[2]
     kr = _repeat_kv(k, n_rep) if n_rep > 1 else k
     vr = _repeat_kv(v, n_rep) if n_rep > 1 else v
@@ -299,6 +329,8 @@ def _bwd(causal, interpret, res, g):
     gt = g.transpose(0, 2, 1, 3)
     ot = out.transpose(0, 2, 1, 3)
     delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     slopes_in = jnp.asarray(slopes, jnp.float32).reshape(H, 1)
     scale = D ** -0.5
 
@@ -319,7 +351,8 @@ def _bwd(causal, interpret, res, g):
             pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype,
+                                       vma=_vma_of(q, k, v, g)),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
@@ -345,11 +378,12 @@ def _bwd(causal, interpret, res, g):
             pl.BlockSpec((1, 1, 1), lambda b, h, j, i: (b, h, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype, vma=_vma_of(q, k, v, g)),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype, vma=_vma_of(q, k, v, g)),
             # dslope partials per kv block: accumulation only crosses the q
             # grid dim, so the kv dim stays parallelizable (megacore)
-            jax.ShapeDtypeStruct((B, H, S // bkv), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S // bkv), jnp.float32,
+                                 vma=_vma_of(q, k, v, g)),
         ],
         scratch_shapes=[pltpu.VMEM((bkv, D), jnp.float32),
                         pltpu.VMEM((bkv, D), jnp.float32)],
@@ -374,7 +408,52 @@ def _bwd(causal, interpret, res, g):
             dslopes)
 
 
+def _bwd(causal, interpret, res, g):
+    q, k, v, slopes, out, lse = res
+    return _flash_bwd_impl(q, k, v, slopes, out, lse, g, None, causal,
+                           interpret)
+
+
 alibi_flash_attention.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_lse(q, k, v, causal: bool = True,
+                        interpret: bool = False):
+    """Plain flash attention that ALSO returns the per-row logsumexp —
+    q [B,T,H,D], k/v [B,S,Hkv,D] -> (out [B,T,H,D], lse [B,H,T]).
+
+    The building block for attention MERGING across partial key sets
+    (ring attention hops, SURVEY §5.7): partial outputs combine exactly via
+    out = Σ_h out_h·exp(lse_h - lse_tot). Differentiable in BOTH outputs —
+    the lse cotangent folds into the dq/dkv kernels' delta term. Implemented
+    as the ALiBi kernel family at slope = 0 (the bias term vanishes)."""
+    import jax.numpy as jnp
+
+    zeros = jnp.zeros((q.shape[2],), jnp.float32)
+    return _alibi_flash_fwd_impl(q, k, v, zeros, causal, interpret)
+
+
+def _lse_fwd(q, k, v, causal, interpret):
+    import jax.numpy as jnp
+
+    zeros = jnp.zeros((q.shape[2],), jnp.float32)
+    out, lse = _alibi_flash_fwd_impl(q, k, v, zeros, causal, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _lse_bwd(causal, interpret, res, g):
+    import jax.numpy as jnp
+
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    zeros = jnp.zeros((q.shape[2],), jnp.float32)
+    dq, dk, dv, _ = _flash_bwd_impl(q, k, v, zeros, out, lse, g_out, g_lse,
+                                    causal, interpret)
+    return dq, dk, dv
+
+
+flash_attention_lse.defvjp(_lse_fwd, _lse_bwd)
 
 
 def alibi_kernel_ok(q, k, causal: bool = True) -> bool:
@@ -392,5 +471,9 @@ def alibi_kernel_ok(q, k, causal: bool = True) -> bool:
     from .flash_attention import _pick_block
 
     bq, bkv = _pick_block(t, q.dtype.itemsize), _pick_block(s, q.dtype.itemsize)
-    return (d in (64, 128) and t % bq == 0 and s % bkv == 0
-            and bq >= 128 and bkv >= 128 and causal and s >= t)
+    # blocks must come from the swept candidate set: _pick_block's
+    # n-itself fallback (no candidate divides) would put the whole
+    # sequence in one VMEM tile — a Mosaic overflow, not a perf knob
+    cands = (1024, 512, 384, 256, 128)
+    return (d in (64, 128) and bq in cands and bkv in cands
+            and t % bq == 0 and s % bkv == 0 and causal and s >= t)

@@ -139,8 +139,23 @@ def _ring_kv_chunk(Tq: int, requested: int = 1024) -> int:
     return c
 
 
+def _ring_hop_kernel_ok(q, interpret: bool) -> bool:
+    """Can the per-hop Pallas flash kernel serve this ring? (mirrors the
+    ALiBi-family gate: MXU-friendly blocks, supported head dim)."""
+    from ..ops.dispatch import pallas_enabled
+    from ..ops.flash_attention import _pick_block
+
+    if not (pallas_enabled() or interpret):
+        return False
+    _, Tq, _, D = q.shape
+    bq = _pick_block(Tq, q.dtype.itemsize)
+    # candidate blocks only — the n-itself fallback would be one giant tile
+    return D in (64, 128) and Tq % bq == 0 and bq in (1024, 512, 384, 256, 128)
+
+
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
-                   kv_chunk: int = 1024):
+                   kv_chunk: int = 1024, use_kernel: str = "auto",
+                   interpret: bool = False):
     """Blockwise full-sequence attention with rotating KV — flash-grade.
 
     q/k/v: [B, T_local, H|Hkv, D] — this device's sequence shard (layout
@@ -153,6 +168,15 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     per hop, so autodiff residuals are the O(T/sp * D) hop inputs
     (q, the rotated kv blocks, and the running (acc, m, l) carry), never
     [T/sp, T/sp] score matrices.
+
+    Compute (round 5, VERDICT r4 #5 / SURVEY §5.7 "splash kernel +
+    ppermute"): when the shapes pass :func:`_ring_hop_kernel_ok`, each hop
+    runs the Pallas :func:`~..ops.alibi_attention.flash_attention_lse`
+    kernel (diagonal hop: causal variant; earlier-source hops: full
+    variant; later-source hops skip compute via ``lax.cond``) and partial
+    outputs merge by logsumexp — the MXU sees flash tiles, not jnp einsum
+    chunks. ``use_kernel``: "auto" | True | False. The jnp chunked path
+    remains for shapes the kernel gate rejects.
     """
     import jax
     import jax.numpy as jnp
@@ -160,6 +184,14 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     sp = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
+    kernel_on = (use_kernel is True or
+                 (use_kernel == "auto" and _ring_hop_kernel_ok(q, interpret)))
+    if use_kernel is True and not _ring_hop_kernel_ok(q, interpret):
+        raise ValueError(
+            f"ring hop kernel forced but the shape gate rejects it "
+            f"(Tq={Tq}, D={D}; need D in (64,128) and a >=128 block)")
+    if kernel_on:
+        return _ring_attention_kernel(q, k, v, axis_name, causal, interpret)
     # GQA: rotate the UN-repeated kv shards (KV-sized ring hops — repeating
     # first would multiply ppermute bytes by H/KV); expand per chunk inside
     # the accumulate step, where the broadcast stays local (and is
@@ -236,6 +268,102 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     acc, m_run, l_run = carry
     out = acc / jnp.maximum(l_run[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,T,H,D]
+
+
+def _ring_attention_kernel(q, k, v, axis_name: str, causal: bool,
+                           interpret: bool):
+    """Ring attention with a Pallas flash kernel inside each hop.
+
+    Each hop attends the local Q shard against one rotated KV shard through
+    :func:`~..ops.alibi_attention.flash_attention_lse` and the partial
+    (out, lse) pairs merge exactly:
+    ``out = Σ_h out_h · exp(lse_h − lse_tot)``. For causal rings the hop's
+    role is data-dependent per device (the source block's causal offset):
+    the r=0 hop is the diagonal (causal kernel, trace-time static), and
+    each later hop runs the full kernel iff the source shard precedes this
+    one — selected with ``lax.cond`` so skipped devices do no attention
+    work. (Load is inherently ring-position-skewed for causal; a zigzag
+    block permutation would even it out — future knob.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.alibi_attention import flash_attention_lse
+
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+
+    def merge(carry, out_h, lse_h):
+        out_run, lse_run = carry
+        m = jnp.maximum(lse_run, lse_h)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        w1 = jnp.where(jnp.isfinite(lse_run), jnp.exp(lse_run - m_safe), 0.0)
+        w2 = jnp.where(jnp.isfinite(lse_h), jnp.exp(lse_h - m_safe), 0.0)
+        r = w1 + w2
+        r_safe = jnp.maximum(r, 1e-30)
+        # lse layout [B,H,T] -> weight layout [B,T,H,1] for the outputs
+        as_bth = lambda t: t.transpose(0, 2, 1)[..., None]
+        out_new = (out_run * as_bth(w1 / r_safe)
+                   + out_h.astype(jnp.float32) * as_bth(w2 / r_safe))
+        lse_new = jnp.where(r > 0, m_safe + jnp.log(r_safe), -jnp.inf)
+        return out_new, lse_new
+
+    def hop(carry, q, k_blk, v_blk, src_idx):
+        if causal:
+            def full_branch(q, kb, vb):
+                return flash_attention_lse(q, kb, vb, False, interpret)
+
+            def skip_branch(q, kb, vb):
+                # constants must carry the same varying-axes set as the
+                # kernel branches' outputs or cond rejects the branch types
+                vma = frozenset()
+                for t in (q, kb, vb):
+                    vma = vma | jax.typeof(t).vma
+
+                def mk(z):
+                    need = tuple(sorted(vma - jax.typeof(z).vma))
+                    return jax.lax.pcast(z, need, to="varying") if need else z
+
+                return (mk(jnp.zeros(q.shape, q.dtype)),
+                        mk(jnp.full((B, H, Tq), -jnp.inf, jnp.float32)))
+
+            def diag_branch(q, kb, vb):
+                return flash_attention_lse(q, kb, vb, True, interpret)
+
+            # diagonal iff src == me; earlier shards attend fully; later
+            # shards are entirely masked -> skip the kernel
+            out_h, lse_h = jax.lax.cond(
+                src_idx == my_idx, diag_branch,
+                lambda q, kb, vb: jax.lax.cond(
+                    src_idx < my_idx, full_branch, skip_branch, q, kb, vb),
+                q, k_blk, v_blk)
+        else:
+            out_h, lse_h = flash_attention_lse(q, k_blk, v_blk, False,
+                                               interpret)
+        return merge(carry, out_h, lse_h)
+
+    # Remat per hop: residuals are the hop inputs (O(Tq·D)), and the
+    # kernel's own custom_vjp recomputes score tiles in its dq/dkv passes.
+    hop = jax.checkpoint(hop)
+
+    def rotate(kv):
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        return jax.tree_util.tree_map(
+            lambda x: comm.ppermute(x, axis_name, perm), kv)
+
+    out0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    out0, lse0 = (jax.lax.pcast(t, (axis_name,), to="varying")
+                  for t in (out0, lse0))
+    carry = (out0, lse0)
+    kv = (k, v)
+    for r in range(sp):
+        src_idx = (my_idx - r) % sp
+        carry = hop(carry, q, kv[0], kv[1], src_idx)
+        if r != sp - 1:
+            kv = rotate(kv)
+    out_run, _ = carry
+    return out_run.astype(q.dtype)  # [B,T,H,D]
 
 
 # ----------------------------------------------------------------------

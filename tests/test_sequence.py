@@ -116,6 +116,66 @@ def test_ring_attention_noncausal(devices8):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal,kvh", [(True, 4), (True, 2), (False, 4)])
+def test_ring_attention_kernel_hops_match_reference(devices8, causal, kvh):
+    """VERDICT r4 #5: ring hops run the Pallas flash_attention_lse kernel
+    (diagonal/full/skip selected per device by the source block's causal
+    offset) with logsumexp merging — forced on via use_kernel=True +
+    interpret mode, exact against the jnp reference."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    topo = _seq_mesh(devices8, sp=2)
+    q, k, v = _qkv(b=1, t=512, h=4, d=64, kvh=kvh)  # Tq=256 >= min block
+    want = reference_attention(q, k, v, causal=causal)
+    fn = shard_map(lambda q, k, v: ring_attention(
+        q, k, v, axis_name="seq", causal=causal, use_kernel=True,
+        interpret=True),
+        mesh=topo.mesh, in_specs=P(None, "seq"), out_specs=P(None, "seq"))
+    got = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_kernel_backward(devices8):
+    """Kernel-hop ring: grads flow through the per-hop custom_vjp (dq/dkv
+    Pallas passes + lse-merge chain rule), match the reference, and keep
+    O(Tq·D) residuals (no quadratic score blocks saved)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from shuffle_exchange_tpu.ops.flash_attention import reference_attention
+
+    topo = _seq_mesh(devices8, sp=2)
+    Tq = 256
+    q, k, v = _qkv(b=1, t=512, h=2, d=64)
+    spec = P(None, "seq", None, None)
+    f = jax.jit(shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq", causal=True,
+                                       use_kernel=True, interpret=True),
+        mesh=topo.mesh, in_specs=(spec, spec, spec), out_specs=spec))
+
+    def loss(q, k, v):
+        return f(q, k, v).sum()
+
+    _, vjp_fn = jax.vjp(loss, q, k, v)
+    leaves = jax.tree_util.tree_leaves(vjp_fn)
+    quad = [tuple(l.shape) for l in leaves
+            if hasattr(l, "shape") and l.ndim >= 2
+            and sum(1 for s in l.shape if s == Tq) >= 2]
+    assert not quad, f"quadratic residuals saved for backward: {quad}"
+    g_ring = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: reference_attention(q, k, v, causal=True)
+                     .astype(np.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_ring, g_ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3, err_msg=nm)
+
+
 def test_tiled_mlp_identity():
     import jax.numpy as jnp
 
