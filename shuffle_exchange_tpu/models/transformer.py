@@ -675,8 +675,19 @@ class Transformer:
         else:
             raise ValueError(f"Unsupported sp_attention {cfg.sp_attention!r}; "
                              "use 'ulysses' or 'ring'")
-        out = jax.shard_map(sp_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec)(q, k, v)
+        # Partial-manual over exactly the axes this region names: it can
+        # then NEST inside the pipeline's manual-over-"pipe" region (the
+        # reference runs Ulysses inside PP stages via its group registry,
+        # utils/groups.py:633 — here SP×PP composes as nested shard_maps).
+        # Inside an enclosing manual region the nested call must use the
+        # CONTEXT mesh (whose outer axes are typed Manual), not the
+        # concrete topology mesh.
+        manual = {"data", "fsdp", "seq"} | ({"tensor"} if head_ax else set())
+        from ..parallel.mesh import constraint_mesh
+
+        out = jax.shard_map(sp_fn, mesh=constraint_mesh(mesh),
+                            in_specs=(spec, spec, spec),
+                            out_specs=spec, axis_names=manual)(q, k, v)
         return out[:, :T0] if pad else out
 
     def stack_apply(self, stacked_layers, x, rope, ltd_mask=None, layer_keep=None):
@@ -700,8 +711,11 @@ class Transformer:
         if sp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from ..parallel.mesh import constraint_mesh
+
             x = jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(("data", "fsdp"), "seq", None)))
+                x, NamedSharding(constraint_mesh(mesh),
+                                 P(("data", "fsdp"), "seq", None)))
         L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
         use_local = bool(cfg.local_attention_window and cfg.attention_pattern)
         local_flags = None

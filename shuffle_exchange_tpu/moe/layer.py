@@ -189,8 +189,10 @@ def _constrain_expert(t, expert_axis, mesh):
             mesh = get_topology().mesh
         if mesh.shape.get(expert_axis, 1) == 1:
             return t
+        from ..parallel.mesh import constraint_mesh
+
         return jax.lax.with_sharding_constraint(
-            t, NamedSharding(mesh, P(expert_axis, None, None)))
+            t, NamedSharding(constraint_mesh(mesh), P(expert_axis, None, None)))
     except Exception:
         return t
 

@@ -175,14 +175,18 @@ def _alibi_dq_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _alibi_dkv_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dk_ref, dv_ref, dslope_ref,
-                      dk_acc_ref, dv_acc_ref, *,
+                      delta_ref, dk_ref, dv_ref, *rest,
                       bq: int, bkv: int, off: int, scale: float,
-                      causal: bool):
+                      causal: bool, need_dslope: bool):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    if need_dslope:
+        dslope_ref, dk_acc_ref, dv_acc_ref = rest
+    else:
+        dslope_ref = None
+        dk_acc_ref, dv_acc_ref = rest
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -191,9 +195,11 @@ def _alibi_dkv_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
-        # dslope partials are per (b, h, kv-block): init with the kv block,
-        # accumulate across q blocks only — the kv grid dim stays parallel
-        dslope_ref[...] = jnp.zeros_like(dslope_ref)
+        if need_dslope:
+            # dslope partials are per (b, h, kv-block): init with the kv
+            # block, accumulate across q blocks only — the kv grid dim
+            # stays parallel
+            dslope_ref[...] = jnp.zeros_like(dslope_ref)
 
     @pl.when(_block_visible(qi, ki, bq, bkv, off, causal))
     def _compute():
@@ -205,8 +211,9 @@ def _alibi_dkv_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        # bias = slope * j  ->  dslope += sum_ij ds_ij * j
-        dslope_ref[...] = dslope_ref[...] + jnp.sum(ds * kv_pos_f)
+        if need_dslope:
+            # bias = slope * j  ->  dslope += sum_ij ds_ij * j
+            dslope_ref[...] = dslope_ref[...] + jnp.sum(ds * kv_pos_f)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -306,7 +313,8 @@ def _fwd(q, k, v, slopes, causal, interpret):
     return out, (q, k, v, slopes, out, lse)
 
 
-def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret):
+def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
+                    need_dslope=True):
     """Shared dq/dkv-kernel backward. ``g_lse`` (cotangent of the emitted
     logsumexp, used by :func:`flash_attention_lse` consumers like ring
     attention's hop merge) folds into delta: dL/ds = p*(dp - delta) +
@@ -360,9 +368,26 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret):
         interpret=interpret,
     )(slopes_in, qt, kt, vt, gt, lse, delta)
 
-    dk_t, dv_t, dslope_bhk = pl.pallas_call(
+    dkv_out_specs = [
+        pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
+    ]
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct((B, H, S, D), k.dtype, vma=_vma_of(q, k, v, g)),
+        jax.ShapeDtypeStruct((B, H, S, D), v.dtype, vma=_vma_of(q, k, v, g)),
+    ]
+    if need_dslope:
+        # dslope partials per kv block: accumulation only crosses the q
+        # grid dim, so the kv dim stays parallelizable (megacore)
+        dkv_out_specs.append(
+            pl.BlockSpec((1, 1, 1), lambda b, h, j, i: (b, h, j)))
+        dkv_out_shape.append(
+            jax.ShapeDtypeStruct((B, H, S // bkv), jnp.float32,
+                                 vma=_vma_of(q, k, v, g)))
+    dkv_res = pl.pallas_call(
         functools.partial(_alibi_dkv_kernel, bq=bq, bkv=bkv, off=off,
-                          scale=scale, causal=causal),
+                          scale=scale, causal=causal,
+                          need_dslope=need_dslope),
         grid=(B, H, S // bkv, T // bq),
         in_specs=common_in + [
             pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
@@ -372,19 +397,8 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret):
             pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
             pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, 1), lambda b, h, j, i: (b, h, j)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), k.dtype, vma=_vma_of(q, k, v, g)),
-            jax.ShapeDtypeStruct((B, H, S, D), v.dtype, vma=_vma_of(q, k, v, g)),
-            # dslope partials per kv block: accumulation only crosses the q
-            # grid dim, so the kv dim stays parallelizable (megacore)
-            jax.ShapeDtypeStruct((B, H, S // bkv), jnp.float32,
-                                 vma=_vma_of(q, k, v, g)),
-        ],
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shape,
         scratch_shapes=[pltpu.VMEM((bkv, D), jnp.float32),
                         pltpu.VMEM((bkv, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -392,6 +406,7 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret):
                                  "arbitrary")),
         interpret=interpret,
     )(slopes_in, qt, kt, vt, gt, lse, delta)
+    dk_t, dv_t = dkv_res[0], dkv_res[1]
 
     dq = dq_t.transpose(0, 2, 1, 3)
     dk = dk_t.transpose(0, 2, 1, 3)
@@ -401,7 +416,9 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret):
         Hkv = k.shape[2]
         dk = dk.reshape(B, S, Hkv, n_rep, D).sum(axis=3)
         dv = dv.reshape(B, S, Hkv, n_rep, D).sum(axis=3)
-    dslopes = dslope_bhk.sum(axis=(0, 2))
+    if not need_dslope:
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
+    dslopes = dkv_res[2].sum(axis=(0, 2))
     slopes_arr = jnp.asarray(slopes)
     dslopes = dslopes.astype(slopes_arr.dtype).reshape(slopes_arr.shape)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
@@ -448,8 +465,10 @@ def _lse_bwd(causal, interpret, res, g):
     q, k, v, out, lse = res
     g_out, g_lse = g
     zeros = jnp.zeros((q.shape[2],), jnp.float32)
+    # need_dslope=False: the slope is the constant 0 here — skip the dkv
+    # kernel's dslope accumulate and its extra output entirely
     dq, dk, dv, _ = _flash_bwd_impl(q, k, v, zeros, out, lse, g_out, g_lse,
-                                    causal, interpret)
+                                    causal, interpret, need_dslope=False)
     return dq, dk, dv
 
 
@@ -468,12 +487,12 @@ def alibi_kernel_ok(q, k, causal: bool = True) -> bool:
         return False
     b, t, h, d = q.shape
     s = k.shape[1]
-    from .flash_attention import _pick_block
+    from .flash_attention import BLOCK_CANDIDATES, _pick_block
 
     bq, bkv = _pick_block(t, q.dtype.itemsize), _pick_block(s, q.dtype.itemsize)
     # blocks must come from the swept candidate set: _pick_block's
     # n-itself fallback (no candidate divides) would put the whole
     # sequence in one VMEM tile — a Mosaic overflow, not a perf knob
-    cands = (1024, 512, 384, 256, 128)
+    cands = BLOCK_CANDIDATES
     return (d in (64, 128) and bq in cands and bkv in cands
             and t % bq == 0 and s % bkv == 0 and causal and s >= t)

@@ -24,6 +24,13 @@ import functools
 from ..utils.logging import warning_once
 
 
+# MXU-friendly block candidates, hardware-swept (see _pick_block notes).
+# Single source of truth: the kernel gates (alibi_kernel_ok,
+# parallel/sequence._ring_hop_kernel_ok) test membership against this —
+# keep them in sync by construction, not by copy.
+BLOCK_CANDIDATES = (1024, 512, 384, 256, 128)
+
+
 def _forced_block(env_var: str, n: int, itemsize: int) -> int:
     """Parse + clamp a block-size override env var: 0 when unset/invalid/
     not dividing n; otherwise the forced value clamped to the itemsize-
@@ -63,7 +70,8 @@ def _pick_block(n: int, itemsize: int = 2) -> int:
     forced = _forced_block("SXT_ATTN_BLOCK", n, itemsize)
     if forced:
         return forced
-    candidates = (1024, 512, 384, 256, 128) if itemsize <= 2 else (512, 384, 256, 128)
+    candidates = (BLOCK_CANDIDATES if itemsize <= 2 else
+                  tuple(c for c in BLOCK_CANDIDATES if c <= 512))
     for b in candidates:
         if n % b == 0:
             return b

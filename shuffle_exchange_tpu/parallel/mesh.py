@@ -210,6 +210,28 @@ def reset_topology() -> None:
 
 # Reference-compatible getter names (utils/groups.py:57-749).
 
+def constraint_mesh(default=None):
+    """Mesh to use for in-trace sharding constraints / nested shard_maps.
+
+    Inside a (partial-)manual region, constraints must be built on the
+    CONTEXT abstract mesh (whose enclosing axes are typed Manual) — a
+    NamedSharding over the concrete topology mesh (all-Auto) trips the
+    mesh-equality check. Outside any region, returns ``default`` (or the
+    topology mesh)."""
+    import jax
+
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and getattr(ctx, "axis_names", ()):
+        try:
+            if any(t == jax.sharding.AxisType.Manual for t in ctx.axis_types):
+                return ctx
+        except Exception:
+            pass
+    if default is not None:
+        return default
+    return get_topology().mesh
+
+
 def get_data_parallel_world_size() -> int:
     return get_topology().data_parallel_world_size
 

@@ -232,10 +232,26 @@ class PipelinedModel:
                 sums, counts = jax.lax.map(one, (outputs, labels))
                 return sums.sum(), counts.sum()
 
-            nll_sum, count = jax.lax.cond(
-                stage == S - 1, last_stage_ce,
-                lambda o: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-                outputs)
+            sp = _current_mesh().shape.get("seq", 1)
+            if sp > 1:
+                # seq x pipe (round 5): with an auto "seq" axis live inside
+                # this region, the CE contains seq-group collectives; a
+                # stage-VARYING lax.cond would run them only on the last
+                # stage while its pipe partners move on to the next tick's
+                # ppermute — a rendezvous deadlock (observed on the 8-dev
+                # CPU mesh). Keep the collective schedule uniform: every
+                # stage computes the CE (non-last stages on their zero
+                # outputs) and the result is masked. Costs (S-1) wasted
+                # head matmuls — the pipeline bubble already dwarfs this.
+                nll_all, count_all = last_stage_ce(outputs)
+                is_last = (stage == S - 1).astype(jnp.float32)
+                nll_sum, count = nll_all * is_last, count_all * is_last
+            else:
+                nll_sum, count = jax.lax.cond(
+                    stage == S - 1, last_stage_ce,
+                    lambda o: (jnp.zeros((), jnp.float32),
+                               jnp.zeros((), jnp.float32)),
+                    outputs)
             # Per-stage partials, reduced OUTSIDE the manual region (the
             # reference broadcasts the aggregated loss from the last stage,
             # runtime/pipe/engine.py:584; here summing the [S] vector is

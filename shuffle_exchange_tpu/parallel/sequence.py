@@ -148,9 +148,11 @@ def _ring_hop_kernel_ok(q, interpret: bool) -> bool:
     if not (pallas_enabled() or interpret):
         return False
     _, Tq, _, D = q.shape
+    from ..ops.flash_attention import BLOCK_CANDIDATES
+
     bq = _pick_block(Tq, q.dtype.itemsize)
     # candidate blocks only — the n-itself fallback would be one giant tile
-    return D in (64, 128) and Tq % bq == 0 and bq in (1024, 512, 384, 256, 128)
+    return D in (64, 128) and Tq % bq == 0 and bq in BLOCK_CANDIDATES
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
@@ -187,9 +189,17 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     kernel_on = (use_kernel is True or
                  (use_kernel == "auto" and _ring_hop_kernel_ok(q, interpret)))
     if use_kernel is True and not _ring_hop_kernel_ok(q, interpret):
+        from ..ops.dispatch import pallas_enabled
+
+        if not (pallas_enabled() or interpret):
+            raise ValueError(
+                "ring hop kernel forced but Pallas is disabled on this "
+                "backend — run on TPU, pass interpret=True, or drop "
+                "use_kernel=True")
         raise ValueError(
             f"ring hop kernel forced but the shape gate rejects it "
-            f"(Tq={Tq}, D={D}; need D in (64,128) and a >=128 block)")
+            f"(Tq={Tq}, D={D}; need D in (64,128) and a swept block "
+            f"size dividing Tq)")
     if kernel_on:
         return _ring_attention_kernel(q, k, v, axis_name, causal, interpret)
     # GQA: rotate the UN-repeated kv shards (KV-sized ring hops — repeating
