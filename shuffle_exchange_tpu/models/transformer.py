@@ -92,6 +92,11 @@ class TransformerConfig:
     moe_shared_expert_ff: int = 0              # Qwen2-MoE shared expert (0 = none)
     moe_norm_topk: bool = True                 # renormalize top-k weights (Mixtral);
                                                # False = raw softmax probs (Qwen2-MoE)
+    # Megatron --expert-interval interleaving: per-layer MoE flags, cycled
+    # over n_layers; () = every layer is MoE (when n_experts > 0). Dense
+    # layers store their FFN in expert slot 0 of the stacked arrays and a
+    # traced per-layer flag selects the dense path inside the scan.
+    moe_layer_pattern: Tuple[bool, ...] = ()
     attention_impl: str = "auto"
     # Chunked vocab CE (reference FPDT chunked logits loss,
     # sequence/fpdt_layer.py:1137): compute the loss in seq chunks under
@@ -476,11 +481,15 @@ class Transformer:
             return x, (None, None)
         return x, rope_table(T, cfg.rotary_dims, cfg.rope_theta)
 
-    def layer_apply(self, lw, h, rope, local=None):
+    def layer_apply(self, lw, h, rope, local=None, moe_on=None):
         """One transformer block. h [B, T, D] -> (h, moe_aux).
 
         ``local`` (traced bool scalar, GPT-Neo): this layer restricts
-        attention to the trailing ``local_attention_window`` positions."""
+        attention to the trailing ``local_attention_window`` positions.
+        ``moe_on`` (traced bool scalar, Megatron --expert-interval): False
+        routes this layer through the dense FFN stored in expert slot 0
+        (the flag is replica-identical, so both lax.cond branches keep a
+        uniform collective schedule across devices)."""
         import jax
         import jax.numpy as jnp
 
@@ -546,10 +555,35 @@ class Transformer:
             expert_params = {name[4:]: lw[name] for name in lw
                              if name.startswith("moe_")
                              and name != "moe_gate" and not name.startswith("moe_shared")}
-            res = moe_layer(lw["moe_gate"], expert_params, y2, k=cfg.moe_top_k,
-                            capacity_factor=cfg.capacity_factor, activation=cfg.activation,
-                            impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk)
-            ff, aux = res.output, res.aux_loss
+
+            def moe_branch(y2):
+                res = moe_layer(lw["moe_gate"], expert_params, y2, k=cfg.moe_top_k,
+                                capacity_factor=cfg.capacity_factor, activation=cfg.activation,
+                                impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk)
+                return res.output, res.aux_loss
+
+            if moe_on is None:
+                ff, aux = moe_branch(y2)
+            else:
+                def dense_branch(y2):
+                    # expert slot 0 carries the dense FFN of interleaved
+                    # dense layers (Megatron --expert-interval import)
+                    up = y2 @ expert_params["w_up"][0].astype(dtype)
+                    if "b_up" in expert_params:
+                        up = up + expert_params["b_up"][0].astype(dtype)
+                    if cfg.activation == "swiglu":
+                        g = y2 @ expert_params["w_gate"][0].astype(dtype)
+                        if "b_gate" in expert_params:
+                            g = g + expert_params["b_gate"][0].astype(dtype)
+                        hh = jax.nn.silu(g) * up
+                    else:
+                        hh = activation_fn(cfg.activation)(up)
+                    out = hh @ expert_params["w_down"][0].astype(dtype)
+                    if "b_down" in expert_params:
+                        out = out + expert_params["b_down"][0].astype(dtype)
+                    return out, jnp.zeros((), jnp.float32)
+
+                ff, aux = jax.lax.cond(moe_on, moe_branch, dense_branch, y2)
             if cfg.moe_shared_expert_ff > 0:
                 # Qwen2-MoE shared expert: a dense swiglu MLP every token
                 # runs, added with a per-token sigmoid gate
@@ -723,8 +757,15 @@ class Transformer:
             pat = [cfg.attention_pattern[i % len(cfg.attention_pattern)] == "local"
                    for i in range(L)]
             local_flags = jnp.asarray(pat)
+        # Megatron --expert-interval: per-layer MoE/dense flags (cycled)
+        mixed_moe = bool(cfg.n_experts > 0 and cfg.moe_layer_pattern
+                         and not all(cfg.moe_layer_pattern))
+        moe_flags = None
+        if mixed_moe:
+            mp = cfg.moe_layer_pattern
+            moe_flags = jnp.asarray([bool(mp[i % len(mp)]) for i in range(L)])
 
-        if ltd_mask is None and layer_keep is None:
+        if ltd_mask is None and layer_keep is None and not mixed_moe:
             if use_local:
                 def layer_fn(h, xs):
                     lw, loc = xs
@@ -750,11 +791,14 @@ class Transformer:
                        else jnp.asarray(layer_keep))
         if local_flags is None:
             local_flags = jnp.zeros((L,), bool)
+        if moe_flags is None:
+            moe_flags = jnp.ones((L,), bool)
 
         def layer_fn(h, xs):
-            lw, act, keep_l, loc = xs
+            lw, act, keep_l, loc, moe_l = xs
             out, aux = self.layer_apply(lw, h, rope,
-                                        local=(loc if use_local else None))
+                                        local=(loc if use_local else None),
+                                        moe_on=(moe_l if mixed_moe else None))
             if ltd_mask is not None:
                 keep = jnp.logical_or(~act, ltd_mask)[..., None]   # [B,T,1]
                 out = jnp.where(keep, out, h)
@@ -764,7 +808,8 @@ class Transformer:
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg.remat_policy))
         x, aux_losses = jax.lax.scan(
-            layer_fn, x, (stacked_layers, active, keep_layers, local_flags))
+            layer_fn, x, (stacked_layers, active, keep_layers, local_flags,
+                          moe_flags))
         return x, jnp.sum(aux_losses)
 
     def _unembed(self, params, dtype):

@@ -23,8 +23,13 @@ from typing import Callable, NamedTuple, Optional
 from .gating import GateOutput, topk_gating
 
 
-def init_expert_mlp(rng, n_experts: int, d_model: int, d_ff: int, activation: str = "swiglu"):
-    """Stacked expert FFN weights: leading dim E (shard over "expert")."""
+def init_expert_mlp(rng, n_experts: int, d_model: int, d_ff: int, activation: str = "swiglu",
+                    bias: bool = False):
+    """Stacked expert FFN weights: leading dim E (shard over "expert").
+
+    ``bias=True`` adds per-expert b_up/b_down (+ b_gate for swiglu) leaves —
+    the classic Megatron/DeepSpeed-MoE expert layout (reference
+    module_inject/containers/megatron_gpt_moe.py imports biased experts)."""
     import jax
     import jax.numpy as jnp
 
@@ -37,30 +42,48 @@ def init_expert_mlp(rng, n_experts: int, d_model: int, d_ff: int, activation: st
     }
     if activation == "swiglu":
         params["w_gate"] = jax.random.normal(k1, (n_experts, d_model, d_ff), jnp.float32) * scale_in
+    if bias:
+        params["b_up"] = jnp.zeros((n_experts, d_ff), jnp.float32)
+        params["b_down"] = jnp.zeros((n_experts, d_model), jnp.float32)
+        if activation == "swiglu":
+            params["b_gate"] = jnp.zeros((n_experts, d_ff), jnp.float32)
     return params
 
 
 def expert_partition_specs(params):
     from jax.sharding import PartitionSpec as P
 
-    return {k: P("expert", None, "tensor") if k in ("w_gate", "w_up") else P("expert", "tensor", None)
-            for k in params}
+    def spec(k):
+        if k in ("w_gate", "w_up"):
+            return P("expert", None, "tensor")
+        if k in ("b_gate", "b_up"):
+            return P("expert", "tensor")
+        if k == "b_down":
+            return P("expert", None)
+        return P("expert", "tensor", None)
+
+    return {k: spec(k) for k in params}
 
 
 def expert_mlp(params, x, activation: str = "swiglu"):
-    """x [E, C', M] -> [E, C', M]: per-expert FFN as one batched einsum."""
+    """x [E, C', M] -> [E, C', M]: per-expert FFN as one batched einsum.
+    Optional per-expert biases (b_gate/b_up/b_down) add as [E, 1, F]
+    broadcasts — the Megatron biased-expert layout."""
     import jax
     import jax.numpy as jnp
 
-    up = jnp.einsum("ecm,emf->ecf", x, params["w_up"].astype(x.dtype))
+    def b(key, t):
+        return t + params[key].astype(t.dtype)[:, None, :] if key in params else t
+
+    up = b("b_up", jnp.einsum("ecm,emf->ecf", x, params["w_up"].astype(x.dtype)))
     if activation == "swiglu":
-        gate = jnp.einsum("ecm,emf->ecf", x, params["w_gate"].astype(x.dtype))
+        gate = b("b_gate", jnp.einsum("ecm,emf->ecf", x, params["w_gate"].astype(x.dtype)))
         h = jax.nn.silu(gate) * up
     else:
         from ..models.transformer import activation_fn
 
         h = activation_fn(activation)(up)
-    return jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype))
+    return b("b_down", jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype)))
 
 
 def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
@@ -87,15 +110,23 @@ def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
     from ..ops.grouped_gemm import grouped_matmul
 
     dtype = xs.dtype
-    up = grouped_matmul(xsort, params["w_up"].astype(dtype), group_sizes)
+    e_sorted = jnp.take(flat_e, order)                   # [S*k] expert per row
+
+    def b(key, t):
+        # grouped-GEMM bias epilogue: gather each row's expert bias
+        if key not in params:
+            return t
+        return t + jnp.take(params[key].astype(dtype), e_sorted, axis=0)
+
+    up = b("b_up", grouped_matmul(xsort, params["w_up"].astype(dtype), group_sizes))
     if activation == "swiglu":
-        gate = grouped_matmul(xsort, params["w_gate"].astype(dtype), group_sizes)
+        gate = b("b_gate", grouped_matmul(xsort, params["w_gate"].astype(dtype), group_sizes))
         h = jax.nn.silu(gate) * up
     else:
         from ..models.transformer import activation_fn
 
         h = activation_fn(activation)(up)
-    out_sorted = grouped_matmul(h, params["w_down"].astype(dtype), group_sizes)
+    out_sorted = b("b_down", grouped_matmul(h, params["w_down"].astype(dtype), group_sizes))
     out_flat = jnp.zeros_like(out_sorted).at[order].set(out_sorted)   # unsort
     return (out_flat.reshape(S, k, M) * topk_w[..., None].astype(dtype)).sum(axis=1)
 
