@@ -128,7 +128,9 @@ def initialize(
             pass
         elif model is not None and hasattr(model, "stack_apply"):
             n_micro = cfg.pipeline.micro_batches or cfg.gradient_accumulation_steps
-            model = PipelinedModel(model, n_stages=topology.axis_sizes["pipe"], micro_batches=n_micro)
+            model = PipelinedModel(model, n_stages=topology.axis_sizes["pipe"],
+                                   micro_batches=n_micro,
+                                   partition_method=cfg.pipeline.partition_method)
             # Microbatching moves inside the pipeline; the engine sees one
             # macro batch per step. Keep train = micro * gas * dp consistent.
             cfg.pipeline.micro_batches = n_micro

@@ -583,7 +583,19 @@ class Transformer:
                         out = out + expert_params["b_down"][0].astype(dtype)
                     return out, jnp.zeros((), jnp.float32)
 
-                ff, aux = jax.lax.cond(moe_on, moe_branch, dense_branch, y2)
+                from ..parallel.mesh import inside_manual_region
+
+                if inside_manual_region():
+                    # under a partial-manual region (pipeline stage) a cond
+                    # around the MoE dispatch CHECK-fails XLA's partitioner;
+                    # compute both branches and select — the dense branch
+                    # is one FFN, small next to the expert compute
+                    ff_m, aux_m = moe_branch(y2)
+                    ff_d, aux_d = dense_branch(y2)
+                    ff = jnp.where(moe_on, ff_m, ff_d)
+                    aux = jnp.where(moe_on, aux_m, aux_d)
+                else:
+                    ff, aux = jax.lax.cond(moe_on, moe_branch, dense_branch, y2)
             if cfg.moe_shared_expert_ff > 0:
                 # Qwen2-MoE shared expert: a dense swiglu MLP every token
                 # runs, added with a per-token sigmoid gate
@@ -724,7 +736,8 @@ class Transformer:
                             out_specs=spec, axis_names=manual)(q, k, v)
         return out[:, :T0] if pad else out
 
-    def stack_apply(self, stacked_layers, x, rope, ltd_mask=None, layer_keep=None):
+    def stack_apply(self, stacked_layers, x, rope, ltd_mask=None,
+                    layer_keep=None, layer_ids=None):
         """Scan the (sub)stack of layers over x. Returns (x, summed aux).
 
         ``ltd_mask`` [B, T] bool (True = keep): random-LTD token freezing
@@ -732,7 +745,12 @@ class Transformer:
         ``layer_keep`` [L] bool (True = run): progressive layer drop
         (reference runtime/progressive_layer_drop.py) — a dropped layer is
         an identity skip (its aux loss is zeroed too). Both masks are
-        traced, so the anneal never recompiles."""
+        traced, so the anneal never recompiles.
+        ``layer_ids`` [L_local] int32 (pipeline stages): each scanned row's
+        GLOBAL layer index — per-layer pattern flags (attention_pattern,
+        moe_layer_pattern, random-LTD ranges) must be derived from global
+        positions, not the stage-local row number; pad rows carry
+        id == n_layers and map to all-off flags."""
         import jax
         import jax.numpy as jnp
 
@@ -751,19 +769,27 @@ class Transformer:
                 x, NamedSharding(constraint_mesh(mesh),
                                  P(("data", "fsdp"), "seq", None)))
         L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+        LG = cfg.n_layers
+
+        def per_layer_flags(fn):
+            """[L_local] bool from a global-layer predicate; pad id -> False."""
+            glob = jnp.asarray([bool(fn(i)) for i in range(LG)] + [False])
+            if layer_ids is None:
+                return glob[:L]
+            return jnp.take(glob, jnp.asarray(layer_ids, jnp.int32))
+
         use_local = bool(cfg.local_attention_window and cfg.attention_pattern)
         local_flags = None
         if use_local:
-            pat = [cfg.attention_pattern[i % len(cfg.attention_pattern)] == "local"
-                   for i in range(L)]
-            local_flags = jnp.asarray(pat)
+            ap = cfg.attention_pattern
+            local_flags = per_layer_flags(lambda i: ap[i % len(ap)] == "local")
         # Megatron --expert-interval: per-layer MoE/dense flags (cycled)
         mixed_moe = bool(cfg.n_experts > 0 and cfg.moe_layer_pattern
                          and not all(cfg.moe_layer_pattern))
         moe_flags = None
         if mixed_moe:
             mp = cfg.moe_layer_pattern
-            moe_flags = jnp.asarray([bool(mp[i % len(mp)]) for i in range(L)])
+            moe_flags = per_layer_flags(lambda i: mp[i % len(mp)])
 
         if ltd_mask is None and layer_keep is None and not mixed_moe:
             if use_local:
@@ -783,8 +809,9 @@ class Transformer:
             return x, jnp.sum(aux_losses)
 
         if ltd_mask is not None:
-            end = cfg.random_ltd_end_layer if cfg.random_ltd_end_layer >= 0 else L - 1
-            active = (jnp.arange(L) >= cfg.random_ltd_start_layer) & (jnp.arange(L) < end)
+            end = cfg.random_ltd_end_layer if cfg.random_ltd_end_layer >= 0 else LG - 1
+            active = per_layer_flags(
+                lambda i: cfg.random_ltd_start_layer <= i < end)
         else:
             active = jnp.zeros((L,), bool)
         keep_layers = (jnp.ones((L,), bool) if layer_keep is None

@@ -210,6 +210,19 @@ def reset_topology() -> None:
 
 # Reference-compatible getter names (utils/groups.py:57-749).
 
+def inside_manual_region() -> bool:
+    """True when tracing inside a (partial-)manual shard_map region."""
+    import jax
+
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is None or not getattr(ctx, "axis_names", ()):
+        return False
+    try:
+        return any(t == jax.sharding.AxisType.Manual for t in ctx.axis_types)
+    except Exception:
+        return False
+
+
 def constraint_mesh(default=None):
     """Mesh to use for in-trace sharding constraints / nested shard_maps.
 

@@ -46,6 +46,58 @@ from ..config.config_utils import ConfigError
 from . import comm
 
 
+def partition_balanced(weights, n_parts: int):
+    """Contiguous partition of ``weights`` into ``n_parts`` minimizing the
+    max part weight (reference ``ds_utils.partition_balanced`` used by
+    PipelineModule partition_method="parameters"/"type:regex",
+    runtime/pipe/module.py:378-398). Returns boundaries [n_parts + 1]."""
+    L = len(weights)
+    if n_parts <= 0:
+        raise ConfigError(f"n_parts must be positive, got {n_parts}")
+    prefix = [0]
+    for w in weights:
+        prefix.append(prefix[-1] + w)
+
+    def parts_needed(cap):
+        # greedy: how many contiguous parts with sum <= cap (every single
+        # weight must fit — cap >= max(weights) is ensured by the caller)
+        parts, cur = 1, 0
+        for w in weights:
+            if cur + w > cap:
+                parts += 1
+                cur = w
+            else:
+                cur += w
+        return parts
+
+    lo, hi = max(weights, default=0), prefix[-1]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if parts_needed(mid) <= n_parts:
+            hi = mid
+        else:
+            lo = mid + 1
+    cap = lo
+    bounds = [0]
+    cur = 0
+    for i, w in enumerate(weights):
+        # keep enough layers in reserve that every later stage is nonempty
+        remaining_stages = n_parts - len(bounds)
+        if ((cur + w > cap or L - i <= remaining_stages)
+                and cur > 0 and len(bounds) < n_parts):
+            bounds.append(i)
+            cur = 0
+        cur += w
+    while len(bounds) < n_parts:
+        bounds.append(L)
+    bounds.append(L)
+    # zero-weight runs (sparse type:regex) can leave trailing stages empty;
+    # repair to strictly increasing boundaries (requires L >= n_parts)
+    for j in range(1, n_parts):
+        bounds[j] = min(max(bounds[j], bounds[j - 1] + 1), L - (n_parts - j))
+    return bounds
+
+
 def pipeline_stage_count(topology=None) -> int:
     from .mesh import get_topology
 
@@ -118,18 +170,69 @@ class PipelinedModel:
     """
 
     def __init__(self, model, n_stages: Optional[int] = None, micro_batches: int = 1,
-                 axis_name: str = "pipe"):
+                 axis_name: str = "pipe", partition_method: str = "uniform"):
         self.model = model
         self.config = model.config
         self.axis_name = axis_name
         self.micro_batches = int(micro_batches)
         self._n_stages = n_stages
-        if self.config.n_layers % self.n_stages:
-            raise ConfigError(
-                f"n_layers {self.config.n_layers} not divisible by pipeline stages {self.n_stages} "
-                "(reference partition_method='uniform', runtime/pipe/module.py:393)")
+        self.partition_method = partition_method
+        self._bounds = self._layer_bounds()
+        counts = [self._bounds[s + 1] - self._bounds[s]
+                  for s in range(self.n_stages)]
+        self.stage_size = max(counts)
+        # even layout: contiguous equal stages — the stacked dim shards
+        # straight over "pipe". Uneven (L % S != 0 or weighted methods):
+        # stages pad to the max count with identity-masked rows.
+        self._even = (len(set(counts)) == 1
+                      and self._bounds == [s * counts[0]
+                                           for s in range(self.n_stages + 1)])
         if self.micro_batches < 1:
             raise ConfigError(f"micro_batches must be >= 1, got {self.micro_batches}")
+
+    def _layer_bounds(self):
+        """Per-stage layer boundaries (reference PipelineModule
+        _partition_layers, runtime/pipe/module.py:378-398):
+        "uniform" — balanced layer counts; "parameters" — balanced per-layer
+        parameter counts; "type:regex" — balance the count of layers whose
+        type name matches the regex (this zoo's scanned layers are typed
+        "moe" or "dense" per moe_layer_pattern)."""
+        import re
+
+        L, S = self.config.n_layers, self.n_stages
+        if S > L:
+            raise ConfigError(
+                f"pipeline stages {S} > n_layers {L}: at least one stage "
+                "would be empty (reference partition_balanced rejects this "
+                "too — reduce mesh.pipe)")
+        method = (self.partition_method or "uniform").lower()
+        if method in ("uniform", "parameters"):
+            if method == "parameters":
+                # stacked scan layers are homogeneous (same shapes), so
+                # per-layer param counts are equal and this reduces to
+                # balanced counts — computed anyway for fidelity
+                cfg = self.config
+                per_layer = (4 * cfg.d_model * cfg.d_model
+                             + 3 * cfg.d_model * cfg.ff_dim)
+                weights = [per_layer] * L
+            else:
+                weights = [1] * L
+            return partition_balanced(weights, S)
+        if method.startswith("type:"):
+            pattern = method[len("type:"):]
+            mp = self.config.moe_layer_pattern
+            types = [("moe" if (self.config.n_experts > 0
+                                and (not mp or mp[i % len(mp)]))
+                      else "dense") for i in range(L)]
+            weights = [1 if re.search(pattern, t) else 0 for t in types]
+            if not any(weights):
+                raise ConfigError(
+                    f"partition_method {self.partition_method!r} matches no "
+                    f"layers (types present: {sorted(set(types))})")
+            return partition_balanced(weights, S)
+        raise ConfigError(
+            f"Unknown pipeline partition_method {self.partition_method!r}; "
+            "use 'uniform', 'parameters', or 'type:regex'")
 
     @property
     def n_stages(self) -> int:
@@ -144,11 +247,17 @@ class PipelinedModel:
         return self.model.apply(params, input_ids)
 
     def partition_specs(self, params):
-        """Model specs with the stacked-layer leading dim put on "pipe"."""
+        """Model specs with the stacked-layer leading dim put on "pipe".
+
+        Uneven partitions (padded stages) keep the RAW [L] stacks off the
+        pipe axis — L doesn't divide S — and the loss reshards the padded
+        [S * stage_size] gather instead; ZeRO still claims a free dim."""
         import jax
         from jax.sharding import PartitionSpec as P
 
         base = self.model.partition_specs(params)
+        if not self._even:
+            return base
 
         def pin_stage_dim(path, spec):
             keys = [getattr(e, "key", getattr(e, "name", None)) for e in path]
@@ -195,6 +304,36 @@ class PipelinedModel:
 
         layer_params = params["layers"]
         other_params = {k: v for k, v in params.items() if k != "layers"}
+        keep_flags = ()
+        # each stage's rows carry their GLOBAL layer index so per-layer
+        # pattern flags (attention_pattern / moe_layer_pattern / random-LTD)
+        # resolve correctly inside the stage (stage-local row numbers would
+        # silently pick the wrong flags on stages > 0)
+        layer_ids = jnp.arange(self.config.n_layers, dtype=jnp.int32)
+        if not self._even:
+            # Uneven partition (partition_method="parameters"/"type:regex"
+            # or L % S != 0): gather each stage's rows into a padded
+            # [S * stage_size] stack (pad rows = zeros, masked to identity
+            # by stack_apply's layer_keep), so the manual region still
+            # shards an even dim over "pipe". The gather/scatter pair is
+            # O(params) data movement once per step — noise next to the
+            # stage compute.
+            S_sz = self.stage_size
+            pad_idx, keep = [], []
+            L_total = self.config.n_layers
+            for s in range(S):
+                rows = list(range(self._bounds[s], self._bounds[s + 1]))
+                keep += [True] * len(rows) + [False] * (S_sz - len(rows))
+                pad_idx += rows + [L_total] * (S_sz - len(rows))
+            pad_idx = jnp.asarray(pad_idx, jnp.int32)
+            keep_flags = jnp.asarray(keep)
+            layer_ids = pad_idx     # pad rows: id == n_layers -> flags off
+
+            def pad_stack(a):
+                zero_row = jnp.zeros((1,) + a.shape[1:], a.dtype)
+                return jnp.concatenate([a, zero_row])[pad_idx]
+
+            layer_params = jax.tree_util.tree_map(pad_stack, layer_params)
         layer_specs = jax.tree_util.tree_map(lambda _: P(self.axis_name), layer_params)
 
         # XLA's partial-manual partitioner CHECK-fails when a convert feeds a
@@ -207,7 +346,7 @@ class PipelinedModel:
             lambda v: v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.floating) else v,
             other_params)
 
-        def inner(layer_params, other_params, inputs, labels):
+        def inner(layer_params, keep_flags, layer_ids, other_params, inputs, labels):
             other_params = jax.tree_util.tree_map(
                 lambda v, d: v.astype(d), other_params, other_dtypes)
             # Embed per microbatch (cheap gather; runs on every stage but
@@ -215,8 +354,15 @@ class PipelinedModel:
             # elsewhere, so tied/embed grads stay correct).
             x, rope = model.embed(other_params, inputs)   # [n_micro, mb, T, D]
 
+            # keep_flags (uneven partitions): pad rows are identity skips
+            # via stack_apply's layer_keep masking; the even path passes
+            # () so stack_apply keeps its fast unmasked scan body
+            keep = keep_flags if not isinstance(keep_flags, tuple) else None
+
             def stage_fn(h):
-                return model.stack_apply(layer_params, h, rope)
+                return model.stack_apply(layer_params, h, rope,
+                                         layer_keep=keep,
+                                         layer_ids=layer_ids)
 
             outputs, aux = spmd_pipeline(stage_fn, x, n_stages=S, axis_name=self.axis_name)
 
@@ -261,10 +407,14 @@ class PipelinedModel:
 
         fn = jax.shard_map(
             inner, mesh=mesh,
-            in_specs=(layer_specs, P(), P(), P()),
+            in_specs=(layer_specs,
+                      P() if isinstance(keep_flags, tuple) else P(self.axis_name),
+                      P(self.axis_name), P(), P(), P()),
             out_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name)),
             axis_names={self.axis_name}, check_vma=False)
-        nll_parts, count_parts, aux_parts = fn(layer_params, other_params, inputs, labels)
+        nll_parts, count_parts, aux_parts = fn(layer_params, keep_flags,
+                                               layer_ids, other_params,
+                                               inputs, labels)
         nll_sum, count, aux = nll_parts.sum(), count_parts.sum(), aux_parts.sum()
         ce = nll_sum / jnp.maximum(count, 1.0)
         # aux summed layers×micros; dense model sums layers on the full

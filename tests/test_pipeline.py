@@ -122,3 +122,119 @@ def test_engine_pipeline_matches_dense_engine(devices8):
     l_pipe = float(e_pipe.train_batch(batch))
     assert np.isclose(l_dense, l_pipe, rtol=1e-4), (l_dense, l_pipe)
     reset_topology()
+
+
+def test_partition_balanced_boundaries():
+    """Reference ds_utils.partition_balanced semantics: contiguous parts,
+    minimized max part weight, every stage nonempty."""
+    from shuffle_exchange_tpu.parallel.pipeline import partition_balanced
+
+    assert partition_balanced([1] * 8, 4) == [0, 2, 4, 6, 8]
+    b = partition_balanced([1] * 7, 2)
+    assert b[0] == 0 and b[-1] == 7 and max(b[1] - 0, 7 - b[1]) == 4
+    # one heavy layer: it gets its own stage
+    assert partition_balanced([5, 1, 1, 1], 2) == [0, 1, 4]
+    # zero-weight tail layers ride along with the last matching layer
+    b = partition_balanced([1, 0, 0, 1], 2)
+    assert b[0] == 0 and b[-1] == 4 and 1 <= b[1] <= 3
+
+
+@pytest.mark.slow
+def test_uneven_pipeline_matches_dense(devices8):
+    """VERDICT r4 #9: L % S != 0 pipelines via balanced padded stages
+    (partition_method='parameters') instead of raising — trajectory matches
+    the non-pipelined engine."""
+    model, params, batch = _model_and_batch(layers=5, batch=8, seq=16)
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10**9,
+    }
+    reset_topology()
+    e_dense, *_ = sxt.initialize(model=model, config=dict(cfg), params=params, seed=3)
+    l_dense = [float(e_dense.train_batch(batch)) for _ in range(2)]
+    reset_topology()
+    e_pipe, *_ = sxt.initialize(
+        model=model, params=params, seed=3,
+        config={**cfg, "mesh": {"pipe": 2, "data": -1},
+                "pipeline": {"partition_method": "parameters"}})
+    pm = e_pipe.loss_fn.__self__
+    assert pm._bounds == [0, 3, 5] and pm.stage_size == 3 and not pm._even
+    l_pipe = [float(e_pipe.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(l_dense, l_pipe, rtol=1e-3)
+    reset_topology()
+
+
+def test_type_regex_partition_method(devices8):
+    """partition_method='type:regex' balances the count of matching layers
+    (reference runtime/pipe/module.py:383); unknown methods and no-match
+    regexes raise targeted errors."""
+    from shuffle_exchange_tpu.config.config_utils import ConfigError
+    from shuffle_exchange_tpu.models import tiny_moe
+
+    reset_topology()
+    initialize_topology(MeshConfig(pipe=2, data=-1), force=True)
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        tiny_moe(vocab=64, d=32, layers=4, heads=4, seq=16, experts=2),
+        moe_layer_pattern=(False, True))   # moe on layers 1, 3
+    model = Transformer(cfg)
+    pm = PipelinedModel(model, n_stages=2, micro_batches=2,
+                        partition_method="type:moe")
+    # one moe layer per stage: [0..2], [3]
+    assert pm._bounds[0] == 0 and pm._bounds[-1] == 4
+    counts = [sum(1 for i in range(pm._bounds[s], pm._bounds[s + 1])
+                  if (False, True)[i % 2]) for s in range(2)]
+    assert counts == [1, 1], (pm._bounds, counts)
+    with pytest.raises(ConfigError, match="matches no"):
+        PipelinedModel(model, n_stages=2, micro_batches=2,
+                       partition_method="type:nothing")
+    with pytest.raises(ConfigError, match="partition_method"):
+        PipelinedModel(model, n_stages=2, micro_batches=2,
+                       partition_method="bogus")
+    reset_topology()
+
+
+@pytest.mark.slow
+def test_mixed_moe_pattern_pipeline_flag_alignment(devices8):
+    """Review r5: per-layer pattern flags must resolve from GLOBAL layer
+    indices inside pipeline stages — stage-local row numbers silently pick
+    the wrong MoE/dense branch on stages > 0. Parity vs the non-pipelined
+    engine on an expert-interval model catches any misalignment."""
+    import dataclasses
+
+    import jax
+
+    from shuffle_exchange_tpu.models import tiny_moe
+
+    cfg_m = dataclasses.replace(
+        tiny_moe(vocab=64, d=32, layers=4, heads=4, seq=16, experts=2),
+        moe_layer_pattern=(False, True))   # moe on layers 1, 3
+    model = Transformer(cfg_m)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 64, size=(8, 16)).astype(np.int32)}
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10**9,
+    }
+    reset_topology()
+    e_dense, *_ = sxt.initialize(model=model, config=dict(cfg), params=params, seed=3)
+    l_ref = [float(e_dense.train_batch(batch)) for _ in range(2)]
+    for mesh, method in (({"pipe": 2, "data": -1}, "uniform"),
+                         ({"pipe": 2, "data": -1}, "type:moe")):
+        reset_topology()
+        e_pipe, *_ = sxt.initialize(
+            model=model, params=params, seed=3,
+            config={**cfg, "mesh": mesh,
+                    "pipeline": {"partition_method": method}})
+        l_pipe = [float(e_pipe.train_batch(batch)) for _ in range(2)]
+        np.testing.assert_allclose(l_ref, l_pipe, rtol=1e-3,
+                                   err_msg=f"method={method}")
+    reset_topology()
