@@ -379,6 +379,85 @@ class InferenceEngineV2(InferenceEngine):
 
         return np.stack([self._seqs[uid].last_logits for uid in uids])
 
+    # -- fused multi-token decode --------------------------------------
+
+    def _decode_loop_fn(self, key):
+        fn = self._loop_cache.get(key) if hasattr(self, "_loop_cache") else None
+        if fn is not None:
+            return fn
+        if not hasattr(self, "_loop_cache"):
+            self._loop_cache = {}
+        import jax
+
+        B, n_steps = key
+
+        def impl(params, cache, tok, pos, btables):
+            import jax.numpy as jnp
+
+            def step(carry, _):
+                cache, tok, pos, _ = carry
+                cache, logits = self._paged_decode_impl(params, cache, tok,
+                                                        pos, btables)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (cache, nxt, pos + 1, logits), nxt
+
+            logits0 = jnp.zeros((B, self._mcfg.vocab_size), jnp.float32)
+            (cache, _, _, logits), toks = jax.lax.scan(
+                step, (cache, tok, pos, logits0), None, length=n_steps)
+            return cache, toks.T, logits       # toks [B, n_steps]
+
+        fn = jax.jit(impl, donate_argnums=(1,))
+        self._loop_cache[key] = fn
+        return fn
+
+    def decode_loop(self, uids: Sequence[int], tokens: Sequence[int],
+                    n_steps: int) -> np.ndarray:
+        """Greedy-decode ``n_steps`` tokens for known uids in ONE device
+        program (a ``lax.scan`` over the paged decode step with on-device
+        argmax feedback). The host sees a single dispatch, so per-token
+        latency is the ENGINE's, not the host/tunnel round trip — the
+        serving-latency isolation the per-``put`` API number can't give
+        (each put() is a host RTT). Returns the generated tokens
+        [len(uids), n_steps]; descriptors advance as if put() had run
+        n_steps times.
+
+        The reference's FastGen equivalent is host-looped puts
+        (inference/v2/engine_v2.py:107) — on TPU the fused loop is the
+        shape a serving process should prefer for long generations."""
+        descs = [self._seqs[u] for u in uids]
+        # Admission control BEFORE any mutation (same contract as put():
+        # a rejected call leaves allocator + descriptors untouched). The
+        # length cap matters doubly here — in-jit btable indexing clamps
+        # instead of erroring, so an overrun would silently write another
+        # sequence's KV blocks.
+        bs = self.cache.block_size
+        need = 0
+        for d in descs:
+            total = d.seen_tokens + n_steps
+            if total > self.config.max_seq_len:
+                raise RuntimeError(
+                    f"decode_loop would overrun max_seq_len: uid {d.uid} at "
+                    f"{d.seen_tokens} + {n_steps} > {self.config.max_seq_len}")
+            need += max(0, blocks_needed(total, bs) - len(d.blocks))
+        if need > self.allocator.free_blocks:
+            raise RuntimeError(
+                f"cannot schedule decode_loop: needs {need} KV blocks, "
+                f"{self.allocator.free_blocks} free")
+        for d in descs:
+            self._ensure_blocks(d, d.seen_tokens + n_steps)
+        btables = np.stack([self._table(d) for d in descs]).astype(np.int32)
+        pos = np.asarray([d.seen_tokens for d in descs], np.int32)
+        tok0 = np.asarray(tokens, np.int32)
+        fn = self._decode_loop_fn((len(uids), int(n_steps)))
+        self.cache, toks, last_logits = fn(self.params, self.cache, tok0,
+                                           pos, btables)
+        self.dispatch_count += 1
+        last_logits = np.asarray(last_logits)
+        for i, d in enumerate(descs):
+            d.seen_tokens += n_steps
+            d.last_logits = last_logits[i]
+        return np.asarray(toks)
+
     def flush(self, uids: Sequence[int]) -> None:
         """Free all state for finished sequences (engine_v2.py:242)."""
         for uid in uids:

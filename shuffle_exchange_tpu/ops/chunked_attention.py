@@ -20,19 +20,25 @@ from .flash_attention import _repeat_kv
 
 
 def online_softmax_block(q32, k_blk, v_blk, acc, m_run, l_run,
-                         q_pos0, kv_pos0, causal: bool):
+                         q_pos0, kv_pos0, causal: bool,
+                         logits_bias_fn=None):
     """One online-softmax attention block — the FPDT accumulation step,
-    shared by :func:`chunked_attention` and the host-offload driver
-    (ops/fpdt_offload.py).
+    shared by :func:`chunked_attention`, the host-offload driver
+    (ops/fpdt_offload.py), and evoformer attention (ops/evoformer_attn.py).
 
-    q32 [B,cq,H,D] PRE-SCALED; k/v [B,ck,H,D]; carries acc [B,H,cq,D],
-    m/l [B,H,cq]; q_pos0/kv_pos0 are the chunks' absolute start positions
-    (traced scalars fine). Returns the updated (acc, m, l).
+    q32 [*,cq,H,D] PRE-SCALED (any leading dims); k/v [*,ck,H,D]; carries
+    acc [*,H,cq,D], m/l [*,H,cq]; q_pos0/kv_pos0 are the chunks' absolute
+    start positions (traced scalars fine). ``logits_bias_fn`` adds
+    arbitrary additive biases to the [*,H,cq,ck] logits tile before the
+    mask. Returns the updated (acc, m, l).
     """
     import jax.numpy as jnp
 
-    cq, ck = q32.shape[1], k_blk.shape[1]
-    logits = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
+    cq, ck = q32.shape[-3], k_blk.shape[-3]
+    logits = jnp.einsum("...thd,...shd->...hts", q32,
+                        k_blk.astype(jnp.float32))
+    if logits_bias_fn is not None:
+        logits = logits_bias_fn(logits)
     if causal:
         q_pos = q_pos0 + jnp.arange(cq)
         kv_pos = kv_pos0 + jnp.arange(ck)
@@ -45,7 +51,7 @@ def online_softmax_block(q32, k_blk, v_blk, acc, m_run, l_run,
     corr = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
     l_new = l_run * corr + p.sum(-1)
     acc_new = acc * corr[..., None] + jnp.einsum(
-        "bhts,bshd->bhtd", p, v_blk.astype(jnp.float32))
+        "...hts,...shd->...htd", p, v_blk.astype(jnp.float32))
     return acc_new, m_new, l_new
 
 

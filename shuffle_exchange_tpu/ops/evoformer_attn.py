@@ -1,0 +1,124 @@
+"""Evoformer (DS4Sci) attention — AlphaFold-style biased attention.
+
+Capability parity with the reference's ``DS4Sci_EvoformerAttention``
+(``ops/deepspeed4science/evoformer_attn.py:88``, backed by a CUTLASS
+kernel): attention over MSA/pair activations with up to two additive
+biases —
+
+- ``bias1`` [*, N, 1, 1, L]: per-row key mask bias (broadcast over heads
+  and queries);
+- ``bias2`` [B, 1, H, L, L]: the pair-representation bias (broadcast over
+  the MSA-row dim N).
+
+TPU-native shape: the CUTLASS kernel's value is never materializing the
+[*, H, L, L] softmax scores; here that is a CHECKPOINTED chunked
+online-softmax over key blocks (the same machinery as the ring-attention
+hop), so peak memory is one [*, H, L, chunk] tile and the backward
+recomputes tiles — XLA fuses the bias adds into the logits matmul. Note
+``bias2`` itself is already an L×L-per-head tensor supplied by the caller,
+so the scores tile is the only quadratic the kernel avoids — this matches
+the reference's memory story exactly. Head dim is unrestricted (the CUDA
+kernel caps D at 64, ``evoformer_attn.py:34``); seq len has no minimum
+(the CUDA kernel requires L > 16, ``:15``).
+"""
+
+from __future__ import annotations
+
+
+def _chunk_size(L: int, requested: int) -> int:
+    c = min(L, max(1, requested))
+    while L % c:
+        c -= 1
+    return c
+
+
+def evoformer_attention(q, k, v, bias1=None, bias2=None, chunk: int = 512):
+    """q, k, v: [*, L, H, D] (same convention as the reference — attention
+    runs over the L dim, per head H). ``bias1``/``bias2``: additive bias
+    tensors (see module docstring). Returns [*, L, H, D].
+
+    Differentiable in q/k/v AND the biases (the reference computes
+    dB1/dB2 in its backward, ``evoformer_attn.py:33``)."""
+    import jax
+    import jax.numpy as jnp
+
+    *lead, L, H, D = q.shape
+    if bias1 is not None and tuple(bias1.shape[-3:]) != (1, 1, L):
+        raise ValueError(
+            f"bias1 shape {bias1.shape} is incorrect: trailing dims must be "
+            f"(1, 1, L)=(1, 1, {L}) (reference bias_1_shape)")
+    if bias2 is not None and not (
+            bias2.shape[-1] == L and bias2.shape[-2] == L
+            and bias2.shape[-3] in (1, H)):
+        raise ValueError(
+            f"bias2 shape {bias2.shape} is incorrect: trailing dims must be "
+            f"(H|1, L, L) (reference bias_2_shape)")
+
+    scale = D ** -0.5
+    ck = _chunk_size(L, chunk)
+    n_chunks = L // ck
+
+    from .chunked_attention import online_softmax_block
+
+    def attn(q, k, v, bias1, bias2):
+        q32 = q.astype(jnp.float32) * scale
+
+        def chunk_body(carry, ci):
+            acc, m_run, l_run = carry
+            ks = jax.lax.dynamic_slice_in_dim(k, ci * ck, ck, axis=-3)
+            vs = jax.lax.dynamic_slice_in_dim(v, ci * ck, ck, axis=-3)
+
+            def bias_fn(s):
+                # s [*, H, L, ck]
+                if bias1 is not None:
+                    s = s + jax.lax.dynamic_slice_in_dim(
+                        bias1, ci * ck, ck, axis=-1).astype(jnp.float32)
+                if bias2 is not None:
+                    s = s + jax.lax.dynamic_slice_in_dim(
+                        bias2, ci * ck, ck, axis=-1).astype(jnp.float32)
+                return s
+
+            carry = online_softmax_block(q32, ks, vs, acc, m_run, l_run,
+                                         0, 0, False, logits_bias_fn=bias_fn)
+            return carry, None
+
+        acc0 = jnp.zeros((*lead, H, L, D), jnp.float32)
+        m0 = jnp.full((*lead, H, L), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((*lead, H, L), jnp.float32)
+        if n_chunks == 1:
+            (acc, m, l), _ = chunk_body((acc0, m0, l0),
+                                        jnp.asarray(0, jnp.int32))
+        else:
+            (acc, m, l), _ = jax.lax.scan(
+                chunk_body, (acc0, m0, l0),
+                jnp.arange(n_chunks, dtype=jnp.int32))
+        out = acc / jnp.maximum(l[..., None], 1e-30)
+        # [*, H, L, D] -> [*, L, H, D]
+        return jnp.swapaxes(out, -3, -2).astype(q.dtype)
+
+    # Checkpoint: backward recomputes score tiles chunk by chunk instead of
+    # saving them — residuals stay O(L·D) per call (+ the caller's biases).
+    attn = jax.checkpoint(attn)
+    return attn(q, k, v, bias1, bias2)
+
+
+def ds4sci_evoformer_attention(Q, K, V, biases):
+    """Drop-in surface of the reference ``DS4Sci_EvoformerAttention``
+    (``evoformer_attn.py:88``): positional bias list (bias1, then bias2),
+    strict bias-shape checks against Q's shape."""
+    if len(biases) > 2:
+        raise ValueError("at most two biases (reference "
+                         "DS4Sci_EvoformerAttention:89)")
+    biases = (list(biases) + [None, None])[:2]
+    *lead, L, H, D = Q.shape
+    if biases[0] is not None:
+        want = (*Q.shape[:-3], 1, 1, L)
+        if tuple(biases[0].shape) != want:
+            raise ValueError(f"bias1 shape is incorrect: {biases[0].shape} "
+                             f"!= {want}")
+    if biases[1] is not None:
+        want = (Q.shape[0], 1, H, L, L)
+        if tuple(biases[1].shape) != want:
+            raise ValueError(f"bias2 shape is incorrect: {biases[1].shape} "
+                             f"!= {want}")
+    return evoformer_attention(Q, K, V, bias1=biases[0], bias2=biases[1])
