@@ -645,7 +645,11 @@ class Engine:
         # any topology). "pipe" and "seq" remain excluded: their own inner
         # manual regions (parallel/pipeline.py:246, models/transformer.py:678)
         # spell out data/fsdp in specs/constraints, which a surrounding
-        # manual-over-(data,fsdp) region forbids.
+        # manual-over-(data,fsdp) region forbids. (Round 5 attempt: nesting
+        # the pipe region inside the wire region trips Shardy — the
+        # check_vma=False legacy lowering binds ALL mesh axes, and the
+        # check_vma=True path runtime-aborts in the pipeline transpose —
+        # so the emulation fallback stands for those meshes.)
         _wire_compat = all(axis_sizes.get(ax, 1) == 1 for ax in ("pipe", "seq"))
         qg_real = bool(qg and not ensemble and self.zero_stage <= 2 and _wire_compat)
         # Stage-3 real wire (round 3, VERDICT r2 #5): a manual shard_map
