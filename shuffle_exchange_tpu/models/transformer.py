@@ -646,15 +646,7 @@ class Transformer:
         replicated path (per-head slopes don't survive the head scatter)."""
         cfg = self.config
         sp, mesh = self._sp_mesh()
-        if sp > 1 and alibi is not None:
-            from ..utils.logging import warning_once
-
-            warning_once(
-                "mesh seq > 1 with an ALiBi model: per-head slopes do not "
-                "survive the Ulysses head scatter, so attention stays "
-                "replicated — the seq axis adds layout cost without "
-                "sequence-parallel benefit for this model")
-        if sp > 1 and alibi is None:
+        if sp > 1:
             # The shard_map's batch spec needs the global batch divisible by
             # the data x fsdp extent; callers outside the training layout
             # (e.g. a 1-prompt inference forward while a seq mesh is live)
@@ -667,7 +659,28 @@ class Transformer:
                     f"sequence-parallel attention skipped: batch {q.shape[0]} "
                     f"not divisible by data*fsdp={dp} (replicated fallback)")
                 sp = 1
-        if sp <= 1 or alibi is not None:
+        H_all, KV_all = q.shape[2], k.shape[2]
+        # ALiBi composes with SP (round 5): Ulysses scatters WHOLE heads, so
+        # each device's head block takes its own slope slice; the ring path
+        # adds the bias with global kv positions. Falls back to replicated
+        # attention when head counts don't split evenly (the uneven-head pad
+        # path would misalign slope indices), for bidirectional ALiBi, or
+        # with a live tensor axis (the slope slice would also need the
+        # tensor-rank head offset — not wired; replicated attention under
+        # TP still shards heads and slopes consistently via auto sharding).
+        tp_live = int(mesh.shape.get("tensor", 1)) > 1 if sp > 1 else False
+        alibi_sp_ok = (alibi is not None and sp > 1 and cfg.causal
+                       and not tp_live
+                       and (cfg.sp_attention == "ring"
+                            or (H_all % sp == 0 and KV_all % sp == 0)))
+        if sp > 1 and alibi is not None and not alibi_sp_ok:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "mesh seq > 1 with an ALiBi model: this shape can't ride "
+                "the SP paths (uneven heads under Ulysses, a live tensor "
+                "axis, or bidirectional) — attention stays replicated")
+        if sp <= 1 or (alibi is not None and not alibi_sp_ok):
             return causal_attention(q, k, v, attention_impl=cfg.attention_impl,
                                     alibi=alibi, causal=cfg.causal)
         import functools as ft
@@ -707,15 +720,29 @@ class Transformer:
                 "tensor axis inside the attention region (slower, correct)")
             head_ax = None
         spec = P(("data", "fsdp"), "seq", head_ax, None)
+        slopes_all = (jnp.asarray(alibi, jnp.float32)
+                      if alibi is not None else None)
         if cfg.sp_attention == "ring":
             from ..parallel.sequence import ring_attention
 
             sp_fn = ft.partial(ring_attention, axis_name="seq",
-                               causal=cfg.causal)
+                               causal=cfg.causal, alibi_slopes=slopes_all)
         elif cfg.sp_attention == "ulysses":
-            local = ft.partial(causal_attention,
-                               attention_impl=cfg.attention_impl,
-                               causal=cfg.causal)
+            if slopes_all is None:
+                local = ft.partial(causal_attention,
+                                   attention_impl=cfg.attention_impl,
+                                   causal=cfg.causal)
+            else:
+                def local(q, k, v):
+                    # after the seq->head a2a, device d owns the contiguous
+                    # head block [d*Hc, (d+1)*Hc) — its slope slice
+                    Hc = q.shape[2]
+                    idx = jax.lax.axis_index("seq")
+                    sl = jax.lax.dynamic_slice_in_dim(
+                        slopes_all, idx * Hc, Hc)
+                    return causal_attention(
+                        q, k, v, attention_impl=cfg.attention_impl,
+                        alibi=sl, causal=cfg.causal)
             sp_fn = ft.partial(ulysses_attention, axis_name="seq",
                                attn_fn=local, causal=cfg.causal)
         else:

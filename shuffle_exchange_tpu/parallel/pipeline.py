@@ -298,11 +298,8 @@ class PipelinedModel:
         # OUT HERE by XLA (one gather per stage-local stack — the PP analog
         # of the per-stage ZeRO gather), and never reaches the partial-manual
         # shard_map, whose partitioner mishandles such subgroup collectives.
-        from .mesh import constraint_mesh
-
-        cmesh = constraint_mesh(mesh)
         model_shardings = jax.tree_util.tree_map(
-            lambda s: jax.sharding.NamedSharding(cmesh, s), self.partition_specs(params))
+            lambda s: jax.sharding.NamedSharding(mesh, s), self.partition_specs(params))
         params = jax.tree_util.tree_map(jax.lax.with_sharding_constraint, params, model_shardings)
 
         layer_params = params["layers"]
@@ -409,7 +406,7 @@ class PipelinedModel:
             return (nll_sum.reshape(1), count.reshape(1), aux.reshape(1))
 
         fn = jax.shard_map(
-            inner, mesh=cmesh,
+            inner, mesh=mesh,
             in_specs=(layer_specs,
                       P() if isinstance(keep_flags, tuple) else P(self.axis_name),
                       P(self.axis_name), P(), P(), P()),

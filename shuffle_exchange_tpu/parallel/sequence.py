@@ -157,7 +157,7 @@ def _ring_hop_kernel_ok(q, interpret: bool) -> bool:
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
                    kv_chunk: int = 1024, use_kernel: str = "auto",
-                   interpret: bool = False):
+                   interpret: bool = False, alibi_slopes=None):
     """Blockwise full-sequence attention with rotating KV — flash-grade.
 
     q/k/v: [B, T_local, H|Hkv, D] — this device's sequence shard (layout
@@ -186,8 +186,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     sp = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
-    kernel_on = (use_kernel is True or
-                 (use_kernel == "auto" and _ring_hop_kernel_ok(q, interpret)))
+    if alibi_slopes is not None and use_kernel is True:
+        raise ValueError("ring hop kernel has no per-hop bias offset; "
+                         "ALiBi rings use the jnp chunked path")
+    kernel_on = (alibi_slopes is None and
+                 (use_kernel is True or
+                  (use_kernel == "auto" and _ring_hop_kernel_ok(q, interpret))))
     if use_kernel is True and not _ring_hop_kernel_ok(q, interpret):
         from ..ops.dispatch import pallas_enabled
 
@@ -227,8 +231,13 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
                 ks = _repeat_kv(ks, n_rep)
                 vs = _repeat_kv(vs, n_rep)
             logits = jnp.einsum("bthd,bshd->bhts", q32, ks.astype(jnp.float32))
+            kv_pos = src_idx * Tq + chunk_idx * ck + jnp.arange(ck)
+            if alibi_slopes is not None:
+                # BLOOM ALiBi under CP: absolute key positions are global
+                # in the ring, so the bias is exact across hops
+                logits = logits + (alibi_slopes[None, :, None, None]
+                                   * kv_pos.astype(jnp.float32)[None, None, None, :])
             if causal:
-                kv_pos = src_idx * Tq + chunk_idx * ck + jnp.arange(ck)
                 mask = q_pos[:, None] >= kv_pos[None, :]
                 logits = jnp.where(mask[None, None], logits, -jnp.inf)
             m_blk = jnp.max(logits, axis=-1)                      # [B,H,Tq]

@@ -204,6 +204,34 @@ def test_engine_seq_times_pipe_matches_dp(devices8):
     np.testing.assert_allclose(sp_pp, dp, rtol=5e-3)
 
 
+@pytest.mark.parametrize("flavor", ["ulysses", "ring"])
+def test_alibi_rides_sequence_parallel(devices8, flavor):
+    """Round 5: ALiBi composes with SP — Ulysses slices the slope vector
+    per head shard, the ring adds the bias at global kv positions — so
+    BLOOM-style models train sequence-parallel and track plain DP."""
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    def run(mesh, bs=16):
+        reset_topology()
+        model = Transformer(tiny(vocab=64, d=64, layers=2, heads=4, seq=64,
+                                 position="alibi", sp_attention=flavor))
+        engine, *_ = sxt.initialize(model=model, config={
+            "train_batch_size": bs,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "mesh": mesh, "steps_per_print": 10**9})
+        b = {"input_ids": np.random.default_rng(0).integers(
+            0, 64, size=(bs, 64)).astype(np.int32)}
+        return [float(engine.train_batch(b)) for _ in range(3)]
+
+    sp = run({"seq": 2, "data": -1})
+    dp = run({"data": -1})
+    np.testing.assert_allclose(sp, dp, rtol=5e-3)
+
+
 def test_tiled_mlp_identity():
     import jax.numpy as jnp
 
