@@ -154,3 +154,40 @@ def test_checkpoint_reshard_from_sequence_parallel(tmp_path, devices8):
     reset_topology()
     np.testing.assert_allclose(float(e_dp.eval_batch(batch)), loss_before,
                                rtol=1e-4)
+
+
+def test_checkpoint_reshard_from_uneven_pipeline(tmp_path, devices8):
+    """Round 5: uneven pipeline partitions keep the RAW [L] stacks in the
+    checkpoint (the padded per-stage layout is loss-internal), so a
+    5-layer pipe=2 'parameters'-balanced run resumes on a plain DP mesh
+    bit-exactly."""
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    mcfg = tiny(vocab=128, d=64, layers=5, heads=4, seq=64,
+                activation="swiglu", norm="rmsnorm", position="rope")
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(8, 64)).astype(np.int32)}
+    base = {"train_batch_size": 8, "steps_per_print": 10**9,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+
+    reset_topology()
+    cfg = dict(base)
+    cfg["mesh"] = {"pipe": 2, "data": -1}
+    cfg["pipeline"] = {"partition_method": "parameters", "micro_batches": 2}
+    cfg["zero_optimization"] = {"stage": 1}
+    e_pp, *_ = sxt.initialize(model=Transformer(mcfg), config=cfg, seed=0)
+    assert not e_pp.loss_fn.__self__._even
+    for _ in range(2):
+        e_pp.train_batch(batch)
+    loss_before = float(e_pp.eval_batch(batch))
+    e_pp.save_checkpoint(str(tmp_path / "ppck"))
+
+    reset_topology()
+    cfg2 = dict(base)
+    cfg2["zero_optimization"] = {"stage": 2}
+    e_dp, *_ = sxt.initialize(model=Transformer(mcfg), config=cfg2, seed=0)
+    e_dp.load_checkpoint(str(tmp_path / "ppck"))
+    reset_topology()
+    np.testing.assert_allclose(float(e_dp.eval_batch(batch)), loss_before,
+                               rtol=1e-4)
