@@ -23,8 +23,8 @@ import numpy as np
 
 from ..utils.logging import logger
 from .config import InferenceConfig
-from .engine import (InferenceEngine, _bucket, _rope_rows, _apply_rope_batched,
-                     decode_attention, extend_attention)
+from .engine import (InferenceEngine, _bucket, _rope_rows,
+                     _apply_rope_batched)
 from .paged import (BlockedAllocator, PagedKVCache, append_token_kv, blocks_needed,
                     paged_decode_attention, write_prefill_kv)
 
@@ -166,8 +166,6 @@ class InferenceEngineV2(InferenceEngine):
         import jax
         import jax.numpy as jnp
 
-        from .paged import gather_kv
-
         B, C = ids.shape
         bs = self.cache.block_size
         x, (cos, sin), positions = self._embed_at(params, ids, start)
@@ -192,17 +190,13 @@ class InferenceEngineV2(InferenceEngine):
                     k.reshape(B * C, *k.shape[2:]).astype(ck.dtype))
                 cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
                     v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
-                if self._alibi is not None:
-                    # no bias operand in the Pallas kernel: ALiBi gathers
-                    kg, vg = gather_kv(ck2, cv2, btables)         # [B,S,KV,Dh]
-                    out = extend_attention(q, kg, vg, start, start + nnew,
-                                           alibi_slopes=self._alibi)
-                else:
-                    # paged extend: q chunk attends the pool through the
-                    # block table — no [B, S_max, KV, Dh] gather (r2 weak #7)
-                    from ..ops.paged_attention import paged_extend_attention
+                # paged extend: q chunk attends the pool through the
+                # block table — no [B, S_max, KV, Dh] gather (r2 weak #7);
+                # ALiBi slopes ride the kernel (round 5)
+                from ..ops.paged_attention import paged_extend_attention
 
-                    out = paged_extend_attention(q, ck2, cv2, btables, start, nnew)
+                out = paged_extend_attention(q, ck2, cv2, btables, start,
+                                             nnew, alibi_slopes=self._alibi)
                 return out, (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
@@ -234,15 +228,11 @@ class InferenceEngineV2(InferenceEngine):
 
             def attn_fn(q, k, v):
                 ck2, cv2 = append_token_kv(ck, cv, k[:, 0], v[:, 0], btables, pos)
-                if self._alibi is not None:
-                    # the Pallas decode kernel has no bias operand; ALiBi
-                    # models take the gather path
-                    from .paged import gather_kv
-
-                    kg, vg = gather_kv(ck2, cv2, btables)
-                    return decode_attention(q, kg, vg, kv_len=pos + 1,
-                                            alibi_slopes=self._alibi), (ck2, cv2)
-                return paged_decode_attention(q, ck2, cv2, btables, kv_len=pos + 1), (ck2, cv2)
+                # round 5: slopes ride the paged kernel (no cache gather
+                # for BLOOM serving); the wrapper's CPU fallback gathers
+                return paged_decode_attention(q, ck2, cv2, btables,
+                                              kv_len=pos + 1,
+                                              alibi_slopes=self._alibi), (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, pos, attn_fn)
 

@@ -136,7 +136,7 @@ def write_prefill_kv(ck, cv, ks, vs, block_table):
     return ck, cv
 
 
-def paged_decode_attention(q, ck, cv, block_table, kv_len):
+def paged_decode_attention(q, ck, cv, block_table, kv_len, alibi_slopes=None):
     """q [B,1,H,Dh] against paged KV (one layer) [nblk,bs,KV,Dh].
 
     On TPU this dispatches to the fused Pallas kernel
@@ -144,7 +144,9 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len):
     KV blocks stream through VMEM once — no materialized [B,S,KV,Dh] gather
     (reference blocked_flash + atom_builder). Elsewhere (and as the numerics
     oracle) it gathers by table and runs dense decode attention.
+    ``alibi_slopes`` [H] rides the kernel (BLOOM serving).
     """
     from ..ops.paged_attention import paged_decode_attention as _dispatch
 
-    return _dispatch(q, ck, cv, block_table, kv_len)
+    return _dispatch(q, ck, cv, block_table, kv_len,
+                     alibi_slopes=alibi_slopes)

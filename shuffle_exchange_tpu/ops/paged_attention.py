@@ -23,12 +23,16 @@ import functools
 
 
 def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
+                                  alibi_slopes=None,
                                   interpret: bool = False):
     """q [B,1,H,Dh]; ck/cv [nblk,KV,bs,Dh]; block_table [B,maxblk] (-1 pad);
     kv_len [B] -> out [B,1,H,Dh].
 
     H % KV == 0 (GQA groups map h -> h * KV // H). Softmax/accumulation in
-    f32; output in q.dtype.
+    f32; output in q.dtype. ``alibi_slopes`` [H]: adds slope_h * j at
+    absolute key position j inside the score tile (BLOOM serving WITHOUT
+    the per-layer [B,S,KV,Dh] cache gather the bias-free kernel forced —
+    reference ds_attention.py:16 applies ALiBi in its fused softmax).
     """
     import jax
     import jax.numpy as jnp
@@ -53,9 +57,17 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
     # table: -1 padding -> 0 (masked out by kv_len); int32 scalar prefetch
     bt = jnp.maximum(block_table, 0).astype(jnp.int32)
     kvl = kv_len.astype(jnp.int32)
+    has_alibi = alibi_slopes is not None
+    slopes_in = ()
+    if has_alibi:
+        # [KV, G]: q head h = kv * G + g (the _repeat_kv convention)
+        slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G),)
 
-    def kernel(bt_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref,
-               m_ref, l_ref, acc_ref):
+    def kernel(bt_ref, kvl_ref, q_ref, k_ref, v_ref, *rest):
+        if has_alibi:
+            sl_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
         b = pl.program_id(0)
         j = pl.program_id(2)
 
@@ -75,6 +87,10 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
 
         # mask tokens past this sequence's length
         token_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (G, bs), 1)
+        if has_alibi:
+            # slope_g * absolute key position (per-row softmax shift
+            # invariance == the relative slope_g * (j - i) form)
+            s = s + sl_ref[0][:, None] * token_pos.astype(jnp.float32)
         s = jnp.where(token_pos < kvl_ref[b], s, -1e30)
 
         m_prev = m_ref[...]                                  # [G, 1]
@@ -93,16 +109,20 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         def _emit():
             o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, G, Dh), lambda b, kv, j, bt_ref, kvl_ref: (b, kv, 0, 0)),
+        pl.BlockSpec((1, 1, bs, Dh),
+                     lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
+        pl.BlockSpec((1, 1, bs, Dh),
+                     lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
+    ]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(
+            (1, G), lambda b, kv, j, bt_ref, kvl_ref: (kv, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, maxblk),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, Dh), lambda b, kv, j, bt_ref, kvl_ref: (b, kv, 0, 0)),
-            pl.BlockSpec((1, 1, bs, Dh),
-                         lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
-            pl.BlockSpec((1, 1, bs, Dh),
-                         lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, Dh),
                                lambda b, kv, j, bt_ref, kvl_ref: (b, kv, 0, 0)),
         scratch_shapes=[
@@ -116,11 +136,12 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=interpret,
-    )(bt, kvl, q4, ck, cv)
+    )(bt, kvl, q4, ck, cv, *slopes_in)
     return out.reshape(B, 1, H, Dh)
 
 
 def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
+                                  alibi_slopes=None,
                                   interpret: bool = False):
     """Chunked-prefill extension over paged KV WITHOUT gathering the cache
     (VERDICT r2 weak #7: the gather path allocates [B, S_max, KV, Dh] per
@@ -151,9 +172,16 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
     q5 = q.reshape(B, C, KV, G, Dh).transpose(0, 2, 3, 1, 4).reshape(B, KV, GC, Dh)
     bt = jnp.maximum(block_table, 0).astype(jnp.int32)
     start = start.astype(jnp.int32)
+    has_alibi = alibi_slopes is not None
+    slopes_in = ()
+    if has_alibi:
+        slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G),)
 
-    def kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
-               m_ref, l_ref, acc_ref):
+    def kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, *rest):
+        if has_alibi:
+            sl_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
         b = pl.program_id(0)
         j = pl.program_id(2)
 
@@ -174,6 +202,11 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         # causal-within-chunk mask: row (g, c) sees pos < start[b] + c + 1
         row_c = jax.lax.broadcasted_iota(jnp.int32, (GC, bs), 0) % C
         token_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (GC, bs), 1)
+        if has_alibi:
+            # per-row slope: row r belongs to q head g = r // C
+            slope_rows = jnp.broadcast_to(
+                sl_ref[0][:, None], (G, C)).reshape(GC, 1)
+            s = s + slope_rows * token_pos.astype(jnp.float32)
         s = jnp.where(token_pos < start_ref[b] + row_c + 1, s, -1e30)
 
         m_prev = m_ref[...]
@@ -191,16 +224,20 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         def _emit():
             o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, GC, Dh), lambda b, kv, j, bt_ref, st_ref: (b, kv, 0, 0)),
+        pl.BlockSpec((1, 1, bs, Dh),
+                     lambda b, kv, j, bt_ref, st_ref: (bt_ref[b, j], kv, 0, 0)),
+        pl.BlockSpec((1, 1, bs, Dh),
+                     lambda b, kv, j, bt_ref, st_ref: (bt_ref[b, j], kv, 0, 0)),
+    ]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(
+            (1, G), lambda b, kv, j, bt_ref, st_ref: (kv, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, maxblk),
-        in_specs=[
-            pl.BlockSpec((1, 1, GC, Dh), lambda b, kv, j, bt_ref, st_ref: (b, kv, 0, 0)),
-            pl.BlockSpec((1, 1, bs, Dh),
-                         lambda b, kv, j, bt_ref, st_ref: (bt_ref[b, j], kv, 0, 0)),
-            pl.BlockSpec((1, 1, bs, Dh),
-                         lambda b, kv, j, bt_ref, st_ref: (bt_ref[b, j], kv, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, GC, Dh),
                                lambda b, kv, j, bt_ref, st_ref: (b, kv, 0, 0)),
         scratch_shapes=[
@@ -214,19 +251,23 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, GC, Dh), q.dtype),
         interpret=interpret,
-    )(bt, start, q5, ck, cv)
+    )(bt, start, q5, ck, cv, *slopes_in)
     return out.reshape(B, KV, G, C, Dh).transpose(0, 3, 1, 2, 4).reshape(B, C, H, Dh)
 
 
-def paged_extend_attention(q, ck, cv, block_table, start, nnew, *, impl: str = "auto"):
+def paged_extend_attention(q, ck, cv, block_table, start, nnew, *,
+                           alibi_slopes=None, impl: str = "auto"):
     """Dispatching wrapper: Pallas paged-extend on TPU; gather + dense
-    extend_attention oracle elsewhere."""
+    extend_attention oracle elsewhere. ``alibi_slopes`` rides the kernel
+    (BLOOM serving: no cache gather)."""
     from .dispatch import pallas_enabled
 
     if impl == "pallas" or (impl == "auto" and pallas_enabled()
                             and q.shape[2] % ck.shape[1] == 0):
         try:
-            return paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew)
+            return paged_extend_attention_pallas(q, ck, cv, block_table,
+                                                 start, nnew,
+                                                 alibi_slopes=alibi_slopes)
         except Exception:
             if impl == "pallas":
                 raise
@@ -234,20 +275,25 @@ def paged_extend_attention(q, ck, cv, block_table, start, nnew, *, impl: str = "
     from ..inference.paged import gather_kv
 
     kg, vg = gather_kv(ck, cv, block_table)
-    return extend_attention(q, kg, vg, start, start + nnew)
+    return extend_attention(q, kg, vg, start, start + nnew,
+                            alibi_slopes=alibi_slopes)
 
 
-def paged_decode_attention(q, ck, cv, block_table, kv_len, *, impl: str = "auto"):
+def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
+                           alibi_slopes=None, impl: str = "auto"):
     """Dispatching wrapper: Pallas kernel on TPU (no materialized gather),
     jnp gather+dense oracle elsewhere. ck/cv are [nblk, KV, bs, Dh] pool
     blocks (PagedKVCache layout). See inference/paged.py for the gather
-    path it replaces (VERDICT r1 missing #4)."""
+    path it replaces (VERDICT r1 missing #4). ``alibi_slopes`` rides the
+    kernel (BLOOM serving: no cache gather)."""
     from .dispatch import pallas_enabled
 
     if impl == "pallas" or (impl == "auto" and pallas_enabled()
                             and q.shape[2] % ck.shape[1] == 0):
         try:
-            return paged_decode_attention_pallas(q, ck, cv, block_table, kv_len)
+            return paged_decode_attention_pallas(q, ck, cv, block_table,
+                                                 kv_len,
+                                                 alibi_slopes=alibi_slopes)
         except Exception:
             if impl == "pallas":
                 raise
@@ -255,4 +301,4 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *, impl: str = "auto"
     from ..inference.engine import decode_attention
 
     k, v = gather_kv(ck, cv, block_table)
-    return decode_attention(q, k, v, kv_len)
+    return decode_attention(q, k, v, kv_len, alibi_slopes=alibi_slopes)

@@ -111,3 +111,57 @@ def test_extend_dispatch_fallback_on_cpu():
     out = paged_extend_attention(q, ck, cv, bt, jnp.asarray(starts), jnp.asarray(nnew))
     ref = _extend_oracle(q, ck, cv, bt, jnp.asarray(starts), jnp.asarray(nnew))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("kv", [8, 2])
+def test_interpret_parity_alibi_decode(kv):
+    """Round 5: ALiBi slopes ride the paged decode kernel (slope_h * j at
+    absolute key positions) — BLOOM serving without the cache gather."""
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.models.transformer import alibi_slopes
+    from shuffle_exchange_tpu.inference.engine import decode_attention
+    from shuffle_exchange_tpu.inference.paged import gather_kv
+    from shuffle_exchange_tpu.ops.paged_attention import paged_decode_attention_pallas
+
+    q, ck, cv, bt, kvl = _mk(3, 8, kv, 64, 16, 30, [30, 49, 16], seed=3)
+    sl = jnp.asarray(alibi_slopes(8), jnp.float32)
+    out = paged_decode_attention_pallas(q, ck, cv, bt, kvl,
+                                        alibi_slopes=sl, interpret=True)
+    k, v = gather_kv(ck, cv, bt)
+    ref = decode_attention(q, k, v, kvl, alibi_slopes=sl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_interpret_parity_alibi_extend():
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.models.transformer import alibi_slopes
+    from shuffle_exchange_tpu.inference.engine import extend_attention
+    from shuffle_exchange_tpu.inference.paged import gather_kv
+    from shuffle_exchange_tpu.ops.paged_attention import paged_extend_attention_pallas
+
+    rng = np.random.default_rng(5)
+    B, C, H, KV, Dh, bs, nblk = 2, 4, 4, 4, 32, 16, 10
+    q = jnp.asarray(rng.standard_normal((B, C, H, Dh)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((nblk, KV, bs, Dh)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((nblk, KV, bs, Dh)), jnp.float32)
+    start = jnp.asarray([17, 5], jnp.int32)
+    nnew = jnp.asarray([4, 3], jnp.int32)
+    maxblk = 3
+    bt = np.full((B, maxblk), -1, np.int32)
+    bt[0, :2] = [1, 2]
+    bt[1, :1] = [3]
+    bt = jnp.asarray(np.maximum(bt, 0))
+    sl = jnp.asarray(alibi_slopes(H), jnp.float32)
+    out = paged_extend_attention_pallas(q, ck, cv, bt, start, nnew,
+                                        alibi_slopes=sl, interpret=True)
+    k, v = gather_kv(ck, cv, bt)
+    ref = extend_attention(q, k, v, start, start + nnew, alibi_slopes=sl)
+    # rows past nnew[b] are don't-care (the engine slices by nnew)
+    for b in range(B):
+        n = int(nnew[b])
+        np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                   np.asarray(ref)[b, :n],
+                                   rtol=1e-4, atol=1e-4)

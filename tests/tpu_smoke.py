@@ -157,6 +157,49 @@ def main() -> int:
         ok &= _check(f"alibi-flash-bwd-{nm}", gg.astype(np.float32),
                      rr.astype(np.float32), 5e-2)
 
+    # paged decode with ALiBi slopes riding the kernel (round 5: BLOOM
+    # serving without the per-layer cache gather)
+    from shuffle_exchange_tpu.inference.engine import decode_attention
+    from shuffle_exchange_tpu.inference.paged import gather_kv
+    from shuffle_exchange_tpu.ops.paged_attention import \
+        paged_decode_attention_pallas
+
+    Bp, Hp, KVp, Dp, bsp, nbp = 2, 8, 8, 128, 64, 10
+    qp = jnp.asarray(rng.standard_normal((Bp, 1, Hp, Dp)), jnp.bfloat16)
+    ckp = jnp.asarray(rng.standard_normal((nbp, KVp, bsp, Dp)), jnp.bfloat16)
+    cvp = jnp.asarray(rng.standard_normal((nbp, KVp, bsp, Dp)), jnp.bfloat16)
+    btp = jnp.asarray(np.array([[1, 2, 3], [4, 5, 0]], np.int32))
+    kvlp = jnp.asarray(np.array([170, 100], np.int32))
+    slp = jnp.asarray(alibi_slopes(Hp), jnp.float32)
+    got_p = jax.jit(lambda q, k, v: paged_decode_attention_pallas(
+        q, k, v, btp, kvlp, alibi_slopes=slp))(qp, ckp, cvp).astype(np.float32)
+    kgp, vgp = gather_kv(ckp, cvp, jnp.maximum(btp, 0))
+    want_p = decode_attention(qp, kgp, vgp, kvlp,
+                              alibi_slopes=slp).astype(np.float32)
+    ok &= _check("paged-decode-alibi", got_p, want_p, 5e-2)
+
+    # ... and the ALiBi paged EXTEND kernel (BLOOM chunked prefill): the
+    # (1, G) slope block + slope_rows broadcast must also lower on Mosaic
+    from shuffle_exchange_tpu.inference.engine import extend_attention
+    from shuffle_exchange_tpu.ops.paged_attention import \
+        paged_extend_attention_pallas
+
+    Ce = 4
+    qe = jnp.asarray(rng.standard_normal((Bp, Ce, Hp, Dp)), jnp.bfloat16)
+    st = jnp.asarray(np.array([100, 40], np.int32))
+    nn = jnp.asarray(np.array([4, 3], np.int32))
+    got_e = jax.jit(lambda q, k, v: paged_extend_attention_pallas(
+        q, k, v, btp, st, nn, alibi_slopes=slp))(qe, ckp, cvp).astype(np.float32)
+    want_e = extend_attention(qe, kgp, vgp, st, st + nn,
+                              alibi_slopes=slp).astype(np.float32)
+    eok = True
+    for b in range(Bp):
+        n = int(nn[b])
+        eok &= bool(np.allclose(got_e[b, :n], want_e[b, :n],
+                                rtol=5e-2, atol=5e-2))
+    ok &= eok
+    print("paged-extend-alibi:", "ok" if eok else "FAIL")
+
     # long-context fwd smoke: 32k context through the streamed-KV kernel —
     # the pre-round-5 kernel would have fallen back (8MB whole-S cap)
     q32 = jnp.asarray(rng.standard_normal((1, 32768, 2, 128)), jnp.bfloat16)
