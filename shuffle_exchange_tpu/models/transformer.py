@@ -642,8 +642,10 @@ class Transformer:
         Ulysses (reference DistributedAttention, sequence/layer.py:331)
         engaged via shard_map inside the jitted step: activations shard
         [batch over data+fsdp, seq over "seq"], the two all-to-alls swap
-        seq<->head sharding around the local flash kernel. ALiBi keeps the
-        replicated path (per-head slopes don't survive the head scatter)."""
+        seq<->head sharding around the local flash kernel. ALiBi rides
+        both SP flavors (round 5): Ulysses slices the slope vector per
+        head shard, the ring adds the bias at global key positions; see
+        alibi_sp_ok below for the replicated-fallback cases."""
         cfg = self.config
         sp, mesh = self._sp_mesh()
         if sp > 1:

@@ -1,2 +1,4 @@
+from .alibi_attention import alibi_flash_attention, flash_attention_lse
+from .evoformer_attn import ds4sci_evoformer_attention, evoformer_attention
 from .flash_attention import flash_attention
 from .rmsnorm import rmsnorm, rmsnorm_reference

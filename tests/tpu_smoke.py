@@ -192,13 +192,10 @@ def main() -> int:
         q, k, v, btp, st, nn, alibi_slopes=slp))(qe, ckp, cvp).astype(np.float32)
     want_e = extend_attention(qe, kgp, vgp, st, st + nn,
                               alibi_slopes=slp).astype(np.float32)
-    eok = True
     for b in range(Bp):
         n = int(nn[b])
-        eok &= bool(np.allclose(got_e[b, :n], want_e[b, :n],
-                                rtol=5e-2, atol=5e-2))
-    ok &= eok
-    print("paged-extend-alibi:", "ok" if eok else "FAIL")
+        ok &= _check(f"paged-extend-alibi-b{b}", got_e[b, :n],
+                     want_e[b, :n], 5e-2)
 
     # long-context fwd smoke: 32k context through the streamed-KV kernel —
     # the pre-round-5 kernel would have fallen back (8MB whole-S cap)
