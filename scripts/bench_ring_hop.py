@@ -72,14 +72,21 @@ def main():
             out = acc / jnp.maximum(l[..., None], 1e-30)
             return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
+        from bench import host_sync
+
+        def sync(x):
+            # under the axon tunnel block_until_ready returns before
+            # execution finishes; a host transfer is the only barrier
+            return host_sync(x[0, 0, 0])
+
         for name, fn in (("kernel", kernel_hop), ("jnp-chunk", jnp_hop)):
             f = jax.jit(fn)
-            f(q, k, v).block_until_ready()
+            sync(f(q, k, v))
             n = 5
             t0 = time.perf_counter()
             for _ in range(n):
                 o = f(q, k, v)
-            o.block_until_ready()
+            sync(o)
             dt = (time.perf_counter() - t0) / n
             # causal flops: 2 matmuls * B*H*T^2/2*D MACs * 2 flops
             flops = 2 * 2 * B * H * (T * T / 2) * D

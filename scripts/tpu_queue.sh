@@ -6,6 +6,8 @@
 #     decode fp32-cast fixes, int8/int4/fp8 serving measurement)
 set -e
 cd "$(dirname "$0")/.."
+PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
+export PYTHONPATH
 echo "== tpu_smoke ==" && timeout 900 python tests/tpu_smoke.py
 echo "== ring_hop bench ==" && timeout 1800 python scripts/bench_ring_hop.py
 echo "== tune_config2 ==" && timeout 9000 python scripts/tune_config2.py

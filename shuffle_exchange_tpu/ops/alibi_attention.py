@@ -69,7 +69,7 @@ def _alibi_fwd_kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
     D = q_ref.shape[-1]
-    slope = slope_ref[0, 0]
+    slope = slope_ref[pl.program_id(1)]      # SMEM [H]: dynamic scalar read
 
     @pl.when(ki == 0)
     def _init():
@@ -112,7 +112,7 @@ def _alibi_fwd_kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         o_ref[...] = out.reshape(o_ref.shape).astype(o_ref.dtype)
         m = m_ref[:, :1]
         lse = jnp.where(jnp.isfinite(m), m + jnp.log(jnp.maximum(l, 1e-30)), -jnp.inf)
-        lse_ref[...] = lse.reshape(lse_ref.shape)
+        lse_ref[...] = lse.reshape(lse_ref.shape)   # [1,1,bq,1] trailing-1
 
 
 def _score_grads(slope, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -128,7 +128,7 @@ def _score_grads(slope, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     kb = _blk(k_ref)
     vb = _blk(v_ref)
     do = _blk(do_ref)
-    lse = lse_ref[...].reshape(bq, 1)
+    lse = lse_ref[...].reshape(bq, 1)      # [1,1,bq,1] trailing-1 block
     delta = delta_ref[...].reshape(bq, 1)
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)   # [bq,bkv]
@@ -156,6 +156,8 @@ def _alibi_dq_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
+    slope = slope_ref[pl.program_id(1)]   # top-level read: the interpret
+    # path can't lower a program_id-indexed ref access inside pl.when
 
     @pl.when(ki == 0)
     def _init():
@@ -164,7 +166,8 @@ def _alibi_dq_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(_block_visible(qi, ki, bq, bkv, off, causal))
     def _compute():
         _, kb, _, _, ds, _ = _score_grads(
-            slope_ref[0, 0], q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            slope, q_ref, k_ref, v_ref, do_ref,
+            lse_ref, delta_ref,
             qi, ki, bq=bq, bkv=bkv, off=off, scale=scale, causal=causal)
         dq_acc_ref[...] += scale * jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -190,6 +193,7 @@ def _alibi_dkv_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
+    slope = slope_ref[pl.program_id(1)]   # top-level read (see dq kernel)
 
     @pl.when(qi == 0)
     def _init():
@@ -204,7 +208,7 @@ def _alibi_dkv_kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(_block_visible(qi, ki, bq, bkv, off, causal))
     def _compute():
         q, _, do, p, ds, kv_pos_f = _score_grads(
-            slope_ref[0, 0], q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            slope, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qi, ki, bq=bq, bkv=bkv, off=off, scale=scale, causal=causal)
         # dv += p^T @ do ; dk = scale * ds^T @ q_raw = ds^T @ (q*scale)
         dv_acc_ref[...] += jax.lax.dot_general(
@@ -263,7 +267,10 @@ def _alibi_flash_fwd_impl(q, k, v, slopes, causal: bool, interpret: bool):
     qt = q.transpose(0, 2, 1, 3)      # [B,H,T,D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    slopes = jnp.asarray(slopes, jnp.float32).reshape(H, 1)
+    # slopes live in SMEM as the full [H] vector (one dynamic scalar read
+    # per program): a (1, 1) VMEM block over [H, 1] violates Mosaic's
+    # second-minor-divisible-by-8 block rule when H % 8 != 0
+    slopes = jnp.asarray(slopes, jnp.float32).reshape(H)
 
     kernel = functools.partial(_alibi_fwd_kernel, bq=bq, bkv=bkv, off=off,
                                scale=D ** -0.5, causal=causal)
@@ -271,18 +278,22 @@ def _alibi_flash_fwd_impl(q, k, v, slopes, causal: bool, interpret: bool):
         kernel,
         grid=(B, H, T // bq, S // bkv),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, i, j: (h, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            # lse rides with a trailing length-1 minor dim: a (1,1,bq) block
+            # over [B,H,T] has second-minor block size 1 vs array dim H,
+            # which Mosaic's divisible-by-8-or-equal rule rejects; with the
+            # trailing axis the last two dims are (bq, 1) == legal
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype, vma=_vma_of(q, k, v)),
-            jax.ShapeDtypeStruct((B, H, T), jnp.float32, vma=_vma_of(q, k, v)),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32, vma=_vma_of(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -294,7 +305,7 @@ def _alibi_flash_fwd_impl(q, k, v, slopes, causal: bool, interpret: bool):
                                  "arbitrary")),
         interpret=interpret,
     )(slopes, qt, kt, vt)
-    return out.transpose(0, 2, 1, 3), lse
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 import jax  # noqa: E402  (after module docstring; kernels import lazily)
@@ -339,11 +350,15 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
     delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
-    slopes_in = jnp.asarray(slopes, jnp.float32).reshape(H, 1)
+    # trailing length-1 minor dim (same Mosaic block rule as the forward's
+    # lse output)
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+    slopes_in = jnp.asarray(slopes, jnp.float32).reshape(H)
     scale = D ** -0.5
 
     common_in = [
-        pl.BlockSpec((1, 1), lambda b, h, i, j: (h, 0)),
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # full [H] slope vector
     ]
 
     dq_t = pl.pallas_call(
@@ -355,8 +370,8 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype,
@@ -366,7 +381,7 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(slopes_in, qt, kt, vt, gt, lse, delta)
+    )(slopes_in, qt, kt, vt, gt, lse4, delta4)
 
     dkv_out_specs = [
         pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
@@ -378,11 +393,14 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
     ]
     if need_dslope:
         # dslope partials per kv block: accumulation only crosses the q
-        # grid dim, so the kv dim stays parallelizable (megacore)
+        # grid dim, so the kv dim stays parallelizable (megacore). The
+        # scalar partial rides an (8, 128) tile (smallest legal f32 VMEM
+        # block); every lane carries the same value and the host reads
+        # [..., 0, 0]
         dkv_out_specs.append(
-            pl.BlockSpec((1, 1, 1), lambda b, h, j, i: (b, h, j)))
+            pl.BlockSpec((1, 1, 1, 8, 128), lambda b, h, j, i: (b, h, j, 0, 0)))
         dkv_out_shape.append(
-            jax.ShapeDtypeStruct((B, H, S // bkv), jnp.float32,
+            jax.ShapeDtypeStruct((B, H, S // bkv, 8, 128), jnp.float32,
                                  vma=_vma_of(q, k, v, g)))
     dkv_res = pl.pallas_call(
         functools.partial(_alibi_dkv_kernel, bq=bq, bkv=bkv, off=off,
@@ -394,8 +412,8 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
         ],
         out_specs=dkv_out_specs,
         out_shape=dkv_out_shape,
@@ -405,7 +423,7 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(slopes_in, qt, kt, vt, gt, lse, delta)
+    )(slopes_in, qt, kt, vt, gt, lse4, delta4)
     dk_t, dv_t = dkv_res[0], dkv_res[1]
 
     dq = dq_t.transpose(0, 2, 1, 3)
@@ -418,7 +436,7 @@ def _flash_bwd_impl(q, k, v, slopes, out, lse, g, g_lse, causal, interpret,
         dv = dv.reshape(B, S, Hkv, n_rep, D).sum(axis=3)
     if not need_dslope:
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
-    dslopes = dkv_res[2].sum(axis=(0, 2))
+    dslopes = dkv_res[2][..., 0, 0].sum(axis=(0, 2))
     slopes_arr = jnp.asarray(slopes)
     dslopes = dslopes.astype(slopes_arr.dtype).reshape(slopes_arr.shape)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),

@@ -61,7 +61,7 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
     slopes_in = ()
     if has_alibi:
         # [KV, G]: q head h = kv * G + g (the _repeat_kv convention)
-        slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G),)
+        slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, 1, G),)
 
     def kernel(bt_ref, kvl_ref, q_ref, k_ref, v_ref, *rest):
         if has_alibi:
@@ -90,7 +90,7 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         if has_alibi:
             # slope_g * absolute key position (per-row softmax shift
             # invariance == the relative slope_g * (j - i) form)
-            s = s + sl_ref[0][:, None] * token_pos.astype(jnp.float32)
+            s = s + sl_ref[0, 0][:, None] * token_pos.astype(jnp.float32)
         s = jnp.where(token_pos < kvl_ref[b], s, -1e30)
 
         m_prev = m_ref[...]                                  # [G, 1]
@@ -117,8 +117,11 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
                      lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
     ]
     if has_alibi:
+        # [KV, 1, G] with a (1, 1, G) block: a (1, G) block over [KV, G]
+        # has second-minor block size 1 vs array dim KV, which Mosaic's
+        # divisible-by-8-or-equal rule rejects
         in_specs.append(pl.BlockSpec(
-            (1, G), lambda b, kv, j, bt_ref, kvl_ref: (kv, 0)))
+            (1, 1, G), lambda b, kv, j, bt_ref, kvl_ref: (kv, 0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, maxblk),
@@ -175,7 +178,7 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
     has_alibi = alibi_slopes is not None
     slopes_in = ()
     if has_alibi:
-        slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, G),)
+        slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, 1, G),)
 
     def kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, *rest):
         if has_alibi:
@@ -205,7 +208,7 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         if has_alibi:
             # per-row slope: row r belongs to q head g = r // C
             slope_rows = jnp.broadcast_to(
-                sl_ref[0][:, None], (G, C)).reshape(GC, 1)
+                sl_ref[0, 0][:, None], (G, C)).reshape(GC, 1)
             s = s + slope_rows * token_pos.astype(jnp.float32)
         s = jnp.where(token_pos < start_ref[b] + row_c + 1, s, -1e30)
 
@@ -233,7 +236,7 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
     ]
     if has_alibi:
         in_specs.append(pl.BlockSpec(
-            (1, G), lambda b, kv, j, bt_ref, st_ref: (kv, 0)))
+            (1, 1, G), lambda b, kv, j, bt_ref, st_ref: (kv, 0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, maxblk),

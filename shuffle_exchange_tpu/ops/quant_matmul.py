@@ -230,9 +230,9 @@ def _quant_matmul_pallas(x, qm: QuantizedMatrix, block_m: int = 256,
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
         if int4:
-            w = _unpack_int4(q_ref[...], gs).astype(jnp.float32) * s_ref[...]
+            w = _unpack_int4(q_ref[...], gs).astype(jnp.float32) * s_ref[0]
         else:
-            w = q_ref[...].astype(jnp.float32) * s_ref[...]      # [bk,bn]*[1,bn]
+            w = q_ref[...].astype(jnp.float32) * s_ref[0]        # [bk,bn]*[1,bn]
         acc_ref[...] += jax.lax.dot(
             x_ref[...].astype(jnp.float32), w,
             preferred_element_type=jnp.float32)
@@ -245,19 +245,22 @@ def _quant_matmul_pallas(x, qm: QuantizedMatrix, block_m: int = 256,
     # same lane width; grid offset k lands on the group's packed rows
     q_spec = (pl.BlockSpec((bk // 2, bn), lambda i, j, k: (k, j)) if int4
               else pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)))
+    # scales ride as [nk, 1, N]: Mosaic requires the block's second-minor
+    # dim to divide 8 or equal the array dim, so a (1, bn) block over the
+    # raw [nk, N] scales fails to lower when nk % 8 != 0
     out = pl.pallas_call(
         kernel,
         grid=(Mp // bm, N // bn, nk),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
             q_spec,
-            pl.BlockSpec((1, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, 1, bn), lambda i, j, k: (k, 0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((Mp, N), qm.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=interpret,
-    )(x2, qm.q, qm.scales)
+    )(x2, qm.q, qm.scales.reshape(nk, 1, N))
     if m_pad:
         out = out[:M]
     return out.reshape(*orig_shape[:-1], N)
