@@ -88,7 +88,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
-    moe_impl: str = "auto"                     # auto | capacity | ragged (dropless)
+    moe_impl: str = "auto"   # auto | capacity (index dispatch) | capacity_einsum | ragged (dropless)
     moe_shared_expert_ff: int = 0              # Qwen2-MoE shared expert (0 = none)
     moe_norm_topk: bool = True                 # renormalize top-k weights (Mixtral);
                                                # False = raw softmax probs (Qwen2-MoE)

@@ -24,6 +24,22 @@ class GateOutput(NamedTuple):
     metadata: dict                 # expert_counts, dropped fraction (traced)
 
 
+class GateCompact(NamedTuple):
+    """Index-form capacity assignment (same semantics as GateOutput's dense
+    masks, O(S·k) instead of O(S·E·C)): the dense dispatch/combine einsums
+    are one-hot MATMULS costing 2·S·E·C·M flops each — 4x the expert
+    compute itself at bench shapes — while gather/scatter dispatch moves
+    the same rows for free (round-5 on-chip profile)."""
+
+    eidx: "jax.Array"       # [S, k] i32  expert id per choice
+    loc: "jax.Array"        # [S, k] i32  slot within the expert's buffer
+    kept: "jax.Array"       # [S, k] bool False = dropped (over capacity)
+    weights: "jax.Array"    # [S, k] f32  post-drop (+renorm) combine weight
+    capacity: int
+    aux_loss: "jax.Array"
+    metadata: dict
+
+
 def compute_capacity(num_tokens: int, num_experts: int, k: int, capacity_factor: float,
                      min_capacity: int = 4) -> int:
     cap = int(-(-num_tokens * k * capacity_factor // num_experts))
@@ -70,17 +86,19 @@ def topk_select(logits, k: int, normalize_weights: bool = True,
     return idx, w, aux_loss, masks
 
 
-def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
-                train: bool = True, rng=None, noise_std: float = 0.0,
-                normalize_weights: bool = True, drop_tokens: bool = True) -> GateOutput:
-    """logits [S, E] -> GateOutput. top1/top2 are k=1/2 (reference dispatch
-    table moe/sharded_moe.py:587-678 calls into the same machinery)."""
+def topk_gating_compact(logits, k: int = 2, capacity_factor: float = 1.0,
+                        min_capacity: int = 4, train: bool = True, rng=None,
+                        noise_std: float = 0.0, normalize_weights: bool = True,
+                        drop_tokens: bool = True) -> GateCompact:
+    """logits [S, E] -> GateCompact: the ONE capacity-assignment rule
+    (selection, buffer positions, drops, weight renormalization, aux loss).
+    ``topk_gating`` densifies this into the GShard einsum contract."""
     import jax
     import jax.numpy as jnp
 
     S, E = logits.shape
     # weights re-normalize AFTER capacity drops below, so take them raw here
-    _, raw_w, aux_loss, masks = topk_select(
+    idx, raw_w, aux_loss, masks = topk_select(
         logits, k, normalize_weights=False, train=train, rng=rng, noise_std=noise_std)
     gates = raw_w  # per-choice raw gate probabilities [S, k]
 
@@ -108,19 +126,42 @@ def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: 
         denom = jnp.maximum(denom, 1e-9)
         gate_weights = [g / denom for g in gate_weights]
 
-    combine = jnp.zeros((S, E, capacity), jnp.float32)
-    for m, loc, gw in zip(kept_masks, locations, gate_weights):
-        loc_idx = (loc * m).sum(axis=-1).astype(jnp.int32)        # [S]
-        loc_oh = jax.nn.one_hot(loc_idx, capacity, dtype=jnp.float32)  # [S, C]
-        combine = combine + gw[:, None, None] * m[:, :, None] * loc_oh[:, None, :]
-    dispatch = combine > 0
+    loc_idx = jnp.stack([(loc * m).sum(axis=-1).astype(jnp.int32)
+                         for loc, m in zip(locations, kept_masks)], axis=1)
+    kept_sk = jnp.stack([m.sum(axis=-1) > 0 for m in kept_masks], axis=1)
+    w_sk = jnp.stack(gate_weights, axis=1)
 
     expert_counts = sum(kept_masks).sum(axis=0)
     kept = sum(m.sum() for m in kept_masks)
     total = sum(m.sum() for m in masks)
     metadata = {"expert_counts": expert_counts, "drop_fraction": 1.0 - kept / jnp.maximum(total, 1.0),
                 "capacity": capacity}
-    return GateOutput(combine, dispatch, aux_loss, metadata)
+    return GateCompact(idx, loc_idx, kept_sk, w_sk, capacity, aux_loss, metadata)
+
+
+def topk_gating(logits, k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
+                train: bool = True, rng=None, noise_std: float = 0.0,
+                normalize_weights: bool = True, drop_tokens: bool = True) -> GateOutput:
+    """logits [S, E] -> GateOutput. top1/top2 are k=1/2 (reference dispatch
+    table moe/sharded_moe.py:587-678 calls into the same machinery).
+    Densifies ``topk_gating_compact`` into the [S, E, C] einsum contract."""
+    import jax
+    import jax.numpy as jnp
+
+    ca = topk_gating_compact(logits, k=k, capacity_factor=capacity_factor,
+                             min_capacity=min_capacity, train=train, rng=rng,
+                             noise_std=noise_std,
+                             normalize_weights=normalize_weights,
+                             drop_tokens=drop_tokens)
+    S, E = logits.shape
+    combine = jnp.zeros((S, E, ca.capacity), jnp.float32)
+    for j in range(k):
+        m = jax.nn.one_hot(ca.eidx[:, j], E, dtype=jnp.float32) \
+            * ca.kept[:, j, None].astype(jnp.float32)
+        loc_oh = jax.nn.one_hot(ca.loc[:, j], ca.capacity, dtype=jnp.float32)
+        combine = combine + ca.weights[:, j, None, None] * m[:, :, None] * loc_oh[:, None, :]
+    dispatch = combine > 0
+    return GateOutput(combine, dispatch, ca.aux_loss, ca.metadata)
 
 
 def top1_gating(logits, **kw) -> GateOutput:

@@ -144,16 +144,21 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
     """x [..., M] -> MoEResult. gate_w [M, E].
 
     impl:
-      - "capacity": GShard einsum dispatch over [E, C, M] with capacity/drop
-        semantics; the EP path (dispatched tensor sharding-constrained to
-        the expert axis -> XLA inserts the all-to-all pair).
+      - "capacity": GShard capacity/drop semantics dispatched BY INDEX
+        (scalar slot scatter + row gathers, zero matmul flops); the EP path
+        (dispatched tensor sharding-constrained to the expert axis -> XLA
+        inserts the all-to-all pair).
+      - "capacity_einsum": the dense [S, E, C] one-hot einsum dispatch —
+        identical semantics, kept as the parity oracle (the one-hot
+        matmuls cost 2·S·E·C·M flops each, ~4x the expert compute at
+        bench shapes — round-5 on-chip profile).
       - "ragged": dropless grouped-GEMM (``expert_mlp_ragged``) — no
         capacity padding FLOPs, no drops; the single-device/data-parallel
-        path (reference cutlass moe_gemm). Perf note (v5e, 2026-07): when
-        the layer sits inside a ``lax.scan`` over stacked layer weights,
-        XLA's ragged_dot lowering ran at ~4% MXU vs the capacity einsums'
-        ~3x-faster end-to-end step — measure before picking ragged for a
-        scanned stack; standalone (unscanned) ragged_dot is fine.
+        path (reference cutlass moe_gemm). Perf note (v5e, 2026-07, both
+        measured on-chip): under a ``lax.scan`` over stacked layer weights
+        the Pallas megablox gmm ran the bench step 2.4x SLOWER than the
+        capacity einsums (5.3% vs 12.5% active-param MFU) — measure before
+        picking ragged for a scanned stack; standalone gmm is fine.
       - "auto": ragged when the mesh has no expert axis > 1, else capacity.
     """
     import jax
@@ -193,16 +198,59 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
                          {"expert_counts": counts, "drop_fraction": jnp.zeros(()),
                           "capacity": S})
 
-    gate = topk_gating(logits, k=k, capacity_factor=capacity_factor, train=train,
-                       rng=rng, noise_std=noise_std, min_capacity=min_capacity,
-                       normalize_weights=normalize_weights)
+    if impl == "capacity_einsum":
+        # the GShard dense-mask contract, kept as the parity oracle: the
+        # one-hot dispatch/combine einsums are real matmuls costing
+        # 2·S·E·C·M flops EACH — ~4x the expert compute at bench shapes
+        gate = topk_gating(logits, k=k, capacity_factor=capacity_factor, train=train,
+                           rng=rng, noise_std=noise_std, min_capacity=min_capacity,
+                           normalize_weights=normalize_weights)
 
-    dispatched = jnp.einsum("sec,sm->ecm", gate.dispatch_mask.astype(xs.dtype), xs)
+        dispatched = jnp.einsum("sec,sm->ecm", gate.dispatch_mask.astype(xs.dtype), xs)
+        dispatched = _constrain_expert(dispatched, expert_axis, mesh)
+        expert_out = expert_mlp(expert_params, dispatched, activation)
+        expert_out = _constrain_expert(expert_out, expert_axis, mesh)
+        combined = jnp.einsum("sec,ecm->sm", gate.combine_weights.astype(xs.dtype), expert_out)
+        return MoEResult(combined.reshape(orig_shape), gate.aux_loss, gate.metadata)
+
+    # "capacity": same assignment/drop semantics in index form — dispatch is
+    # one scalar scatter (slot -> token id) plus a row gather, combine is a
+    # row gather weighted by the compact gate weights. Zero matmul flops
+    # (round 5; the reference's own v2 engine dispatches by index the same
+    # way, inference/v2/ragged_ops/moe_scatter). EP evidence: parity +
+    # training on the 8-device CPU mesh (test_moe_expert_parallel_*,
+    # dryrun config 3) and 1.84x on one real chip; how XLA lowers the
+    # cross-shard gather on a real EP pod (a2a vs all-gather of xs) is
+    # unmeasured until multi-chip hardware is available — if it regresses
+    # there, set moe_impl="capacity_einsum" to restore the proven wire.
+    from .gating import topk_gating_compact
+
+    ca = topk_gating_compact(logits, k=k, capacity_factor=capacity_factor,
+                             train=train, rng=rng, noise_std=noise_std,
+                             min_capacity=min_capacity,
+                             normalize_weights=normalize_weights)
+    E = gate_w.shape[1]
+    C = ca.capacity
+    slot = ca.eidx * C + ca.loc                              # [S, k]
+    trash = E * C                                            # dropped -> trash slot
+    tgt = jnp.where(ca.kept, slot, trash)
+    token_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], tgt.shape)
+    # kept slots are unique by construction (cumsum buffer positions), so
+    # the scatter never collides; empty slots keep sentinel S -> zero row
+    inv = jnp.full((E * C + 1,), S, jnp.int32).at[tgt.reshape(-1)].set(
+        token_ids.reshape(-1), mode="drop")[:E * C]
+    xs_pad = jnp.concatenate([xs, jnp.zeros((1, M), xs.dtype)], axis=0)
+    dispatched = xs_pad[inv].reshape(E, C, M)
     dispatched = _constrain_expert(dispatched, expert_axis, mesh)
     expert_out = expert_mlp(expert_params, dispatched, activation)
     expert_out = _constrain_expert(expert_out, expert_axis, mesh)
-    combined = jnp.einsum("sec,ecm->sm", gate.combine_weights.astype(xs.dtype), expert_out)
-    return MoEResult(combined.reshape(orig_shape), gate.aux_loss, gate.metadata)
+    eo = expert_out.reshape(E * C, M)
+    gath = eo[jnp.clip(slot, 0, E * C - 1)]                  # [S, k, M]
+    # ca.weights is already zero for dropped choices (the one drop-zeroing
+    # site, topk_gating_compact), so the clipped gather row is harmless
+    w = ca.weights.astype(xs.dtype)
+    combined = (w[..., None] * gath).sum(axis=1)
+    return MoEResult(combined.reshape(orig_shape), ca.aux_loss, ca.metadata)
 
 
 def _constrain_expert(t, expert_axis, mesh):
