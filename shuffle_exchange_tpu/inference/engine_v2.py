@@ -217,7 +217,17 @@ class InferenceEngineV2(InferenceEngine):
         return fn
 
     def _paged_decode_impl(self, params, cache: PagedKVCache, tok, pos, btables):
-        """tok [B], pos [B] (next slot), btables [B, max_blocks]."""
+        """tok [B], pos [B] (next slot), btables [B, max_blocks].
+
+        Cache structure note (round 5, all three measured on-chip): this
+        xs/ys layer scan rewrites the KV pool into stacked outputs every
+        token (~22% of decode device time in the trace), yet it is the
+        FASTEST of the structures tried — an unrolled layer loop with
+        per-layer carry buffers measured 6-15% slower, and carrying the
+        stacked pool through the scan with the pooled Pallas kernel
+        (``paged_decode_attention(..., layer=i)``) measured 2x slower
+        (XLA double-buffers a carry that is both a custom-call input and
+        scatter-updated in the same iteration). Details in ROUND5_NOTES."""
         import jax
         import jax.numpy as jnp
 

@@ -99,22 +99,31 @@ def gather_kv(ck, cv, block_table):
     return g(ck), g(cv)
 
 
-def append_token_kv(ck, cv, newk, newv, block_table, pos):
+def append_token_kv(ck, cv, newk, newv, block_table, pos, layer=None):
     """Scatter one new token's K/V per sequence into the block pool.
 
-    ck/cv [nblk, KV, bs, Dh]; newk/newv [B, KV, Dh]; block_table [B, maxblk];
+    ck/cv [nblk, KV, bs, Dh] — or the stacked [L, nblk, KV, bs, Dh] pool
+    with ``layer`` set, which scatters into layer ``layer`` WITHOUT ever
+    slicing the pool (the decode loop carries one pool buffer and XLA
+    updates it in place; a per-layer slice would read+write the whole
+    layer each step). newk/newv [B, KV, Dh]; block_table [B, maxblk];
     pos [B] = token index within the sequence (the slot being written).
     Reference: linear_blocked_kv_rotary's KV append half.
     """
     import jax.numpy as jnp
 
-    bs = ck.shape[2]
+    pooled = ck.ndim == 5
+    bs = ck.shape[3] if pooled else ck.shape[2]
     blk = jnp.take_along_axis(jnp.maximum(block_table, 0), (pos // bs)[:, None], axis=1)[:, 0]
     off = pos % bs
     # advanced indices around the KV slice: result is [B, KV, Dh] (numpy
     # moves the advanced dims to the front), matching newk/newv exactly
-    ck = ck.at[blk, :, off].set(newk.astype(ck.dtype))
-    cv = cv.at[blk, :, off].set(newv.astype(cv.dtype))
+    if pooled:
+        ck = ck.at[layer, blk, :, off].set(newk.astype(ck.dtype))
+        cv = cv.at[layer, blk, :, off].set(newv.astype(cv.dtype))
+    else:
+        ck = ck.at[blk, :, off].set(newk.astype(ck.dtype))
+        cv = cv.at[blk, :, off].set(newv.astype(cv.dtype))
     return ck, cv
 
 
@@ -136,8 +145,10 @@ def write_prefill_kv(ck, cv, ks, vs, block_table):
     return ck, cv
 
 
-def paged_decode_attention(q, ck, cv, block_table, kv_len, alibi_slopes=None):
-    """q [B,1,H,Dh] against paged KV (one layer) [nblk,bs,KV,Dh].
+def paged_decode_attention(q, ck, cv, block_table, kv_len, alibi_slopes=None,
+                           layer=None):
+    """q [B,1,H,Dh] against paged KV (one layer) [nblk, KV, bs, Dh], or
+    the stacked [L, nblk, KV, bs, Dh] pool with ``layer`` set.
 
     On TPU this dispatches to the fused Pallas kernel
     (``ops/paged_attention.py``): the block table rides in scalar memory and
@@ -149,4 +160,4 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, alibi_slopes=None):
     from ..ops.paged_attention import paged_decode_attention as _dispatch
 
     return _dispatch(q, ck, cv, block_table, kv_len,
-                     alibi_slopes=alibi_slopes)
+                     alibi_slopes=alibi_slopes, layer=layer)

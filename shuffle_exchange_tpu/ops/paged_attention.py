@@ -23,16 +23,27 @@ import functools
 
 
 def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
-                                  alibi_slopes=None,
+                                  alibi_slopes=None, layer=None,
                                   interpret: bool = False):
-    """q [B,1,H,Dh]; ck/cv [nblk,KV,bs,Dh]; block_table [B,maxblk] (-1 pad);
-    kv_len [B] -> out [B,1,H,Dh].
+    """q [B,1,H,Dh]; ck/cv [nblk,KV,bs,Dh] (or the WHOLE stacked pool
+    [L,nblk,KV,bs,Dh] with ``layer`` an i32 scalar — see below);
+    block_table [B,maxblk] (-1 pad); kv_len [B] -> out [B,1,H,Dh].
 
     H % KV == 0 (GQA groups map h -> h * KV // H). Softmax/accumulation in
     f32; output in q.dtype. ``alibi_slopes`` [H]: adds slope_h * j at
     absolute key position j inside the score tile (BLOOM serving WITHOUT
     the per-layer [B,S,KV,Dh] cache gather the bias-free kernel forced —
     reference ds_attention.py:16 applies ALiBi in its fused softmax).
+
+    Stacked-pool mode (round 5): passing the full multi-layer pool plus a
+    scalar-prefetched ``layer`` index means the caller never slices the
+    cache — the index map adds the layer offset and the kernel DMAs only
+    the pages the block table names. This is what lets the decode layer
+    loop carry ONE pool buffer and update it in place (a per-layer
+    ``cache.k[i]`` slice would read/write the whole layer pool each step;
+    the round-5 decode trace measured those copies at ~22% of device
+    time). Reference: blocked_flash reads the shared multi-layer pool the
+    same way (kv_cache.py:40).
     """
     import jax
     import jax.numpy as jnp
@@ -41,7 +52,10 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
 
     B, one, H, Dh = q.shape
     assert one == 1, "decode kernel: one query token per sequence"
-    nblk, KV, bs, _ = ck.shape
+    pooled = ck.ndim == 5
+    if pooled and layer is None:
+        raise ValueError("stacked [L,...] pool needs a layer index")
+    nblk, KV, bs, _ = ck.shape[1:] if pooled else ck.shape
     assert H % KV == 0, "GQA requires H % KV == 0"
     G = H // KV
     maxblk = block_table.shape[1]
@@ -57,13 +71,18 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
     # table: -1 padding -> 0 (masked out by kv_len); int32 scalar prefetch
     bt = jnp.maximum(block_table, 0).astype(jnp.int32)
     kvl = kv_len.astype(jnp.int32)
+    layer_in = ((jnp.asarray(layer, jnp.int32).reshape(1),) if pooled else ())
     has_alibi = alibi_slopes is not None
     slopes_in = ()
     if has_alibi:
         # [KV, G]: q head h = kv * G + g (the _repeat_kv convention)
         slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, 1, G),)
 
-    def kernel(bt_ref, kvl_ref, q_ref, k_ref, v_ref, *rest):
+    def kernel(bt_ref, kvl_ref, *rest):
+        if pooled:
+            _layer_ref, q_ref, k_ref, v_ref, *rest = rest
+        else:
+            q_ref, k_ref, v_ref, *rest = rest
         if has_alibi:
             sl_ref, o_ref, m_ref, l_ref, acc_ref = rest
         else:
@@ -78,8 +97,9 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
         qv = q_ref[0, 0].astype(jnp.float32) * scale         # [G, Dh]
-        kb = k_ref[0, 0].astype(jnp.float32)                 # [bs, Dh]
-        vb = v_ref[0, 0].astype(jnp.float32)                 # [bs, Dh]
+        kv_blk = (lambda r: r[0, 0, 0]) if pooled else (lambda r: r[0, 0])
+        kb = kv_blk(k_ref).astype(jnp.float32)               # [bs, Dh]
+        vb = kv_blk(v_ref).astype(jnp.float32)               # [bs, Dh]
 
         s = jax.lax.dot_general(
             qv, kb, (((1,), (1,)), ((), ())),
@@ -109,25 +129,33 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         def _emit():
             o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
-    in_specs = [
-        pl.BlockSpec((1, 1, G, Dh), lambda b, kv, j, bt_ref, kvl_ref: (b, kv, 0, 0)),
-        pl.BlockSpec((1, 1, bs, Dh),
-                     lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
-        pl.BlockSpec((1, 1, bs, Dh),
-                     lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0)),
-    ]
+    if pooled:
+        # scalar prefetch order: (bt, kvl, layer); the kv index maps add
+        # the layer offset as the leading block coordinate
+        q_map = lambda b, kv, j, bt_ref, kvl_ref, lr: (b, kv, 0, 0)
+        kv_spec = pl.BlockSpec(
+            (1, 1, 1, bs, Dh),
+            lambda b, kv, j, bt_ref, kvl_ref, lr: (lr[0], bt_ref[b, j], kv, 0, 0))
+        sl_map = lambda b, kv, j, bt_ref, kvl_ref, lr: (kv, 0, 0)
+        n_prefetch = 3
+    else:
+        q_map = lambda b, kv, j, bt_ref, kvl_ref: (b, kv, 0, 0)
+        kv_spec = pl.BlockSpec(
+            (1, 1, bs, Dh),
+            lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0))
+        sl_map = lambda b, kv, j, bt_ref, kvl_ref: (kv, 0, 0)
+        n_prefetch = 2
+    in_specs = [pl.BlockSpec((1, 1, G, Dh), q_map), kv_spec, kv_spec]
     if has_alibi:
         # [KV, 1, G] with a (1, 1, G) block: a (1, G) block over [KV, G]
         # has second-minor block size 1 vs array dim KV, which Mosaic's
         # divisible-by-8-or-equal rule rejects
-        in_specs.append(pl.BlockSpec(
-            (1, 1, G), lambda b, kv, j, bt_ref, kvl_ref: (kv, 0, 0)))
+        in_specs.append(pl.BlockSpec((1, 1, G), sl_map))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=n_prefetch,
         grid=(B, KV, maxblk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, G, Dh),
-                               lambda b, kv, j, bt_ref, kvl_ref: (b, kv, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, G, Dh), q_map),
         scratch_shapes=[
             pltpu.VMEM((G, 1), jnp.float32),
             pltpu.VMEM((G, 1), jnp.float32),
@@ -139,7 +167,7 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=interpret,
-    )(bt, kvl, q4, ck, cv, *slopes_in)
+    )(bt, kvl, *layer_in, q4, ck, cv, *slopes_in)
     return out.reshape(B, 1, H, Dh)
 
 
@@ -283,19 +311,29 @@ def paged_extend_attention(q, ck, cv, block_table, start, nnew, *,
 
 
 def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
-                           alibi_slopes=None, impl: str = "auto"):
+                           alibi_slopes=None, layer=None, impl: str = "auto"):
     """Dispatching wrapper: Pallas kernel on TPU (no materialized gather),
     jnp gather+dense oracle elsewhere. ck/cv are [nblk, KV, bs, Dh] pool
-    blocks (PagedKVCache layout). See inference/paged.py for the gather
-    path it replaces (VERDICT r1 missing #4). ``alibi_slopes`` rides the
-    kernel (BLOOM serving: no cache gather)."""
+    blocks (PagedKVCache layout), or the stacked [L, nblk, KV, bs, Dh]
+    pool with ``layer`` set (the decode loop's in-place-carry mode). See
+    inference/paged.py for the gather path it replaces (VERDICT r1
+    missing #4). ``alibi_slopes`` rides the kernel (BLOOM serving: no
+    cache gather)."""
     from .dispatch import pallas_enabled
 
+    pooled = ck.ndim == 5
+    if pooled and layer is None:
+        # validate BEFORE dispatch: the auto path's except would swallow
+        # the kernel's informative error and the gather fallback would
+        # crash opaquely on a None index
+        raise ValueError("stacked [L, nblk, KV, bs, Dh] pool needs a "
+                         "layer index (layer=...)")
+    kv_heads = ck.shape[2] if pooled else ck.shape[1]
     if impl == "pallas" or (impl == "auto" and pallas_enabled()
-                            and q.shape[2] % ck.shape[1] == 0):
+                            and q.shape[2] % kv_heads == 0):
         try:
             return paged_decode_attention_pallas(q, ck, cv, block_table,
-                                                 kv_len,
+                                                 kv_len, layer=layer,
                                                  alibi_slopes=alibi_slopes)
         except Exception:
             if impl == "pallas":
@@ -303,5 +341,10 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
     from ..inference.paged import gather_kv
     from ..inference.engine import decode_attention
 
+    if pooled:
+        import jax
+
+        ck = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
     k, v = gather_kv(ck, cv, block_table)
     return decode_attention(q, k, v, kv_len, alibi_slopes=alibi_slopes)

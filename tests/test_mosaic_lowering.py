@@ -92,6 +92,13 @@ def test_paged_decode_and_extend_lower(with_alibi):
     _tpu_lower(lambda q, k, v, bt, st, nn: paged_extend_attention_pallas(
         q, k, v, bt, st, nn, alibi_slopes=sl), qc, ck, ck, bt, st, nn)
 
+    # stacked-pool mode: [L, nblk, KV, bs, Dh] + scalar-prefetched layer
+    # index (the decode loop's in-place-carry path)
+    ck5 = jnp.zeros((3, nblk, KV, bs, Dh), jnp.bfloat16)
+    lyr = jnp.zeros((), jnp.int32)
+    _tpu_lower(lambda q, k, v, bt, kvl, lyr: paged_decode_attention_pallas(
+        q, k, v, bt, kvl, layer=lyr, alibi_slopes=sl), q1, ck5, ck5, bt, kvl, lyr)
+
 
 @pytest.mark.parametrize("bits", [8, 4, "fp8"])
 def test_quant_matmul_lowers(bits):

@@ -165,3 +165,37 @@ def test_interpret_parity_alibi_extend():
         np.testing.assert_allclose(np.asarray(out)[b, :n],
                                    np.asarray(ref)[b, :n],
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_decode_pooled_layer_mode_matches_sliced():
+    """The stacked-pool decode mode (``layer=i`` over [L, nblk, KV, bs, Dh])
+    must match running the plain kernel on the sliced layer — both the
+    Pallas interpret path and the gather fallback."""
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.paged_attention import \
+        paged_decode_attention_pallas
+
+    B, H, KV, Dh, bs, nblk, L = 2, 8, 2, 64, 64, 12, 3
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), np.float32)
+    ck5 = jnp.asarray(rng.standard_normal((L, nblk, KV, bs, Dh)), np.float32)
+    cv5 = jnp.asarray(rng.standard_normal((L, nblk, KV, bs, Dh)), np.float32)
+    bt = jnp.asarray(np.array([[1, 2, 3], [4, 5, 0]], np.int32))
+    kvl = jnp.asarray(np.array([170, 100], np.int32))
+    from shuffle_exchange_tpu.ops.paged_attention import paged_decode_attention
+
+    for layer in range(L):
+        pooled = paged_decode_attention_pallas(
+            q, ck5, cv5, bt, kvl, layer=jnp.int32(layer), interpret=True)
+        sliced = paged_decode_attention_pallas(
+            q, ck5[layer], cv5[layer], bt, kvl, interpret=True)
+        np.testing.assert_allclose(np.asarray(pooled), np.asarray(sliced),
+                                   rtol=1e-5, atol=1e-5)
+        # the wrapper's pooled gather fallback (pallas disabled on CPU)
+        wrapped = paged_decode_attention(q, ck5, cv5, bt, kvl,
+                                         layer=jnp.int32(layer))
+        np.testing.assert_allclose(np.asarray(wrapped), np.asarray(sliced),
+                                   rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="layer index"):
+        paged_decode_attention(q, ck5, cv5, bt, kvl)
