@@ -1,17 +1,24 @@
 #!/bin/sh
-# Pending on-chip validation queue (run when the TPU tunnel is back):
-#  1. kernel parity smoke (grouped-GEMM fwd+VJP, ALiBi fused, fp8 matmul)
-#  2. config-2 tuning sweep (remat x batch x attention fwd/bwd blocks)
-#  3. full benchmark -> BASELINE.json published rows (vocab-pad loss,
-#     decode fp32-cast fixes, int8/int4/fp8 serving measurement)
+# On-chip validation queue. Round-5 status: FLUSHED — the tunnel returned
+# and every entry ran on silicon (kernel smoke 27/27, ring-hop bench,
+# 14-candidate config-2 sweep, MoE impl shootout, full bench + BASELINE
+# republish). Keep this runnable: it is the regression pass for any
+# round where kernels changed while the tunnel was down.
 set -e
 cd "$(dirname "$0")/.."
 PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
 export PYTHONPATH
-echo "== tpu_smoke ==" && timeout 900 python tests/tpu_smoke.py
+echo "== tpu_smoke ==" && timeout 1800 python tests/tpu_smoke.py
 echo "== ring_hop bench ==" && timeout 1800 python scripts/bench_ring_hop.py
-echo "== tune_config2 ==" && timeout 9000 python scripts/tune_config2.py
-echo "== bench ==" && timeout 3600 python bench.py
-# Multi-chip only (run on a pod slice when one is available): ring-vs-
-# Ulysses tokens/s at seq >= 32k through the engine (mesh {seq: N},
-# sp_attention ring|ulysses) — single-chip proxy is bench_ring_hop.py.
+echo "== moe impl shootout ==" && timeout 5600 python scripts/bench_moe_impl.py
+echo "== tune_config2 ==" && timeout 10000 python scripts/tune_config2.py
+echo "== bench ==" && timeout 4200 python bench.py
+# Multi-chip only (run on a pod slice when one is available):
+#  - ring-vs-Ulysses tokens/s at seq >= 32k through the engine
+#    (mesh {seq: N}, sp_attention ring|ulysses) — single-chip proxy is
+#    bench_ring_hop.py (4.6x per-hop at 32k, round 5)
+#  - MoE index-dispatch EP wire: confirm XLA lowers the cross-shard
+#    gather as a2a (not an xs all-gather) on a real expert axis; fall
+#    back to moe_impl="capacity_einsum" if it regresses
+#  - ZeRO++ int8 wire bandwidth on a real data/fsdp axis (single chip
+#    runs the collectives degenerately)
