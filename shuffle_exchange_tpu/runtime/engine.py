@@ -195,15 +195,22 @@ class Engine:
             from ..linear import optimized_linear as _ol
 
             if self.ensemble:
-                # The fork's sync mixes WEIGHT trees across replicas; with
-                # lora the trainable tree is rank-r factors, and
-                # mix(A) @ mix(B) != mix(A @ B) — there is no consensus
-                # semantics that is both factor-space and model-space.
-                # Document-and-reject (same policy as seq x ensemble).
-                raise ConfigError(
-                    "lora is not supported with the decentralized ensemble "
-                    "(shuffle_exchange) mode: replica mixing is defined on "
-                    "weight trees, not LoRA factor pairs")
+                # The fork's sync mixes whatever bit16 tensors the ZeRO
+                # optimizer holds (stage_1_and_2.py:2231 averages the
+                # trainable partitions) — with the reference's
+                # deepspeed/linear LoRA, those ARE the rank-r factor
+                # tensors, mixed per-tensor. We match that: factors mix in
+                # FACTOR space, which is not equivalent to mixing the
+                # effective weights (mix(A) @ mix(B) != mix(A @ B)) — the
+                # same bias FedAvg-style LoRA averaging carries. The frozen
+                # base is identical on every replica, so it neither mixes
+                # nor needs to. (Round 5: lifted from document-and-reject —
+                # the reject was a parity gap, the reference runs this.)
+                logger.warning(
+                    "lora x shuffle_exchange: replica mixing averages the "
+                    "LoRA FACTOR tensors per-tensor (the reference's "
+                    "behavior); note mix(A)@mix(B) != mix(A@B), so "
+                    "consensus is factor-space, not weight-space")
             lora_cfg = _ol.LoRAConfig(
                 lora_r=config.lora.lora_r, lora_alpha=config.lora.lora_alpha,
                 base_weight_sharding=config.lora.base_weight_sharding,

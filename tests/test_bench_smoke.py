@@ -1,0 +1,38 @@
+"""The driver runs ``python bench.py`` at the end of every round and
+records its ONE JSON line; a broken bench invalidates the round's perf
+artifact even when the framework itself is healthy. This smoke runs the
+real ``bench.py`` main() end to end on CPU (subprocess isolation, device
+probe, config-1 CPU branch, headline-line assembly) and asserts the
+output contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_main_cpu_smoke_emits_contract_line():
+    env = dict(os.environ, SXT_BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    # drop the axon sitecustomize: the bench must not touch the tunnel
+    # from CI (and the subprocess must behave on a machine without it)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=900,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line in output: {proc.stdout[-500:]!r}"
+    row = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "valid"):
+        assert key in row, row
+    assert row["valid"] is True, row
+    assert row["value"] > 0, row
+    # a CPU run must never publish into the committed baseline
+    assert "config1_tiny_cpu" not in json.load(
+        open(os.path.join(REPO, "BASELINE.json")))["published"]

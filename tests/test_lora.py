@@ -266,19 +266,42 @@ def test_lora_hybrid_engine_fused_rollout_parity():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
 
 
-def test_lora_rejects_ensemble_mode():
+def test_lora_composes_with_ensemble_mode(devices8):
+    """lora x shuffle_exchange (round 5, lifted from document-and-reject):
+    the reference's sync averages the trainable bit16 partitions — with
+    deepspeed/linear LoRA those ARE the factor tensors — so factor-space
+    per-tensor mixing is the reference behavior. Frozen base stays
+    replica-free; synchronization() converges the factor replicas."""
+    import jax
     import shuffle_exchange_tpu as sxt
-    from shuffle_exchange_tpu.config import ConfigError
     from shuffle_exchange_tpu.models import Transformer, tiny
 
     model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
-    with pytest.raises(ConfigError, match="lora.*ensemble|ensemble.*lora"):
-        sxt.initialize(model=model, config={
-            "train_batch_size": 8,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-            "lora": {"enabled": True},
-            "steps_per_print": 10**9,
-        }, method="RR", rings=2)
+    engine, *_ = sxt.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "lora": {"enabled": True, "lora_r": 4},
+        "steps_per_print": 10**9,
+    }, method="RR", rings=2)
+    assert engine.ensemble and engine.replicas > 1
+    R = engine.replicas
+
+    # factors carry the replica dim; the frozen base must NOT
+    f_leaves = jax.tree_util.tree_leaves(engine.state.master)
+    assert all(l.shape[0] == R for l in f_leaves)
+    froz_shapes = [l.shape for l in jax.tree_util.tree_leaves(engine.state.frozen)
+                   if hasattr(l, "shape")]
+    assert froz_shapes and all(s[0] != R or len(s) < 2 for s in froz_shapes)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, size=(8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+
+    engine.synchronization()
+    m = jax.device_get(jax.tree_util.tree_leaves(engine.state.master)[0])
+    for r in range(1, R):
+        np.testing.assert_allclose(m[0], m[r], rtol=1e-5, atol=1e-6)
 
 
 def test_disabled_lora_section_skips_validation():
