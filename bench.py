@@ -299,6 +299,28 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
     logits = eng.put(uids, prompts)
     prefill_s = time.perf_counter() - t0
 
+    # Large-batch prefill through the same public put(): 8 x 1024-token
+    # prompts = 8192 tokens in ONE dispatch, so the ~65ms tunnel RTT is
+    # amortized 4x vs the bs4x512 figure — the number a batch-serving
+    # deployment sees (the bs4x512 row doubles as the small-batch API
+    # latency figure).
+    try:
+        big_prompts = [rng.integers(0, cfg.vocab_size, size=1024).tolist()
+                       for _ in range(8)]
+        big_uids = list(range(100, 108))
+        eng2 = InferenceEngineV2(model, params, icfg)
+        eng2.put(big_uids, big_prompts)          # warm the 8x1024 bucket
+        eng2.flush(big_uids)
+        t0 = time.perf_counter()
+        eng2.put(big_uids, big_prompts)
+        prefill_big_s = time.perf_counter() - t0
+        del eng2                                 # free its KV pool before
+        # the quantized / decode-sweep benches below run
+    except Exception as e:
+        print(f"SXT_WARN big prefill bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        prefill_big_s = None
+
     nxt = [[int(np.argmax(logits[i]))] for i in range(bsz)]
     t0 = time.perf_counter()
     for _ in range(decode_steps):
@@ -406,6 +428,8 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "batch_size": bsz,
         "prompt_len": prompt_len,
         "prefill_tokens_per_sec": round(bsz * prompt_len / prefill_s, 1),
+        "prefill_bs8x1024_tokens_per_sec": (
+            round(8 * 1024 / prefill_big_s, 1) if prefill_big_s else None),
         "decode_tokens_per_sec": round(decode_tps, 1),
         "decode_ms_per_token": round(1000 * decode_s / decode_steps, 2),
         "put_api_note": "per-put numbers include one host RTT per token",
