@@ -422,6 +422,11 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
     # batch width, which is throughput-facing for b > 1)
     eng_best = next((r for r in engine_rows if r["batch"] == 1),
                     engine_rows[0] if engine_rows else None)
+    # headline bandwidth-utilization figure (round 6, VERDICT r5 #2): the
+    # bs-1 hbm_util from streamed bytes/step (weights once + live KV once)
+    # against the chip's HBM bandwidth. Tracked goal in BASELINE.md:
+    # >= 0.5 on chip (round-5 XLA layer body measured 0.183; the fused
+    # decode_kernel path exists to close that gap).
     return {
         "config": label,
         "params_m": round(n_params / 1e6, 1),
@@ -436,6 +441,8 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "engine_decode_sweep": engine_rows,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
                                 if eng_best else None),
+        "decode_hbm_util": (eng_best or {}).get("hbm_util"),
+        "decode_kernel": getattr(eng, "_decode_kernel", "xla"),
         "serving_mfu": round(decode_mfu, 4),
         "fused_generate_tokens_per_sec": round(fused_tps, 1),
         **{f"fused_generate_{key}_tokens_per_sec":

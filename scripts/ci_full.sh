@@ -3,4 +3,9 @@
 # The fast development gate is: pytest tests/ -q -m "not slow"
 set -e
 cd "$(dirname "$0")/.."
-exec python -m pytest tests/ -q "$@"
+# Fused-decode parity first (kernel + engine-level, CPU interpret mode) —
+# a broken serving kernel should fail the run before the long tail does;
+# the main run then skips the two files so nothing executes twice.
+python -m pytest tests/test_fused_decode.py tests/test_mosaic_lowering.py -q "$@"
+exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
+    --ignore=tests/test_mosaic_lowering.py "$@"

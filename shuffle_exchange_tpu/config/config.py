@@ -355,6 +355,19 @@ class LoRASectionConfig(ConfigModel):
     (QuantizedParameter analog); ``base_weight_sharding > 1`` shards the
     frozen base over the ZeRO world even at stage < 3 (reference
     base_weight_sharding; 0/1 = follow the ZeRO stage).
+
+    ``ensemble_factor_mixing`` (default False) gates the LoRA x
+    shuffle_exchange composition: the decentralized ensemble mixes the
+    bit16 trainable tensors per-tensor, and with LoRA those ARE the rank-r
+    factor pairs — consensus happens in FACTOR space, which is NOT
+    equivalent to mixing the effective weights (``mix(A) @ mix(B) !=
+    mix(A @ B)``, the same bias FedAvg-style LoRA averaging carries). The
+    reference runs exactly this (stage_1_and_2.py:2231 averages whatever
+    trainable partitions the optimizer holds), so the composition is
+    available — but only behind this explicit opt-in; by default the
+    combination raises a ``ConfigError`` so nobody gets biased
+    factor-space consensus from a config that used to hard-fail
+    (ADVICE r5 #5).
     """
 
     enabled: bool = config_field(False)
@@ -368,6 +381,7 @@ class LoRASectionConfig(ConfigModel):
     quantize_base: bool = config_field(False)
     q_bits: int = config_field(8)
     group_size: int = config_field(512, ge=1)
+    ensemble_factor_mixing: bool = config_field(False)
 
     def _validate(self, path=""):
         super()._validate(path)

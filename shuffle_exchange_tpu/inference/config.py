@@ -35,6 +35,14 @@ class InferenceConfig:
     top_p: float = 1.0                        # 1 = off
     # kernels
     attention_impl: str = "auto"              # reference replace_with_kernel_inject
+    # Fused per-layer decode path (ops/fused_decode.py: QKV+RoPE+KV-append,
+    # split-K paged flash-decode, residual+MLP — the reference's
+    # linear_blocked_kv_rotary + blocked_flash fusion):
+    #   "auto"   — fused kernels on TPU, XLA layer body elsewhere
+    #   "pallas" — force fused kernels (errors surface; model structures
+    #              the kernels can't take raise at engine construction)
+    #   "xla"    — force the reference XLA layer body
+    decode_kernel: str = "auto"
     # quantization (reference quant.enabled / FP6): int8 weight-only.
     # Layer matmul weights use int8 STORAGE (QuantizedMatrix + Pallas
     # kernel) with groups capped at 256 along K (one scale row per kernel
@@ -80,6 +88,10 @@ class InferenceConfig:
                 raise ConfigError(f"unsupported inference dtype {dtype!r}")
             else:
                 d["dtype"] = _DTYPES[key]
+        dk = d.get("decode_kernel", "auto")
+        if dk not in ("auto", "pallas", "xla"):
+            raise ConfigError(
+                f'decode_kernel must be "auto", "pallas" or "xla", got {dk!r}')
         qb = d.get("quant_bits", 8)
         if str(qb).strip().lower() == "fp8":
             d["quant_bits"] = "fp8"

@@ -142,6 +142,10 @@ class InferenceEngine:
     its pytree (cast to the serving dtype and TP-sharded on construction).
     """
 
+    # v2 overrides: its paged decode step can fuse ATTENTION (split-K paged
+    # kernel + in-pool append) even when qkv/mlp fusion is structurally off
+    _fused_attention = False
+
     def __init__(self, model, params, config: Optional[InferenceConfig] = None):
         import jax
         import jax.numpy as jnp
@@ -159,7 +163,38 @@ class InferenceEngine:
         self._gen_cache: Dict[Tuple, Any] = {}
         self._fwd = jax.jit(model.apply)
         self._rng = jax.random.PRNGKey(self.config.seed)
+        self._resolve_decode_kernel()
         self.update_params(params)
+
+    def _resolve_decode_kernel(self) -> None:
+        """Pin the decode-path implementation for this engine's lifetime
+        (the jitted programs bake it in). "auto" falls back to the XLA
+        layer body off-TPU or when the model structure isn't fusable;
+        "pallas" raises instead of silently degrading."""
+        from ..models.transformer import decode_fusion_eligibility
+        from ..ops.dispatch import resolve_decode_kernel
+        from ..utils.logging import warning_once
+
+        requested = self.config.decode_kernel
+        self._decode_kernel = resolve_decode_kernel(requested)
+        self._fuse_qkv = self._fuse_mlp = False
+        if self._decode_kernel != "pallas":
+            return
+        elig = decode_fusion_eligibility(self._mcfg)
+        self._fuse_qkv = elig["qkv"] is None
+        self._fuse_mlp = elig["mlp"] is None
+        reasons = [r for r in (elig["qkv"], elig["mlp"]) if r]
+        if not (self._fuse_qkv or self._fuse_mlp or self._fused_attention):
+            if requested == "pallas":
+                raise ValueError(
+                    "decode_kernel='pallas' but no part of the decode "
+                    f"layer is fusable for this model: {'; '.join(reasons)}")
+            warning_once(f"decode_kernel=auto: model not fusable "
+                         f"({'; '.join(reasons)}); using the XLA decode path")
+            self._decode_kernel = "xla"
+        elif reasons:
+            warning_once("fused decode: partially fused layer body "
+                         f"({'; '.join(reasons)})")
 
     def update_params(self, params) -> None:
         """Swap in new weights (same tree/shapes) without dropping compiled
@@ -299,36 +334,153 @@ class InferenceEngine:
         """One transformer block shared by every cached path (v1/v2 ×
         prefill/decode) — norm → QKV(+RoPE) → ``attn_fn`` → residual → FFN.
         ``attn_fn(q, k, v) -> (attn [B,T,H,Dh], cache_out)`` supplies the
-        attention and the KV-cache write for that path."""
+        attention and the KV-cache write for that path.
+
+        On 1-token steps with ``decode_kernel`` resolved to "pallas", the
+        QKV projection(+bias+RoPE) and the residual+MLP collapse into the
+        fused kernels (ops/fused_decode.py) so each weight matrix streams
+        through VMEM exactly once per step."""
         from ..models.transformer import _norm
 
         cfg = self._mcfg
         B, T = h.shape[:2]
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm, eps=cfg.norm_eps)
-        q = (y @ lw["wq"]).reshape(B, T, H, Dh)
-        k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
-        v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
-        if cfg.attn_qkv_bias:
-            q = q + lw["b_q"].astype(y.dtype).reshape(H, Dh)
-            k = k + lw["b_k"].astype(y.dtype).reshape(KV, Dh)
-            v = v + lw["b_v"].astype(y.dtype).reshape(KV, Dh)
-        if cfg.position == "rope":
-            pc, ps = _rope_rows(cos, sin, positions)
-            q = _apply_rope_batched(q, pc, ps, interleaved=cfg.rope_interleaved)
-            k = _apply_rope_batched(k, pc, ps, interleaved=cfg.rope_interleaved)
+        qkv = self._maybe_fused_qkv(lw, y, cos, sin, positions)
+        if qkv is None:
+            q = (y @ lw["wq"]).reshape(B, T, H, Dh)
+            k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
+            v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
+            if cfg.attn_qkv_bias:
+                q = q + lw["b_q"].astype(y.dtype).reshape(H, Dh)
+                k = k + lw["b_k"].astype(y.dtype).reshape(KV, Dh)
+                v = v + lw["b_v"].astype(y.dtype).reshape(KV, Dh)
+            if cfg.position == "rope":
+                pc, ps = _rope_rows(cos, sin, positions)
+                q = _apply_rope_batched(q, pc, ps, interleaved=cfg.rope_interleaved)
+                k = _apply_rope_batched(k, pc, ps, interleaved=cfg.rope_interleaved)
+        else:
+            q, k, v = qkv
         attn, cache_out = attn_fn(q, k, v)
-        attn_out = attn.reshape(B, T, H * Dh) @ lw["wo"]
+        return self._block_tail(lw, h, y, attn), cache_out
+
+    def _block_tail(self, lw, h, y, attn):
+        """Output projection + residual(s) + FFN — shared by the XLA and
+        fused layer bodies (engine_v2's fused paged step re-enters here
+        after its fused attention)."""
+        from ..models.transformer import _norm
+
+        cfg = self._mcfg
+        B, T = h.shape[:2]
+        attn_out = attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ lw["wo"]
         if cfg.attn_out_bias:
             attn_out = attn_out + lw["b_o"].astype(attn_out.dtype)
         if cfg.parallel_block:
-            y2 = y if cfg.parallel_shared_ln else _norm(
-                h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
-            return h + attn_out + self._ffn(lw, y2), cache_out
+            resid = h + attn_out
+            if cfg.parallel_shared_ln:
+                out = self._maybe_fused_ffn(lw, resid, y, apply_norm=False)
+                return out if out is not None else resid + self._ffn(lw, y)
+            out = self._maybe_fused_ffn(lw, resid, h, apply_norm=True)
+            if out is not None:
+                return out
+            y2 = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm,
+                       eps=cfg.norm_eps)
+            return resid + self._ffn(lw, y2)
         h = h + attn_out
+        out = self._maybe_fused_ffn(lw, h, h, apply_norm=True)
+        if out is not None:
+            return out
         y2 = _norm(h, lw["ln2_w"], lw.get("ln2_b", 0), cfg.norm, eps=cfg.norm_eps)
-        h = h + self._ffn(lw, y2)
-        return h, cache_out
+        return h + self._ffn(lw, y2)
+
+    def _fused_qkv_args(self, lw, cos, sin, positions):
+        """Per-layer preconditions + argument assembly shared by the v1
+        and v2 fused-QKV call sites (one definition so weight-form checks
+        can never diverge between the engines): None when this layer's
+        attention weights can't take the kernel, else
+        ``(cos_rows, sin_rows, bias_kwargs)``."""
+        cfg = self._mcfg
+        from ..ops.quant_matmul import QuantizedMatrix
+        from ..utils.logging import warning_once
+
+        if any(isinstance(lw[n], QuantizedMatrix) for n in ("wq", "wk", "wv")):
+            warning_once("fused decode: quantized attention weights — QKV "
+                         "stays on the dequant-into-dot XLA path")
+            return None
+        cosr = sinr = None
+        if cfg.position == "rope":
+            pc, ps = _rope_rows(cos, sin, positions)
+            cosr, sinr = pc[:, 0], ps[:, 0]
+        bias = {}
+        if cfg.attn_qkv_bias:
+            bias = {"bq": lw["b_q"], "bk": lw["b_k"], "bv": lw["b_v"]}
+        return cosr, sinr, bias
+
+    def _maybe_fused_qkv(self, lw, y, cos, sin, positions):
+        """Fused QKV+bias+RoPE for a 1-token step; None -> use the XLA
+        path (not enabled, T > 1, or this layer's weights aren't dense)."""
+        cfg = self._mcfg
+        if not (self._fuse_qkv and self._decode_kernel == "pallas"
+                and y.shape[1] == 1):
+            return None
+        from ..ops import fused_decode as fd
+        from ..utils.logging import warning_once
+
+        args = self._fused_qkv_args(lw, cos, sin, positions)
+        if args is None:
+            return None
+        cosr, sinr, bias = args
+        try:
+            q, k, v = fd.fused_qkv_rope(
+                y[:, 0], lw["wq"], lw["wk"], lw["wv"], cos=cosr, sin=sinr,
+                n_heads=cfg.n_heads, kv_heads=cfg.kv_heads, **bias)
+        except Exception as e:
+            warning_once(f"fused decode: QKV kernel failed with "
+                         f"{type(e).__name__} (D={y.shape[-1]}, "
+                         f"H={cfg.n_heads}, KV={cfg.kv_heads}); using the "
+                         "XLA path")
+            return None
+        return q[:, None], k[:, None], v[:, None]
+
+    def _maybe_fused_ffn(self, lw, resid, y_src, apply_norm: bool):
+        """Fused residual+norm+MLP for a 1-token step; None -> XLA path."""
+        cfg = self._mcfg
+        if not (self._fuse_mlp and self._decode_kernel == "pallas"
+                and resid.shape[1] == 1):
+            return None
+        from ..ops import fused_decode as fd
+        from ..ops.quant_matmul import QuantizedMatrix
+        from ..utils.logging import warning_once
+
+        gated = cfg.activation == "swiglu"
+        wg = lw["w_gate"] if gated else None
+        reason = fd.mlp_weights_fusable(lw["w_up"], lw["w_down"], wg)
+        has_bias = cfg.mlp_bias and not gated and "b_up" in lw
+        if reason is None and has_bias and isinstance(lw["w_up"],
+                                                      QuantizedMatrix):
+            reason = "quantized MLP weights with fc biases"
+        if reason is not None:
+            warning_once(f"fused decode: MLP stays on the XLA path "
+                         f"({reason})")
+            return None
+        kw = {}
+        if has_bias:
+            kw = {"b_up": lw["b_up"], "b_down": lw["b_down"]}
+        # with apply_norm=False the norm params are unused; ln1_w rides
+        # along as a shape-correct dummy
+        ln_w = lw["ln2_w"] if apply_norm else lw["ln1_w"]
+        ln_b = lw.get("ln2_b") if apply_norm else None
+        try:
+            out = fd.fused_mlp(
+                resid[:, 0], y_src[:, 0], ln_w, ln_b,
+                lw["w_up"], lw["w_down"], wg, norm=cfg.norm,
+                eps=cfg.norm_eps, activation=cfg.activation,
+                apply_norm=apply_norm, **kw)
+        except Exception as e:
+            warning_once(f"fused decode: MLP kernel failed with "
+                         f"{type(e).__name__}; using the XLA path")
+            return None
+        return out[:, None]
 
     def _prefill(self, params, ids, prompt_len, cache: KVCache):
         """Process right-padded prompts [B,T]; fill cache[:, :, :T]; return

@@ -46,6 +46,9 @@ class InferenceEngineV2(InferenceEngine):
     extension for known ones, returning next-token logits per uid in order.
     """
 
+    _fused_attention = True   # the paged decode step has a fused-attention
+    # form (split-K kernel + in-pool append) independent of qkv/mlp fusion
+
     def __init__(self, model, params, config: Optional[InferenceConfig] = None):
         super().__init__(model, params, config)
         cfg, mcfg = self.config, self._mcfg
@@ -227,7 +230,16 @@ class InferenceEngineV2(InferenceEngine):
         stacked pool through the scan with the pooled Pallas kernel
         (``paged_decode_attention(..., layer=i)``) measured 2x slower
         (XLA double-buffers a carry that is both a custom-call input and
-        scatter-updated in the same iteration). Details in ROUND5_NOTES."""
+        scatter-updated in the same iteration). Details in ROUND5_NOTES.
+
+        Round 6: with ``decode_kernel`` resolved to "pallas" each layer
+        runs the FUSED path (``_fused_paged_layer``): one kernel for
+        QKV+RoPE+pool-append (``input_output_aliases`` on the layer's pool
+        slice — the scatter that used to be an XLA whole-slice update is an
+        in-kernel DMA of just the new rows), one split-K flash-decode
+        kernel over the block table, and one residual+MLP kernel — the
+        next candidate for closing the remaining per-token gap, to be
+        traced on silicon against this scan structure."""
         import jax
         import jax.numpy as jnp
 
@@ -235,9 +247,32 @@ class InferenceEngineV2(InferenceEngine):
 
         def layer_fn(h, layer_and_cache):
             lw, ck, cv = layer_and_cache
+            if self._decode_kernel == "pallas":
+                fused = self._fused_paged_layer(lw, h, ck, cv, cos, sin,
+                                                pos, btables)
+                if fused is not None:
+                    return fused
 
             def attn_fn(q, k, v):
                 ck2, cv2 = append_token_kv(ck, cv, k[:, 0], v[:, 0], btables, pos)
+                if self._decode_kernel == "pallas":
+                    # attention-only fusion: even when QKV fusion is off
+                    # for this layer (quantized weights, interleaved rope)
+                    # the split-K kernel still replaces the per-kv-head
+                    # streaming one
+                    try:
+                        from ..ops import fused_decode as fd
+
+                        return fd.fused_paged_decode_attention(
+                            q, ck2, cv2, btables, kv_len=pos + 1,
+                            alibi_slopes=self._alibi), (ck2, cv2)
+                    except Exception as e:
+                        from ..utils.logging import warning_once
+
+                        warning_once(
+                            "fused decode: split-K attention kernel failed "
+                            f"with {type(e).__name__}; using the streaming "
+                            "paged kernel")
                 # round 5: slopes ride the paged kernel (no cache gather
                 # for BLOOM serving); the wrapper's CPU fallback gathers
                 return paged_decode_attention(q, ck2, cv2, btables,
@@ -249,6 +284,47 @@ class InferenceEngineV2(InferenceEngine):
         x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
         logits = self.model.head(params, x)[:, 0]
         return PagedKVCache(kp, vp), logits
+
+    def _fused_paged_layer(self, lw, h, ck, cv, cos, sin, pos, btables):
+        """One fully-fused decode layer: fused QKV+RoPE+append writes the
+        new token's K/V into the pool slice in place, the split-K paged
+        kernel attends through the block table, and the shared
+        ``_block_tail`` finishes (fusing the MLP when eligible). Returns
+        ``(h_new, (ck2, cv2))`` or None to take the XLA path (quantized
+        attention weights, or a kernel that fails to build)."""
+        import jax.numpy as jnp
+
+        from ..models.transformer import _norm
+        from ..ops import fused_decode as fd
+        from ..utils.logging import warning_once
+
+        cfg = self._mcfg
+        if not self._fuse_qkv:
+            return None
+        args = self._fused_qkv_args(lw, cos, sin, pos)
+        if args is None:
+            return None
+        cosr, sinr, bias = args
+        y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm,
+                  eps=cfg.norm_eps)
+        bs = self.cache.block_size
+        blk = jnp.take_along_axis(jnp.maximum(btables, 0),
+                                  (pos // bs)[:, None], axis=1)[:, 0]
+        off = pos % bs
+        try:
+            q, k, v, ck2, cv2 = fd.fused_qkv_rope(
+                y[:, 0], lw["wq"], lw["wk"], lw["wv"], cos=cosr, sin=sinr,
+                n_heads=cfg.n_heads, kv_heads=cfg.kv_heads,
+                pool_k=ck, pool_v=cv, blk=blk, off=off, **bias)
+            attn = fd.fused_paged_decode_attention(
+                q[:, None], ck2, cv2, btables, pos + 1,
+                alibi_slopes=self._alibi)
+        except Exception as e:
+            warning_once(f"fused decode: paged layer kernels failed with "
+                         f"{type(e).__name__} (D={y.shape[-1]}, "
+                         f"pool={tuple(ck.shape)}); using the XLA path")
+            return None
+        return self._block_tail(lw, h, y, attn), (ck2, cv2)
 
     # -- host-side scheduling ------------------------------------------
 

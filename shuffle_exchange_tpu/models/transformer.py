@@ -270,6 +270,35 @@ def alibi_slopes(n_heads: int):
     return np.asarray(s, np.float32)
 
 
+def decode_fusion_eligibility(cfg: "TransformerConfig") -> dict:
+    """Which parts of the fused Pallas decode path (ops/fused_decode.py)
+    this model STRUCTURE supports — the single source of truth both
+    serving engines consult when ``decode_kernel`` resolves to "pallas".
+
+    Returns ``{"qkv": None | reason, "mlp": None | reason}``; ``None``
+    means fusable. Per-layer WEIGHT-form checks (dense vs QuantizedMatrix,
+    group sizes) happen at dispatch time in the engines — this classifies
+    only what is knowable from the config. Attention fusion has no
+    structural requirements beyond the engine-wide pre-LN layer body (GQA
+    H % KV == 0 is a construction invariant).
+    """
+    from ..ops.fused_decode import FUSABLE_ACTIVATIONS
+
+    qkv = None
+    if cfg.position == "rope" and cfg.rope_interleaved:
+        qkv = ("interleaved (GPT-J rotate-every-two) rope pairing: the "
+               "fused kernel's lane-roll rotate-half form does not cover it")
+    mlp = None
+    if cfg.n_experts > 0:
+        mlp = "MoE FFN (expert dispatch stays on the moe_layer path)"
+    elif cfg.activation not in FUSABLE_ACTIVATIONS:
+        mlp = (f"activation {cfg.activation!r} has no Mosaic lowering "
+               f"(fusable: {', '.join(FUSABLE_ACTIVATIONS)})")
+    elif cfg.norm not in ("rmsnorm", "layernorm"):
+        mlp = f"unknown norm {cfg.norm!r}"
+    return {"qkv": qkv, "mlp": mlp}
+
+
 def causal_attention(q, k, v, attention_impl: str = "auto", alibi=None,
                      causal: bool = True):
     """q: [B,T,H,D], k/v: [B,T,Hkv,D] → [B,T,H,D]. fp32 softmax.

@@ -164,6 +164,14 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
     import jax
     import jax.numpy as jnp
 
+    if impl not in ("auto", "capacity", "capacity_einsum", "ragged"):
+        # validate BEFORE the dispatch chain: an unrecognized string (e.g. a
+        # typo like "einsum" or "index") would otherwise silently fall
+        # through to the index-dispatch capacity path (ADVICE r5 #1)
+        raise ValueError(
+            f"moe impl must be one of 'auto', 'capacity', 'capacity_einsum', "
+            f"'ragged'; got {impl!r}")
+
     orig_shape = x.shape
     M = orig_shape[-1]
     xs = x.reshape(-1, M)

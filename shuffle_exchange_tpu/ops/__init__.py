@@ -1,4 +1,6 @@
 from .alibi_attention import alibi_flash_attention, flash_attention_lse
 from .evoformer_attn import ds4sci_evoformer_attention, evoformer_attention
 from .flash_attention import flash_attention
+from .fused_decode import (fused_mlp, fused_paged_decode_attention,
+                           fused_qkv_rope)
 from .rmsnorm import rmsnorm, rmsnorm_reference

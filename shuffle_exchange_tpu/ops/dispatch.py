@@ -20,3 +20,28 @@ def pallas_enabled() -> bool:
     import jax
 
     return jax.default_backend() == "tpu"
+
+
+def resolve_decode_kernel(mode: str) -> str:
+    """Resolve the serving ``decode_kernel`` knob to "pallas" or "xla".
+
+    - "xla": always the reference XLA layer body.
+    - "pallas": force the fused decode kernels (ops/fused_decode.py) —
+      errors surface instead of degrading; on a non-TPU backend this only
+      makes sense with SXT_FUSED_INTERPRET=1 (the CPU test hook).
+    - "auto": fused kernels iff the backend is TPU (and Pallas isn't
+      kill-switched) — the working-fallback contract for CPU/GPU hosts.
+
+    Caveat: the engines' runtime fallbacks catch TRACE-time kernel
+    failures; a Mosaic failure at XLA-compile time still surfaces (the
+    lowering gate in tests/test_mosaic_lowering.py pins the real serving
+    geometries precisely so that class is caught chip-free). Kill
+    switches: ``decode_kernel: "xla"`` per engine, ``SXT_DISABLE_PALLAS=1``
+    globally.
+    """
+    if mode not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f'decode_kernel must be "auto", "pallas" or "xla", got {mode!r}')
+    if mode == "auto":
+        return "pallas" if pallas_enabled() else "xla"
+    return mode

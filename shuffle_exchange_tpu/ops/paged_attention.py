@@ -299,9 +299,20 @@ def paged_extend_attention(q, ck, cv, block_table, start, nnew, *,
             return paged_extend_attention_pallas(q, ck, cv, block_table,
                                                  start, nnew,
                                                  alibi_slopes=alibi_slopes)
-        except Exception:
+        except Exception as e:
             if impl == "pallas":
                 raise
+            from ..utils.logging import warning_once
+
+            # a silent per-step degrade to the gather path hides real
+            # kernel regressions (ADVICE r5 #3) — say so once, with enough
+            # shape context to reproduce
+            warning_once(
+                "paged_extend_attention: Pallas kernel failed with "
+                f"{type(e).__name__} (q={tuple(q.shape)} "
+                f"kv_pool={tuple(ck.shape)} "
+                f"table={tuple(block_table.shape)}); falling back to the "
+                "gather path, which materializes the layer's KV")
     from ..inference.engine import extend_attention
     from ..inference.paged import gather_kv
 
@@ -335,9 +346,20 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
             return paged_decode_attention_pallas(q, ck, cv, block_table,
                                                  kv_len, layer=layer,
                                                  alibi_slopes=alibi_slopes)
-        except Exception:
+        except Exception as e:
             if impl == "pallas":
                 raise
+            from ..utils.logging import warning_once
+
+            # the bare except also swallows stacked-pool kernel failures —
+            # exactly the whole-layer KV copy the pooled mode exists to
+            # avoid (ADVICE r5 #3); make the degrade visible once
+            warning_once(
+                "paged_decode_attention: Pallas kernel failed with "
+                f"{type(e).__name__} (q={tuple(q.shape)} "
+                f"kv_pool={tuple(ck.shape)} pooled={pooled} "
+                f"table={tuple(block_table.shape)}); falling back to the "
+                "gather path, which materializes the layer's KV")
     from ..inference.paged import gather_kv
     from ..inference.engine import decode_attention
 

@@ -189,6 +189,27 @@ def quant_matmul(x, qm: QuantizedMatrix, impl: str = "auto"):
         raise ValueError(f"quant_matmul needs a 2D weight, got {qm.shape} "
                          "(stacked weights are sliced by lax.scan)")
     if impl == "pallas":
+        # kernel eligibility guard (ADVICE r5 #2): ineligible shapes would
+        # otherwise die deep in _quant_matmul_pallas with an opaque
+        # Mosaic/reshape error; name the violated constraint instead
+        K, N = qm.shape
+        gs = qm.group_size
+        if x.shape[-1] != K:
+            raise ValueError(
+                f"quant_matmul(impl='pallas'): x contraction dim "
+                f"{x.shape[-1]} != weight K {K}")
+        if K % gs:
+            raise ValueError(
+                f"quant_matmul(impl='pallas'): K={K} must be a multiple of "
+                f"group_size={gs} (one scale row per kernel K-block)")
+        if N % 128:
+            raise ValueError(
+                f"quant_matmul(impl='pallas'): N={N} must be a multiple of "
+                "128 (MXU lane tile)")
+        if gs % 128:
+            raise ValueError(
+                f"quant_matmul(impl='pallas'): group_size={gs} must be a "
+                "multiple of 128 (the kernel's K-block is one scale group)")
         return _quant_matmul_pallas(x, qm)
     # dequant fuses into the dot's operand: weights cross HBM quantized;
     # output in qm.dtype — the same contract as the Pallas path

@@ -199,18 +199,28 @@ class Engine:
                 # optimizer holds (stage_1_and_2.py:2231 averages the
                 # trainable partitions) — with the reference's
                 # deepspeed/linear LoRA, those ARE the rank-r factor
-                # tensors, mixed per-tensor. We match that: factors mix in
-                # FACTOR space, which is not equivalent to mixing the
-                # effective weights (mix(A) @ mix(B) != mix(A @ B)) — the
-                # same bias FedAvg-style LoRA averaging carries. The frozen
-                # base is identical on every replica, so it neither mixes
-                # nor needs to. (Round 5: lifted from document-and-reject —
-                # the reject was a parity gap, the reference runs this.)
+                # tensors, mixed per-tensor: consensus happens in FACTOR
+                # space, which is not equivalent to mixing the effective
+                # weights (mix(A) @ mix(B) != mix(A @ B)) — the same bias
+                # FedAvg-style LoRA averaging carries. The frozen base is
+                # identical on every replica, so it neither mixes nor needs
+                # to. Because that semantic change is easy to miss from a
+                # log line, the composition is opt-in (ADVICE r5 #5): the
+                # default restores the round-4 hard reject.
+                if not config.lora.ensemble_factor_mixing:
+                    raise ConfigError(
+                        "lora x shuffle_exchange: the ensemble mixes LoRA "
+                        "FACTOR tensors per-tensor, and factor-space "
+                        "consensus is biased (mix(A)@mix(B) != mix(A@B)). "
+                        "Set lora.ensemble_factor_mixing=true to opt in to "
+                        "the reference's behavior (see LoRASectionConfig "
+                        "docs), or disable shuffle_exchange/lora.")
                 logger.warning(
-                    "lora x shuffle_exchange: replica mixing averages the "
-                    "LoRA FACTOR tensors per-tensor (the reference's "
-                    "behavior); note mix(A)@mix(B) != mix(A@B), so "
-                    "consensus is factor-space, not weight-space")
+                    "lora x shuffle_exchange (ensemble_factor_mixing=true): "
+                    "replica mixing averages the LoRA FACTOR tensors "
+                    "per-tensor (the reference's behavior); note "
+                    "mix(A)@mix(B) != mix(A@B), so consensus is "
+                    "factor-space, not weight-space")
             lora_cfg = _ol.LoRAConfig(
                 lora_r=config.lora.lora_r, lora_alpha=config.lora.lora_alpha,
                 base_weight_sharding=config.lora.base_weight_sharding,

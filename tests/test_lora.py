@@ -266,12 +266,32 @@ def test_lora_hybrid_engine_fused_rollout_parity():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
 
 
+def test_lora_ensemble_requires_explicit_opt_in(devices8):
+    """The default config REJECTS lora x shuffle_exchange (ADVICE r5 #5):
+    factor-space per-tensor mixing is a semantic change from the round-4
+    hard fail, so it must be asked for by name."""
+    import pytest
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.config import ConfigError
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    model = Transformer(tiny(vocab=64, d=32, layers=2, heads=2, seq=32))
+    with pytest.raises(ConfigError, match="ensemble_factor_mixing"):
+        sxt.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "lora": {"enabled": True, "lora_r": 4},
+            "steps_per_print": 10**9,
+        }, method="RR", rings=2)
+
+
 def test_lora_composes_with_ensemble_mode(devices8):
-    """lora x shuffle_exchange (round 5, lifted from document-and-reject):
-    the reference's sync averages the trainable bit16 partitions — with
-    deepspeed/linear LoRA those ARE the factor tensors — so factor-space
-    per-tensor mixing is the reference behavior. Frozen base stays
-    replica-free; synchronization() converges the factor replicas."""
+    """lora x shuffle_exchange (round 5, lifted from document-and-reject;
+    round 6: behind lora.ensemble_factor_mixing): the reference's sync
+    averages the trainable bit16 partitions — with deepspeed/linear LoRA
+    those ARE the factor tensors — so factor-space per-tensor mixing is the
+    reference behavior. Frozen base stays replica-free; synchronization()
+    converges the factor replicas."""
     import jax
     import shuffle_exchange_tpu as sxt
     from shuffle_exchange_tpu.models import Transformer, tiny
@@ -280,7 +300,8 @@ def test_lora_composes_with_ensemble_mode(devices8):
     engine, *_ = sxt.initialize(model=model, config={
         "train_batch_size": 8,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-        "lora": {"enabled": True, "lora_r": 4},
+        "lora": {"enabled": True, "lora_r": 4,
+                 "ensemble_factor_mixing": True},
         "steps_per_print": 10**9,
     }, method="RR", rings=2)
     assert engine.ensemble and engine.replicas > 1

@@ -244,3 +244,19 @@ def test_index_dispatch_matches_einsum_dispatch():
         for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
+
+
+def test_moe_layer_rejects_unknown_impl():
+    """ADVICE r5 #1: a typo'd impl must raise, not silently fall through
+    to the index-dispatch capacity path."""
+    import pytest
+
+    from shuffle_exchange_tpu.moe.layer import moe_layer
+
+    rng = np.random.default_rng(0)
+    gate_w = np.zeros((16, 4), np.float32)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    for bad in ("einsum", "index", "gshard", ""):
+        # validation fires before any expert params are touched
+        with pytest.raises(ValueError, match="impl must be one of"):
+            moe_layer(gate_w, {}, x, impl=bad)

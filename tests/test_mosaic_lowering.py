@@ -113,6 +113,136 @@ def test_quant_matmul_lowers(bits):
     _tpu_lower(lambda x: _quant_matmul_pallas(x, qm), x)
 
 
+def test_fused_qkv_rope_lowers():
+    from shuffle_exchange_tpu.ops.fused_decode import fused_qkv_rope_pallas
+
+    B, D, H, KV, Dh = 4, 1024, 8, 4, 128
+    y = jnp.zeros((B, D), jnp.bfloat16)
+    wq = jnp.zeros((D, H * Dh), jnp.bfloat16)
+    wkv = jnp.zeros((D, KV * Dh), jnp.bfloat16)
+    cos = jnp.zeros((B, Dh // 2), jnp.float32)
+    _tpu_lower(lambda y, wq, wk, wv, c, s: fused_qkv_rope_pallas(
+        y, wq, wk, wv, cos=c, sin=s, n_heads=H, kv_heads=KV),
+        y, wq, wkv, wkv, cos, cos)
+
+    # append form: in-kernel DMA into the aliased paged pool
+    pool = jnp.zeros((32, KV, 64, Dh), jnp.bfloat16)
+    idx = jnp.zeros((B,), jnp.int32)
+    _tpu_lower(lambda y, wq, wk, wv, c, s, pk, pv, blk, off:
+               fused_qkv_rope_pallas(y, wq, wk, wv, cos=c, sin=s,
+                                     n_heads=H, kv_heads=KV, pool_k=pk,
+                                     pool_v=pv, blk=blk, off=off),
+               y, wq, wkv, wkv, cos, cos, pool, pool, idx, idx)
+
+
+@pytest.mark.parametrize("with_alibi", [False, True])
+def test_fused_splitk_attention_lowers(with_alibi):
+    from shuffle_exchange_tpu.models.transformer import alibi_slopes
+    from shuffle_exchange_tpu.ops.fused_decode import (
+        fused_paged_decode_attention_pallas)
+
+    B, H, KV, Dh, bs, nblk = 4, 8, 4, 128, 64, 32
+    q = jnp.zeros((B, 1, H, Dh), jnp.bfloat16)
+    pool = jnp.zeros((nblk, KV, bs, Dh), jnp.bfloat16)
+    bt = jnp.zeros((B, 8), jnp.int32)
+    kvl = jnp.zeros((B,), jnp.int32)
+    sl = jnp.asarray(alibi_slopes(H), jnp.float32) if with_alibi else None
+    _tpu_lower(lambda q, ck, cv, bt, kvl: fused_paged_decode_attention_pallas(
+        q, ck, cv, bt, kvl, alibi_slopes=sl, num_splits=2),
+        q, pool, pool, bt, kvl)
+
+    # stacked-pool + scalar-prefetched layer index
+    pool5 = jnp.zeros((3, nblk, KV, bs, Dh), jnp.bfloat16)
+    lyr = jnp.zeros((), jnp.int32)
+    _tpu_lower(lambda q, ck, cv, bt, kvl, lyr:
+               fused_paged_decode_attention_pallas(
+                   q, ck, cv, bt, kvl, layer=lyr, alibi_slopes=sl,
+                   num_splits=2), q, pool5, pool5, bt, kvl, lyr)
+
+
+@pytest.mark.parametrize("bits", [None, 8, 4, "fp8"])
+def test_fused_mlp_lowers(bits):
+    from shuffle_exchange_tpu.ops.fused_decode import (fused_mlp_pallas,
+                                                       fused_mlp_quant_pallas)
+    from shuffle_exchange_tpu.ops.quant_matmul import quantize_weight
+
+    B, D, F = 4, 1024, 2048
+    resid = jnp.zeros((B, D), jnp.bfloat16)
+    lnw = jnp.zeros((D,), jnp.float32)
+    if bits is None:
+        w = jnp.zeros((D, F), jnp.bfloat16)
+        wd = jnp.zeros((F, D), jnp.bfloat16)
+        _tpu_lower(lambda r, y, lnw, wu, wd, wg: fused_mlp_pallas(
+            r, y, lnw, None, wu, wd, wg, norm="rmsnorm",
+            activation="swiglu"), resid, resid, lnw, w, wd, w)
+        return
+    qg = quantize_weight(np.zeros((D, F), np.float32), group_size=256, bits=bits)
+    qd = quantize_weight(np.zeros((F, D), np.float32), group_size=256, bits=bits)
+    _tpu_lower(lambda r, y, lnw: fused_mlp_quant_pallas(
+        r, y, lnw, None, qg, qd, qg, norm="rmsnorm", activation="swiglu"),
+        resid, resid, lnw)
+
+
+@pytest.mark.parametrize("geom", [
+    # bench config-5 ladder entry the TPU box actually serves
+    dict(D=1536, H=12, KV=3, Dh=128, F=4096, bs=64, rope=True, bias=False,
+         gated=True),
+    # gpt2-style HF serving: Dh=64, MHA, biases, no rope
+    dict(D=768, H=12, KV=12, Dh=64, F=3072, bs=64, rope=False, bias=True,
+         gated=False),
+])
+def test_fused_decode_serving_geometries_lower(geom):
+    """The exact shapes the serving stack will hand the fused kernels on
+    chip (decode_kernel=auto flips TPU serving onto them sight-unseen, so
+    the lowering gate must cover the real geometries, not just nice round
+    ones)."""
+    from shuffle_exchange_tpu.ops.fused_decode import (
+        fused_mlp_pallas, fused_paged_decode_attention_pallas,
+        fused_qkv_rope_pallas)
+
+    B, D, H, KV, Dh, F, bs = (4, geom["D"], geom["H"], geom["KV"],
+                              geom["Dh"], geom["F"], geom["bs"])
+    y = jnp.zeros((B, D), jnp.bfloat16)
+    wq = jnp.zeros((D, H * Dh), jnp.bfloat16)
+    wkv = jnp.zeros((D, KV * Dh), jnp.bfloat16)
+    pool = jnp.zeros((64, KV, bs, Dh), jnp.bfloat16)
+    idx = jnp.zeros((B,), jnp.int32)
+    kw = {}
+    if geom["rope"]:
+        kw.update(cos=jnp.zeros((B, Dh // 2), jnp.float32),
+                  sin=jnp.zeros((B, Dh // 2), jnp.float32))
+    if geom["bias"]:
+        kw.update(bq=jnp.zeros((H * Dh,), jnp.float32),
+                  bk=jnp.zeros((KV * Dh,), jnp.float32),
+                  bv=jnp.zeros((KV * Dh,), jnp.float32))
+    _tpu_lower(lambda y, wq, wk, wv, pk, pv, blk, off: fused_qkv_rope_pallas(
+        y, wq, wk, wv, n_heads=H, kv_heads=KV, pool_k=pk, pool_v=pv,
+        blk=blk, off=off, **kw), y, wq, wkv, wkv, pool, pool, idx, idx)
+
+    q = jnp.zeros((B, 1, H, Dh), jnp.bfloat16)
+    bt = jnp.zeros((B, 32), jnp.int32)
+    kvl = jnp.zeros((B,), jnp.int32)
+    _tpu_lower(lambda q, ck, cv, bt, kvl: fused_paged_decode_attention_pallas(
+        q, ck, cv, bt, kvl, num_splits=2), q, pool, pool, bt, kvl)
+
+    resid = jnp.zeros((B, D), jnp.bfloat16)
+    lnw = jnp.zeros((D,), jnp.float32)
+    wu = jnp.zeros((D, F), jnp.bfloat16)
+    wd = jnp.zeros((F, D), jnp.bfloat16)
+    if geom["gated"]:
+        _tpu_lower(lambda r, y, lnw, wu, wd, wg: fused_mlp_pallas(
+            r, y, lnw, None, wu, wd, wg, norm="rmsnorm",
+            activation="swiglu"), resid, resid, lnw, wu, wd, wu)
+    else:
+        lnb = jnp.zeros((D,), jnp.float32)
+        bu = jnp.zeros((F,), jnp.float32)
+        bd = jnp.zeros((D,), jnp.float32)
+        _tpu_lower(lambda r, y, lnw, lnb, wu, wd, bu, bd: fused_mlp_pallas(
+            r, y, lnw, lnb, wu, wd, None, b_up=bu, b_down=bd,
+            norm="layernorm", activation="gelu_new"),
+            resid, resid, lnw, lnb, wu, wd, bu, bd)
+
+
 def test_rmsnorm_lowers():
     from shuffle_exchange_tpu.ops.rmsnorm import rmsnorm
 

@@ -538,3 +538,26 @@ def test_evoformer_attention_parity_and_grads():
         ds4sci_evoformer_attention(q, k, v, [b2])
     with pytest.raises(ValueError, match="bias2 shape"):
         ds4sci_evoformer_attention(q, k, v, [b1, b1])
+
+
+def test_quant_matmul_pallas_eligibility_guard():
+    """ADVICE r5 #2: impl="pallas" validates kernel eligibility up front
+    with a descriptive error instead of an opaque Mosaic failure."""
+    import jax.numpy as jnp
+
+    from shuffle_exchange_tpu.ops.quant_matmul import (quant_matmul,
+                                                       quantize_weight)
+
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((256, 256)),
+                    jnp.float32)
+    qm = quantize_weight(w, group_size=128)
+    with pytest.raises(ValueError, match="contraction dim"):
+        quant_matmul(jnp.zeros((4, 128), jnp.float32), qm, impl="pallas")
+    qm64 = quantize_weight(w, group_size=64)
+    with pytest.raises(ValueError, match="group_size=64"):
+        quant_matmul(jnp.zeros((4, 256), jnp.float32), qm64, impl="pallas")
+    w_odd = jnp.asarray(np.random.default_rng(0).standard_normal((256, 192)),
+                        jnp.float32)
+    qm_odd = quantize_weight(w_odd, group_size=128)
+    with pytest.raises(ValueError, match="multiple of.*128"):
+        quant_matmul(jnp.zeros((4, 256), jnp.float32), qm_odd, impl="pallas")
