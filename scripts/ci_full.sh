@@ -3,9 +3,14 @@
 # The fast development gate is: pytest tests/ -q -m "not slow"
 set -e
 cd "$(dirname "$0")/.."
-# Fused-decode parity first (kernel + engine-level, CPU interpret mode) —
-# a broken serving kernel should fail the run before the long tail does;
-# the main run then skips the two files so nothing executes twice.
-python -m pytest tests/test_fused_decode.py tests/test_mosaic_lowering.py -q "$@"
+# Fused-decode parity + the resilience suite first — a broken serving kernel
+# or a rotten crash-recovery path should fail the run before the long tail
+# does. test_resilience.py drives injected crash→restart→bit-exact-resume
+# cycles (kill-during-save, torn latest, corrupted shards) through the real
+# ElasticAgent; its fast cases are unmarked so the tier-1 "not slow" gate
+# always exercises the recovery path too. The main run then skips the three
+# files so nothing executes twice.
+python -m pytest tests/test_fused_decode.py tests/test_mosaic_lowering.py \
+    tests/test_resilience.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
-    --ignore=tests/test_mosaic_lowering.py "$@"
+    --ignore=tests/test_mosaic_lowering.py --ignore=tests/test_resilience.py "$@"

@@ -1,9 +1,16 @@
 from .engine import (
+    CheckpointCorruption,
     CheckpointEngine,
     MockCheckpointEngine,
+    NativeCheckpointEngine,
+    NoLoadableCheckpoint,
     OrbaxCheckpointEngine,
+    RECOVERABLE_ERRORS,
     get_checkpoint_engine,
+    list_complete_tags,
+    load_with_fallback,
     read_latest_tag,
+    resolve_tag_candidates,
     write_latest_tag,
 )
 from .universal import consolidate_to_fp32

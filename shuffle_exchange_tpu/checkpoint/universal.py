@@ -26,14 +26,14 @@ def consolidate_to_fp32(checkpoint_dir: str, output_file: str, tag: Optional[str
     replica_mode: how to collapse the decentralized replica dim if present —
     "mean" (consensus, matches synchronization()) or "first".
     """
-    from .engine import OrbaxCheckpointEngine, read_latest_tag
+    from .engine import OrbaxCheckpointEngine, load_with_fallback
 
-    tag = tag or read_latest_tag(checkpoint_dir)
-    if tag is None:
-        raise FileNotFoundError(f"No 'latest' tag in {checkpoint_dir}")
-    path = os.path.join(checkpoint_dir, tag, "model")
     eng = OrbaxCheckpointEngine()
-    master = eng.load(path)  # host restore, no target
+
+    def load_tag(cand):
+        return cand, eng.load(os.path.join(checkpoint_dir, cand, "model"))
+
+    tag, master = load_with_fallback(checkpoint_dir, tag, load_tag)
 
     host_meta_path = os.path.join(checkpoint_dir, tag, "host_state.json")
     has_replicas = False

@@ -11,6 +11,7 @@ from .config import (
     ActivationCheckpointingConfig,
     ElasticityConfig,
     CheckpointConfig,
+    ResilienceConfig,
 )
 from .config_utils import ConfigError, ConfigModel
 

@@ -329,6 +329,54 @@ class CheckpointConfig(ConfigModel):
 
 
 # ---------------------------------------------------------------------------
+# Resilience: preemption-safe saves, crash recovery, runtime guards
+# (failure-recovery literature: Gemini SOSP'23, Bamboo NSDI'23 — the
+# save-path atomicity + fast-restore loop is the core of training resilience)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResilienceConfig(ConfigModel):
+    """Knobs for the resilience layer (runtime/resilience.py).
+
+    ``preemption_save``: install a SIGTERM hook that runs one final
+    synchronous ``save_checkpoint`` before exit (preemptible TPU pods send
+    SIGTERM ahead of reclaim). The hook arms itself once the engine knows a
+    checkpoint directory — ``save_dir`` here, or the first save/load's dir.
+
+    ``keep_last_n``: checkpoint GC after each committed save — keep the N
+    newest fully-committed tags; the tag ``latest`` points at is never
+    deleted, and staging leftovers from crashed saves are swept. 0 keeps all.
+
+    ``nonfinite_policy``: what the train step does when the loss or grad
+    norm comes out non-finite (beyond the fp16 overflow skip):
+      - ``skip``     — drop the update in-graph (free: no host sync);
+      - ``rollback`` — restore the last committed checkpoint in place
+                       (raises if no checkpoint exists yet, or if a second
+                       rollback fires with no progress since the first);
+      - ``raise``    — raise NonFiniteLossError (an ElasticAgent above can
+                       restart the worker);
+      - ``off``      — reference behavior: the bad update is applied.
+
+    ``watchdog_timeout_s``: per-step watchdog; a step exceeding it is
+    flagged through the monitor (``resilience/hung_steps``). 0 disables.
+    """
+
+    preemption_save: bool = config_field(True)
+    save_dir: Optional[str] = config_field(None)
+    keep_last_n: int = config_field(0, ge=0)
+    nonfinite_policy: str = config_field("skip")
+    watchdog_timeout_s: float = config_field(0.0, ge=0.0)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.nonfinite_policy not in ("off", "skip", "rollback", "raise"):
+            raise ConfigError(
+                "resilience.nonfinite_policy must be off|skip|rollback|raise, "
+                f"got {self.nonfinite_policy!r}")
+
+
+# ---------------------------------------------------------------------------
 # Fork section: Shuffle-exchange decentralized weight sync (reference §2.1,
 # stage_1_and_2.py:163-241; also settable via initialize() kwargs)
 # ---------------------------------------------------------------------------
@@ -510,6 +558,7 @@ class SXConfig(ConfigModel):
     comms_logger: CommsLoggerConfig = config_field(default_factory=CommsLoggerConfig)
     elasticity: ElasticityConfig = config_field(default_factory=ElasticityConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = config_field(default_factory=ResilienceConfig)
 
     lora: LoRASectionConfig = config_field(default_factory=LoRASectionConfig,
                                            aliases=("optimized_linear",))

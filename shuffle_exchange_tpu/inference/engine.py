@@ -217,6 +217,34 @@ class InferenceEngine:
             params = self._quantize(params)
         self.params = self._place(params)
 
+    # -- checkpoint-backed serving (resilience layer) -------------------
+
+    @classmethod
+    def from_checkpoint(cls, model, ckpt_dir: str,
+                        config: Optional[InferenceConfig] = None,
+                        tag: Optional[str] = None) -> "InferenceEngine":
+        """Serve straight from a training checkpoint directory, with the
+        same torn-latest / corrupted-tag fallback as the trainer (see
+        ``load_serving_weights``). Works for every engine class (v2
+        inherits)."""
+        return cls(model, load_serving_weights(ckpt_dir, model, tag=tag), config)
+
+    def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None) -> bool:
+        """Hot-swap serving weights from the newest complete checkpoint in
+        ``ckpt_dir`` (a serving fleet following a live trainer). Degrades
+        gracefully: when no tag is loadable — mid-save, torn ``latest``,
+        corrupted shards — the engine KEEPS SERVING its current weights and
+        returns False instead of raising."""
+        try:
+            params = load_serving_weights(ckpt_dir, self.model, tag=tag)
+        except (ValueError, OSError) as e:
+            logger.warning(f"reload_weights: no loadable checkpoint in "
+                           f"{ckpt_dir} ({type(e).__name__}: {e}); continuing "
+                           "to serve the current weights")
+            return False
+        self.update_params(params)
+        return True
+
     # -- sharding (AutoTP analog: inference/engine.py:247 TP group create) --
 
     def _place(self, params):
@@ -663,26 +691,43 @@ def load_serving_weights(ckpt_dir: str, model, tag: Optional[str] = None):
     (reference: ``init_inference(checkpoint=...)`` + the mp-sharded
     checkpoint loaders, ``runtime/state_dict_factory.py`` /
     ``module_inject/load_checkpoint.py``). Works for checkpoints written by
-    either checkpoint engine; the optimizer bytes are never read."""
+    either checkpoint engine; the optimizer bytes are never read.
+
+    Degrades gracefully like the trainer's ``load_checkpoint``: native
+    loads are checksum-verified, and when the ``latest`` pointer is torn or
+    the tag it names fails an integrity check, serving falls back to the
+    newest *complete* earlier tag (one warning) instead of refusing to
+    start. An explicit ``tag`` never falls back."""
     import os
 
     import jax
 
     from ..checkpoint.engine import (NativeCheckpointEngine, OrbaxCheckpointEngine,
-                                     read_latest_tag)
+                                     RECOVERABLE_ERRORS, load_with_fallback)
 
-    tag = tag or read_latest_tag(ckpt_dir)
-    if tag is None:
-        raise ValueError(f"no 'latest' tag in {ckpt_dir} and none given")
-    path = os.path.join(ckpt_dir, tag, "model")
     target = jax.eval_shape(model.init, jax.random.PRNGKey(0))
-    errors = []
-    for eng in (OrbaxCheckpointEngine(), NativeCheckpointEngine()):
-        try:
-            return eng.load(path, target=target)
-        except Exception as e:
-            errors.append(f"{type(eng).__name__}: {type(e).__name__}")
-    raise ValueError(f"could not load {path} with any checkpoint engine ({errors})")
+
+    def load_tag(cand):
+        path = os.path.join(ckpt_dir, cand, "model")
+        errors, recoverable = [], None
+        for eng in (OrbaxCheckpointEngine(), NativeCheckpointEngine()):
+            try:
+                return eng.load(path, target=target)
+            except RECOVERABLE_ERRORS as e:
+                recoverable = e
+                errors.append(f"{type(eng).__name__}: {type(e).__name__}: {e}")
+            except Exception as e:
+                errors.append(f"{type(eng).__name__}: {type(e).__name__}: {e}")
+        if recoverable is not None:
+            # integrity-shaped failure: let load_with_fallback try an
+            # earlier complete tag
+            raise recoverable
+        # structural (wrong model shape etc.): retrying older tags would
+        # only bury the real error under 'unusable tag' warnings
+        raise ValueError(f"could not load {path} with any checkpoint engine "
+                         f"({errors})")
+
+    return load_with_fallback(ckpt_dir, tag, load_tag, what="serving checkpoint")
 
 
 def init_inference(model=None, params=None, config=None, checkpoint: Optional[str] = None,

@@ -29,6 +29,17 @@ from .paged import (BlockedAllocator, PagedKVCache, append_token_kv, blocks_need
                     paged_decode_attention, write_prefill_kv)
 
 
+
+
+def _donate_cache():
+    """KV-pool donation for the paged programs, disabled when the persistent
+    compile cache + CPU backend combination makes donation unsafe (see
+    utils/placement.cache_safe_donate_argnums)."""
+    from ..utils.placement import cache_safe_donate_argnums
+
+    return cache_safe_donate_argnums((1,))
+
+
 @dataclasses.dataclass
 class SequenceDescriptor:
     """Host state for one live sequence (ragged/sequence_descriptor.py:59)."""
@@ -102,7 +113,7 @@ class InferenceEngineV2(InferenceEngine):
             return fn
         import jax
 
-        fn = jax.jit(self._paged_prefill_impl, donate_argnums=(1,))
+        fn = jax.jit(self._paged_prefill_impl, donate_argnums=_donate_cache())
         self._prefill_cache[(p, tpad)] = fn
         return fn
 
@@ -155,7 +166,7 @@ class InferenceEngineV2(InferenceEngine):
             return fn
         import jax
 
-        fn = jax.jit(self._extend_impl, donate_argnums=(1,))
+        fn = jax.jit(self._extend_impl, donate_argnums=_donate_cache())
         self._extend_cache[c] = fn
         return fn
 
@@ -215,7 +226,7 @@ class InferenceEngineV2(InferenceEngine):
             return fn
         import jax
 
-        fn = jax.jit(self._paged_decode_impl, donate_argnums=(1,))
+        fn = jax.jit(self._paged_decode_impl, donate_argnums=_donate_cache())
         self._decode_cache[b] = fn
         return fn
 
@@ -482,7 +493,7 @@ class InferenceEngineV2(InferenceEngine):
                 step, (cache, tok, pos, logits0), None, length=n_steps)
             return cache, toks.T, logits       # toks [B, n_steps]
 
-        fn = jax.jit(impl, donate_argnums=(1,))
+        fn = jax.jit(impl, donate_argnums=_donate_cache())
         self._loop_cache[key] = fn
         return fn
 
@@ -533,6 +544,23 @@ class InferenceEngineV2(InferenceEngine):
             d.seen_tokens += n_steps
             d.last_logits = last_logits[i]
         return np.asarray(toks)
+
+    def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None,
+                       force: bool = False) -> bool:
+        """Hot-swap serving weights from a training checkpoint (see the base
+        engine), with a continuous-batching guard: live sequences hold KV
+        entries computed under the OLD weights, so swapping under them would
+        silently corrupt their continuations. With live sequences the swap
+        is refused (returns False, keeps serving) unless ``force=True`` —
+        callers that accept the approximation (e.g. RLHF rollouts mid-
+        episode) can opt in; everyone else flushes or drains first."""
+        if self._seqs and not force:
+            logger.warning(
+                f"reload_weights: {len(self._seqs)} live sequences hold KV "
+                "from the current weights; refusing the hot-swap (drain or "
+                "flush() them, or pass force=True)")
+            return False
+        return super().reload_weights(ckpt_dir, tag=tag)
 
     def flush(self, uids: Sequence[int]) -> None:
         """Free all state for finished sequences (engine_v2.py:242)."""

@@ -25,36 +25,73 @@ class ElasticAgent:
     and ``load_checkpoint`` from its save dir if present — the agent itself
     is state-free. ``on_failure(exc, restart_count)`` may veto the restart
     by returning False (e.g. for config errors that will never succeed).
+
+    Backoff is exponential from ``backoff_s`` up to the ``max_backoff_s``
+    ceiling. When an attempt ran healthy for at least ``healthy_reset_s``
+    before failing, ``restart_count`` resets first — a long job's restart
+    budget guards against crash *loops*, not against unrelated failures
+    days apart. Restart events are emitted to ``monitor`` (a
+    ``MonitorMaster`` or anything with ``write_events``) under
+    ``resilience/restarts``.
     """
 
     def __init__(self, max_restarts: int = 3, backoff_s: float = 2.0,
-                 on_failure: Optional[Callable] = None):
+                 on_failure: Optional[Callable] = None,
+                 max_backoff_s: float = 60.0,
+                 healthy_reset_s: Optional[float] = None,
+                 monitor=None):
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.healthy_reset_s = healthy_reset_s
         self.on_failure = on_failure
+        self.monitor = monitor
         self.restart_count = 0
+        self.total_restarts = 0
+
+    def _emit_restart(self) -> None:
+        if self.monitor is None:
+            return
+        try:
+            self.monitor.write_events([
+                ("resilience/restarts", self.total_restarts, self.total_restarts)])
+        except Exception:
+            logger.exception("elastic agent: monitor write failed")
 
     def run(self, train_fn: Callable):
         while True:
+            started = time.monotonic()
             try:
                 return train_fn(self.restart_count)
             except KeyboardInterrupt:
                 raise
             except Exception as e:
+                healthy_for = time.monotonic() - started
+                if (self.healthy_reset_s is not None and self.restart_count
+                        and healthy_for >= self.healthy_reset_s):
+                    logger.info(
+                        f"elastic agent: attempt ran healthy for "
+                        f"{healthy_for:.0f}s (>= {self.healthy_reset_s:.0f}s); "
+                        f"resetting restart budget ({self.restart_count} -> 0)")
+                    self.restart_count = 0
                 if self.on_failure is not None and self.on_failure(e, self.restart_count) is False:
                     raise
                 if self.restart_count >= self.max_restarts:
                     logger.error(f"elastic agent: giving up after {self.restart_count} restarts")
                     raise
                 self.restart_count += 1
-                delay = min(60.0, self.backoff_s * (2.0 ** (self.restart_count - 1)))
+                self.total_restarts += 1
+                self._emit_restart()
+                delay = min(self.max_backoff_s, self.backoff_s * (2.0 ** (self.restart_count - 1)))
                 logger.warning(f"elastic agent: worker failed ({type(e).__name__}: {e}); "
                                f"restart {self.restart_count}/{self.max_restarts} in {delay:.0f}s")
                 time.sleep(delay)
 
 
 def run_elastic(train_fn: Callable, max_restarts: int = 3, backoff_s: float = 2.0,
-                on_failure: Optional[Callable] = None):
+                on_failure: Optional[Callable] = None, max_backoff_s: float = 60.0,
+                healthy_reset_s: Optional[float] = None, monitor=None):
     """Functional entry: supervise ``train_fn`` (see ElasticAgent)."""
     return ElasticAgent(max_restarts=max_restarts, backoff_s=backoff_s,
-                        on_failure=on_failure).run(train_fn)
+                        on_failure=on_failure, max_backoff_s=max_backoff_s,
+                        healthy_reset_s=healthy_reset_s, monitor=monitor).run(train_fn)

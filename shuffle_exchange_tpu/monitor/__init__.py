@@ -1,5 +1,7 @@
 """Experiment monitoring fan-out (reference ``monitor/monitor.py:13,30``)."""
 
-from .monitor import Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor, CSVMonitor
+from .monitor import (Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor,
+                      CSVMonitor, InMemoryMonitor)
 
-__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "CSVMonitor"]
+__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
+           "CSVMonitor", "InMemoryMonitor"]

@@ -160,8 +160,39 @@ class CSVMonitor(Monitor):
                 w.writerow([int(step), float(value)])
 
 
+class InMemoryMonitor(Monitor):
+    """Bounded in-process ring of recent events — always on.
+
+    The resilience layer (runtime/resilience.py, launcher/elastic_agent.py)
+    writes operational counters (``resilience/restarts``, ``.../rollbacks``,
+    ``.../ckpt_save_s``, ``.../hung_steps``) that must be observable even
+    when no external backend is configured: tests assert on them and a
+    debugger can read ``engine.monitor.memory_monitor.events`` post-mortem.
+    """
+
+    def __init__(self, maxlen: int = 512):
+        super().__init__(enabled=True)
+        from collections import deque
+
+        self.events = deque(maxlen=maxlen)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        self.events.extend(event_list)
+
+    def latest(self, label: str):
+        """Most recent value recorded under ``label``, or None."""
+        for lbl, value, _ in reversed(self.events):
+            if lbl == label:
+                return value
+        return None
+
+
 class MonitorMaster(Monitor):
-    """Fan-out to every enabled backend (reference monitor/monitor.py:30)."""
+    """Fan-out to every enabled backend (reference monitor/monitor.py:30).
+
+    ``enabled`` reflects the configured external backends only — the
+    always-on in-memory sink records every ``write_events`` regardless, so
+    resilience counters are never lost to an unconfigured monitor."""
 
     def __init__(self, monitor_config):
         super().__init__(enabled=True)
@@ -169,11 +200,13 @@ class MonitorMaster(Monitor):
         self.wandb_monitor = WandbMonitor(monitor_config.wandb)
         self.csv_monitor = CSVMonitor(monitor_config.csv_monitor)
         self.comet_monitor = CometMonitor(monitor_config.comet)
+        self.memory_monitor = InMemoryMonitor()
         self._sinks: List[Monitor] = [m for m in
                                       (self.tb_monitor, self.wandb_monitor,
                                        self.csv_monitor, self.comet_monitor)
                                       if m.enabled]
         self.enabled = bool(self._sinks)
+        self._sinks.append(self.memory_monitor)
 
     def write_events(self, event_list: Sequence[Event]) -> None:
         for sink in self._sinks:

@@ -68,7 +68,9 @@ class AsyncIOEngine:
             with open(path, "rb") as f:
                 f.seek(offset)
                 data = f.read(arr.nbytes)
-            arr.view(np.uint8).reshape(-1)[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+            # reshape-then-view: .view() on a 0-d (scalar) array rejects
+            # itemsize changes, reshape(-1) first makes it byte-addressable
+            arr.reshape(-1).view(np.uint8)[:len(data)] = np.frombuffer(data, dtype=np.uint8)
             self._sync_next += 1
             self._sync_results[self._sync_next] = len(data)
             return self._sync_next
@@ -108,6 +110,13 @@ class AsyncIOEngine:
 
     def close(self) -> None:
         if self._handle is not None:
+            # Drain before destroy: tearing the thread pool down with
+            # requests in flight (e.g. after a crashed/aborted checkpoint
+            # save) aborts the process from the native side.
+            try:
+                self.wait_all()
+            except Exception:
+                pass
             self._lib.sxt_aio_destroy(self._handle)
             self._handle = None
 

@@ -359,10 +359,14 @@ class Engine:
                     jax.random.PRNGKey(seed))
         else:
             def place_master(p, sh):
+                from ..utils.placement import owned_device_put
+
                 arr = np.asarray(jax.device_get(p), dtype=np.float32)
                 if self.ensemble:
                     arr = np.broadcast_to(arr, (self.replicas,) + arr.shape)
-                return jax.device_put(arr, sh)
+                # owned_device_put: master is donated every step — it must
+                # never alias host numpy memory (utils/placement.py)
+                return owned_device_put(arr, sh)
 
             master = jax.tree_util.tree_map(place_master, params, self.master_shardings)
             if self._lora is not None:
@@ -482,6 +486,13 @@ class Engine:
         from ..monitor import MonitorMaster
 
         self.monitor = MonitorMaster(config)
+        # resilience layer (runtime/resilience.py): preemption hook, step
+        # watchdog, non-finite policy, checkpoint GC + save timing counters
+        from .resilience import ResilienceManager
+
+        self.resilience = ResilienceManager(config.resilience, self.monitor)
+        self._last_ckpt_dir: Optional[str] = config.resilience.save_dir
+        self.resilience.attach_engine(self)
         # flops profiler auto-run (reference runtime/engine.py:320-321)
         self.flops_profiler = None
         if config.flops_profiler.enabled:
@@ -1020,6 +1031,15 @@ class Engine:
 
             return optax.apply_updates(master, updates), new_o
 
+        # Non-finite sentinel (resilience layer, beyond the fp16 overflow
+        # skip): "skip" folds the guard into the jitted step — the bad
+        # update is dropped in-graph at zero host cost; "rollback"/"raise"
+        # surface the flag so train_batch can react (one scalar sync/step);
+        # "off" restores the reference behavior (the bad update applies).
+        nonfinite_policy = cfg.resilience.nonfinite_policy
+        nonfinite_guard = nonfinite_policy != "off"
+        skip_nonfinite = nonfinite_policy == "skip"
+
         def train_step(state: TrainState, batch, mix, rng, lr_mult):
             p16 = fwd_weights(state.master, mix, state.step)
             fro16 = fro16_of(state.frozen)
@@ -1036,6 +1056,15 @@ class Engine:
                 grads = jax.tree_util.tree_map(
                     lambda g: quantize_dequantize(g, group_size=2048), grads)
             overflow = ls.check_overflow(grads) if fp16_cfg.enabled else jnp.asarray(False)
+            grad_norm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree_util.tree_leaves(grads))).real
+            # "beyond the fp16 overflow skip": an overflow already has its
+            # own handling (skip + halve the loss scale) — it must not look
+            # like a non-finite step, or rollback/raise policies would
+            # treat every routine dynamic-loss-scale overflow as fatal.
+            nonfinite = (jnp.logical_not(jnp.isfinite(loss) & jnp.isfinite(grad_norm))
+                         & jnp.logical_not(overflow)
+                         if nonfinite_guard else jnp.asarray(False))
+            bad = (overflow | nonfinite) if skip_nonfinite else overflow
             # lr_mult only participates when dynamic batching is live — the
             # common path skips the O(params) update rescale entirely
             # (_build_programs runs after the dyn-plan setup, so this is a
@@ -1043,16 +1072,17 @@ class Engine:
             new_master, new_opt = apply_update(
                 grads, state.opt_state, state.master,
                 lr_mult if self._dyn_plan is not None else None)
-            new_master = _tree_select(overflow, state.master, new_master)
-            new_opt = _tree_select(overflow, state.opt_state, new_opt)
+            new_master = _tree_select(bad, state.master, new_master)
+            new_opt = _tree_select(bad, state.opt_state, new_opt)
             new_scale = ls.update(state.loss_scale, overflow, fp16_cfg)
             new_state = TrainState(master=new_master, opt_state=new_opt, loss_scale=new_scale,
-                                   step=state.step + jnp.where(overflow, 0, 1).astype(jnp.int32),
+                                   step=state.step + jnp.where(bad, 0, 1).astype(jnp.int32),
                                    frozen=state.frozen)
-            grad_norm = jnp.sqrt(sum(jnp.vdot(g, g) for g in jax.tree_util.tree_leaves(grads))).real
-            return new_state, loss, overflow, grad_norm
+            return new_state, loss, overflow, grad_norm, nonfinite
 
-        donate = (0,)
+        from ..utils.placement import cache_safe_donate_argnums
+
+        donate = cache_safe_donate_argnums((0,))
         self._train_step = jax.jit(train_step, donate_argnums=donate)
 
         def eval_step(state: TrainState, batch, mix, rng):
@@ -1104,7 +1134,7 @@ class Engine:
                               state.step + jnp.where(overflow, 0, 1).astype(jnp.int32),
                               state.frozen), overflow
 
-        self._apply_only = jax.jit(apply_only, donate_argnums=(0,))
+        self._apply_only = jax.jit(apply_only, donate_argnums=donate)
 
         def materialize(state: TrainState, mix):
             # With lora, module_weights consumers (hybrid engine rollouts,
@@ -1363,6 +1393,11 @@ class Engine:
                 if it is None:
                     raise ConfigError("train_batch needs a batch, a data_iter, or training_data at init")
                 batch = next(it)
+        from ..testing import faults
+
+        if faults.ACTIVE:
+            faults.maybe_sigterm("sigterm_mid_step", index=self.global_steps)
+            batch = faults.poison_batch(batch, self.global_steps)
         if self._host_opt is not None:
             return self._host_train_batch(batch)
         self.tput_timer.start()
@@ -1396,8 +1431,25 @@ class Engine:
                 "includes XLA compilation — set profile_step>=2 for steady-state TFLOPS")
         t0 = time.time() if profiling else 0.0
         lr_mult_arr = np.asarray(lr_mult, np.float32)
-        self.state, loss, overflow, grad_norm = self._train_step(
-            self.state, shaped, mix, rng, lr_mult_arr)
+        self.resilience.step_begin(self.global_steps)
+        try:
+            self.state, loss, overflow, grad_norm, nonfinite = self._train_step(
+                self.state, shaped, mix, rng, lr_mult_arr)
+            if self.resilience.watchdog.timeout_s > 0:
+                # dispatch is async: the watchdog must cover device
+                # execution, not just the enqueue
+                import jax
+
+                jax.block_until_ready(loss)
+        finally:
+            self.resilience.step_end()
+        if self.resilience.nonfinite_host_check and bool(nonfinite):
+            # rollback restores the last committed checkpoint in place;
+            # raise propagates (an ElasticAgent above restarts the worker)
+            self.resilience.on_nonfinite(self)
+            self.timers(TRAIN_BATCH_TIMER).stop()
+            self.tput_timer.stop(global_step=True)
+            return loss
         if profiling:
             import jax
 
@@ -1681,46 +1733,69 @@ class Engine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None,
                         exclude_frozen_parameters: bool = False):
         """Write the full training state (sharded, async-capable) + host
-        metadata + `latest` tag (reference engine.save_checkpoint :3343)."""
+        metadata + `latest` tag (reference engine.save_checkpoint :3343).
+
+        Atomicity: every item is written into a ``<tag>.tmp-<nonce>``
+        staging directory; the commit is a single directory rename followed
+        by an atomic ``latest`` pointer update — a crash at ANY point
+        (shard write, manifest write, pre-commit, pre-latest) leaves the
+        previous committed checkpoint loadable."""
         import json
         import os
+        import shutil
 
-        from ..checkpoint.engine import validate_tag, write_latest_tag
+        from ..checkpoint.engine import staging_path, validate_tag
+        from ..testing import faults
 
         import jax
 
+        t0 = time.time()
         tag = tag or f"global_step{self.global_steps}"
         self._finalize_pending_checkpoint()   # at most one decoupled save in flight
         self._ensure_opt_resident()
         validate_tag(tag, self.config.checkpoint.tag_validation)
-        path = os.path.join(save_dir, tag)
+        final_path = os.path.join(save_dir, tag)
+        staging = staging_path(final_path)
+        # Clear a stale staging dir from a crashed earlier attempt (single
+        # cleaner + barrier on multi-host). The committed tag, if any, is
+        # untouched until the rename-commit below.
+        if jax.process_index() == 0 and os.path.isdir(staging):
+            shutil.rmtree(staging)
+        if jax.process_count() > 1:
+            from ..parallel import comm as _comm
+
+            _comm.barrier("ckpt_tag_clean")
         eng = self._checkpoint_engine()
         # Model weights and optimizer state are separate items so that
         # load_module_only never reads the (2x-params) optimizer bytes.
         if self._host_opt is not None:
-            eng.save(self._host_opt.master_tree(), os.path.join(path, "model"))
-            eng.save(self._host_opt.state_dict(), os.path.join(path, "opt"))
+            items = [("model", self._host_opt.master_tree()),
+                     ("opt", self._host_opt.state_dict())]
         else:
-            eng.save(self.state.master, os.path.join(path, "model"))
-            eng.save({"opt_state": self.state.opt_state,
-                      "loss_scale": self.state.loss_scale,
-                      "step": self.state.step}, os.path.join(path, "opt"))
+            items = [("model", self.state.master),
+                     ("opt", {"opt_state": self.state.opt_state,
+                              "loss_scale": self.state.loss_scale,
+                              "step": self.state.step})]
         # LoRA frozen base: separate item, droppable (reference
         # exclude_frozen_parameters, engine.py save_checkpoint) — an
         # adapter-only checkpoint restores against a base loaded elsewhere.
         if self._lora is not None and not exclude_frozen_parameters:
-            eng.save(self.state.frozen, os.path.join(path, "frozen"))
+            items.append(("frozen", self.state.frozen))
+        for i, (name, obj) in enumerate(items):
+            if faults.ACTIVE:
+                faults.maybe_crash("ckpt_item_save", index=i)
+            eng.save(obj, os.path.join(staging, name))
         # Host-side metadata: single-writer (process 0) on shared storage.
         if jax.process_index() == 0:
             host = self._host_state()
             if client_state:
                 host["client_state"] = client_state
-            os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, "host_state.json"), "w") as f:
+            os.makedirs(staging, exist_ok=True)
+            with open(os.path.join(staging, "host_state.json"), "w") as f:
                 json.dump(host, f, default=str)
             # recovery breadcrumb (reference engine.py writes a recovery
             # script into checkpoints): everything a restart needs
-            with open(os.path.join(path, "recovery.json"), "w") as f:
+            with open(os.path.join(staging, "recovery.json"), "w") as f:
                 json.dump({
                     "load_dir": os.path.abspath(save_dir), "tag": tag,
                     "global_steps": self.global_steps,
@@ -1734,24 +1809,46 @@ class Engine:
             # Decoupled writer (reference decoupled_checkpoint_engine.py:68):
             # writes continue in the background; commit + `latest` tag land
             # at the next step boundary (engine.py:2431) or next save/load.
-            self._pending_ckpt = (eng, tag, save_dir, path)
-            log_dist(f"checkpoint {path} writing in background (decoupled)", ranks=[0])
-            return path
-        self._commit_checkpoint(eng, tag, save_dir, path)
-        return path
+            self._pending_ckpt = (eng, tag, save_dir, staging, final_path, t0)
+            log_dist(f"checkpoint {final_path} writing in background (decoupled)", ranks=[0])
+            return final_path
+        self._commit_checkpoint(eng, tag, save_dir, staging, final_path, t0)
+        return final_path
 
-    def _commit_checkpoint(self, eng, tag: str, save_dir: str, path: str) -> None:
+    def _commit_checkpoint(self, eng, tag: str, save_dir: str, staging: str,
+                           path: str, t0: float) -> None:
+        import os
+
         import jax
 
-        from ..checkpoint.engine import write_latest_tag
+        from ..checkpoint.engine import commit_staged, write_latest_tag
+        from ..testing import faults
 
-        eng.commit(tag)
-        if jax.process_index() == 0:
-            write_latest_tag(save_dir, tag)
+        if faults.ACTIVE:
+            faults.maybe_crash("ckpt_pre_commit")
+        eng.commit(tag)   # join outstanding IO + item renames inside staging
+        multihost = jax.process_count() > 1
         from ..parallel import comm as _comm
 
+        if multihost:
+            # every process's items must be committed into the staging dir
+            # before the single tag-level rename
+            _comm.barrier("ckpt_tag_commit")
+        if jax.process_index() == 0:
+            commit_staged(staging, path)      # the atomic tag commit
+        if faults.ACTIVE:
+            faults.maybe_crash("ckpt_pre_latest")
+        if jax.process_index() == 0:
+            write_latest_tag(save_dir, tag)   # tmp + fsync + rename
         _comm.barrier("save_checkpoint")
-        log_dist(f"saved checkpoint {path}", ranks=[0])
+        if faults.ACTIVE:
+            faults.after_commit(path)
+        self._last_ckpt_dir = os.path.abspath(save_dir)
+        elapsed = time.time() - t0
+        self.resilience.record_save(self._last_ckpt_dir, elapsed, self.global_steps)
+        if jax.process_index() == 0:
+            self.resilience.gc(save_dir, protect=(tag,))
+        log_dist(f"saved checkpoint {path} ({elapsed:.2f}s)", ranks=[0])
 
     def _finalize_pending_checkpoint(self) -> None:
         pending = getattr(self, "_pending_ckpt", None)
@@ -1773,17 +1870,39 @@ class Engine:
                         load_module_only: bool = False):
         """Restore into the *current* topology's shardings — a checkpoint
         written at any dp/fsdp/tp layout reshards on read (the universal-
-        checkpoint capability, reference checkpoint/ds_to_universal.py)."""
+        checkpoint capability, reference checkpoint/ds_to_universal.py).
+
+        Recovery: when ``tag`` is None and the ``latest`` pointer is torn,
+        names a missing tag, or the tag fails an integrity check (checksum,
+        missing manifest), the load falls back to the newest *complete*
+        earlier tag with a loud warning instead of crashing. An explicit
+        ``tag`` never falls back — the caller asked for that one."""
+        import os
+
+        from ..checkpoint.engine import NoLoadableCheckpoint, load_with_fallback
+
+        self._finalize_pending_checkpoint()
+        self._ensure_opt_resident()
+        try:
+            result = load_with_fallback(
+                load_dir, tag,
+                lambda cand: self._load_checkpoint_tag(
+                    load_dir, cand, load_optimizer_states=load_optimizer_states,
+                    load_lr_scheduler_states=load_lr_scheduler_states,
+                    load_module_only=load_module_only))
+        except NoLoadableCheckpoint as e:
+            raise ConfigError(str(e)) from None
+        self._last_ckpt_dir = os.path.abspath(load_dir)
+        self.resilience.arm_preemption(self._last_ckpt_dir)
+        return result
+
+    def _load_checkpoint_tag(self, load_dir: str, tag: str,
+                             load_optimizer_states: bool = True,
+                             load_lr_scheduler_states: bool = True,
+                             load_module_only: bool = False):
         import json
         import os
 
-        from ..checkpoint.engine import read_latest_tag
-
-        self._finalize_pending_checkpoint()
-        tag = tag or read_latest_tag(load_dir)
-        if tag is None:
-            raise ConfigError(f"No 'latest' tag in {load_dir} and none given")
-        self._ensure_opt_resident()
         path = os.path.join(load_dir, tag)
         eng = self._checkpoint_engine()
         if self._host_opt is not None:

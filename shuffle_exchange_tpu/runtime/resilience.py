@@ -1,0 +1,305 @@
+"""Runtime resilience: preemption hooks, step watchdog, checkpoint GC,
+non-finite-loss policy.
+
+The failure model (Gemini SOSP'23, Bamboo NSDI'23; ROADMAP north-star):
+preemptible TPU pods get SIGTERM ahead of reclaim, steps can hang on a
+wedged collective, and losses can go non-finite from bad data or numerics.
+Recovery is only as good as the last *committed* checkpoint — the atomic
+save path lives in ``checkpoint/engine.py``; this module supplies the
+engine-side wiring: a SIGTERM handler that runs one final synchronous save,
+a per-step watchdog that flags hung steps through the monitor, retention GC
+that never deletes the tag ``latest`` points at, and the skip|rollback|raise
+policy for non-finite steps.
+
+Counters (written through ``MonitorMaster`` — always recorded in its
+in-memory sink, and in any configured backend):
+``resilience/restarts`` (ElasticAgent), ``resilience/rollbacks``,
+``resilience/ckpt_save_s``, ``resilience/hung_steps``,
+``resilience/preemptions``, ``resilience/nonfinite_steps``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import threading
+import time
+import weakref
+from typing import Callable, List, Optional, Sequence
+
+from ..utils.logging import logger
+
+
+class NonFiniteLossError(RuntimeError):
+    """Loss/grad-norm came out non-finite under nonfinite_policy='raise'
+    (or a rollback could not make progress)."""
+
+
+# ----------------------------------------------------------------------
+# Checkpoint retention
+# ----------------------------------------------------------------------
+
+
+def gc_checkpoints(save_dir: str, keep_last_n: int,
+                   protect: Sequence[str] = ()) -> List[str]:
+    """Delete committed tags beyond the ``keep_last_n`` newest, plus stale
+    staging leftovers from crashed saves. The tag ``latest`` points at and
+    anything in ``protect`` are never deleted; only fully-committed tags are
+    considered (a partially-written tag is left for inspection/fallback
+    until its save either commits or is re-attempted). Returns what was
+    deleted."""
+    from ..checkpoint.engine import (LATEST_FILE, is_staging_name,
+                                     list_complete_tags, read_latest_tag,
+                                     staging_path)
+
+    if keep_last_n <= 0 or not os.path.isdir(save_dir):
+        return []
+    keep = set(protect)
+    latest = read_latest_tag(save_dir)
+    if latest is not None:
+        keep.add(latest)
+    tags = list_complete_tags(save_dir)  # newest first
+    deleted: List[str] = []
+    for tag in tags[keep_last_n:]:
+        if tag in keep:
+            continue
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+        deleted.append(tag)
+    # Staging dirs of deleted/committed tags are crash leftovers; a LIVE
+    # staging dir (a decoupled save still writing) is exactly the staging
+    # path of a protected tag, so it survives this sweep.
+    live = {os.path.basename(staging_path(os.path.join(save_dir, t))) for t in keep}
+    for name in os.listdir(save_dir):
+        if name == LATEST_FILE or not is_staging_name(name):
+            continue
+        if name in live:
+            continue
+        shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
+        deleted.append(name)
+    if deleted:
+        logger.info(f"checkpoint GC ({save_dir}): removed {deleted} "
+                    f"(keep_last_n={keep_last_n}, latest={latest!r})")
+    return deleted
+
+
+# ----------------------------------------------------------------------
+# Step watchdog
+# ----------------------------------------------------------------------
+
+
+class StepWatchdog:
+    """Flags steps that exceed ``timeout_s``. The timer fires on a daemon
+    thread; it never kills the step (a TPU program cannot be safely
+    interrupted mid-flight) — it makes the hang VISIBLE: a log line + a
+    monitor counter an operator can alert on."""
+
+    def __init__(self, timeout_s: float, on_hang: Callable[[int, float], None]):
+        self.timeout_s = timeout_s
+        self.on_hang = on_hang
+        self._timer: Optional[threading.Timer] = None
+        self.hung_steps = 0
+
+    def start(self, step: int) -> None:
+        if self.timeout_s <= 0:
+            return
+        self.stop()
+        self._timer = threading.Timer(self.timeout_s, self._fire, args=(step,))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self, step: int) -> None:
+        self.hung_steps += 1
+        self.on_hang(step, self.timeout_s)
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+# ----------------------------------------------------------------------
+# Preemption (SIGTERM) hook
+# ----------------------------------------------------------------------
+
+_PREEMPTION_LOCK = threading.Lock()
+_PREEMPTION_PREV = None       # handler we replaced (restored on uninstall)
+_PREEMPTION_SAVE: Optional[Callable[[], None]] = None
+_PREEMPTION_INSTALLED = False
+
+
+def _preemption_handler(signum, frame):
+    global _PREEMPTION_SAVE
+    save = _PREEMPTION_SAVE
+    _PREEMPTION_SAVE = None   # re-entrancy: a second SIGTERM goes straight to exit
+    if save is not None:
+        logger.warning("preemption signal received: running final synchronous "
+                       "checkpoint save before exit")
+        try:
+            save()
+        except Exception as e:
+            logger.error(f"preemption save failed: {type(e).__name__}: {e}")
+    raise SystemExit(128 + signum)
+
+
+def install_preemption_hook(save_fn: Callable[[], None]) -> bool:
+    """Install (or re-point) the SIGTERM hook to ``save_fn``. Returns False
+    when not callable from this thread (signal.signal is main-thread-only)."""
+    global _PREEMPTION_PREV, _PREEMPTION_SAVE, _PREEMPTION_INSTALLED
+    with _PREEMPTION_LOCK:
+        _PREEMPTION_SAVE = save_fn
+        if _PREEMPTION_INSTALLED:
+            return True
+        try:
+            _PREEMPTION_PREV = signal.signal(signal.SIGTERM, _preemption_handler)
+        except ValueError:
+            logger.warning("preemption hook not installed: not on the main thread")
+            _PREEMPTION_SAVE = None
+            return False
+        _PREEMPTION_INSTALLED = True
+        return True
+
+
+def uninstall_preemption_hook() -> None:
+    global _PREEMPTION_PREV, _PREEMPTION_SAVE, _PREEMPTION_INSTALLED
+    with _PREEMPTION_LOCK:
+        _PREEMPTION_SAVE = None
+        if not _PREEMPTION_INSTALLED:
+            return
+        try:
+            signal.signal(signal.SIGTERM, _PREEMPTION_PREV or signal.SIG_DFL)
+        except ValueError:
+            pass
+        _PREEMPTION_PREV = None
+        _PREEMPTION_INSTALLED = False
+
+
+# ----------------------------------------------------------------------
+# Engine-side manager
+# ----------------------------------------------------------------------
+
+
+class ResilienceManager:
+    """Owns the engine's resilience state: the watchdog, the preemption
+    hook arming, rollback bookkeeping, and counter emission. Holds the
+    engine by weakref — the signal hook must not keep a dead engine alive."""
+
+    def __init__(self, config, monitor):
+        self.config = config
+        self.monitor = monitor
+        self._engine_ref = None
+        self.rollbacks = 0
+        self.preemptions = 0
+        self.nonfinite_steps = 0
+        self._last_rollback_step: Optional[int] = None
+        self.watchdog = StepWatchdog(config.watchdog_timeout_s, self._on_hang)
+
+    # -- wiring --------------------------------------------------------
+
+    def attach_engine(self, engine) -> None:
+        self._engine_ref = weakref.ref(engine)
+        if self.config.preemption_save and self.config.save_dir:
+            self.arm_preemption(self.config.save_dir)
+
+    def _engine(self):
+        return self._engine_ref() if self._engine_ref is not None else None
+
+    def _event(self, label: str, value, step: int) -> None:
+        # unconditionally: MonitorMaster always records into its in-memory
+        # sink even when no external backend is configured
+        try:
+            self.monitor.write_events([(label, value, step)])
+        except Exception:
+            logger.exception("resilience: monitor write failed")
+
+    # -- preemption ----------------------------------------------------
+
+    def arm_preemption(self, save_dir: str) -> None:
+        """(Re-)point the SIGTERM hook at a final save into ``save_dir``.
+        Called once a checkpoint directory is known (config, or the first
+        save/load)."""
+        if not self.config.preemption_save:
+            return
+        ref = self._engine_ref
+        if ref is None:
+            return
+
+        def final_save():
+            eng = ref()
+            if eng is None:
+                return
+            self.preemptions += 1
+            self._event("resilience/preemptions", self.preemptions,
+                        eng.global_steps)
+            eng.save_checkpoint(save_dir)
+            eng._finalize_pending_checkpoint()  # decoupled writer: force the commit NOW
+
+        install_preemption_hook(final_save)
+
+    # -- watchdog ------------------------------------------------------
+
+    def step_begin(self, step: int) -> None:
+        self.watchdog.start(step)
+
+    def step_end(self) -> None:
+        self.watchdog.stop()
+
+    def _on_hang(self, step: int, timeout_s: float) -> None:
+        eng = self._engine()
+        logger.error(f"resilience: step {step} exceeded the {timeout_s:.1f}s "
+                     "watchdog (hung collective / wedged host callback?); "
+                     "flagging through the monitor")
+        self._event("resilience/hung_steps", self.watchdog.hung_steps,
+                    eng.global_samples if eng is not None else step)
+
+    # -- non-finite policy ---------------------------------------------
+
+    @property
+    def nonfinite_in_graph(self) -> bool:
+        """skip folds into the jitted step (free); rollback/raise need the
+        flag on host, which costs one scalar sync per step."""
+        return self.config.nonfinite_policy == "skip"
+
+    @property
+    def nonfinite_host_check(self) -> bool:
+        return self.config.nonfinite_policy in ("rollback", "raise")
+
+    def on_nonfinite(self, engine) -> None:
+        """Host-side reaction for rollback|raise (skip is handled in-graph)."""
+        self.nonfinite_steps += 1
+        self._event("resilience/nonfinite_steps", self.nonfinite_steps,
+                    engine.global_samples)
+        policy = self.config.nonfinite_policy
+        step = engine.global_steps
+        if policy == "raise":
+            raise NonFiniteLossError(
+                f"non-finite loss/grad-norm at step {step} "
+                "(resilience.nonfinite_policy='raise')")
+        # rollback: restore the last committed checkpoint in place
+        ckpt_dir = engine._last_ckpt_dir or self.config.save_dir
+        if ckpt_dir is None:
+            raise NonFiniteLossError(
+                f"non-finite loss at step {step} with nonfinite_policy="
+                "'rollback', but no checkpoint has been saved or loaded yet")
+        if self._last_rollback_step == step:
+            raise NonFiniteLossError(
+                f"non-finite loss at step {step} again after rolling back to "
+                f"{ckpt_dir} — no progress since the last rollback; the "
+                "checkpoint itself (or the data at this step) is bad")
+        self._last_rollback_step = step
+        self.rollbacks += 1
+        logger.warning(f"resilience: non-finite loss at step {step}; rolling "
+                       f"back to the last committed checkpoint in {ckpt_dir}")
+        engine.load_checkpoint(ckpt_dir)
+        self._event("resilience/rollbacks", self.rollbacks, engine.global_samples)
+
+    # -- save-path bookkeeping -----------------------------------------
+
+    def record_save(self, save_dir: str, elapsed_s: float, step: int) -> None:
+        self._event("resilience/ckpt_save_s", elapsed_s, step)
+        self.arm_preemption(save_dir)
+
+    def gc(self, save_dir: str, protect: Sequence[str] = ()) -> List[str]:
+        if self.config.keep_last_n <= 0:
+            return []
+        return gc_checkpoints(save_dir, self.config.keep_last_n, protect)

@@ -54,12 +54,17 @@ def _restore(meta, shards, sharding=None):
     """Rebuild a jax.Array from its local shard snapshot."""
     import jax
 
+    from ...utils.placement import owned_device_put
+
     if meta is None:
         ((_, data),) = shards
-        return jax.device_put(data, sharding) if sharding is not None else data
+        return owned_device_put(data, sharding) if sharding is not None else data
     shape, dtype, saved_sharding = meta
     target = sharding if sharding is not None else saved_sharding
-    singles = [jax.device_put(data, dev) for devices, data in shards for dev in devices]
+    # owned_device_put: swapped-in optimizer state is donated by the next
+    # step — the shards must not alias their host numpy snapshots
+    # (utils/placement.py)
+    singles = [owned_device_put(data, dev) for devices, data in shards for dev in devices]
     return jax.make_array_from_single_device_arrays(shape, target, singles)
 
 

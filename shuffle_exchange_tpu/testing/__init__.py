@@ -1,0 +1,4 @@
+"""Test seams: fault injection for the resilience layer (testing/faults.py)."""
+
+from . import faults  # noqa: F401
+from .faults import Fault, InjectedFault  # noqa: F401

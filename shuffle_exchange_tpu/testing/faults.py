@@ -1,0 +1,222 @@
+"""Fault-injection seam for the resilience layer.
+
+The save→crash→resume loop can only be trusted if it is continuously
+exercised, so the checkpoint writers and the training engine consult this
+module at the exact points a preemption can land. When no fault is armed the
+seam is one module-level boolean check — zero overhead in production.
+
+Sites (``Fault.site``):
+
+- ``ckpt_shard_write``   — kill the native save at shard ordinal ``index``;
+  with ``byte_offset`` a torn prefix of that many bytes is written first
+  (simulating a write cut mid-flight).
+- ``ckpt_manifest_write``— kill the native save before its per-process
+  manifest lands.
+- ``ckpt_item_save``     — kill ``save_checkpoint`` before item ``index``
+  (0=model, 1=opt, ...) is handed to the engine (engine-agnostic).
+- ``ckpt_pre_commit``    — kill between the item writes and the atomic
+  tag-directory rename.
+- ``ckpt_pre_latest``    — kill after the tag commit but before the
+  ``latest`` pointer update.
+- ``nan_loss``           — poison the batch at global step ``index`` so the
+  loss/grads come out non-finite (drives the non-finite sentinel).
+- ``sigterm_mid_step``   — deliver SIGTERM to this process at global step
+  ``index`` (drives the preemption hook).
+- ``corrupt_manifest`` / ``drop_manifest`` / ``corrupt_shard`` — post-commit
+  damage to an already-committed tag (drives checksum verification and the
+  newest-complete-tag fallback on load). ``index`` selects the manifest
+  process id / shard file ordinal; ``byte_offset`` the byte to flip.
+
+Arm programmatically (``faults.arm(...)``) or via the environment::
+
+    SXT_FAULTS="ckpt_shard_write:index=1:byte_offset=16,sigterm_mid_step:index=3"
+
+Faults are one-shot by default (``once=True``): after tripping they disarm,
+so the restarted run proceeds clean — exactly a transient preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import List, Optional
+
+from ..utils.logging import logger
+
+
+class InjectedFault(Exception):
+    """Raised at an armed fault site (simulates a crash/preemption)."""
+
+
+SITES = (
+    "ckpt_shard_write", "ckpt_manifest_write", "ckpt_item_save",
+    "ckpt_pre_commit", "ckpt_pre_latest",
+    "nan_loss", "sigterm_mid_step",
+    "corrupt_manifest", "drop_manifest", "corrupt_shard",
+)
+
+
+@dataclasses.dataclass
+class Fault:
+    site: str
+    index: int = 0                      # shard ordinal / step / process id
+    byte_offset: Optional[int] = None   # torn-prefix length or flip position
+    once: bool = True
+    hits: int = 0
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; known: {SITES}")
+
+
+_PLAN: List[Fault] = []
+ACTIVE = False   # fast-path gate: every seam checks this first
+
+
+def _update_active() -> None:
+    global ACTIVE
+    ACTIVE = bool(_PLAN)
+
+
+def arm(site: str, index: int = 0, byte_offset: Optional[int] = None,
+        once: bool = True) -> Fault:
+    """Arm one fault; returns it (``.hits`` counts trips)."""
+    f = Fault(site, index=index, byte_offset=byte_offset, once=once)
+    _PLAN.append(f)
+    _update_active()
+    return f
+
+
+def clear() -> None:
+    _PLAN.clear()
+    _update_active()
+
+
+def armed() -> List[Fault]:
+    return list(_PLAN)
+
+
+def trip(site: str, index: Optional[int] = 0) -> Optional[Fault]:
+    """The armed fault matching (site, index), disarmed if one-shot.
+    ``index=None`` matches any armed fault at the site — used by sites
+    where ``index`` is a payload selector, not a match key."""
+    if not ACTIVE:
+        return None
+    for f in _PLAN:
+        if f.site == site and (index is None or f.index == index):
+            f.hits += 1
+            if f.once:
+                _PLAN.remove(f)
+                _update_active()
+            return f
+    return None
+
+
+def maybe_crash(site: str, index: int = 0) -> None:
+    """Raise InjectedFault when (site, index) is armed."""
+    if ACTIVE and trip(site, index) is not None:
+        raise InjectedFault(f"injected crash at {site}[{index}]")
+
+
+def on_write(site: str, index: int, path: str, data) -> None:
+    """Pre-write hook: when armed, leave a torn prefix of ``byte_offset``
+    bytes at ``path`` and raise — the on-disk state a mid-write kill leaves."""
+    if not ACTIVE:
+        return
+    f = trip(site, index)
+    if f is None:
+        return
+    if f.byte_offset:
+        buf = bytes(memoryview(data).cast("B"))[:f.byte_offset]
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(buf)
+    raise InjectedFault(f"injected crash at {site}[{index}] ({path})")
+
+
+def maybe_sigterm(site: str, index: int = 0) -> None:
+    """Deliver SIGTERM to this process when (site, index) is armed."""
+    if ACTIVE and trip(site, index) is not None:
+        logger.warning(f"faults: delivering SIGTERM at {site}[{index}]")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def poison_batch(batch, step: int):
+    """Replace the first float leaf with NaNs when nan_loss is armed for
+    ``step`` — the loss/grads then come out non-finite through the real
+    compute path (no shortcut into the sentinel)."""
+    if not ACTIVE or trip("nan_loss", step) is None:
+        return batch
+    import numpy as np
+
+    done = []
+
+    def poison(leaf):
+        arr = np.asarray(leaf)
+        if not done and np.issubdtype(arr.dtype, np.floating):
+            done.append(True)
+            return np.full_like(arr, np.nan)
+        return leaf
+
+    import jax
+
+    poisoned = jax.tree_util.tree_map(poison, batch)
+    if not done:
+        raise InjectedFault("nan_loss armed but the batch has no float leaf")
+    logger.warning(f"faults: poisoned a float batch leaf with NaN at step {step}")
+    return poisoned
+
+
+def after_commit(tag_path: str) -> None:
+    """Post-commit damage hooks against the committed tag directory.
+    ``index`` on these sites selects WHAT to damage (manifest process id /
+    shard ordinal), so any armed fault at the site trips."""
+    if not ACTIVE:
+        return
+    import glob as _glob
+
+    f = trip("drop_manifest", index=None)
+    if f is not None:
+        victim = os.path.join(tag_path, "model", f"manifest_{f.index}.json")
+        if os.path.exists(victim):
+            os.remove(victim)
+            logger.warning(f"faults: dropped {victim}")
+    f = trip("corrupt_manifest", index=None)
+    if f is not None:
+        for m in sorted(_glob.glob(os.path.join(tag_path, "model", "manifest_*.json"))):
+            with open(m, "r+b") as fh:
+                fh.truncate(max(1, f.byte_offset or 8))
+            logger.warning(f"faults: truncated {m}")
+            break
+    f = trip("corrupt_shard", index=None)
+    if f is not None:
+        shards = sorted(_glob.glob(os.path.join(tag_path, "model", "*.bin")))
+        if f.index < len(shards):
+            with open(shards[f.index], "r+b") as fh:
+                fh.seek(f.byte_offset or 0)
+                b = fh.read(1)
+                fh.seek(f.byte_offset or 0)
+                fh.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+            logger.warning(f"faults: flipped a byte in {shards[f.index]}")
+
+
+def _parse_env(spec: str) -> None:
+    """SXT_FAULTS="site[:k=v]*,site..." — arm faults from the environment."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        kwargs = {}
+        for kv in fields[1:]:
+            k, _, v = kv.partition("=")
+            if k == "once":
+                kwargs[k] = v.lower() not in ("0", "false")
+            else:
+                kwargs[k] = int(v)
+        arm(fields[0], **kwargs)
+
+
+if os.environ.get("SXT_FAULTS"):
+    _parse_env(os.environ["SXT_FAULTS"])

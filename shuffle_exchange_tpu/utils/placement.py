@@ -1,0 +1,63 @@
+"""Safe host→device placement for arrays that will be DONATED.
+
+On the CPU backend, ``jax.device_put`` of an aligned numpy array can
+zero-copy: the resulting jax.Array aliases the host buffer instead of
+owning a copy. That alias is fine for read-only use, but an executable
+with ``donate_argnums`` deserialized from the persistent compilation
+cache will reuse the buffer as scratch/output (jax 0.4.x) — and once the
+numpy side is garbage-collected, the program is writing through freed
+memory: silently corrupted training state, and eventually a segfault.
+
+The resilience suite's bit-exact crash→resume cycles exposed this on the
+checkpoint-restore path; master-init and the optimizer-offload swap-in
+feed donated state from host numpy the same way. ``owned_device_put``
+routes the host array through ``jnp.asarray`` first, which materializes
+an XLA-owned buffer, so the subsequent reshard copies instead of
+aliasing. On non-CPU backends host→device is always a real transfer, so
+the extra hop is skipped.
+"""
+
+from __future__ import annotations
+
+
+def owned_device_put(arr, sharding):
+    """``jax.device_put`` whose result NEVER aliases host numpy memory —
+    required for any array that lands in a donated (donate_argnums)
+    pytree. No-op overhead off CPU."""
+    import jax
+
+    if jax.default_backend() == "cpu" and not isinstance(arr, jax.Array):
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(arr)
+    return jax.device_put(arr, sharding)
+
+
+def cache_safe_donate_argnums(argnums):
+    """``donate_argnums`` to actually pass to ``jax.jit``.
+
+    jax 0.4.x CPU: an executable deserialized from the persistent
+    compilation cache races donated-buffer frees — the runtime releases the
+    donated inputs while the (aliasing-info-less) deserialized program is
+    still reading them. The result is nondeterministic corruption of
+    whatever reuses the freed pages (observed: garbage/NaN training state
+    after a checkpoint restore, then segfaults — found by the resilience
+    suite's bit-exact crash→resume cycles). When that combination is
+    active, donation is disabled: one extra buffer copy per step on a CPU
+    host beats silently corrupted training state. TPU/GPU backends keep
+    donation (and its HBM savings) unconditionally."""
+    import jax
+
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        cache_dir = None
+    if cache_dir and jax.default_backend() == "cpu":
+        from .logging import warning_once
+
+        warning_once(
+            "persistent compilation cache + CPU backend: disabling jit "
+            "input donation (jax 0.4.x deserialized executables race "
+            "donated-buffer frees, corrupting memory)")
+        return ()
+    return tuple(argnums)

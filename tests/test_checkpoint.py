@@ -156,6 +156,88 @@ def test_checkpoint_reshard_from_sequence_parallel(tmp_path, devices8):
                                rtol=1e-4)
 
 
+def test_read_latest_tag_empty_or_whitespace(tmp_path):
+    """An empty/whitespace `latest` must read as absent — '' used to
+    resolve to the save_dir itself."""
+    from shuffle_exchange_tpu.checkpoint import read_latest_tag
+
+    assert read_latest_tag(str(tmp_path)) is None      # no file at all
+    for content in ("", "   ", "\n\t "):
+        with open(tmp_path / "latest", "w") as f:
+            f.write(content)
+        assert read_latest_tag(str(tmp_path)) is None
+    with open(tmp_path / "latest", "w") as f:
+        f.write("  global_step7\n")
+    assert read_latest_tag(str(tmp_path)) == "global_step7"
+
+
+def test_write_latest_tag_is_atomic(tmp_path):
+    """The pointer update goes through tmp+fsync+rename: no partially
+    written `latest` is ever visible, and staging files don't linger."""
+    from shuffle_exchange_tpu.checkpoint import read_latest_tag, write_latest_tag
+
+    write_latest_tag(str(tmp_path), "global_step1")
+    write_latest_tag(str(tmp_path), "global_step2")
+    assert read_latest_tag(str(tmp_path)) == "global_step2"
+    assert [n for n in os.listdir(tmp_path) if ".tmp-" in n] == []
+
+
+class _FakeProcs:
+    """Pretend to be a 2-process world for validate_tag."""
+
+    def __init__(self, monkeypatch, agreed_tag):
+        import jax
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        digest = np.frombuffer(agreed_tag.encode().ljust(64, b"\0")[:64],
+                               dtype=np.uint8).copy()
+        self.broadcasts = []
+
+        def fake_broadcast(x):
+            self.broadcasts.append(x)
+            return digest
+
+        monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", fake_broadcast)
+
+
+def test_validate_tag_fail_raises_on_mismatch(monkeypatch):
+    from shuffle_exchange_tpu.checkpoint.engine import validate_tag
+
+    _FakeProcs(monkeypatch, agreed_tag="global_step5")
+    with pytest.raises(RuntimeError, match="differs across processes"):
+        validate_tag("global_step9", mode="Fail")
+
+
+def test_validate_tag_warn_logs_on_mismatch(monkeypatch):
+    from shuffle_exchange_tpu.checkpoint.engine import validate_tag
+    from shuffle_exchange_tpu.utils.logging import logger as sxt_logger
+
+    _FakeProcs(monkeypatch, agreed_tag="global_step5")
+    warnings = []
+    monkeypatch.setattr(sxt_logger, "warning",
+                        lambda msg, *a, **k: warnings.append(str(msg)))
+    validate_tag("global_step9", mode="Warn")       # no raise
+    assert any("differs across processes" in m for m in warnings)
+
+
+def test_validate_tag_ignore_skips_collective(monkeypatch):
+    from shuffle_exchange_tpu.checkpoint.engine import validate_tag
+
+    fake = _FakeProcs(monkeypatch, agreed_tag="global_step5")
+    validate_tag("global_step9", mode="Ignore")
+    assert fake.broadcasts == []                    # never hit the wire
+
+
+def test_validate_tag_agreement_passes(monkeypatch):
+    from shuffle_exchange_tpu.checkpoint.engine import validate_tag
+
+    _FakeProcs(monkeypatch, agreed_tag="global_step5")
+    validate_tag("global_step5", mode="Fail")       # agreeing tags: no raise
+
+
 def test_checkpoint_reshard_from_uneven_pipeline(tmp_path, devices8):
     """Round 5: uneven pipeline partitions keep the RAW [L] stacks in the
     checkpoint (the padded per-stage layout is loss-internal), so a

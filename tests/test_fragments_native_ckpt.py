@@ -90,9 +90,16 @@ def test_native_writer_roundtrip(tmp_path, writer, devices8):
     path = engine.save_checkpoint(str(tmp_path))
     import os
 
-    assert any(f.startswith("manifest_") for f in os.listdir(os.path.join(path, "model")))
-    # diverge, then restore
+    if writer == "decoupled":
+        # atomic-commit contract: the background save stays in its staging
+        # dir until the step-boundary commit — nothing is visible at the
+        # final tag path yet, so a crash here can't tear the checkpoint.
+        assert not os.path.exists(path)
+    else:
+        assert any(f.startswith("manifest_") for f in os.listdir(os.path.join(path, "model")))
+    # diverge (the decoupled commit lands at this step boundary), then restore
     engine.train_batch(_batch(1))
+    assert any(f.startswith("manifest_") for f in os.listdir(os.path.join(path, "model")))
     w_diverged = engine.get_full_fp32_param("embed")
     engine.load_checkpoint(str(tmp_path))
     w_restored = engine.get_full_fp32_param("embed")
