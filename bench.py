@@ -100,6 +100,12 @@ def host_sync(x) -> float:
     return float(np.asarray(x).reshape(-1)[0])
 
 
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def calibrate(peak_flops: float):
     """Time a known-FLOPs bf16 matmul chain with the same sync discipline.
 
@@ -197,15 +203,64 @@ def pick_config2(hbm: int):
     return ladder[-1]
 
 
+def host_offload_ladder_entry(toy: bool = False):
+    """The host-offload-fitted ladder entry: ~1.7B params on a 16 GB chip.
+
+    Resident training needs 14 B/param (bf16 fwd + fp32 master + adam m/v)
+    — caps one chip at ~750M. The cpu offload tier keeps master+moments in
+    host RAM (runtime/zero/host_optimizer.py) so the device holds only the
+    2 B/param bf16 weights plus the fp32 grad transient (~6 B/param peak
+    during the step) — a ~1.7B entry fits, where arithmetic intensity is
+    higher and the remat tax relatively smaller (the ZeRO-Offload fit
+    argument, Ren et al. 2021). ``offload_overlap`` runs the grad-D2H /
+    host-Adam / param-H2D pipeline concurrently with step compute;
+    ``save_flash_lse`` remat keeps the flash forward out of the backward
+    recompute.
+
+    Returns (name, model_cfg, ds_config, batch_size, seq_len). ``toy=True``
+    is the CPU-runnable miniature of the SAME config shape, used by
+    ``tests/test_bench_smoke.py`` so the entry cannot rot.
+    """
+    from shuffle_exchange_tpu.models import TransformerConfig
+
+    ds = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1, "offload_optimizer": {
+            "device": "cpu", "offload_overlap": True}},
+        "steps_per_print": 10**9,
+    }
+    if toy:
+        mcfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=2, n_kv_heads=1,
+            d_ff=256, max_seq_len=64, activation="swiglu", norm="rmsnorm",
+            position="rope", rope_theta=500000.0, tie_embeddings=True,
+            remat=True, remat_policy="save_flash_lse")
+        # batch 8: divides the CI harness's 8 virtual CPU devices
+        return ("host-offload-toy", mcfg, dict(ds, train_batch_size=8), 8, 64)
+    # North-star head geometry (head_dim 128, GQA group 4); 24 layers x
+    # d2048 x ff8192 + 128k vocab = ~1.72B params -> 3.4 GB bf16 resident.
+    mcfg = TransformerConfig(
+        vocab_size=128256, d_model=2048, n_layers=24, n_heads=16,
+        n_kv_heads=4, d_ff=8192, max_seq_len=2048, activation="swiglu",
+        norm="rmsnorm", position="rope", rope_theta=500000.0,
+        tie_embeddings=True, remat=True, remat_policy="save_flash_lse")
+    return ("llama-1.7b-host-offload", mcfg, ds, 8, 2048)
+
+
 # ---------------------------------------------------------------------------
 # Benches
 # ---------------------------------------------------------------------------
 
 def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
-                peak_flops, n_chips):
+                peak_flops, n_chips, offload_budget=False):
     """For MoE models (model.config.n_experts > 0) the 6*N*T FLOPs model
     bills only the ACTIVATED expert params (top-k routing runs k/E of the
-    expert FLOPs)."""
+    expert FLOPs). ``offload_budget=True`` (host-offload configs) attaches
+    the per-step time budget the engine's overlap pipeline publishes
+    through the monitor: D2H grad wait / host fused-Adam / H2D dispatch."""
     import jax.tree_util as jtu
 
     import shuffle_exchange_tpu as sxt
@@ -236,11 +291,17 @@ def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
 
     tokens_per_step = batch_size * (seq_len - 1)
     tps_chip = tokens_per_step * steps / total / n_chips
-    master = engine.state.master
-    n_params = sum(int(np.prod(l.shape)) for l in jtu.tree_leaves(master))
-    expert = sum(int(np.prod(l.shape))
-                 for name, l in master.get("layers", {}).items()
-                 if name.startswith("moe_") and name != "moe_gate")
+    if getattr(engine, "_host_opt", None) is not None:
+        # cpu offload tier: master/moments live on host, not in state
+        engine._join_host_update()   # land the in-flight overlapped step
+        n_params = sum(int(p.size) for p in engine._host_opt.params)
+        expert = 0
+    else:
+        master = engine.state.master
+        n_params = sum(int(np.prod(l.shape)) for l in jtu.tree_leaves(master))
+        expert = sum(int(np.prod(l.shape))
+                     for name, l in master.get("layers", {}).items()
+                     if name.startswith("moe_") and name != "moe_gate")
     if engine.ensemble:   # leading replica dim on every leaf
         n_params //= engine.replicas
         expert //= engine.replicas
@@ -249,7 +310,7 @@ def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
     if mcfg is not None and getattr(mcfg, "n_experts", 0) > 0:
         n_active = n_params - expert + expert * mcfg.moe_top_k // mcfg.n_experts
     mfu = 6.0 * n_active * tps_chip / peak_flops
-    return {
+    row = {
         "config": label,
         "params_m": round(n_params / 1e6, 1),
         "batch_size": batch_size,
@@ -260,6 +321,19 @@ def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
         "valid": bool(mfu <= 1.0),
         "unit": "tokens/s/chip",
     }
+    if offload_budget:
+        mm = engine.monitor.memory_monitor
+        budget = {k: mm.latest(f"offload/{k}")
+                  for k in ("d2h_wait_s", "host_adam_s", "h2d_dispatch_s",
+                            "pipeline_s")}
+        # D2H wait starts at dispatch, so it absorbs the device step's tail;
+        # compute_s here is the step wall minus the post-grad pipeline
+        # stages (host adam + h2d) — the overlapped portion of those is
+        # exactly what the pipeline hides.
+        budget["step_p50_s"] = round(p50, 4)
+        budget["overlap"] = bool(getattr(engine, "_host_pipeline", None))
+        row["offload_budget"] = budget
+    return row
 
 
 def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
@@ -298,6 +372,33 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
     eng.flush(uids)
     logits = eng.put(uids, prompts)
     prefill_s = time.perf_counter() - t0
+
+    # Device-side prefill figure (VERDICT r5 missing #3): every put() pays
+    # one host/tunnel round trip, which on the tunneled platform (~65 ms)
+    # dominates the bs4x512 figure and makes per-run prose drift ~25%.
+    # Measure the dispatch RTT with a noop program (same discipline as
+    # calibrate()) and publish the RTT-EXCLUDED compiled-prefill number —
+    # median of 3, compared against the flash-bound compute roofline via
+    # its MFU (prefill is matmul-bound: 2N flops/token + attention).
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    @_jax.jit
+    def _noop(a):
+        return a + 1.0
+
+    z = _jnp.zeros((), _jnp.float32)
+    host_sync(_noop(z))
+    rtt = min(_timed(lambda: host_sync(_noop(z))) for _ in range(5))
+    pf_times = []
+    for _ in range(3):
+        eng.flush(uids)
+        t0 = time.perf_counter()
+        logits = eng.put(uids, prompts)
+        pf_times.append(time.perf_counter() - t0)
+    prefill_device_s = max(sorted(pf_times)[1] - rtt, 1e-9)
+    prefill_tokens = bsz * prompt_len
+    prefill_device_mfu = 2.0 * n_params * prefill_tokens / prefill_device_s / peak_flops
 
     # Large-batch prefill through the same public put(): 8 x 1024-token
     # prompts = 8192 tokens in ONE dispatch, so the ~65ms tunnel RTT is
@@ -433,6 +534,17 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "batch_size": bsz,
         "prompt_len": prompt_len,
         "prefill_tokens_per_sec": round(bsz * prompt_len / prefill_s, 1),
+        "prefill_device_tokens_per_sec": round(prefill_tokens / prefill_device_s, 1),
+        "prefill_device_mfu": round(prefill_device_mfu, 4),
+        "prefill_rtt_ms_excluded": round(rtt * 1000, 2),
+        "prefill_note": ("prefill_device_* = median-of-3 put() with the "
+                         "measured noop-dispatch RTT subtracted — a "
+                         "conservative LOWER bound on device throughput: "
+                         "the [bsz, vocab] logits host readback and the "
+                         "host-side prompt batching remain included (the "
+                         "decode figure times an on-device loop and avoids "
+                         "both); per-put prefill figures include one host "
+                         "RTT each"),
         "prefill_bs8x1024_tokens_per_sec": (
             round(8 * 1024 / prefill_big_s, 1) if prefill_big_s else None),
         "decode_tokens_per_sec": round(decode_tps, 1),
@@ -540,6 +652,22 @@ def _config2(peak, hbm, n_chips, on_tpu, hbm_bw=None):
         Transformer(m4096), cfg2, batch_size=8, seq_len=4096,
         steps=10, warmup=3, peak_flops=peak, n_chips=n_chips)
     row["seq4096_row"] = row4096
+    # Host-offload ladder entry (the two untried config-2 levers, round 7):
+    # ~1.7B fits via the cpu tier + overlapped optimizer pipeline, with the
+    # save_flash_lse remat policy cutting the flash-forward recompute. The
+    # per-step time budget rides in offload_budget.
+    name_h, mcfg_h, ds_h, bs_h, seq_h = host_offload_ladder_entry()
+    try:
+        row["host_offload_row"] = bench_train(
+            f"{name_h} cpu-offload overlapped optimizer + save_flash_lse "
+            "(fits one chip only via the host tier: 2 B/param device vs 14)",
+            Transformer(mcfg_h), ds_h, batch_size=bs_h, seq_len=seq_h,
+            steps=8, warmup=2, peak_flops=peak, n_chips=n_chips,
+            offload_budget=True)
+    except Exception as e:
+        print(f"SXT_WARN host-offload ladder bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        row["host_offload_row"] = {"error": _short_err(e)}
     return "config2_llama3_zero3_fused_adam", row
 
 
@@ -587,7 +715,8 @@ def _config5(peak, hbm, n_chips, on_tpu, hbm_bw=None):
 _CONFIGS = {"1": _config1, "2": _config2, "3": _config3, "5": _config5}
 # per-config wall budgets (compile through the remote tunnel is the risk):
 # a stuck compile must cost one config, not the whole bench
-_BUDGET_S = {"1": 480, "2": 1200, "3": 900, "5": 1500}   # 5: four quant
+_BUDGET_S = {"1": 480, "2": 1800, "3": 900, "5": 1500}   # 2: + the host-
+# offload ladder row's extra compile; 5: four quant
 # tiers x3 medians + big prefill + decode sweep (compile cache makes the
 # steady-state ~5 min; the budget covers a cold cache)
 

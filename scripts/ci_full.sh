@@ -3,14 +3,20 @@
 # The fast development gate is: pytest tests/ -q -m "not slow"
 set -e
 cd "$(dirname "$0")/.."
-# Fused-decode parity + the resilience suite first — a broken serving kernel
-# or a rotten crash-recovery path should fail the run before the long tail
-# does. test_resilience.py drives injected crash→restart→bit-exact-resume
-# cycles (kill-during-save, torn latest, corrupted shards) through the real
-# ElasticAgent; its fast cases are unmarked so the tier-1 "not slow" gate
-# always exercises the recovery path too. The main run then skips the three
-# files so nothing executes twice.
+# Fused-decode parity + the resilience/offload suites first — a broken
+# serving kernel or a rotten crash-recovery path should fail the run before
+# the long tail does. test_resilience.py drives injected crash→restart→
+# bit-exact-resume cycles through the real ElasticAgent;
+# test_offload_overlap.py drives the overlapped host-offload pipeline's
+# parity + crash-mid-pipeline cycles; test_remat_lse.py gates the
+# save_flash_lse policy's gradient parity and forward-recompute DCE. Their
+# fast cases are unmarked so the tier-1 "not slow" gate always exercises
+# them too. The main run then skips these files so nothing executes twice.
 python -m pytest tests/test_fused_decode.py tests/test_mosaic_lowering.py \
-    tests/test_resilience.py -q "$@"
+    tests/test_resilience.py tests/test_offload_overlap.py \
+    tests/test_remat_lse.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
-    --ignore=tests/test_mosaic_lowering.py --ignore=tests/test_resilience.py "$@"
+    --ignore=tests/test_mosaic_lowering.py \
+    --ignore=tests/test_resilience.py \
+    --ignore=tests/test_offload_overlap.py \
+    --ignore=tests/test_remat_lse.py "$@"

@@ -73,7 +73,21 @@ class DataTypesConfig(ConfigModel):
 
 @dataclass
 class OffloadConfig(ConfigModel):
-    """reference: runtime/zero/offload_config.py — device none|cpu|nvme."""
+    """reference: runtime/zero/offload_config.py — device none|cpu|nvme.
+
+    TPU-first additions (no reference-key collisions):
+
+    ``offload_overlap`` turns the cpu tier's host-resident fused-Adam step
+    into the overlapped double-buffered pipeline (runtime/zero/overlap.py):
+    bucketed grad D2H issued at dispatch, host fused-Adam on a worker
+    concurrently with the step's tail, H2D param upload overlapped with the
+    next step via delayed parameter application — bit-exact with the
+    synchronous path (parity-tested). False keeps the synchronous step.
+
+    ``overlap_bucket_mb`` sizes the transfer buckets (MB of fp32 gradient
+    per bucket; 0 = one leaf per bucket). Scanned models stack per-layer
+    weights, so leaves are the natural per-layer granularity.
+    """
 
     device: str = config_field("none")
     nvme_path: Optional[str] = config_field(None)
@@ -85,6 +99,8 @@ class OffloadConfig(ConfigModel):
     pipeline_write: bool = config_field(False)
     fast_init: bool = config_field(False)
     ratio: float = config_field(1.0, ge=0.0, le=1.0)
+    offload_overlap: bool = config_field(False)
+    overlap_bucket_mb: int = config_field(128, ge=0)
 
     @classmethod
     def from_dict(cls, data=None, path=""):
@@ -184,11 +200,18 @@ class ActivationCheckpointingConfig(ConfigModel):
     synchronize_checkpoint_boundary: bool = config_field(False)
     profile: bool = config_field(False)
     # TPU-first: which jax.checkpoint policy to use when remat is on.
-    # "none"|"full"|"dots_saveable"|"nothing_saveable"|"dots_with_no_batch_dims_saveable"
+    # Beyond the stock jax policies, the named-seam policies from
+    # models/transformer._remat_policy: "offload_kv_host" (KV residuals to
+    # host RAM), "save_attn_seams"/"save_ffn" (selective [B,T,*] seams), and
+    # "save_flash_lse" (save the flash kernel's OWN residuals — attention
+    # output + logsumexp — so backward enters the flash bwd kernel directly
+    # instead of re-running forward attention).
     policy: str = config_field("dots_saveable")
     enabled: bool = config_field(False)
 
-    VALID_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable", "dots_with_no_batch_dims_saveable")
+    VALID_POLICIES = ("none", "full", "dots_saveable", "nothing_saveable",
+                      "dots_with_no_batch_dims_saveable", "offload_kv_host",
+                      "save_attn_seams", "save_ffn", "save_flash_lse")
 
     def _validate(self, path=""):
         super()._validate(path)

@@ -547,9 +547,14 @@ class InferenceEngine:
             expert_params = {n[4:]: lw[n] for n in lw
                              if n.startswith("moe_")
                              and n != "moe_gate" and not n.startswith("moe_shared")}
+            # scanned=True: _ffn runs inside the lax.scan over stacked
+            # layers — "auto" must not pick the megablox ragged path here
+            # (the ~4x scanned-gmm cliff, moe/resolve_moe_impl), same as
+            # the training stack_apply call site
             res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
                             capacity_factor=cfg.capacity_factor, activation=cfg.activation,
-                            impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk)
+                            impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk,
+                            scanned=True)
             out = res.output
             if cfg.moe_shared_expert_ff > 0:
                 shared = (jax.nn.silu(y @ lw["moe_shared_w_gate"])

@@ -586,9 +586,13 @@ class Transformer:
                              and name != "moe_gate" and not name.startswith("moe_shared")}
 
             def moe_branch(y2):
+                # scanned=True: layer_apply always runs under stack_apply's
+                # lax.scan — "auto" must not pick the megablox ragged path
+                # there (the ~4x scanned-gmm cliff, moe/resolve_moe_impl)
                 res = moe_layer(lw["moe_gate"], expert_params, y2, k=cfg.moe_top_k,
                                 capacity_factor=cfg.capacity_factor, activation=cfg.activation,
-                                impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk)
+                                impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk,
+                                scanned=True)
                 return res.output, res.aux_loss
 
             if moe_on is None:
@@ -677,6 +681,32 @@ class Transformer:
         alibi_sp_ok below for the replicated-fallback cases."""
         cfg = self.config
         sp, mesh = self._sp_mesh()
+        if (cfg.remat and cfg.remat_policy == "save_flash_lse"
+                and alibi is None and sp <= 1 and cfg.causal
+                and not cfg.local_attention_window):
+            # save_flash_lse: route through the lse-emitting kernel so the
+            # policy has residuals to save — the stock flash kernel's
+            # custom-vjp residuals are anonymous, which is exactly why
+            # save_attn_seams regressed (it paid HBM for the named "attn"
+            # seam while the flash forward still re-ran in backward to
+            # rebuild its out+lse residuals). SXT_LSE_INTERPRET=1 drives
+            # the kernel in interpret mode for CPU parity tests.
+            import os
+
+            from ..ops.flash_attention import (flash_attention_remat,
+                                               flash_lse_ok)
+
+            interp = bool(os.environ.get("SXT_LSE_INTERPRET"))
+            if interp or flash_lse_ok(q, k, cfg.causal):
+                return flash_attention_remat(q, k, v, causal=True,
+                                             interpret=interp)
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "remat_policy=save_flash_lse: shapes/backend do not qualify "
+                "for the lse flash kernel (head_dim 64/128, causal, Pallas "
+                "backend) — attention takes the standard path and the "
+                "policy saves nothing for this layer")
         if sp > 1:
             # The shard_map's batch spec needs the global batch divisible by
             # the data x fsdp extent; callers outside the training layout
@@ -1121,5 +1151,20 @@ def _remat_policy(name: str):
             "q", "kv", "attn"),
         "save_ffn": jax.checkpoint_policies.save_only_these_names(
             "q", "kv", "attn", "ffn_gate", "ffn_up"),
+        # Save the flash kernel's OWN residuals (out + logsumexp, named
+        # inside ops/alibi_attention._alibi_flash_fwd_impl) so backward
+        # enters the flash bwd kernels directly from saved state — the
+        # forward attention kernel is DCE'd out of the remat recompute.
+        # Why "save_attn_seams" lost ~1pt despite saving "attn": the layer-
+        # level attn seam is NOT a residual of the kernel's custom vjp —
+        # the backward replay still re-ran the flash forward to rebuild its
+        # (out, lse) residuals, so that policy paid the HBM for the saved
+        # seams without removing any attention recompute. Saving the
+        # residuals themselves (this policy) is what removes it; cost is
+        # out[B,T,H,D] bf16 + lse[B,H,T] f32 per layer. Requires the model
+        # to route attention through the lse kernel (Transformer._attention
+        # does this automatically under this policy).
+        "save_flash_lse": jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"),
     }
     return policies.get(name, jax.checkpoint_policies.dots_saveable)

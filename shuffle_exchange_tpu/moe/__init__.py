@@ -1,2 +1,3 @@
 from .gating import GateOutput, compute_capacity, top1_gating, top2_gating, topk_gating
-from .layer import MoEResult, expert_mlp, init_expert_mlp, moe_layer, residual_moe
+from .layer import (MoEResult, expert_mlp, init_expert_mlp, moe_layer,
+                    residual_moe, resolve_moe_impl)

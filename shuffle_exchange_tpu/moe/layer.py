@@ -137,10 +137,30 @@ class MoEResult(NamedTuple):
     metadata: dict
 
 
+def resolve_moe_impl(impl: str, ep_size: int, scanned: bool = False) -> str:
+    """Resolve ``impl="auto"`` to a concrete dispatch path.
+
+    - an expert axis > 1 -> "capacity" (the EP path; XLA inserts the
+      all-to-all pair around the sharded dispatch);
+    - under a scanned layer stack -> "capacity" even without an expert
+      axis: the Pallas megablox gmm ran the bench step ~4x slower inside
+      a ``lax.scan`` over stacked layer weights (5.3% vs 23.1% active-param
+      MFU on-chip, scripts/bench_moe_impl.py) — the scan context starves
+      the grouped kernel; standalone gmm is fine;
+    - otherwise -> "ragged" (dropless grouped-GEMM).
+    """
+    if impl != "auto":
+        return impl
+    if ep_size > 1 or scanned:
+        return "capacity"
+    return "ragged"
+
+
 def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0,
               activation: str = "swiglu", train: bool = True, rng=None,
               noise_std: float = 0.0, min_capacity: int = 4, expert_axis: str = "expert",
-              mesh=None, impl: str = "auto", normalize_weights: bool = True) -> MoEResult:
+              mesh=None, impl: str = "auto", normalize_weights: bool = True,
+              scanned: bool = False) -> MoEResult:
     """x [..., M] -> MoEResult. gate_w [M, E].
 
     impl:
@@ -159,7 +179,10 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
         the Pallas megablox gmm ran the bench step 2.4x SLOWER than the
         capacity einsums (5.3% vs 12.5% active-param MFU) — measure before
         picking ragged for a scanned stack; standalone gmm is fine.
-      - "auto": ragged when the mesh has no expert axis > 1, else capacity.
+      - "auto": capacity when the mesh has an expert axis > 1 OR the layer
+        runs under a scanned stack (``scanned=True`` — the model's
+        ``stack_apply`` passes it; megablox gmm measured ~4x slower there,
+        see ``resolve_moe_impl``); ragged otherwise.
     """
     import jax
     import jax.numpy as jnp
@@ -186,15 +209,25 @@ def moe_layer(gate_w, expert_params, x, k: int = 2, capacity_factor: float = 1.0
             from ..parallel.mesh import get_topology, topology_is_initialized
 
             ep = get_topology().size(expert_axis) if topology_is_initialized() else 1
-        impl = "capacity" if ep > 1 else "ragged"
-        if impl == "ragged":
-            from ..utils.logging import warning_once
+        impl = resolve_moe_impl("auto", ep, scanned)
+        from ..utils.logging import warning_once
 
+        if impl == "ragged":
             warning_once(
                 "moe_impl=auto resolved to the dropless ragged grouped-GEMM "
-                "path (no expert axis > 1): capacity_factor/min_capacity/"
-                "drop semantics do not apply — set moe_impl='capacity' to "
-                "keep GShard capacity/drop behavior")
+                "path (no expert axis > 1, unscanned): capacity_factor/"
+                "min_capacity/drop semantics do not apply — set "
+                "moe_impl='capacity' to keep GShard capacity/drop behavior")
+        elif ep <= 1 and scanned:
+            warning_once(
+                "moe_impl=auto resolved to the capacity (index-dispatch) "
+                "path: this layer runs under a scanned stack, where the "
+                "ragged megablox grouped-GEMM measured ~4x SLOWER on-chip "
+                "(5.3% vs 23.1% active-param MFU, scripts/bench_moe_impl.py)."
+                " Capacity/drop semantics apply (capacity_factor/"
+                "min_capacity; overflow tokens drop) — set "
+                "moe_impl='ragged' to force dropless routing despite the "
+                "perf cliff")
     if impl == "ragged":
         from .gating import topk_select
 

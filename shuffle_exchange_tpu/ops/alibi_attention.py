@@ -333,7 +333,17 @@ def _alibi_flash_fwd_impl(q, k, v, slopes, causal: bool, interpret: bool):
                                  "arbitrary")),
         interpret=interpret,
     )(slopes, qt, kt, vt)
-    return out.transpose(0, 2, 1, 3), lse[..., 0]
+    # Named as remat seams (the splash kernel's residual_checkpoint_name
+    # pattern): under remat_policy="save_flash_lse" these are exactly the
+    # custom-vjp residuals the backward needs, so the policy's
+    # save_only_these_names DCEs the forward kernel out of the backward
+    # recompute — the bwd kernels consume the SAVED out+lse directly.
+    # No-op under every other policy.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out.transpose(0, 2, 1, 3), "flash_out")
+    lse = checkpoint_name(lse[..., 0], "flash_lse")
+    return out, lse
 
 
 import jax  # noqa: E402  (after module docstring; kernels import lazily)

@@ -268,6 +268,47 @@ def pallas_attention(q, k, v, causal: bool = True, segment_ids=None):
     return out[:, :t0] if t_pad else out
 
 
+def flash_lse_ok(q, k, causal: bool = True) -> bool:
+    """Gate for the ``save_flash_lse`` remat route: the lse-emitting kernel
+    family (ops/alibi_attention) handles head_dim 64/128, causal only (the
+    route pads ragged T/S up to the 128 tile, which is mask-free only under
+    the causal mask), SELF-attention shapes only (T == S: independent
+    padding of unequal T/S would change the kernel's causal diagonal
+    offset ``off = S - T`` and silently move the mask), on a Pallas-enabled
+    backend."""
+    from .dispatch import pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    d = q.shape[3]
+    return bool(causal and d in (64, 128) and k.shape[1] == q.shape[1])
+
+
+def flash_attention_remat(q, k, v, causal: bool = True, interpret: bool = False):
+    """Attention whose forward never re-runs under the ``save_flash_lse``
+    remat policy: routes through ``flash_attention_lse`` (the fused kernel
+    that emits out + logsumexp, both checkpoint-named inside its custom-vjp
+    forward), so with ``save_only_these_names("flash_out", "flash_lse")``
+    the backward enters the flash bwd kernels directly from the saved
+    residuals. Ragged T/S (label-shifted T-1) pads up to the 128 tile the
+    same way ``pallas_attention`` does — exact under the causal mask."""
+    import jax.numpy as jnp
+
+    from .alibi_attention import flash_attention_lse
+
+    assert causal, "flash_attention_remat pads ragged seqs; causal only"
+    t0, s0 = q.shape[1], k.shape[1]
+    # Self-attention only: padding T and S independently would change the
+    # kernel's causal diagonal offset (off = S - T) and move the mask.
+    assert t0 == s0, "flash_attention_remat requires T == S (self-attention)"
+    t_pad, s_pad = -t0 % 128, -s0 % 128
+    if t_pad or s_pad:
+        pad4 = lambda x, p: jnp.pad(x, ((0, 0), (0, p), (0, 0), (0, 0)))
+        q, k, v = pad4(q, t_pad), pad4(k, s_pad), pad4(v, s_pad)
+    out, _ = flash_attention_lse(q, k, v, causal, interpret)
+    return out[:, :t0] if t_pad else out
+
+
 def flash_attention(q, k, v, causal: bool = True, impl: str = "auto", segment_ids=None,
                     alibi_slopes=None):
     """q [B,T,H,D], k/v [B,S,Hkv,D] -> [B,T,H,D].

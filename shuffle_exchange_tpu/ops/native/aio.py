@@ -143,3 +143,48 @@ def get_io_engine(num_threads: int = 4) -> AsyncIOEngine:
     if _DEFAULT is None or (_DEFAULT._handle is None and _DEFAULT._lib is not None):
         _DEFAULT = AsyncIOEngine(num_threads=num_threads)
     return _DEFAULT
+
+
+class PinnedBufferPool:
+    """Long-lived page-aligned host staging buffers (the native AIO pool's
+    allocator, ``csrc/aio.cc:sxt_aligned_alloc``).
+
+    The host-offload pipeline stages its H2D parameter mirrors here: the
+    buffers are allocated once at optimizer construction and rewritten every
+    step, so the transfer path never touches the Python allocator, and the
+    4096-alignment keeps them O_DIRECT-capable for the NVMe tier. (TPU hosts
+    have no cudaHostRegister-style pinning API — alignment + reuse is the
+    whole of what "pinned" can mean here.) Falls back to ``np.empty`` when
+    the native library is unavailable.
+    """
+
+    ALIGNMENT = 4096
+
+    def __init__(self):
+        self._lib = load_native()
+        self._ptrs: List[int] = []
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def empty(self, shape, dtype) -> np.ndarray:
+        import ctypes
+
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if self._lib is None or nbytes == 0:
+            return np.empty(shape, dtype)
+        ptr = self._lib.sxt_aligned_alloc(nbytes, self.ALIGNMENT)
+        if not ptr:
+            return np.empty(shape, dtype)
+        self._ptrs.append(ptr)
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def close(self) -> None:
+        # Caller contract: no numpy views of the buffers outlive the pool.
+        if self._lib is not None:
+            for ptr in self._ptrs:
+                self._lib.sxt_aligned_free(ptr)
+        self._ptrs.clear()

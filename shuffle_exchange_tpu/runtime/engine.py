@@ -440,6 +440,7 @@ class Engine:
         self._opt_dev_shardings = self.opt_shardings
         self._host_opt = None
         self._host_opt_wanted = False
+        self._host_pipeline = None
         if off.enabled and off.device == "cpu":
             # cpu tier, reference semantics (DeepSpeedCPUAdam under
             # ZeRO-Offload, ops/adam/cpu_adam.py:10): fp32 master + moments
@@ -1260,6 +1261,7 @@ class Engine:
 
         from .zero.host_optimizer import HostAdamOptimizer
 
+        off = self.config.zero_optimization.offload_optimizer
         p = dict(self.config.optimizer.params)
         betas = p.get("betas", (0.9, 0.999))
         base_lr = get_base_lr(self.config.optimizer)
@@ -1275,7 +1277,9 @@ class Engine:
             # cpu offload on does not change the weight-decay semantics
             adamw=bool(p.get("adam_w_mode", self.config.optimizer.type.lower()
                              in ("adamw", "fusedadam", "cpuadam"))),
-            grad_clip=float(self.config.gradient_clipping or 0.0))
+            grad_clip=float(self.config.gradient_clipping or 0.0),
+            # overlap stages its H2D mirrors in the aligned native pool
+            pinned=bool(off.pin_memory or off.offload_overlap))
         # free the device fp32/opt copies; HBM keeps bf16 only
         for l in leaves + jax.tree_util.tree_leaves(self.state.opt_state):
             try:
@@ -1284,6 +1288,51 @@ class Engine:
                 pass
         self.state = self.state._replace(master=None, opt_state=None)
         self._fwd16 = self._place_bf16(self._host_opt.bf16_tree())
+        if off.offload_overlap:
+            from .zero.overlap import HostOffloadPipeline
+
+            sh_leaves = jax.tree_util.tree_leaves(self.param_shardings)
+            self._host_pipeline = HostOffloadPipeline(
+                self._host_opt, sh_leaves,
+                bucket_bytes=int(off.overlap_bucket_mb) * (1 << 20))
+            log_dist("optimizer offload: overlapped pipeline on "
+                     f"({len(self._host_pipeline.buckets)} grad buckets, "
+                     "delayed parameter application)", ranks=[0])
+
+    def _join_host_update(self) -> None:
+        """Land the in-flight overlapped optimizer step (delayed parameter
+        application): assemble the new bf16 forward tree from the uploads
+        the pipeline worker dispatched, and republish its time budget
+        through the monitor + comms logger. Raises the worker's error if
+        the step crashed mid-pipeline — torn state never flows onward."""
+        pipe = self._host_pipeline
+        if pipe is None:
+            return
+        import jax
+
+        new_leaves = pipe.join()
+        if new_leaves is None:
+            return
+        self._fwd16 = jax.tree_util.tree_unflatten(self._host_opt.treedef,
+                                                   new_leaves)
+        c = pipe.counters
+        n_bytes = sum(p.size for p in self._host_opt.params)
+        from ..parallel.comm import comms_logger
+
+        # the cpu tier's wire budget: grads down fp32 (4 B/param), params
+        # up bf16 (2 B/param) — the ZeRO-Offload transfer argument
+        comms_logger.record("offload_d2h_grads", 4 * n_bytes,
+                            elapsed=c.get("d2h_wait_s"))
+        comms_logger.record("offload_h2d_params", 2 * n_bytes,
+                            elapsed=c.get("h2d_dispatch_s"))
+        s = self.global_samples
+        self.monitor.write_events([
+            ("offload/d2h_wait_s", c.get("d2h_wait_s", 0.0), s),
+            ("offload/host_adam_s", c.get("host_adam_s", 0.0), s),
+            ("offload/h2d_dispatch_s", c.get("h2d_dispatch_s", 0.0), s),
+            ("offload/pipeline_s", c.get("pipeline_s", 0.0), s),
+            ("offload/overlap_steps", c.get("steps", 0.0), s),
+        ])
 
     def _place_bf16(self, tree):
         import jax
@@ -1294,18 +1343,30 @@ class Engine:
     def _host_train_batch(self, batch):
         """The cpu-tier step: device grads -> host fused AdamW -> device
         bf16 weights (reference ZeRO-Offload step, stage_1_and_2.py +
-        cpu_adam)."""
+        cpu_adam).
+
+        With ``offload_optimizer.offload_overlap`` the D2H / host-update /
+        H2D stages run on the pipeline worker (runtime/zero/overlap.py) and
+        the updated parameters land at the NEXT step's entry (delayed
+        parameter application) — train_batch returns while the host update
+        is still in flight, bit-exact with the synchronous path."""
         import jax
 
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        self._join_host_update()   # step N-1's params land here
         shaped = self._reshape_batch(batch)
         rng = self._next_rng()
+        t_dispatch = time.perf_counter()
         grads, loss = self._grads_batch(self._fwd16, shaped, rng)
-        grad_leaves = [np.asarray(jax.device_get(g), dtype=np.float32)
-                       for g in jax.tree_util.tree_leaves(grads)]
-        self._host_opt.step(grad_leaves)
-        self._fwd16 = self._place_bf16(self._host_opt.bf16_tree())
+        if self._host_pipeline is not None:
+            self._host_pipeline.submit(jax.tree_util.tree_leaves(grads),
+                                       dispatched_at=t_dispatch)
+        else:
+            grad_leaves = [np.asarray(jax.device_get(g), dtype=np.float32)
+                           for g in jax.tree_util.tree_leaves(grads)]
+            self._host_opt.step(grad_leaves)
+            self._fwd16 = self._place_bf16(self._host_opt.bf16_tree())
         self._post_step(False)
         if self.monitor.enabled:
             s = self.global_samples
@@ -1313,12 +1374,18 @@ class Engine:
                 ("Train/Samples/train_loss", float(loss), s),
                 ("Train/Samples/lr", self.get_lr(), s),
             ])
+        if self._host_pipeline is not None:
+            self._host_pipeline.mark("step_return")
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         return loss
 
     def _ensure_opt_resident(self) -> None:
         """Bring swapped-out optimizer state back on device."""
+        # The overlapped host pipeline must land (or surface its crash)
+        # before anything reads or persists optimizer state — a checkpoint
+        # can never observe a half-applied step.
+        self._join_host_update()
         if getattr(self, "_offloaded_states", None) is not None:
             # offload_states() parked master+opt on host; running a step with
             # state.master=None would die deep inside the jitted step with an
@@ -1545,6 +1612,7 @@ class Engine:
             self.reload_states()
         shaped = self._reshape_batch(batch, gas=1)
         if self._host_opt is not None:
+            self._join_host_update()
             if not hasattr(self, "_eval16"):
                 import jax
 
@@ -1671,6 +1739,7 @@ class Engine:
         """Current forward weights (bit16). In ensemble mode, the uniform
         consensus average by default (else replica-stacked)."""
         if self._host_opt is not None:
+            self._join_host_update()
             return self._fwd16
         mix = self._mix_matrix(sync_matrix=consensus)
         return self._materialize(self.state, mix)
@@ -1864,6 +1933,13 @@ class Engine:
             self._finalize_pending_checkpoint()
         except Exception:
             pass
+        # Release the offload pipeline's worker + atexit registration so a
+        # discarded engine (in-process restart loops) frees its host state.
+        try:
+            if getattr(self, "_host_pipeline", None) is not None:
+                self._host_pipeline.close()
+        except Exception:
+            pass
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
@@ -1882,6 +1958,11 @@ class Engine:
         from ..checkpoint.engine import NoLoadableCheckpoint, load_with_fallback
 
         self._finalize_pending_checkpoint()
+        if self._host_pipeline is not None:
+            # restore overwrites every host-optimizer leaf, so whatever a
+            # torn/poisoned in-flight step left behind is irrelevant — drop
+            # it instead of re-raising at the join below
+            self._host_pipeline.reset()
         self._ensure_opt_resident()
         try:
             result = load_with_fallback(

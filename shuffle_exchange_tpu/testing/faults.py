@@ -22,6 +22,10 @@ Sites (``Fault.site``):
   loss/grads come out non-finite (drives the non-finite sentinel).
 - ``sigterm_mid_step``   — deliver SIGTERM to this process at global step
   ``index`` (drives the preemption hook).
+- ``offload_bucket_update`` — kill the overlapped host-offload optimizer
+  pipeline before bucket ``index``'s host update (runtime/zero/overlap.py);
+  the error surfaces at the next pipeline join and poisons the pipeline, so
+  a half-applied step can never reach a checkpoint.
 - ``corrupt_manifest`` / ``drop_manifest`` / ``corrupt_shard`` — post-commit
   damage to an already-committed tag (drives checksum verification and the
   newest-complete-tag fallback on load). ``index`` selects the manifest
@@ -52,7 +56,7 @@ class InjectedFault(Exception):
 SITES = (
     "ckpt_shard_write", "ckpt_manifest_write", "ckpt_item_save",
     "ckpt_pre_commit", "ckpt_pre_latest",
-    "nan_loss", "sigterm_mid_step",
+    "nan_loss", "sigterm_mid_step", "offload_bucket_update",
     "corrupt_manifest", "drop_manifest", "corrupt_shard",
 )
 

@@ -36,3 +36,46 @@ def test_bench_main_cpu_smoke_emits_contract_line():
     # a CPU run must never publish into the committed baseline
     assert "config1_tiny_cpu" not in json.load(
         open(os.path.join(REPO, "BASELINE.json")))["published"]
+
+
+def test_host_offload_ladder_entry_runs_at_toy_size():
+    """The config-2 host-offload ladder entry (bench.py
+    host_offload_ladder_entry) at toy size: same config SHAPE — cpu offload
+    tier + offload_overlap + save_flash_lse remat — trains on CPU, so the
+    published bench config cannot rot."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from bench import host_offload_ladder_entry
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer
+    from shuffle_exchange_tpu.parallel import reset_topology
+
+    name, mcfg, ds, bs, seq = host_offload_ladder_entry(toy=True)
+    assert mcfg.remat and mcfg.remat_policy == "save_flash_lse"
+    off = ds["zero_optimization"]["offload_optimizer"]
+    assert off["device"] == "cpu" and off["offload_overlap"] is True
+
+    reset_topology()
+    engine, *_ = sxt.initialize(model=Transformer(mcfg), config=ds)
+    assert engine._host_opt is not None, "host-resident optimizer not engaged"
+    assert engine._host_pipeline is not None, "overlap pipeline not engaged"
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, mcfg.vocab_size,
+                                       size=(bs, seq)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(2)]
+    assert all(np.isfinite(l) for l in losses)
+    engine.module_weights()    # joins the in-flight overlapped step
+    assert engine.monitor.memory_monitor.latest("offload/overlap_steps") >= 1
+
+    # the full-size entry agrees with the published claims: ~1.5-2B params,
+    # host-offload + overlap + save_flash_lse, north-star head geometry
+    from bench import _param_count
+
+    name_f, mcfg_f, ds_f, _, _ = host_offload_ladder_entry()
+    n = _param_count(mcfg_f)
+    assert 1.5e9 <= n <= 2.0e9, n
+    assert mcfg_f.head_dim == 128 and mcfg_f.n_heads // mcfg_f.kv_heads == 4
+    assert ds_f["zero_optimization"]["offload_optimizer"]["offload_overlap"]

@@ -71,6 +71,25 @@ def test_flash_attention_lse_lowers():
     _tpu_lower(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
 
 
+@pytest.mark.parametrize("t", [512, 127])
+def test_save_flash_lse_policy_lowers(t):
+    """The save_flash_lse remat path — jax.checkpoint with the named-seam
+    policy around the lse kernel route — must pass the real TPU lowering,
+    including the backward that consumes the SAVED out+lse residuals, for
+    both exact-tile and ragged (pad-to-128) sequence lengths."""
+    from shuffle_exchange_tpu.models.transformer import _remat_policy
+    from shuffle_exchange_tpu.ops.flash_attention import flash_attention_remat
+
+    q = jnp.zeros((1, t, 4, 128), jnp.bfloat16)
+
+    def body(q, k, v):
+        return flash_attention_remat(q, k, v, True, False).astype(
+            jnp.float32).sum()
+
+    f = jax.checkpoint(body, policy=_remat_policy("save_flash_lse"))
+    _tpu_lower(jax.grad(f, argnums=(0, 1, 2)), q, q, q)
+
+
 @pytest.mark.parametrize("with_alibi", [False, True])
 def test_paged_decode_and_extend_lower(with_alibi):
     from shuffle_exchange_tpu.models.transformer import alibi_slopes

@@ -1,0 +1,137 @@
+"""``save_flash_lse`` remat policy: the backward enters the flash bwd
+kernels from SAVED residuals (attention output + logsumexp, named inside
+the kernel's custom-vjp forward) instead of re-running forward attention.
+
+CPU-runnable via ``SXT_LSE_INTERPRET=1`` (the lse kernel family executes
+under the Pallas interpreter); the TPU Mosaic lowering of the policy path
+is gated hostless in ``tests/test_mosaic_lowering.py``.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.models.transformer import _remat_policy
+
+
+def _cfg(policy):
+    # d=256/heads=4 -> head_dim 64 (kernel-eligible); seq 129 so the
+    # label-shifted model T-1 = 128 exercises the exact-tile path while
+    # tiny ragged shapes go through the pad-to-128 route in other tests
+    return tiny(vocab=128, d=256, layers=2, heads=4, seq=129,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                remat=True, remat_policy=policy)
+
+
+def _loss_grads(cfg, batch, rng):
+    m = Transformer(cfg)
+    params = m.init(jax.random.PRNGKey(0))
+    loss = float(m.loss(params, batch, rng))
+    grads = jax.grad(lambda p: m.loss(p, batch, rng))(params)
+    return loss, grads, m, params
+
+
+def test_save_flash_lse_gradients_match_default(monkeypatch, devices8):
+    """Gradients under save_flash_lse (interpret-mode lse kernels) match
+    the default remat policy (reference attention) to tolerance."""
+    monkeypatch.setenv("SXT_LSE_INTERPRET", "1")
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(2, 129)).astype(np.int32)}
+    rng = jax.random.PRNGKey(1)
+    l_lse, g_lse, _, _ = _loss_grads(_cfg("save_flash_lse"), batch, rng)
+    monkeypatch.delenv("SXT_LSE_INTERPRET")
+    l_ref, g_ref, _, _ = _loss_grads(_cfg("dots_saveable"), batch, rng)
+    assert l_lse == pytest.approx(l_ref, rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_lse),
+                    jax.tree_util.tree_leaves(g_ref)):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        np.testing.assert_allclose(a, b, rtol=2e-4,
+                                   atol=2e-4 * (np.abs(b).max() + 1e-12))
+
+
+def test_save_flash_lse_skips_forward_recompute(monkeypatch, devices8):
+    """The structural claim (why save_attn_seams lost a point and this
+    policy does not): under save_flash_lse the flash FORWARD kernel appears
+    exactly once in the grad program (primal pass; the recompute's copy is
+    DCE'd because both of its outputs — out and lse — are saved residuals),
+    so the backward holds only the dq/dkv kernels: 3 pallas calls total.
+    Without the save the forward re-runs: 4."""
+    monkeypatch.setenv("SXT_LSE_INTERPRET", "1")
+    from shuffle_exchange_tpu.ops.flash_attention import flash_attention_remat
+
+    q = jnp.ones((1, 128, 4, 64), jnp.float32)
+
+    def body(q):
+        return flash_attention_remat(q, q, q, True, True).astype(
+            jnp.float32).sum()
+
+    counts = {}
+    for pol in ("save_flash_lse", "nothing_saveable"):
+        f = jax.checkpoint(body, policy=_remat_policy(pol))
+        counts[pol] = str(jax.make_jaxpr(jax.grad(f))(q)).count("pallas_call")
+    assert counts["save_flash_lse"] == 3
+    assert counts["nothing_saveable"] == 4
+
+    # and the model-level wiring routes through the kernel: the rematted
+    # scan body carries the lse kernels (3 per layer body), while a policy
+    # that does not engage the route carries none (reference attention)
+    batch = {"input_ids": np.zeros((2, 129), np.int32)}
+    rng = jax.random.PRNGKey(1)
+    for pol, expect in (("save_flash_lse", 3), ("nothing_saveable", 0)):
+        m = Transformer(_cfg(pol))
+        params = m.init(jax.random.PRNGKey(0))
+        s = str(jax.make_jaxpr(
+            jax.grad(lambda p: m.loss(p, batch, rng)))(params))
+        assert s.count("pallas_call") == expect, pol
+
+
+def test_save_flash_lse_ragged_seq_pads(monkeypatch, devices8):
+    """Label-shifted ragged T (not a 128 multiple) rides the pad-to-tile
+    route; forward matches the unpadded reference attention exactly on the
+    real rows."""
+    monkeypatch.setenv("SXT_LSE_INTERPRET", "1")
+    from shuffle_exchange_tpu.ops.flash_attention import (
+        flash_attention_remat, reference_attention)
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 100, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 100, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 100, 2, 64)), jnp.float32)
+    out = flash_attention_remat(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_save_flash_lse_falls_back_when_ineligible(devices8):
+    """Without the interpret knob on a CPU backend the route falls back to
+    the standard attention path (policy saves nothing, training still
+    correct) — the warning documents it."""
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, size=(2, 65)).astype(np.int32)}
+    rng = jax.random.PRNGKey(1)
+    cfg = dataclasses.replace(_cfg("save_flash_lse"), max_seq_len=65)
+    m = Transformer(cfg)
+    params = m.init(jax.random.PRNGKey(0))
+    loss = float(m.loss(params, batch, rng))
+    assert np.isfinite(loss)
+    g = jax.grad(lambda p: m.loss(p, batch, rng))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_activation_checkpointing_config_accepts_named_policies():
+    from shuffle_exchange_tpu.config.config import SXConfig
+
+    cfg = SXConfig.load({
+        "train_batch_size": 8,
+        "activation_checkpointing": {"enabled": True,
+                                     "policy": "save_flash_lse"},
+    })
+    assert cfg.activation_checkpointing.policy == "save_flash_lse"
