@@ -15,8 +15,16 @@ cd "$(dirname "$0")/.."
 python -m pytest tests/test_fused_decode.py tests/test_mosaic_lowering.py \
     tests/test_resilience.py tests/test_offload_overlap.py \
     tests/test_remat_lse.py -q "$@"
+# ZeRO++ wire gates (ISSUE 4): real-s8 HLO + rejection pins per mesh,
+# bucketed/two-level collective parity, and the 8->4 device elasticity
+# drill (preempt mid-step, resume resharded via the universal checkpoint).
+python -m pytest tests/test_zeropp_wire_meshes.py tests/test_comm_buckets.py \
+    tests/test_elasticity_drill.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \
     --ignore=tests/test_offload_overlap.py \
-    --ignore=tests/test_remat_lse.py "$@"
+    --ignore=tests/test_remat_lse.py \
+    --ignore=tests/test_zeropp_wire_meshes.py \
+    --ignore=tests/test_comm_buckets.py \
+    --ignore=tests/test_elasticity_drill.py "$@"

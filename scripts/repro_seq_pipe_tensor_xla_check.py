@@ -1,0 +1,74 @@
+"""Minimized XLA repro: seq x pipe x tensor (VERDICT r4 #7 residue).
+
+seq x pipe composes (the Ulysses region, partial-manual over
+{data, fsdp, seq}, nests inside the pipeline's manual-over-"pipe" region on
+jax >= 0.5). Adding a LIVE tensor axis on top CHECK-fails XLA's
+partial-manual subgroup partitioner (spmd_partitioner_util.cc:495 on the
+round-5 toolchain; spmd_partitioner.cc:512 "Check failed:
+target.IsManualSubgroup() == sharding().IsManualSubgroup()" on jax 0.4.x) —
+with tensor-sharded heads AND with gathered heads alike. The engine
+therefore rejects mesh seq>1 x pipe>1 x tensor>1 with a targeted
+ConfigError (runtime/engine.py __init__; pinned by
+tests/test_zeropp_wire_meshes.py) rather than aborting at run time.
+
+This is the minimal structure: an outer manual-over-"pipe" region (the
+pipeline stage loop) containing a nested region that binds {data, seq} and
+runs the Ulysses all-to-all, while a "tensor" axis stays AUTO and LIVE
+(size > 1) — the auto tensor component is what trips the partitioner's
+manual-subgroup bookkeeping.
+
+Run: python scripts/repro_seq_pipe_tensor_xla_check.py
+EXPECT: a fatal XLA CHECK (process abort), not a python exception.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+try:
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh, in_specs, out_specs, manual):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False, auto=frozenset(mesh.axis_names) - manual)
+except ImportError:  # jax >= 0.5
+    def shard_map(f, mesh, in_specs, out_specs, manual):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False,
+                             axis_names=manual)
+
+
+def main() -> None:
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("pipe", "seq", "tensor"))   # tensor LIVE (size 2)
+
+    def pipe_region(x):          # the pipeline stage loop (manual "pipe")
+        def ulysses(y):          # the attention region (manual "seq")
+            # the seq<->head all-to-all at the heart of Ulysses
+            return jax.lax.all_to_all(y, "seq", split_axis=1,
+                                      concat_axis=0, tiled=True)
+
+        y = shard_map(ulysses, mesh, P("seq", None), P("seq", None),
+                      manual={"seq"})(x)
+        # ppermute = the pipeline's activation hand-off
+        return jax.lax.ppermute(y, "pipe", [(0, 1)])
+
+    f = shard_map(pipe_region, mesh, P(None, None), P(None, None),
+                  manual={"pipe"})
+    x = jnp.arange(32.0).reshape(4, 8)
+    out = jax.jit(f)(x)
+    print("UNEXPECTED: seq x pipe x tensor lowered fine:", out.shape,
+          "— re-test the engine's ConfigError gate on this toolchain")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Minimized XLA repro: why the ZeRO++ s8 wire region cannot nest with the
+sequence-parallel (or any non-psum-collective) region on a shared mesh.
+
+The engine's quantized-gradient wire must be a manual shard_map over the
+ZeRO axes (data, fsdp) ENCLOSING loss+grad — that is the only place the
+per-device unreduced gradients exist to intercept. On seq meshes the
+Ulysses/ring attention region (manual over {data, fsdp, seq}) would then
+have to NEST inside it. Both nesting directions die in XLA's SPMD
+partitioner:
+
+  * inner region binding an axis the outer region left auto, with an
+    all-to-all/all-gather inside  ->  hard CHECK abort at
+    spmd_partitioner.cc:512  "Check failed: target.IsManualSubgroup() ==
+    sharding().IsManualSubgroup()"  (jax 0.4.x), or the Shardy
+    partial-manual rejection (jax >= 0.5, round-5 record);
+  * flattening instead (one region manual over {data, fsdp, seq}) is not a
+    lowering problem but a SEMANTIC one: the model's label shift and RoPE
+    positions are written against the global sequence dim, which a flat
+    manual region would shard.
+
+Hence `runtime/engine.py` raises a targeted ConfigError for
+zero_quantized_gradients/weights on seq > 1 meshes (pinned by
+tests/test_zeropp_wire_meshes.py) instead of silently emulating.
+
+Run: python scripts/repro_wire_nesting_xla_check.py [inner|outer]
+  inner — the fatal direction (wire region OUTER, collective region
+          INNER). EXPECT A PROCESS ABORT (F check), not an exception.
+  outer — the direction that works when the inner axes are disjoint from
+          the outer's manual set AND only psum runs inside (prints ok) —
+          the loophole the seq/pipe regions cannot use, since Ulysses needs
+          an all-to-all.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+try:
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh, in_specs, out_specs, manual):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False, auto=frozenset(mesh.axis_names) - manual)
+except ImportError:  # jax >= 0.5
+    def shard_map(f, mesh, in_specs, out_specs, manual):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False,
+                             axis_names=manual)
+
+
+def main(direction: str) -> None:
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "fsdp", "seq"))
+
+    if direction == "inner":
+        # The wire region (manual data,fsdp; seq left auto) encloses an
+        # attention-like region that binds "seq" and runs an all-to-all —
+        # the Ulysses core. This is the nesting the engine would need for
+        # qgZ on seq meshes. EXPECT: spmd_partitioner.cc CHECK abort
+        # (jax 0.4.x) / Shardy rejection (jax >= 0.5).
+        def wire_region(x):      # x local over (data, fsdp): [2, 8]
+            def ulysses(y):      # y local over seq on dim 1
+                return jax.lax.all_to_all(y, "seq", split_axis=0,
+                                          concat_axis=1, tiled=True)
+
+            y = shard_map(ulysses, mesh, P(None, "seq"), P(None, "seq"),
+                          manual={"seq"})(x)
+            return jax.lax.psum(y, ("data", "fsdp"))
+
+        f = shard_map(wire_region, mesh, P(("data", "fsdp")), P(),
+                      manual={"data", "fsdp"})
+        print(jax.jit(f)(jnp.arange(32.0).reshape(8, 4)))
+        print("UNEXPECTED: nesting lowered — the engine gate can be lifted")
+    else:
+        # Control: inner manual axes disjoint from outer's, psum only —
+        # this composes (it is how seq nests inside pipe on jax >= 0.5).
+        def outer(x):
+            def inner(y):
+                return jax.lax.psum(y, ("data", "fsdp"))
+
+            return shard_map(inner, mesh, P(None, ("data", "fsdp")), P(),
+                             manual={"data", "fsdp"})(x)
+
+        f = shard_map(outer, mesh, P("seq"), P("seq"), manual={"seq"})
+        print("outer-direction psum compose ok:",
+              jax.jit(f)(jnp.arange(16.0).reshape(2, 8)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "inner")

@@ -88,6 +88,8 @@ class Candidate:
     seq_par: int = 1               # mesh seq split (Ulysses sequence parallel)
     offload: Optional[str] = None  # optimizer offload tier: None | cpu | nvme
     seq_len: Optional[int] = None  # None = the tuner's base sequence length
+    bucket_mb: Optional[int] = None  # zeropp.bucket_mb (quantized-wire
+                                     # launch coalescing); None = config default
     est_bytes: int = 0
     metric_val: float = float("nan")
     status: str = "pending"        # pending | pruned | ok | oom | error
@@ -104,6 +106,8 @@ class Candidate:
             n += f"_off{self.offload}"
         if self.seq_len:
             n += f"_sl{self.seq_len}"
+        if self.bucket_mb is not None:
+            n += f"_bkt{self.bucket_mb}"
         return n
 
     def as_config_patch(self) -> Dict[str, Any]:
@@ -122,6 +126,8 @@ class Candidate:
         patch["tensor_parallel"] = {"tp_size": self.tensor}
         if self.offload:
             patch["zero_optimization"]["offload_optimizer"] = {"device": self.offload}
+        if self.bucket_mb is not None:
+            patch["zeropp"] = {"bucket_mb": self.bucket_mb}
         return patch
 
 
@@ -172,7 +178,8 @@ class Autotuner:
                    tensor_list: Optional[Sequence[int]] = None,
                    offload_opts: Sequence[Optional[str]] = (None,),
                    seq_lens: Sequence[Optional[int]] = (None,),
-                   seq_par_list: Sequence[int] = (1,)) -> List[Candidate]:
+                   seq_par_list: Sequence[int] = (1,),
+                   bucket_mb_list: Sequence[Optional[int]] = (None,)) -> List[Candidate]:
         if mbs_list is None:
             lo = self.at.min_train_micro_batch_size_per_gpu if self.at else 1
             hi = self.at.max_train_micro_batch_size_per_gpu if self.at and \
@@ -194,16 +201,16 @@ class Autotuner:
         # tp x sp combos must jointly divide the device count (batch
         # shards over the remaining data extent)
         out = []
-        for mbs, gas, z, r, t, off, sl, sp_ in itertools.product(
+        for mbs, gas, z, r, t, off, sl, sp_, bkt in itertools.product(
                 mbs_list, gas_list, stages, remat_opts, tensor_list,
-                offload_opts, seq_lens, seq_par_list):
+                offload_opts, seq_lens, seq_par_list, bucket_mb_list):
             if self.world % (t * sp_):
                 continue
             if self.at and self.at.max_train_batch_size and \
                     mbs * gas * (self.world // (t * sp_)) > self.at.max_train_batch_size:
                 continue
             out.append(Candidate(mbs, gas, z, r, tensor=t, seq_par=sp_,
-                                 offload=off, seq_len=sl))
+                                 offload=off, seq_len=sl, bucket_mb=bkt))
         return out
 
     # -- memory pruning ------------------------------------------------

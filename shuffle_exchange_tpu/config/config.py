@@ -168,6 +168,49 @@ class ZeroConfig(ConfigModel):
         return self.overlap_comm if self.overlap_comm is not None else (self.stage == 3)
 
 
+@dataclass
+class ZeroPPConfig(ConfigModel):
+    """ZeRO++ wire-shaping knobs (TPU-first section; the qwZ/qgZ enables
+    stay on ``zero_optimization`` for reference-JSON compatibility).
+
+    ``hierarchical_axes``: ``[intra, inter]`` mesh axis names declaring a
+    fast/slow comms split (ICI slice vs DCN). When set, qgZ's gradient
+    reduction becomes the two-level schedule: full-precision reduce-scatter
+    inside the intra axis (cheap, exact), int8 wire across the inter axis
+    (where bytes are the step-time ceiling), full-precision all-gather back
+    inside the intra axis — the reference's intra-node/inter-node qgZ
+    split (runtime/comm/coalesced_collectives.py:31). Unset = the flat
+    schedule: one blockwise-int8 reduction over all ZeRO axes.
+
+    ``bucket_mb``: coalesce gradient leaves into ~this many MB of (logical
+    fp32) gradient per wire collective (runtime/zero/buckets.py). Leaves
+    are still QUANTIZED per leaf — bucketing changes launch count, never
+    rounding — so the bucketed wire is bit-exact with the per-leaf wire.
+    0 = one collective per leaf. Autotuner-visible.
+
+    ``group_size``: blockwise-int8 quantization group (elements per scale).
+    """
+
+    hierarchical_axes: Optional[List[str]] = config_field(None)
+    bucket_mb: int = config_field(32, ge=0)
+    group_size: int = config_field(2048, ge=1)
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.hierarchical_axes is not None:
+            axes = list(self.hierarchical_axes)
+            if len(axes) != 2 or len(set(axes)) != 2:
+                raise ConfigError(
+                    "zeropp.hierarchical_axes must name exactly two distinct "
+                    f"mesh axes [intra, inter], got {self.hierarchical_axes!r}")
+            valid = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+            for ax in axes:
+                if ax not in valid:
+                    raise ConfigError(
+                        f"zeropp.hierarchical_axes: {ax!r} is not a mesh axis "
+                        f"(use one of {valid})")
+
+
 # ---------------------------------------------------------------------------
 # Optimizer / scheduler (reference: engine._configure_basic_optimizer, lr_schedules.py)
 # ---------------------------------------------------------------------------
@@ -567,6 +610,7 @@ class SXConfig(ConfigModel):
     bf16: BF16Config = config_field(default_factory=BF16Config, aliases=("bfloat16",))
     data_types: DataTypesConfig = config_field(default_factory=DataTypesConfig)
     zero_optimization: ZeroConfig = config_field(default_factory=ZeroConfig)
+    zeropp: ZeroPPConfig = config_field(default_factory=ZeroPPConfig)
     # None (absent section or explicit null) means "client supplies the
     # optimizer", exactly like the reference's initialize(optimizer=...).
     optimizer: Optional[OptimizerConfig] = config_field(None, model=OptimizerConfig)
@@ -711,6 +755,16 @@ class SXConfig(ConfigModel):
     def _sanity_check(self) -> None:
         if self.fp16.enabled and self.bf16.enabled:
             raise ConfigError("fp16 and bf16 cannot both be enabled")
+        if (self.zeropp.hierarchical_axes is not None
+                and not self.zero_optimization.zero_quantized_gradients):
+            # the two-level schedule only shapes the qgZ gradient wire —
+            # without the flag the declaration is inert; say so instead of
+            # letting the user believe the split is active
+            logger.warning(
+                "zeropp.hierarchical_axes is set but "
+                "zero_optimization.zero_quantized_gradients is off — the "
+                "two-level schedule shapes the qgZ gradient wire only and "
+                "has no effect in this config")
         if self.zero_optimization.stage >= 2 and self.fp16.enabled and self.fp16.fp16_master_weights_and_grads \
                 and not self.zero_optimization.offload_optimizer.enabled:
             raise ConfigError("fp16_master_weights_and_grads requires optimizer offload with ZeRO-2")

@@ -32,8 +32,14 @@ class CommsLogger:
         self.prof_all = prof_all
         self.debug = debug
         self.prof_ops = prof_ops or []
-        # op name -> {"count": n, "bytes": total, "times": [..] (eager only)}
-        self.stats: Dict[str, Dict[str, Any]] = defaultdict(lambda: {"count": 0, "bytes": 0, "times": []})
+        # op name -> {"count": n, "bytes": logical, "wire_bytes": on-the-wire,
+        #             "times": [..] (eager only)}. ``bytes`` is the LOGICAL
+        # payload (operand dtype × elements — what an uncompressed collective
+        # would move); ``wire_bytes`` is what actually rides the wire
+        # (the s8 payload + fp32 scales for quantized collectives; equal to
+        # ``bytes`` for plain ops). The 4x ZeRO++ reduction is the ratio.
+        self.stats: Dict[str, Dict[str, Any]] = defaultdict(
+            lambda: {"count": 0, "bytes": 0, "wire_bytes": 0, "times": []})
 
     def configure(self, config) -> None:
         self.enabled = config.enabled
@@ -47,31 +53,50 @@ class CommsLogger:
             return False
         return self.prof_all or name in self.prof_ops
 
-    def record(self, name: str, nbytes: int, elapsed: Optional[float] = None, note: str = "") -> None:
+    def record(self, name: str, nbytes: int, elapsed: Optional[float] = None, note: str = "",
+               wire_bytes: Optional[int] = None) -> None:
+        """``nbytes`` is the logical payload; ``wire_bytes`` what actually
+        crosses the wire (defaults to ``nbytes`` for uncompressed ops)."""
         if not self._should_log(name):
             return
         rec = self.stats[name]
         rec["count"] += 1
         rec["bytes"] += int(nbytes)
+        rec["wire_bytes"] += int(wire_bytes if wire_bytes is not None else nbytes)
         if elapsed is not None:
             rec["times"].append(elapsed)
         if self.verbose:
-            log_dist(f"comm op: {name} | bytes: {nbytes} | {note}", ranks=[0])
+            log_dist(f"comm op: {name} | bytes: {nbytes} | wire: "
+                     f"{wire_bytes if wire_bytes is not None else nbytes} | {note}",
+                     ranks=[0])
 
     def log_summary(self, show_straggler: bool = False) -> str:
-        """Bandwidth/count table; eager ops include measured time."""
-        lines = [f"{'Op':<24}{'Count':>8}{'Total MB':>12}{'Avg ms':>10}{'Busbw GB/s':>12}"]
+        """Bandwidth/count table; eager ops include measured time. ``Wire MB``
+        and ``Comp x`` expose the quantized-collective compression: logical
+        bytes / wire bytes (~4x for fp32-grad qgZ, ~2x for bf16-weight qwZ)."""
+        lines = [f"{'Op':<24}{'Count':>8}{'Total MB':>12}{'Wire MB':>12}"
+                 f"{'Comp x':>8}{'Avg ms':>10}{'Busbw GB/s':>12}"]
         for name, rec in sorted(self.stats.items()):
             mb = rec["bytes"] / 1e6
+            wire_mb = rec.get("wire_bytes", rec["bytes"]) / 1e6
+            comp = rec["bytes"] / max(1, rec.get("wire_bytes", rec["bytes"]))
             if rec["times"]:
                 avg_ms = 1000 * sum(rec["times"]) / len(rec["times"])
                 busbw = (rec["bytes"] / max(1, rec["count"])) / max(1e-9, (sum(rec["times"]) / len(rec["times"]))) / 1e9
             else:
                 avg_ms, busbw = 0.0, 0.0
-            lines.append(f"{name:<24}{rec['count']:>8}{mb:>12.2f}{avg_ms:>10.3f}{busbw:>12.2f}")
+            lines.append(f"{name:<24}{rec['count']:>8}{mb:>12.2f}{wire_mb:>12.2f}"
+                         f"{comp:>8.2f}{avg_ms:>10.3f}{busbw:>12.2f}")
         report = "\n".join(lines)
         log_dist("comms log summary:\n" + report, ranks=[0])
         return report
+
+    def op_stats(self, name: str) -> Dict[str, Any]:
+        """A copy of one op's accumulated stats ({}-like zeros if unseen)."""
+        rec = self.stats.get(name)
+        if rec is None:
+            return {"count": 0, "bytes": 0, "wire_bytes": 0, "times": []}
+        return {k: (list(v) if isinstance(v, list) else v) for k, v in rec.items()}
 
     def reset(self) -> None:
         self.stats.clear()

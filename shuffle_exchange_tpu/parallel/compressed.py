@@ -24,6 +24,11 @@ from ..ops.quant import dequantize_int8, quantize_int8
 from .comm import comms_logger
 
 
+def _logical_bytes(x) -> int:
+    """Bytes an UNcompressed collective would move for this operand."""
+    return x.size * x.dtype.itemsize
+
+
 def sign_psum(x, axis_name: str, err=None) -> Tuple["jax.Array", "jax.Array"]:
     """1-bit error-feedback averaging over ``axis_name``.
 
@@ -39,7 +44,8 @@ def sign_psum(x, axis_name: str, err=None) -> Tuple["jax.Array", "jax.Array"]:
     scale = jnp.mean(jnp.abs(combined))
     signs = jnp.where(combined >= 0, 1, -1).astype(jnp.int8)
 
-    comms_logger.record("compressed_all_reduce", signs.size + 4, note=axis_name)
+    comms_logger.record("compressed_all_reduce", _logical_bytes(x),
+                        wire_bytes=signs.size + 4, note=axis_name)
     n = jax.lax.psum(1, axis_name)
     # int8 signs summed as int32 (overflow-safe for any axis size), one
     # scalar psum for the scales. The transmitted approximation uses the
@@ -61,7 +67,8 @@ def quantized_psum(x, axis_name: str, group_size: int = 256):
     import jax.numpy as jnp
 
     q, scales = quantize_int8(x, group_size)
-    comms_logger.record("quantized_all_reduce", q.size + 4 * scales.size, note=axis_name)
+    comms_logger.record("quantized_all_reduce", _logical_bytes(x),
+                        wire_bytes=q.size + 4 * scales.size, note=axis_name)
     n = jax.lax.psum(1, axis_name)
     # Dequantize-then-psum keeps exact additive semantics while the wire
     # payload (post-XLA-fusion) is the int8 operand; for the strict
@@ -89,7 +96,8 @@ def quantized_reduce_scatter(x, axis_name: str, group_size: int = 256,
     # per-piece quantization (quantize_int8 flattens to [groups, group]), so
     # the piece dim stays leading for the all-to-all
     q, scales = jax.vmap(lambda p: quantize_int8(p, group_size))(pieces)
-    comms_logger.record("quantized_reduce_scatter", q.size + 4 * scales.size, note=axis_name)
+    comms_logger.record("quantized_reduce_scatter", _logical_bytes(x),
+                        wire_bytes=q.size + 4 * scales.size, note=axis_name)
     # all_to_all on the piece dim: the wire payload is the int8 tensor.
     q_x = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
     s_x = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=False)
@@ -108,7 +116,8 @@ def quantized_all_gather(x, axis_name: str, group_size: int = 256, axis: int = 0
     import jax.numpy as jnp
 
     q, scales = quantize_int8(x, group_size)
-    comms_logger.record("quantized_all_gather", q.size + 4 * scales.size, note=axis_name)
+    comms_logger.record("quantized_all_gather", _logical_bytes(x),
+                        wire_bytes=q.size + 4 * scales.size, note=axis_name)
     q_g = jax.lax.all_gather(q, axis_name, axis=0, tiled=False)
     s_g = jax.lax.all_gather(scales, axis_name, axis=0, tiled=False)
     n = q_g.shape[0]
@@ -128,15 +137,19 @@ def quantized_all_gather(x, axis_name: str, group_size: int = 256, axis: int = 0
     return moved.reshape(shape)
 
 
-def _int8_wire_allreduce(x, axis_name: str, group_size: int):
-    """Sum over ``axis_name`` where the wire payload is int8: all-gather the
-    quantized tensor + per-group scales, dequantize and sum locally. A plain
-    psum of the dequantized fp32 would let XLA put fp32 on the wire — this
-    form forces the collective operand dtype to s8 (verifiable in HLO)."""
+def _int8_wire_allreduce(x, axis_name, group_size: int, log_name: Optional[str] = None):
+    """Sum over ``axis_name`` (a name or tuple of names) where the wire
+    payload is int8: all-gather the quantized tensor + per-group scales,
+    dequantize and sum locally. A plain psum of the dequantized fp32 would
+    let XLA put fp32 on the wire — this form forces the collective operand
+    dtype to s8 (verifiable in HLO)."""
     import jax
     import jax.numpy as jnp
 
     q, s = quantize_int8(x, group_size)
+    if log_name:
+        comms_logger.record(log_name, _logical_bytes(x),
+                            wire_bytes=q.size + 4 * s.size, note=str(axis_name))
     q_g = jax.lax.all_gather(q, axis_name, axis=0, tiled=False)     # s8 wire
     s_g = jax.lax.all_gather(s, axis_name, axis=0, tiled=False)     # scales: tiny fp32
 
@@ -157,8 +170,46 @@ def quantized_hierarchical_reduce(x, intra_axis: str, inter_axis: str,
 
     n_intra = jax.lax.psum(1, intra_axis)
     n_inter = jax.lax.psum(1, inter_axis)
-    comms_logger.record("quantized_a2a_lvl1", x.size, note=intra_axis)
-    lvl1 = _int8_wire_allreduce(x, intra_axis, group_size)
-    comms_logger.record("quantized_a2a_lvl2", x.size, note=inter_axis)
-    lvl2 = _int8_wire_allreduce(lvl1, inter_axis, group_size)
+    lvl1 = _int8_wire_allreduce(x, intra_axis, group_size,
+                                log_name="quantized_a2a_lvl1")
+    lvl2 = _int8_wire_allreduce(lvl1, inter_axis, group_size,
+                                log_name="quantized_a2a_lvl2")
     return lvl2 / (n_intra * n_inter)
+
+
+def quantized_two_level_reduce(x, intra_axis: str, inter_axis: str,
+                               group_size: int = 256):
+    """The declared-hierarchy qgZ schedule (``zeropp.hierarchical_axes``):
+
+      1. full-precision reduce-scatter INSIDE ``intra_axis`` (the fast
+         domain — ICI — where bytes are cheap and exactness is free),
+      2. int8-wire all-reduce of the 1/n_intra-sized partials ACROSS
+         ``inter_axis`` (the slow domain — DCN — where the 4x matters),
+      3. full-precision all-gather back inside ``intra_axis``.
+
+    Returns the average over both axes. Rounding model: exactly ONE
+    quantize/dequantize round-trip, applied to the intra-summed partials —
+    vs the flat schedule's round-trip per level. The inter-domain wire
+    moves (|x| / n_intra) int8 bytes per device: n_intra x fewer slow-wire
+    bytes than flat qgZ on top of the 4x dtype win."""
+    import jax
+    import jax.numpy as jnp
+
+    n_intra = jax.lax.psum(1, intra_axis)
+    n_inter = jax.lax.psum(1, inter_axis)
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_intra
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    comms_logger.record("qgz_intra_reduce_scatter", _logical_bytes(flat),
+                        note=intra_axis)
+    piece = jax.lax.psum_scatter(flat, intra_axis, scatter_dimension=0,
+                                 tiled=True)
+    piece = _int8_wire_allreduce(piece, inter_axis, group_size,
+                                 log_name="qgz_inter_all_reduce")
+    comms_logger.record("qgz_intra_all_gather", piece.size * 4,
+                        note=intra_axis)
+    full = jax.lax.all_gather(piece, intra_axis, axis=0, tiled=True)
+    if pad:
+        full = full[:x.size]
+    return full.reshape(x.shape) / (n_intra * n_inter)

@@ -210,11 +210,64 @@ def reset_topology() -> None:
 
 # Reference-compatible getter names (utils/groups.py:57-749).
 
-def inside_manual_region() -> bool:
-    """True when tracing inside a (partial-)manual shard_map region."""
+def native_shard_map() -> bool:
+    """True when this jax exposes the first-class ``jax.shard_map`` (>= 0.5),
+    whose partial-manual lowering handles collectives with live (size > 1)
+    auto axes. The 0.4.x ``jax.experimental.shard_map`` fallback lowers
+    FULL-manual regions (and partial-manual regions whose auto axes are all
+    size 1) correctly, but a collective inside a partial-manual region with
+    a live auto axis trips an XLA SPMD-partitioner CHECK
+    (spmd_partitioner.cc:512 IsManualSubgroup) — a process abort, not an
+    exception — so callers must gate statically on this, never probe."""
     import jax
 
-    ctx = jax.sharding.get_abstract_mesh()
+    return hasattr(jax, "shard_map")
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+              check_vma: bool = True):
+    """``jax.shard_map``-compatible facade that also runs on jax 0.4.x.
+
+    ``axis_names`` is the set of MANUAL axes (partial-manual region);
+    None means every mesh axis is manual. On 0.4.x this maps onto
+    ``jax.experimental.shard_map.shard_map``'s complementary ``auto=`` set
+    and ``check_vma`` onto ``check_rep``. See :func:`native_shard_map` for
+    the 0.4.x lowering limits.
+    """
+    import jax
+
+    if native_shard_map():
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    all_axes = frozenset(mesh.axis_names)
+    manual = frozenset(axis_names) if axis_names is not None else all_axes
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=bool(check_vma), auto=all_axes - manual)
+
+
+def _abstract_mesh_ctx():
+    import jax
+
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None:
+        return None  # jax 0.4.x: no trace-context abstract mesh
+    try:
+        return get()
+    except Exception:
+        return None
+
+
+def inside_manual_region() -> bool:
+    """True when tracing inside a (partial-)manual shard_map region.
+    On jax 0.4.x (no abstract-mesh trace context) this returns False."""
+    import jax
+
+    ctx = _abstract_mesh_ctx()
     if ctx is None or not getattr(ctx, "axis_names", ()):
         return False
     try:
@@ -229,11 +282,12 @@ def constraint_mesh(default=None):
     Inside a (partial-)manual region, constraints must be built on the
     CONTEXT abstract mesh (whose enclosing axes are typed Manual) — a
     NamedSharding over the concrete topology mesh (all-Auto) trips the
-    mesh-equality check. Outside any region, returns ``default`` (or the
-    topology mesh)."""
+    mesh-equality check. Outside any region — and always on jax 0.4.x,
+    where nested shard_maps take the concrete mesh — returns ``default``
+    (or the topology mesh)."""
     import jax
 
-    ctx = jax.sharding.get_abstract_mesh()
+    ctx = _abstract_mesh_ctx()
     if ctx is not None and getattr(ctx, "axis_names", ()):
         try:
             if any(t == jax.sharding.AxisType.Manual for t in ctx.axis_types):

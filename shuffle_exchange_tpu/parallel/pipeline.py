@@ -105,7 +105,26 @@ def pipeline_stage_count(topology=None) -> int:
     return topo.axis_sizes.get("pipe", 1)
 
 
-def spmd_pipeline(stage_fn: Callable, x_micro, *, n_stages: int, axis_name: str = "pipe"):
+def _stage_ce(model, other_params, outputs, labels):
+    """Per-device CE over the pipeline outputs buffer: head + token_loss per
+    microbatch via lax.map, summed. The ONE implementation both the
+    shard_map'd ``loss`` and the region-transparent ``region_loss`` call —
+    any CE change lands in both paths by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(args):
+        o, lb = args
+        logits = model.head(other_params, o)
+        s, c = model.token_loss(logits, lb)
+        return s, c.astype(jnp.float32)
+
+    sums, counts = jax.lax.map(one, (outputs, labels))
+    return sums.sum(), counts.sum()
+
+
+def spmd_pipeline(stage_fn: Callable, x_micro, *, n_stages: int, axis_name: str = "pipe",
+                  stage_index=None):
     """Run the microbatch pipeline. Must execute inside shard_map with
     ``axis_name`` manual.
 
@@ -113,6 +132,10 @@ def spmd_pipeline(stage_fn: Callable, x_micro, *, n_stages: int, axis_name: str 
       layer block.
     x_micro: [n_micro, mb, ...] microbatched stage-0 inputs (replicated over
       the pipe axis; only stage 0 reads them).
+    stage_index: this device's stage number. Callers inside a PARTIAL-manual
+      region should thread it as a P(axis_name)-sharded arange operand:
+      ``lax.axis_index`` there lowers to a PartitionId instruction that jax
+      0.4.x's SPMD partitioner rejects when auto axes are still live.
 
     Returns (outputs [n_micro, mb, ...] — valid on the LAST stage, zeros
     elsewhere; aux — sum of stage_fn aux over all (stage, microbatch) pairs,
@@ -122,7 +145,8 @@ def spmd_pipeline(stage_fn: Callable, x_micro, *, n_stages: int, axis_name: str 
     import jax.numpy as jnp
 
     n_micro = x_micro.shape[0]
-    stage = jax.lax.axis_index(axis_name)
+    stage = (stage_index if stage_index is not None
+             else jax.lax.axis_index(axis_name))
     n_ticks = n_micro + n_stages - 1
     # No wrap-around edge: stage 0 always reads fresh microbatch input, so
     # the (S-1 -> 0) send would be dead traffic (devices with no source
@@ -293,6 +317,35 @@ class PipelinedModel:
         inputs = inputs.reshape(n_micro, mb, T)
         labels = labels.reshape(n_micro, mb, T)
         mesh = _current_mesh()
+        # jax 0.4.x cannot lower ppermute inside a PARTIAL-manual region
+        # that still has a live (size > 1) auto axis — an XLA SPMD-
+        # partitioner CHECK abort, not an exception (parallel/mesh.py::
+        # native_shard_map). The pipeline region there must be FLAT: manual
+        # over pipe AND the batch axes, with the microbatch dim sharded
+        # in-region and the CE reduced by explicit psums. This is also the
+        # region shape the ZeRO++ quantized wire composes with (the engine
+        # wraps this same body in its own flat region to make the gradient
+        # reduction ride the s8 wire — runtime/engine.py qg/qz3 pipe path).
+        from .mesh import native_shard_map
+
+        flat = not native_shard_map()
+        dp_world = int(mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
+        if flat:
+            bad = [ax for ax in ("tensor", "expert", "seq")
+                   if int(mesh.shape.get(ax, 1)) > 1]
+            if bad:
+                raise ConfigError(
+                    "pipeline parallelism with a live "
+                    f"{'/'.join(bad)} axis needs jax >= 0.5 (first-class "
+                    "jax.shard_map): the 0.4.x partial-manual lowering "
+                    "CHECK-fails on the pipeline's ppermute with live auto "
+                    "axes, and the flat manual region cannot absorb "
+                    "auto-partitioned model axes")
+            if mb % dp_world:
+                raise ConfigError(
+                    f"pipeline microbatch {mb} not divisible by "
+                    f"data*fsdp={dp_world} (flat pipeline region shards the "
+                    "microbatch dim in-region)")
         # Re-constrain params to their model (pipe/tensor) specs before the
         # manual region: any extra ZeRO axis on the masters is all-gathered
         # OUT HERE by XLA (one gather per stage-local stack — the PP analog
@@ -346,9 +399,14 @@ class PipelinedModel:
             lambda v: v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.floating) else v,
             other_params)
 
-        def inner(layer_params, keep_flags, layer_ids, other_params, inputs, labels):
+        def inner(layer_params, keep_flags, layer_ids, stage_ids, other_params,
+                  inputs, labels):
             other_params = jax.tree_util.tree_map(
                 lambda v, d: v.astype(d), other_params, other_dtypes)
+            # this device's stage number, threaded as a P("pipe")-sharded
+            # operand (see spmd_pipeline: axis_index lowers to PartitionId,
+            # which jax 0.4.x rejects under partial-manual)
+            my_stage = stage_ids[0]
             # Embed per microbatch (cheap gather; runs on every stage but
             # only stage 0's result is consumed — its cotangent is zero
             # elsewhere, so tied/embed grads stay correct).
@@ -364,22 +422,16 @@ class PipelinedModel:
                                          layer_keep=keep,
                                          layer_ids=layer_ids)
 
-            outputs, aux = spmd_pipeline(stage_fn, x, n_stages=S, axis_name=self.axis_name)
+            outputs, aux = spmd_pipeline(stage_fn, x, n_stages=S,
+                                         axis_name=self.axis_name,
+                                         stage_index=my_stage)
 
-            stage = jax.lax.axis_index(self.axis_name)
-
-            def last_stage_ce(outputs):
-                def one(args):
-                    o, lb = args
-                    logits = model.head(other_params, o)
-                    s, c = model.token_loss(logits, lb)
-                    return s, c.astype(jnp.float32)
-
-                sums, counts = jax.lax.map(one, (outputs, labels))
-                return sums.sum(), counts.sum()
+            stage = my_stage
 
             sp = _current_mesh().shape.get("seq", 1)
-            if sp > 1:
+            if sp > 1 or flat:
+                # (flat mode: keep the collective schedule uniform across
+                # the whole region — same rendezvous argument as seq)
                 # seq x pipe (round 5): with an auto "seq" axis live inside
                 # this region, the CE contains seq-group collectives; a
                 # stage-VARYING lax.cond would run them only on the last
@@ -389,12 +441,14 @@ class PipelinedModel:
                 # stage computes the CE (non-last stages on their zero
                 # outputs) and the result is masked. Costs (S-1) wasted
                 # head matmuls — the pipeline bubble already dwarfs this.
-                nll_all, count_all = last_stage_ce(outputs)
+                nll_all, count_all = _stage_ce(model, other_params,
+                                               outputs, labels)
                 is_last = (stage == S - 1).astype(jnp.float32)
                 nll_sum, count = nll_all * is_last, count_all * is_last
             else:
                 nll_sum, count = jax.lax.cond(
-                    stage == S - 1, last_stage_ce,
+                    stage == S - 1,
+                    lambda o: _stage_ce(model, other_params, o, labels),
                     lambda o: (jnp.zeros((), jnp.float32),
                                jnp.zeros((), jnp.float32)),
                     outputs)
@@ -405,20 +459,109 @@ class PipelinedModel:
             # scalar trips XLA's partial-manual partitioner instead).
             return (nll_sum.reshape(1), count.reshape(1), aux.reshape(1))
 
-        fn = jax.shard_map(
+        from .mesh import shard_map as _shard_map
+
+        stage_ids = jnp.arange(S, dtype=jnp.int32)
+        if flat:
+            manual = {self.axis_name, "data", "fsdp"}
+            batch_spec = P(None, ("data", "fsdp"))
+            part_spec = P((self.axis_name, "data", "fsdp"))
+        else:
+            manual = {self.axis_name}
+            batch_spec = P()
+            part_spec = P(self.axis_name)
+        fn = _shard_map(
             inner, mesh=mesh,
             in_specs=(layer_specs,
                       P() if isinstance(keep_flags, tuple) else P(self.axis_name),
-                      P(self.axis_name), P(), P(), P()),
-            out_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name)),
-            axis_names={self.axis_name}, check_vma=False)
+                      P(self.axis_name), P(self.axis_name), P(),
+                      batch_spec, batch_spec),
+            out_specs=(part_spec, part_spec, part_spec),
+            axis_names=manual, check_vma=False)
         nll_parts, count_parts, aux_parts = fn(layer_params, keep_flags,
-                                               layer_ids, other_params,
-                                               inputs, labels)
+                                               layer_ids, stage_ids,
+                                               other_params, inputs, labels)
         nll_sum, count, aux = nll_parts.sum(), count_parts.sum(), aux_parts.sum()
+        # flat mode: every (data,fsdp) shard contributes a copy of the aux
+        # (each computed on its batch shard); average them back to the
+        # full-batch coefficient scale.
+        if flat and dp_world > 1:
+            aux = aux / dp_world
         ce = nll_sum / jnp.maximum(count, 1.0)
         # aux summed layers×micros; dense model sums layers on the full
         # batch, so average over microbatches to keep the coefficient scale.
+        return ce + self.config.aux_loss_coef * aux / n_micro
+
+    # -- region-transparent loss (for an ENCLOSING manual region) -------
+
+    def region_loss(self, params, batch, rng, stage):
+        """The pipeline CE, written to run INSIDE an enclosing manual region
+        that binds {pipe, data, fsdp} (the engine's ZeRO++ wire region —
+        runtime/engine.py qg/qz3 pipe paths — wraps exactly this body so the
+        gradient reduction can ride the s8 collectives; nesting this class's
+        own shard_map there CHECK-fails XLA's partitioner from either
+        direction, scripts/repro_wire_nesting_xla_check.py).
+
+        ``params``: model-structured tree whose ``layers`` stacks are THIS
+        STAGE's rows ([L/S, ...]; even partitions only) and whose other
+        leaves are replicated. ``batch``: this (data, fsdp) shard's batch
+        ({"input_ids": [b_local, T]}). ``stage``: this device's stage index
+        (thread a P("pipe")-sharded arange — see spmd_pipeline).
+
+        Returns this dp-shard's GLOBAL-pipeline ce (nll/count/aux psum'd
+        over "pipe"); the caller owns the (data, fsdp) gradient/loss
+        reduction — that is the point of the composition.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if not self._even:
+            raise ConfigError(
+                "region_loss (ZeRO++ wire x pipeline) supports even layer "
+                "partitions only — L % stages == 0 with "
+                "partition_method='uniform'/'parameters'")
+        model = self.model
+        S = self.n_stages
+        n_micro = self.micro_batches
+        ids = batch["input_ids"]
+        if "labels" in batch:
+            labels, inputs = batch["labels"], ids
+        else:
+            labels, inputs = ids[:, 1:], ids[:, :-1]
+        b, T = inputs.shape
+        if b % n_micro:
+            raise ConfigError(
+                f"local batch {b} not divisible by pipeline micro_batches "
+                f"{n_micro}")
+        mb = b // n_micro
+        inputs = inputs.reshape(n_micro, mb, T)
+        labels = labels.reshape(n_micro, mb, T)
+
+        layer_params = params["layers"]
+        other_params = {k: v for k, v in params.items() if k != "layers"}
+        Ls = self.config.n_layers // S
+        # global layer ids of this stage's rows (traced stage index is fine:
+        # stack_apply's per_layer_flags jnp.takes from a global flag table)
+        layer_ids = stage * Ls + jnp.arange(Ls, dtype=jnp.int32)
+
+        x, rope = model.embed(other_params, inputs)
+
+        def stage_fn(h):
+            return model.stack_apply(layer_params, h, rope,
+                                     layer_ids=layer_ids)
+
+        outputs, aux = spmd_pipeline(stage_fn, x, n_stages=S,
+                                     axis_name=self.axis_name,
+                                     stage_index=stage)
+
+        # uniform collective schedule (every stage runs the CE, masked) —
+        # same rendezvous argument as the flat loss above
+        nll_all, count_all = _stage_ce(model, other_params, outputs, labels)
+        is_last = (stage == S - 1).astype(jnp.float32)
+        nll_sum = jax.lax.psum(nll_all * is_last, self.axis_name)
+        count = jax.lax.psum(count_all * is_last, self.axis_name)
+        aux = jax.lax.psum(aux, self.axis_name)
+        ce = nll_sum / jnp.maximum(count, 1.0)
         return ce + self.config.aux_loss_coef * aux / n_micro
 
 

@@ -42,7 +42,7 @@ def seq_to_head_a2a(x, axis_name: str = "seq"):
     ``uneven_heads_all2all``, sequence/layer.py:111)."""
     import jax
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = jax.lax.psum(1, axis_name)
     if x.shape[2] % sp:
         raise ValueError(
             f"head count ({x.shape[2]}) not divisible by the sequence-parallel "
@@ -80,7 +80,7 @@ class DistributedAttention:
         import jax
         import jax.numpy as jnp
 
-        sp = jax.lax.axis_size(self.axis)
+        sp = jax.lax.psum(1, self.axis)
         H, KV = q.shape[2], k.shape[2]
         even = H % sp == 0 and KV % sp == 0
         if not even:
@@ -183,7 +183,7 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     import jax
     import jax.numpy as jnp
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     if alibi_slopes is not None and use_kernel is True:
@@ -272,8 +272,11 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     l0 = jnp.zeros((B, H, Tq), jnp.float32)
     # The chunk scan's carry must already be device-varying over the seq
     # axis (its outputs are), or shard_map's vma check rejects the scan.
-    acc0, m0, l0 = (jax.lax.pcast(t, (axis_name,), to="varying")
-                    for t in (acc0, m0, l0))
+    # (jax 0.4.x has no pcast and no vma checking — skip the cast there.)
+    _pcast = getattr(jax.lax, "pcast", None)
+    if _pcast is not None:
+        acc0, m0, l0 = (_pcast(t, (axis_name,), to="varying")
+                        for t in (acc0, m0, l0))
 
     carry = (acc0, m0, l0)
     kv = (k, v)
@@ -308,7 +311,7 @@ def _ring_attention_kernel(q, k, v, axis_name: str, causal: bool,
 
     from ..ops.alibi_attention import flash_attention_lse
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
 
@@ -335,6 +338,10 @@ def _ring_attention_kernel(q, k, v, axis_name: str, causal: bool,
             def skip_branch(q, kb, vb):
                 # constants must carry the same varying-axes set as the
                 # kernel branches' outputs or cond rejects the branch types
+                # (jax 0.4.x: no vma tracking — constants pass as-is)
+                if getattr(jax.lax, "pcast", None) is None:
+                    return (jnp.zeros(q.shape, q.dtype),
+                            jnp.full((B, H, Tq), -jnp.inf, jnp.float32))
                 vma = frozenset()
                 for t in (q, kb, vb):
                     vma = vma | jax.typeof(t).vma
@@ -372,8 +379,10 @@ def _ring_attention_kernel(q, k, v, axis_name: str, causal: bool,
 
     out0 = jnp.zeros((B, Tq, H, D), jnp.float32)
     lse0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
-    out0, lse0 = (jax.lax.pcast(t, (axis_name,), to="varying")
-                  for t in (out0, lse0))
+    _pcast = getattr(jax.lax, "pcast", None)
+    if _pcast is not None:
+        out0, lse0 = (_pcast(t, (axis_name,), to="varying")
+                      for t in (out0, lse0))
     carry = (out0, lse0)
     kv = (k, v)
     for r in range(sp):

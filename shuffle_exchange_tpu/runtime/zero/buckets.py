@@ -1,0 +1,138 @@
+"""Bucketed gradient collectives for the ZeRO++ s8 wire.
+
+The reference coalesces gradients into flat fp16 buckets before its
+reduce-scatter (``stage_1_and_2.py`` ``reduce_bucket_size`` /
+``allgather_bucket_size``; ``coalesced_collectives.py`` reduces a LIST of
+tensors per call). Per-leaf collectives cost one launch per parameter —
+O(hundreds) dispatches per step for transformer trees, each with its own
+latency floor. Here the wire payloads are coalesced instead: gradient
+leaves are packed into ~``zeropp.bucket_mb`` flat segments and each bucket
+rides ONE payload all-gather + ONE scales all-gather.
+
+Bit-exactness: every leaf is still quantized SEPARATELY with its own
+blockwise-int8 groups (``ops/quant.py``), and dequantize+sum runs per leaf
+per source in the same order as the per-leaf wire — bucketing changes the
+collective LAUNCH COUNT, never the rounding. ``bucket_bytes=0`` degenerates
+to exactly the per-leaf schedule (one bucket per leaf), which is what the
+parity test pins.
+
+The declared-hierarchy schedule (``zeropp.hierarchical_axes``) concatenates
+the raw fp32 leaves per bucket instead and runs
+:func:`..parallel.compressed.quantized_two_level_reduce` on each flat — the
+intra-domain reduce-scatter is exact regardless of packing, and the single
+s8 round-trip applies to the intra-summed partials (same rounding MODEL as
+the per-leaf two-level schedule; group boundaries follow the bucket flat).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+
+def plan_buckets(nbytes: Sequence[int], bucket_bytes: int) -> List[List[int]]:
+    """Greedy contiguous coalescing of leaf indices into buckets of about
+    ``bucket_bytes`` logical bytes each.
+
+    ``bucket_bytes <= 0`` -> one leaf per bucket (the per-leaf schedule).
+    A single leaf larger than ``bucket_bytes`` gets its own bucket.
+    """
+    if bucket_bytes <= 0:
+        return [[i] for i in range(len(nbytes))]
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_b = 0
+    for i, b in enumerate(nbytes):
+        if cur and cur_b + int(b) > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_b = [], 0
+        cur.append(i)
+        cur_b += int(b)
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _bucket_wire_allreduce(leaves, axes, group_size: int):
+    """One bucket's s8-wire allreduce-sum over ``axes`` (name or tuple):
+    per-leaf quantize -> concatenated payload/scales -> one all-gather pair
+    -> per-leaf dequantize+sum. Returns the SUMMED leaves (caller divides).
+    Bit-exact with per-leaf ``_int8_wire_allreduce`` on each leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.quant import dequantize_int8, quantize_int8
+    from ...parallel.comm import comms_logger
+
+    qs, ss, metas = [], [], []
+    for leaf in leaves:
+        q, s = quantize_int8(leaf, group_size)      # q [G, group] s8, s [G]
+        qs.append(q.reshape(-1))
+        ss.append(s)
+        metas.append((q.shape, s.shape[0], leaf.shape))
+    qcat = jnp.concatenate(qs) if len(qs) > 1 else qs[0]
+    scat = jnp.concatenate(ss) if len(ss) > 1 else ss[0]
+    logical = sum(l.size * l.dtype.itemsize for l in leaves)
+    comms_logger.record("quantized_bucket_all_reduce", logical,
+                        wire_bytes=qcat.size + 4 * scat.size, note=str(axes))
+    q_g = jax.lax.all_gather(qcat, axes, axis=0, tiled=False)   # s8 wire
+    s_g = jax.lax.all_gather(scat, axes, axis=0, tiled=False)   # fp32 scales
+
+    out = []
+    off_q = off_s = 0
+    for (q_shape, n_groups, shape) in metas:
+        n_q = q_shape[0] * q_shape[1]
+        q_leaf = q_g[:, off_q:off_q + n_q]
+        s_leaf = s_g[:, off_s:off_s + n_groups]
+        off_q += n_q
+        off_s += n_groups
+
+        def deq_one(qi, si, q_shape=q_shape, shape=shape):
+            return dequantize_int8(qi.reshape(q_shape), si, shape, jnp.float32)
+
+        out.append(jax.vmap(deq_one)(q_leaf, s_leaf).sum(axis=0))
+    return out
+
+
+def bucketed_gradient_reduce(leaves, *, reduce_axes: Tuple[str, ...],
+                             group_size: int, bucket_bytes: int,
+                             hierarchical_axes: Optional[Sequence[str]] = None):
+    """Average ``leaves`` (local fp32 gradients) over ``reduce_axes`` with
+    the s8 wire, coalescing small leaves into ~``bucket_bytes`` buckets.
+
+    Must run inside a manual region with every axis in ``reduce_axes`` (and
+    ``hierarchical_axes``, when given) bound. ``hierarchical_axes`` =
+    ``(intra, inter)`` routes each bucket through the two-level schedule
+    (fp intra reduce-scatter, s8 inter, fp intra gather) instead of the
+    flat s8 allreduce.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not leaves:
+        return leaves
+    n_world = 1
+    for ax in (reduce_axes if isinstance(reduce_axes, tuple) else (reduce_axes,)):
+        n_world = n_world * jax.lax.psum(1, ax)
+    sizes = [l.size * 4 for l in leaves]                  # logical fp32 bytes
+    plan = plan_buckets(sizes, bucket_bytes)
+    out: List = [None] * len(leaves)
+    for bucket in plan:
+        blv = [leaves[i] for i in bucket]
+        if hierarchical_axes is not None:
+            from ...parallel.compressed import quantized_two_level_reduce
+
+            intra, inter = hierarchical_axes
+            flat = (jnp.concatenate([l.reshape(-1) for l in blv])
+                    if len(blv) > 1 else blv[0].reshape(-1))
+            red = quantized_two_level_reduce(flat, intra, inter,
+                                             group_size=group_size)
+            off = 0
+            for i, l in zip(bucket, blv):
+                out[i] = red[off:off + l.size].reshape(l.shape)
+                off += l.size
+        else:
+            summed = _bucket_wire_allreduce(blv, reduce_axes, group_size)
+            for i, s in zip(bucket, summed):
+                out[i] = s / n_world
+    return out

@@ -38,7 +38,7 @@ def test_collectives_in_shard_map(devices8):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
 
     topo = MeshTopology.build(MeshConfig(data=4, fsdp=2), devices=devices8)
     mesh = topo.mesh
@@ -68,7 +68,7 @@ def test_collectives_in_shard_map(devices8):
 def test_comms_logger_records(devices8):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
     import jax
 
     comm.comms_logger.enabled = True

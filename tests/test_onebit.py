@@ -146,7 +146,7 @@ def _shard_map_ctx(devices8, n_axis=8):
 def test_sign_psum_error_feedback_reduces_bias(devices8):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from shuffle_exchange_tpu.parallel.compressed import sign_psum
@@ -181,7 +181,7 @@ def test_sign_psum_error_feedback_reduces_bias(devices8):
 
 def test_quantized_psum_close_to_exact(devices8):
     import jax
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from shuffle_exchange_tpu.parallel.compressed import quantized_psum
@@ -199,7 +199,7 @@ def test_quantized_psum_close_to_exact(devices8):
 
 def test_quantized_all_gather_roundtrip(devices8):
     import jax
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from shuffle_exchange_tpu.parallel.compressed import quantized_all_gather
@@ -219,7 +219,7 @@ def test_quantized_all_gather_roundtrip(devices8):
 
 def test_quantized_reduce_scatter_int8_wire(devices8):
     import jax
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from shuffle_exchange_tpu.parallel.compressed import quantized_reduce_scatter
@@ -244,7 +244,7 @@ def test_quantized_reduce_scatter_int8_wire(devices8):
 
 def test_quantized_hierarchical_reduce(devices8):
     import jax
-    from jax import shard_map
+    from shuffle_exchange_tpu.parallel.mesh import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from shuffle_exchange_tpu.parallel.compressed import quantized_hierarchical_reduce
