@@ -20,6 +20,10 @@ python -m pytest tests/test_fused_decode.py tests/test_mosaic_lowering.py \
 # drill (preempt mid-step, resume resharded via the universal checkpoint).
 python -m pytest tests/test_zeropp_wire_meshes.py tests/test_comm_buckets.py \
     tests/test_elasticity_drill.py -q "$@"
+# Continuous-batching serving gates (ISSUE 5): scheduler parity with the
+# sequential put/decode_loop reference, preemption/requeue determinism,
+# one-dispatch mixed ticks, and the shape-bin compile bound.
+python -m pytest tests/test_serving_scheduler.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \
@@ -27,4 +31,5 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_remat_lse.py \
     --ignore=tests/test_zeropp_wire_meshes.py \
     --ignore=tests/test_comm_buckets.py \
-    --ignore=tests/test_elasticity_drill.py "$@"
+    --ignore=tests/test_elasticity_drill.py \
+    --ignore=tests/test_serving_scheduler.py "$@"

@@ -10,13 +10,15 @@ Capability analog of the reference's two inference stacks:
     state manager, and a continuous-batching ``put/query/flush`` API.
 """
 
-from .config import InferenceConfig
+from .config import InferenceConfig, ServingConfig
 from .engine import InferenceEngine, init_inference, load_serving_weights
 from .paged import BlockedAllocator, PagedKVCache
 from .engine_v2 import InferenceEngineV2, SequenceDescriptor
+from .scheduler import ContinuousBatchingScheduler, ServingRequest
 
 __all__ = [
     "InferenceConfig",
+    "ServingConfig",
     "InferenceEngine",
     "init_inference",
     "load_serving_weights",
@@ -24,4 +26,6 @@ __all__ = [
     "PagedKVCache",
     "InferenceEngineV2",
     "SequenceDescriptor",
+    "ContinuousBatchingScheduler",
+    "ServingRequest",
 ]

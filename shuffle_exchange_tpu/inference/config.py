@@ -9,13 +9,81 @@ so reference configs load cleanly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 from ..config.config_utils import ConfigError
 from ..utils.logging import logger
 
 _DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16", "fp16": "float16",
            "float16": "float16", "fp32": "float32", "float32": "float32"}
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Continuous-batching scheduler knobs (``inference/scheduler.py`` —
+    the Dynamic-SplitFuse scheduler the reference FastGen engine runs,
+    SURVEY §2.10: mix one decode token per running sequence with prefill
+    chunks from queued sequences into uniform-size steps).
+
+    ``token_budget`` is the per-tick token target the scheduler packs —
+    every running sequence contributes one decode token, the remainder is
+    filled with prefill chunks. ``chunk_bins`` is the padded chunk-size
+    ladder the mixed step compiles against (None derives chunk_min·2^k
+    capped at token_budget), which together with the power-of-two decode
+    and block-table bins bounds the number of compiled programs a serving
+    process can ever need."""
+
+    token_budget: int = 256
+    max_running: int = 8          # cap on concurrently-decoding sequences
+    chunk_min: int = 16           # smallest partial prefill chunk worth a slot
+    chunk_bins: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.token_budget < 1:
+            raise ConfigError(f"serving.token_budget must be >= 1, got "
+                              f"{self.token_budget}")
+        if not 1 <= self.max_running <= self.token_budget:
+            raise ConfigError(
+                f"serving.max_running must be in [1, token_budget="
+                f"{self.token_budget}] (every running sequence takes one "
+                f"budget slot per tick), got {self.max_running}")
+        if not 1 <= self.chunk_min <= self.token_budget:
+            raise ConfigError(
+                f"serving.chunk_min must be in [1, token_budget="
+                f"{self.token_budget}], got {self.chunk_min}")
+        if self.chunk_bins is not None:
+            try:
+                bins = tuple(sorted({int(c) for c in self.chunk_bins}))
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"serving.chunk_bins must be a list of "
+                                  f"ints: {e}") from e
+            if not bins or bins[0] < 1:
+                raise ConfigError(
+                    f"serving.chunk_bins must be positive ints, got "
+                    f"{self.chunk_bins!r}")
+            self.chunk_bins = bins
+
+    def bins(self) -> Tuple[int, ...]:
+        """The padded chunk-size ladder (ascending)."""
+        if self.chunk_bins:
+            return self.chunk_bins
+        out, b = [], self.chunk_min
+        while b < self.token_budget:
+            out.append(b)
+            b *= 2
+        out.append(self.token_budget)
+        return tuple(dict.fromkeys(out))
+
+    def bin_chunk(self, c: int) -> int:
+        """Smallest ladder bin >= c (chunks past the ladder round up to the
+        next power of two so a direct step() caller can't unbound compiles)."""
+        for b in self.bins():
+            if c <= b:
+                return b
+        out = self.bins()[-1]
+        while out < c:
+            out *= 2
+        return out
 
 
 @dataclasses.dataclass
@@ -53,8 +121,19 @@ class InferenceConfig:
     # v2 paged KV (reference ragged/kv_cache.py BlockedKVCache)
     kv_block_size: int = 64
     num_kv_blocks: int = 256
+    # continuous-batching scheduler (inference/scheduler.py, engine_v2.step)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     # misc
     seed: int = 0
+
+    def __post_init__(self):
+        # direct construction accepts a plain dict for the serving section
+        # (from_dict validates unknown keys with a nicer error first);
+        # None means defaults (e.g. an empty YAML "serving:" section)
+        if self.serving is None:
+            self.serving = ServingConfig()
+        elif isinstance(self.serving, dict):
+            self.serving = ServingConfig(**self.serving)
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "InferenceConfig":
@@ -104,6 +183,20 @@ class InferenceConfig:
                 raise ConfigError(
                     f"quant_bits must be 8, 4 or \"fp8\", got {qb!r}")
             d["quant_bits"] = qb_int
+        sv = d.get("serving")
+        if sv is None:
+            d.pop("serving", None)   # empty section -> defaults
+        elif isinstance(sv, dict):
+            allowed = {f.name for f in dataclasses.fields(ServingConfig)}
+            unknown = set(sv) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown serving config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            d["serving"] = ServingConfig(**sv)
+        elif sv is not None and not isinstance(sv, ServingConfig):
+            raise ConfigError(f"serving must be a dict or ServingConfig, "
+                              f"got {type(sv).__name__}")
         known = {f.name for f in dataclasses.fields(cls)}
         ignored = {k: d.pop(k) for k in list(d) if k not in known}
         if ignored:

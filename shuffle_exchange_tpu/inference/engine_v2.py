@@ -75,15 +75,30 @@ class InferenceEngineV2(InferenceEngine):
         self._prefill_cache: Dict[Tuple[int, int], object] = {}
         self._decode_cache: Dict[int, object] = {}
         self._extend_cache: Dict[int, object] = {}
+        self._mixed_cache: Dict[Tuple, object] = {}
         # device programs launched (observability + the <=2-dispatch/step
         # contract for mixed batches; reference counts ragged-batch launches)
         self.dispatch_count = 0
+        # distinct compiled-program shapes dispatched — the shape-bin
+        # ladder's footprint. Serving tests assert this stays bounded by
+        # the ladder while ticks grow unbounded.
+        self._program_keys: set = set()
+        # table width of the most recent decode dispatch (bench.py uses it
+        # to count the KV bytes the kernels actually stream)
+        self._last_decode_table_width = self._max_blocks
 
     # -- scheduling queries (engine_v2.py:158-232) ---------------------
 
     @property
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
+
+    @property
+    def program_shapes(self) -> frozenset:
+        """Distinct compiled device-program shape keys dispatched so far —
+        the shape-bin ladder's compile footprint. Serving runs of any
+        length stay bounded by the ladder (tests assert it)."""
+        return frozenset(self._program_keys)
 
     def query(self, uid: int) -> Tuple[int, int]:
         """(max further tokens for uid, free blocks) — engine_v2.py:158."""
@@ -95,15 +110,35 @@ class InferenceEngineV2(InferenceEngine):
 
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
         """Admission check (engine_v2.py:184 can_schedule)."""
-        need = 0
+        return self._admission_detail(uids, lengths)[0]
+
+    def _admission_detail(self, uids: Sequence[int],
+                          lengths: Sequence[int]) -> Tuple[bool, int, str]:
+        """(ok, blocks_needed, why-not): the named-numbers admission check
+        behind can_schedule/put()/step() — failures say how many KV blocks
+        the batch wants vs how many are free and which uid asks for the
+        most (decode_loop's error discipline, ISSUE 5 satellite)."""
+        bs = self.cache.block_size
+        need, worst_uid, worst_ask = 0, None, -1
         for uid, n in zip(uids, lengths):
             desc = self._seqs.get(uid)
             seen = desc.seen_tokens if desc else 0
             have = len(desc.blocks) if desc else 0
             if seen + n > self.config.max_seq_len:
-                return False
-            need += max(0, blocks_needed(seen + n, self.cache.block_size) - have)
-        return need <= self.allocator.free_blocks
+                return False, 0, (
+                    f"uid {uid} would overrun max_seq_len: {seen} seen + {n} "
+                    f"new > {self.config.max_seq_len} (split the request or "
+                    f"raise max_seq_len)")
+            ask = max(0, blocks_needed(seen + n, bs) - have)
+            need += ask
+            if ask > worst_ask:
+                worst_uid, worst_ask = uid, ask
+        if need > self.allocator.free_blocks:
+            return False, need, (
+                f"needs {need} KV blocks, {self.allocator.free_blocks} free "
+                f"(largest single ask: uid {worst_uid} wants {worst_ask}); "
+                f"flush finished sequences or raise num_kv_blocks")
+        return True, need, ""
 
     # -- device programs ----------------------------------------------
 
@@ -170,50 +205,61 @@ class InferenceEngineV2(InferenceEngine):
         self._extend_cache[c] = fn
         return fn
 
+    def _extend_layer(self, lw, h, ck, cv, cos, sin, positions, start, nnew,
+                      btables):
+        """One chunked-prefill layer: scatter the chunk's K/V into the pool
+        and attend through the block table. Shared by the pure extend
+        program and the mixed Dynamic-SplitFuse step (step()). Returns
+        ``(h2, (ck2, cv2))``."""
+        import jax.numpy as jnp
+
+        B, C = h.shape[:2]
+        bs = self.cache.block_size
+
+        def attn_fn(q, k, v):
+            # scatter the chunk's K/V: token i of row b -> block
+            # btables[b, (start+i)//bs], offset (start+i)%bs. Tokens past
+            # nnew land on the scratch block.
+            pos = positions                                   # [B,C]
+            valid = jnp.arange(C)[None, :] < nnew[:, None]
+            blk = jnp.take_along_axis(jnp.maximum(btables, 0),
+                                      jnp.minimum(pos // bs, btables.shape[1] - 1),
+                                      axis=1)                 # [B,C]
+            blk = jnp.where(valid, blk, self._scratch)
+            off = pos % bs
+            # [nblk,KV,bs,Dh] pool: advanced (blk, off) around the KV
+            # slice yields [B*C, KV, Dh] rows, matching the new K/V
+            ck2 = ck.at[blk.reshape(-1), :, off.reshape(-1)].set(
+                k.reshape(B * C, *k.shape[2:]).astype(ck.dtype))
+            cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
+                v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
+            # paged extend: q chunk attends the pool through the
+            # block table — no [B, S_max, KV, Dh] gather (r2 weak #7);
+            # ALiBi slopes ride the kernel (round 5)
+            from ..ops.paged_attention import paged_extend_attention
+
+            out = paged_extend_attention(q, ck2, cv2, btables, start,
+                                         nnew, alibi_slopes=self._alibi)
+            return out, (ck2, cv2)
+
+        return self._layer_body(lw, h, cos, sin, positions, attn_fn)
+
     def _extend_impl(self, params, cache: PagedKVCache, ids, start, nnew, btables):
         """Chunked-prefill extension — a C-token chunk per sequence in ONE
         program (one program per CHUNK, not per token; VERDICT r1 weak #4).
 
         ids [B,C] (zero-padded past nnew); start [B] = first new position;
-        nnew [B] <= C; btables [B, max_blocks] -> cache, logits [B,V] at each
-        sequence's last new token."""
+        nnew [B] <= C; btables [B, W] (W = binned block-table width) ->
+        cache, logits [B,V] at each sequence's last new token."""
         import jax
         import jax.numpy as jnp
 
-        B, C = ids.shape
-        bs = self.cache.block_size
         x, (cos, sin), positions = self._embed_at(params, ids, start)
 
         def layer_fn(h, layer_and_cache):
             lw, ck, cv = layer_and_cache
-
-            def attn_fn(q, k, v):
-                # scatter the chunk's K/V: token i of row b -> block
-                # btables[b, (start+i)//bs], offset (start+i)%bs. Tokens past
-                # nnew land on the scratch block.
-                pos = positions                                   # [B,C]
-                valid = jnp.arange(C)[None, :] < nnew[:, None]
-                blk = jnp.take_along_axis(jnp.maximum(btables, 0),
-                                          jnp.minimum(pos // bs, btables.shape[1] - 1),
-                                          axis=1)                 # [B,C]
-                blk = jnp.where(valid, blk, self._scratch)
-                off = pos % bs
-                # [nblk,KV,bs,Dh] pool: advanced (blk, off) around the KV
-                # slice yields [B*C, KV, Dh] rows, matching the new K/V
-                ck2 = ck.at[blk.reshape(-1), :, off.reshape(-1)].set(
-                    k.reshape(B * C, *k.shape[2:]).astype(ck.dtype))
-                cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
-                    v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
-                # paged extend: q chunk attends the pool through the
-                # block table — no [B, S_max, KV, Dh] gather (r2 weak #7);
-                # ALiBi slopes ride the kernel (round 5)
-                from ..ops.paged_attention import paged_extend_attention
-
-                out = paged_extend_attention(q, ck2, cv2, btables, start,
-                                             nnew, alibi_slopes=self._alibi)
-                return out, (ck2, cv2)
-
-            return self._layer_body(lw, h, cos, sin, positions, attn_fn)
+            return self._extend_layer(lw, h, ck, cv, cos, sin, positions,
+                                      start, nnew, btables)
 
         x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
         x_last = jnp.take_along_axis(x, (nnew - 1)[:, None, None].astype(jnp.int32), axis=1)
@@ -252,49 +298,55 @@ class InferenceEngineV2(InferenceEngine):
         next candidate for closing the remaining per-token gap, to be
         traced on silicon against this scan structure."""
         import jax
-        import jax.numpy as jnp
 
         x, (cos, sin), _ = self._embed_at(params, tok[:, None], pos)
 
         def layer_fn(h, layer_and_cache):
             lw, ck, cv = layer_and_cache
-            if self._decode_kernel == "pallas":
-                fused = self._fused_paged_layer(lw, h, ck, cv, cos, sin,
-                                                pos, btables)
-                if fused is not None:
-                    return fused
-
-            def attn_fn(q, k, v):
-                ck2, cv2 = append_token_kv(ck, cv, k[:, 0], v[:, 0], btables, pos)
-                if self._decode_kernel == "pallas":
-                    # attention-only fusion: even when QKV fusion is off
-                    # for this layer (quantized weights, interleaved rope)
-                    # the split-K kernel still replaces the per-kv-head
-                    # streaming one
-                    try:
-                        from ..ops import fused_decode as fd
-
-                        return fd.fused_paged_decode_attention(
-                            q, ck2, cv2, btables, kv_len=pos + 1,
-                            alibi_slopes=self._alibi), (ck2, cv2)
-                    except Exception as e:
-                        from ..utils.logging import warning_once
-
-                        warning_once(
-                            "fused decode: split-K attention kernel failed "
-                            f"with {type(e).__name__}; using the streaming "
-                            "paged kernel")
-                # round 5: slopes ride the paged kernel (no cache gather
-                # for BLOOM serving); the wrapper's CPU fallback gathers
-                return paged_decode_attention(q, ck2, cv2, btables,
-                                              kv_len=pos + 1,
-                                              alibi_slopes=self._alibi), (ck2, cv2)
-
-            return self._layer_body(lw, h, cos, sin, pos, attn_fn)
+            return self._decode_layer(lw, h, ck, cv, cos, sin, pos, btables)
 
         x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
         logits = self.model.head(params, x)[:, 0]
         return PagedKVCache(kp, vp), logits
+
+    def _decode_layer(self, lw, h, ck, cv, cos, sin, pos, btables):
+        """One decode layer (one token per sequence): fused Pallas path
+        when eligible, else append + paged attention. Shared by the pure
+        decode step, the fused decode_loop, and the mixed step(). Returns
+        ``(h2, (ck2, cv2))``."""
+        if self._decode_kernel == "pallas":
+            fused = self._fused_paged_layer(lw, h, ck, cv, cos, sin,
+                                            pos, btables)
+            if fused is not None:
+                return fused
+
+        def attn_fn(q, k, v):
+            ck2, cv2 = append_token_kv(ck, cv, k[:, 0], v[:, 0], btables, pos)
+            if self._decode_kernel == "pallas":
+                # attention-only fusion: even when QKV fusion is off
+                # for this layer (quantized weights, interleaved rope)
+                # the split-K kernel still replaces the per-kv-head
+                # streaming one
+                try:
+                    from ..ops import fused_decode as fd
+
+                    return fd.fused_paged_decode_attention(
+                        q, ck2, cv2, btables, kv_len=pos + 1,
+                        alibi_slopes=self._alibi), (ck2, cv2)
+                except Exception as e:
+                    from ..utils.logging import warning_once
+
+                    warning_once(
+                        "fused decode: split-K attention kernel failed "
+                        f"with {type(e).__name__}; using the streaming "
+                        "paged kernel")
+            # round 5: slopes ride the paged kernel (no cache gather
+            # for BLOOM serving); the wrapper's CPU fallback gathers
+            return paged_decode_attention(q, ck2, cv2, btables,
+                                          kv_len=pos + 1,
+                                          alibi_slopes=self._alibi), (ck2, cv2)
+
+        return self._layer_body(lw, h, cos, sin, pos, attn_fn)
 
     def _fused_paged_layer(self, lw, h, ck, cv, cos, sin, pos, btables):
         """One fully-fused decode layer: fused QKV+RoPE+append writes the
@@ -344,10 +396,84 @@ class InferenceEngineV2(InferenceEngine):
         if need > 0:
             desc.blocks.extend(self.allocator.allocate(need))
 
-    def _table(self, desc: SequenceDescriptor) -> np.ndarray:
-        t = np.full((self._max_blocks,), self._scratch, dtype=np.int32)
+    def _table(self, desc: SequenceDescriptor,
+               width: Optional[int] = None) -> np.ndarray:
+        """Block-table row for one sequence, ``width`` entries (default
+        max_seq_len//block). Serving paths bin the width to the smallest
+        power of two covering the batch's allocated blocks: the decode
+        kernels stream EVERY table entry's block through VMEM, padding
+        included, so table width is directly per-step HBM read traffic
+        (the r5 engine_decode_sweep "hbm_util falls with batch" artifact —
+        see BASELINE.md)."""
+        width = self._max_blocks if width is None else width
+        assert len(desc.blocks) <= width, (desc.uid, len(desc.blocks), width)
+        t = np.full((width,), self._scratch, dtype=np.int32)
         t[:len(desc.blocks)] = desc.blocks
         return t
+
+    def _binned_width(self, nblocks: int) -> int:
+        """Power-of-two block-table width covering ``nblocks``, capped at
+        the max_seq_len table."""
+        return min(_bucket(max(1, int(nblocks)), minimum=1), self._max_blocks)
+
+    def _pack_decode(self, descs: List[SequenceDescriptor],
+                     toks: Sequence[int]):
+        """(B, W, tok, pos, tables) for a batched one-token decode step.
+        Blocks must already be ensured for seen+1."""
+        W = self._binned_width(max(len(d.blocks) for d in descs))
+        B = _bucket(len(descs), minimum=1)
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        tables = np.full((B, W), self._scratch, np.int32)
+        for i, (d, t) in enumerate(zip(descs, toks)):
+            tok[i], pos[i] = t, d.seen_tokens
+            tables[i] = self._table(d, W)
+        self._last_decode_table_width = W
+        return B, W, tok, pos, tables
+
+    def _pack_chunks(self, batch: List[Tuple[SequenceDescriptor, List[int]]],
+                     pad_chunk: Optional[int] = None):
+        """(B, C, W, ids, start, nnew, tables) for a chunked-prefill batch.
+        ``pad_chunk`` pins the padded chunk length (the serving ladder);
+        default is the power-of-two bucket of the longest chunk. Blocks
+        must already be ensured for seen+len(chunk)."""
+        cmax = max(len(c) for _, c in batch)
+        C = pad_chunk if pad_chunk is not None else _bucket(cmax, minimum=1)
+        assert C >= cmax, (C, cmax)
+        W = self._binned_width(max(len(d.blocks) for d, _ in batch))
+        B = _bucket(len(batch), minimum=1)
+        ids = np.zeros((B, C), np.int32)
+        start = np.zeros((B,), np.int32)
+        nnew = np.ones((B,), np.int32)
+        tables = np.full((B, W), self._scratch, np.int32)
+        for i, (d, chunk) in enumerate(batch):
+            ids[i, :len(chunk)] = chunk
+            start[i] = d.seen_tokens
+            nnew[i] = len(chunk)
+            tables[i] = self._table(d, W)
+        return B, C, W, ids, start, nnew, tables
+
+    def _pack_prefill(self, prefills: List[Tuple[SequenceDescriptor, List[int]]]):
+        """(P, tpad, ids, plen, btables) for the batched flash-prefill
+        program — shared by put() and bench.py's one-dispatch compiled-
+        prefill measurement (the decode_loop discipline applied to
+        prefill). Allocates each descriptor's blocks."""
+        bs = self.cache.block_size
+        tmax = max(len(toks) for _, toks in prefills)
+        tpad = max(bs, _bucket(tmax, minimum=bs))
+        tpad = min(-(-tpad // bs) * bs, self.config.max_seq_len)
+        nblk_pad = tpad // bs
+        P = _bucket(len(prefills), minimum=1)
+        ids = np.zeros((P, tpad), np.int32)
+        plen = np.ones((P,), np.int32)
+        btables = np.full((P, nblk_pad), self._scratch, np.int32)
+        for i, (desc, toks) in enumerate(prefills):
+            T = len(toks)
+            self._ensure_blocks(desc, T)
+            ids[i, :T] = toks
+            plen[i] = T
+            btables[i, :len(desc.blocks)] = desc.blocks[:nblk_pad]
+        return P, tpad, ids, plen, btables
 
     def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
         """Serve one engine step (engine_v2.py:107). New uids are prefilled;
@@ -360,9 +486,9 @@ class InferenceEngineV2(InferenceEngine):
         if len(set(uids)) != len(uids):
             raise ValueError("duplicate uid in one put() batch: a sequence can "
                              "advance at most one decode position per engine step")
-        if not self.can_schedule(uids, [len(t) for t in tokens]):
-            raise RuntimeError("cannot schedule batch: KV pool exhausted or length cap hit "
-                               "(check query()/free_blocks, flush finished sequences)")
+        ok, _, why = self._admission_detail(uids, [len(t) for t in tokens])
+        if not ok:
+            raise RuntimeError(f"cannot schedule put() batch: {why}")
         bs = self.cache.block_size
         prefills: List[Tuple[SequenceDescriptor, List[int]]] = []
         extends: List[Tuple[SequenceDescriptor, List[int]]] = []
@@ -388,23 +514,11 @@ class InferenceEngineV2(InferenceEngine):
 
         # ---- ALL pending prefills: one bucketed batched program ---------
         if prefills:
-            tmax = max(len(toks) for _, toks in prefills)
-            tpad = max(bs, _bucket(tmax, minimum=bs))
-            tpad = min(-(-tpad // bs) * bs, self.config.max_seq_len)
-            nblk_pad = tpad // bs
-            P = _bucket(len(prefills), minimum=1)
-            ids = np.zeros((P, tpad), np.int32)
-            plen = np.ones((P,), np.int32)
-            btables = np.full((P, nblk_pad), self._scratch, np.int32)
-            for i, (desc, toks) in enumerate(prefills):
-                T = len(toks)
-                self._ensure_blocks(desc, T)
-                ids[i, :T] = toks
-                plen[i] = T
-                btables[i, :len(desc.blocks)] = desc.blocks[:nblk_pad]
+            P, tpad, ids, plen, btables = self._pack_prefill(prefills)
             fn = self._paged_prefill_fn(P, tpad)
             self.cache, logits = fn(self.params, self.cache, ids, plen, btables)
             self.dispatch_count += 1
+            self._program_keys.add(("prefill", P, tpad))
             logits = np.asarray(logits)
             for i, (desc, toks) in enumerate(prefills):
                 desc.seen_tokens = len(toks)
@@ -416,16 +530,12 @@ class InferenceEngineV2(InferenceEngine):
         if singles:
             for d, _ in singles:
                 self._ensure_blocks(d, d.seen_tokens + 1)
-            B = _bucket(len(singles), minimum=1)
-            tok = np.zeros((B,), np.int32)
-            pos = np.zeros((B,), np.int32)
-            tables = np.full((B, self._max_blocks), self._scratch, np.int32)
-            for i, (d, t) in enumerate(singles):
-                tok[i], pos[i] = t, d.seen_tokens
-                tables[i] = self._table(d)
+            B, W, tok, pos, tables = self._pack_decode(
+                [d for d, _ in singles], [t for _, t in singles])
             fn = self._paged_decode_fn(B)
             self.cache, logits = fn(self.params, self.cache, tok, pos, tables)
             self.dispatch_count += 1
+            self._program_keys.add(("decode", B, W))
             logits = np.asarray(logits)
             for i, (d, _) in enumerate(singles):
                 d.seen_tokens += 1
@@ -443,28 +553,167 @@ class InferenceEngineV2(InferenceEngine):
                     chunk, remaining = toks[:bs], toks[bs:]
                     toks[:] = remaining
                     batch.append((d, chunk))
-            cmax = max(len(c) for _, c in batch)
-            C = max(1, _bucket(cmax, minimum=1))
-            B = _bucket(len(batch), minimum=1)
-            ids = np.zeros((B, C), np.int32)
-            start = np.zeros((B,), np.int32)
-            nnew = np.ones((B,), np.int32)
-            tables = np.full((B, self._max_blocks), self._scratch, np.int32)
-            for i, (d, chunk) in enumerate(batch):
+            for d, chunk in batch:
                 self._ensure_blocks(d, d.seen_tokens + len(chunk))
-                ids[i, :len(chunk)] = chunk
-                start[i] = d.seen_tokens
-                nnew[i] = len(chunk)
-                tables[i] = self._table(d)
+            B, C, W, ids, start, nnew, tables = self._pack_chunks(batch)
             fn = self._extend_fn((B, C))
             self.cache, logits = fn(self.params, self.cache, ids, start, nnew, tables)
             self.dispatch_count += 1
+            self._program_keys.add(("extend", B, C, W))
             logits = np.asarray(logits)
             for i, (d, chunk) in enumerate(batch):
                 d.seen_tokens += len(chunk)
                 d.last_logits = logits[i]
 
         return np.stack([self._seqs[uid].last_logits for uid in uids])
+
+    # -- continuous-batching mixed step (Dynamic SplitFuse) ------------
+
+    def _mixed_fn(self, key):
+        fn = self._mixed_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        fn = jax.jit(self._mixed_step_impl, donate_argnums=_donate_cache())
+        self._mixed_cache[key] = fn
+        return fn
+
+    def _mixed_step_impl(self, params, cache: PagedKVCache, dtok, dpos,
+                         dtables, pids, pstart, pnnew, ptables):
+        """The Dynamic-SplitFuse mixed step: ONE program advances every
+        running sequence by one decode token ([Bd] rows) AND absorbs a
+        prefill chunk for every prefilling sequence ([Bp, C] rows) — the
+        reference FastGen scheduler's uniform mixed batch (SURVEY §2.10;
+        Orca iteration-level scheduling / Sarathi chunked prefill), built
+        from the existing paged decode + extend layer bodies over ONE
+        layer scan so the KV pool is rewritten once per step, not twice.
+
+        Decode and prefill rows are disjoint sequences (a uid plays one
+        role per tick), so within a layer the decode append and the chunk
+        scatter write disjoint blocks; both attentions read through their
+        own block tables. Returns (cache, decode_logits [Bd,V],
+        prefill_logits [Bp,V] at each chunk's last token)."""
+        import jax
+        import jax.numpy as jnp
+
+        xd, (cos, sin), _ = self._embed_at(params, dtok[:, None], dpos)
+        xp, _, ppos = self._embed_at(params, pids, pstart)
+
+        def layer_fn(carry, layer_and_cache):
+            hd, hp = carry
+            lw, ck, cv = layer_and_cache
+            hd2, (ck2, cv2) = self._decode_layer(lw, hd, ck, cv, cos, sin,
+                                                 dpos, dtables)
+            hp2, (ck3, cv3) = self._extend_layer(lw, hp, ck2, cv2, cos, sin,
+                                                 ppos, pstart, pnnew, ptables)
+            return (hd2, hp2), (ck3, cv3)
+
+        (xd, xp), (kp, vp) = jax.lax.scan(layer_fn, (xd, xp),
+                                          (params["layers"], cache.k, cache.v))
+        dlogits = self.model.head(params, xd)[:, 0]
+        x_last = jnp.take_along_axis(xp, (pnnew - 1)[:, None, None].astype(jnp.int32),
+                                     axis=1)
+        plogits = self.model.head(params, x_last)[:, 0]
+        return PagedKVCache(kp, vp), dlogits, plogits
+
+    def step(self, decode_uids: Sequence[int], decode_tokens: Sequence[int],
+             prefills: Sequence[Tuple[int, Sequence[int]]] = ()
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """One continuous-batching tick: every uid in ``decode_uids``
+        advances one token and every ``(uid, chunk)`` in ``prefills``
+        absorbs a prompt chunk (new uids start chunked prefill at position
+        0; known uids continue where their last chunk stopped), in ONE
+        device dispatch — the serving loop's per-tick program
+        (inference/scheduler.py packs these against the token budget).
+
+        Shapes are binned so a serving process compiles a bounded program
+        set: decode rows and prefill rows round up a power-of-two ladder,
+        chunk length rounds up the ``serving.chunk_bins`` ladder, and
+        block-table widths round up powers of two covering the batch
+        (asserted in tests/test_serving_scheduler.py). Admission is
+        all-or-nothing BEFORE any state mutation, with errors naming
+        needed-vs-free KV blocks and the offending uid.
+
+        Returns ``(decode_logits [len(decode_uids), V], prefill_logits
+        [len(prefills), V])`` — prefill logits are at each chunk's last
+        token (argmax of a final chunk's row is the sequence's first
+        generated token)."""
+        prefills = [(u, list(map(int, c))) for u, c in prefills]
+        if len(decode_uids) != len(decode_tokens):
+            raise ValueError("decode_uids and decode_tokens must align")
+        all_uids = list(decode_uids) + [u for u, _ in prefills]
+        if len(set(all_uids)) != len(all_uids):
+            raise ValueError(
+                "duplicate uid in one step(): a sequence is either decoding "
+                "or prefilling in a tick, never both")
+        for uid in decode_uids:
+            if uid not in self._seqs:
+                raise ValueError(f"decode uid {uid} unknown — prefill it "
+                                 "first (step(prefills=...) or put())")
+        for uid, chunk in prefills:
+            if not chunk:
+                raise ValueError(f"prefill uid {uid} with an empty chunk")
+        ok, _, why = self._admission_detail(
+            all_uids, [1] * len(decode_uids) + [len(c) for _, c in prefills])
+        if not ok:
+            raise RuntimeError(f"cannot schedule step(): {why}")
+
+        # admission passed: create descriptors for new prefill uids
+        pdescs = []
+        for uid, chunk in prefills:
+            desc = self._seqs.get(uid)
+            if desc is None:
+                desc = SequenceDescriptor(uid=uid)
+                self._seqs[uid] = desc
+            pdescs.append(desc)
+        ddescs = [self._seqs[u] for u in decode_uids]
+        for d in ddescs:
+            self._ensure_blocks(d, d.seen_tokens + 1)
+        for d, (_, chunk) in zip(pdescs, prefills):
+            self._ensure_blocks(d, d.seen_tokens + len(chunk))
+
+        V = self._mcfg.vocab_size
+        dlogits = np.zeros((0, V), np.float32)
+        plogits = np.zeros((0, V), np.float32)
+        if ddescs and pdescs:
+            Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs, decode_tokens)
+            chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
+            cmax = max(len(c) for _, c in chunks)
+            Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
+                chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
+            fn = self._mixed_fn((Bd, Wd, Bp, C, Wp))
+            self.cache, dl, pl = fn(self.params, self.cache, tok, pos,
+                                    dtables, ids, start, nnew, ptables)
+            self._program_keys.add(("mixed", Bd, Wd, Bp, C, Wp))
+            dlogits, plogits = np.asarray(dl), np.asarray(pl)
+        elif ddescs:
+            Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs, decode_tokens)
+            fn = self._paged_decode_fn(Bd)
+            self.cache, dl = fn(self.params, self.cache, tok, pos, dtables)
+            self._program_keys.add(("decode", Bd, Wd))
+            dlogits = np.asarray(dl)
+        elif pdescs:
+            chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
+            cmax = max(len(c) for _, c in chunks)
+            Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
+                chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
+            fn = self._extend_fn((Bp, C))
+            self.cache, pl = fn(self.params, self.cache, ids, start, nnew,
+                                ptables)
+            self._program_keys.add(("extend", Bp, C, Wp))
+            plogits = np.asarray(pl)
+        else:
+            return dlogits, plogits
+        self.dispatch_count += 1
+
+        for i, d in enumerate(ddescs):
+            d.seen_tokens += 1
+            d.last_logits = dlogits[i]
+        for i, (d, (_, chunk)) in enumerate(zip(pdescs, prefills)):
+            d.seen_tokens += len(chunk)
+            d.last_logits = plogits[i]
+        return dlogits[:len(ddescs)], plogits[:len(pdescs)]
 
     # -- fused multi-token decode --------------------------------------
 
@@ -532,13 +781,20 @@ class InferenceEngineV2(InferenceEngine):
                 f"{self.allocator.free_blocks} free")
         for d in descs:
             self._ensure_blocks(d, d.seen_tokens + n_steps)
-        btables = np.stack([self._table(d) for d in descs]).astype(np.int32)
+        # binned table width (round 9): the decode kernels stream every
+        # table entry's block, so a max_seq_len-wide table reads ~3x the
+        # live KV at typical fills — width covers exactly the blocks this
+        # loop can touch, rounded up a power of two to bound compiles
+        W = self._binned_width(max(len(d.blocks) for d in descs))
+        btables = np.stack([self._table(d, W) for d in descs]).astype(np.int32)
+        self._last_decode_table_width = W
         pos = np.asarray([d.seen_tokens for d in descs], np.int32)
         tok0 = np.asarray(tokens, np.int32)
         fn = self._decode_loop_fn((len(uids), int(n_steps)))
         self.cache, toks, last_logits = fn(self.params, self.cache, tok0,
                                            pos, btables)
         self.dispatch_count += 1
+        self._program_keys.add(("decode_loop", len(uids), int(n_steps), W))
         last_logits = np.asarray(last_logits)
         for i, d in enumerate(descs):
             d.seen_tokens += n_steps

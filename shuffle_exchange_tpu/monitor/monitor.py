@@ -186,6 +186,12 @@ class InMemoryMonitor(Monitor):
                 return value
         return None
 
+    def values(self, label: str) -> list:
+        """Every retained value recorded under ``label``, oldest first —
+        the serving scheduler's TTFT/TPOT percentile source (bounded by
+        the ring, so long-lived servers see the recent window)."""
+        return [value for lbl, value, _ in self.events if lbl == label]
+
 
 class MonitorMaster(Monitor):
     """Fan-out to every enabled backend (reference monitor/monitor.py:30).

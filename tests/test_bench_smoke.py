@@ -79,3 +79,36 @@ def test_host_offload_ladder_entry_runs_at_toy_size():
     assert 1.5e9 <= n <= 2.0e9, n
     assert mcfg_f.head_dim == 128 and mcfg_f.n_heads // mcfg_f.kv_heads == 4
     assert ds_f["zero_optimization"]["offload_optimizer"]["offload_overlap"]
+
+
+def test_serving_goodput_row_runs_at_toy_size():
+    """The config-5 serving-goodput row (bench.serving_goodput_row) at toy
+    size: same two-pass shape — capacity pass, then a Poisson trace offered
+    at 2x capacity through the continuous-batching scheduler — runs on CPU,
+    so the published bench row cannot rot on the driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_goodput_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = serving_goodput_row(model, params, icfg, mcfg.vocab_size,
+                              n_requests=6, prompt_lo=4, prompt_hi=20,
+                              max_new=5, load=2.0)
+    assert row["sustained_tokens_per_sec"] > 0
+    assert row["capacity_tokens_per_sec"] > 0
+    assert row["ttft_p50_s"] > 0 and row["tpot_p50_s"] > 0
+    assert 0 < row["budget_fill_mean"] <= 1
+    assert row["n_requests"] == 6 and row["chunk_bins"] == [4, 8, 16]
+    assert row["compiled_programs"] >= 1
