@@ -24,6 +24,11 @@ python -m pytest tests/test_zeropp_wire_meshes.py tests/test_comm_buckets.py \
 # sequential put/decode_loop reference, preemption/requeue determinism,
 # one-dispatch mixed ticks, and the shape-bin compile bound.
 python -m pytest tests/test_serving_scheduler.py -q "$@"
+# Prefix-cache + quantized-KV gates (ISSUE 6): ref-counted content-
+# addressed allocator semantics, shared-prefix admission parity with the
+# zero-new-allocation assert, COW divergence, preempt/requeue with shared
+# blocks, and int8/fp8 KV decode parity vs the bf16 gather oracle.
+python -m pytest tests/test_prefix_cache.py tests/test_kv_quant.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \
@@ -32,4 +37,6 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_zeropp_wire_meshes.py \
     --ignore=tests/test_comm_buckets.py \
     --ignore=tests/test_elasticity_drill.py \
-    --ignore=tests/test_serving_scheduler.py "$@"
+    --ignore=tests/test_serving_scheduler.py \
+    --ignore=tests/test_prefix_cache.py \
+    --ignore=tests/test_kv_quant.py "$@"

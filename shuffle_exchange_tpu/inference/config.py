@@ -17,6 +17,17 @@ from ..utils.logging import logger
 _DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16", "fp16": "float16",
            "float16": "float16", "fp32": "float32", "float32": "float32"}
 
+_KV_CACHE_DTYPES = {"bf16": "bf16", "bfloat16": "bf16", "int8": "int8",
+                    "fp8": "fp8", "float8": "fp8", "e4m3": "fp8"}
+
+
+def _normalize_kv_cache_dtype(value) -> str:
+    key = str(value).strip().lower()
+    if key not in _KV_CACHE_DTYPES:
+        raise ConfigError(
+            f'kv_cache_dtype must be "bf16", "int8" or "fp8", got {value!r}')
+    return _KV_CACHE_DTYPES[key]
+
 
 @dataclasses.dataclass
 class ServingConfig:
@@ -121,6 +132,30 @@ class InferenceConfig:
     # v2 paged KV (reference ragged/kv_cache.py BlockedKVCache)
     kv_block_size: int = 64
     num_kv_blocks: int = 256
+    # KV-cache storage dtype (paged engine): "bf16" stores at the serving
+    # dtype (the historical behavior); "int8"/"fp8" store 1 byte/element
+    # with per-token-per-head scale planes — decode is KV-bandwidth-bound,
+    # so halving resident KV bytes ~doubles the binding resource AND the
+    # resident batch (reference compression/quantization machinery, SURVEY
+    # §2.11/§2.8, applied to the serving cache). Kernels dequantize
+    # in-register on stream; the XLA gather path is the CPU numerics
+    # oracle. One-shot put() prefill logits stay BIT-identical to bf16
+    # mode (the prompt attends the full-precision in-flight chunk; only
+    # storage is compressed), but CHUNKED prefill — the scheduler's
+    # mixed ticks, or a prefix-cache suffix — reads earlier KV back
+    # dequantized, so scheduler-served tokens under int8/fp8 are
+    # approximate vs the sequential reference (greedy near-ties can
+    # flip); bf16 mode keeps the exact-token serving parity guarantee.
+    kv_cache_dtype: str = "bf16"
+    # Prefix caching (paged engine): committed full KV blocks are hashed
+    # (chained per-block token hash) and admitted sequences reuse matching
+    # committed prefix blocks ref-counted instead of re-prefilling them;
+    # copy-on-write protects shared blocks on divergence. Off by default:
+    # a cache hit prefills only the suffix through the extend kernels,
+    # whose reduction order differs from the cold batched-prefill program,
+    # so outputs are token-identical in practice but not guaranteed
+    # bit-identical — production serving configs opt in.
+    prefix_caching: bool = False
     # continuous-batching scheduler (inference/scheduler.py, engine_v2.step)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     # misc
@@ -134,6 +169,11 @@ class InferenceConfig:
             self.serving = ServingConfig()
         elif isinstance(self.serving, dict):
             self.serving = ServingConfig(**self.serving)
+        self.kv_cache_dtype = _normalize_kv_cache_dtype(self.kv_cache_dtype)
+        if not isinstance(self.prefix_caching, bool):
+            raise ConfigError(
+                f"prefix_caching must be a bool, got "
+                f"{self.prefix_caching!r} ({type(self.prefix_caching).__name__})")
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "InferenceConfig":
@@ -171,6 +211,13 @@ class InferenceConfig:
         if dk not in ("auto", "pallas", "xla"):
             raise ConfigError(
                 f'decode_kernel must be "auto", "pallas" or "xla", got {dk!r}')
+        if "kv_cache_dtype" in d:
+            d["kv_cache_dtype"] = _normalize_kv_cache_dtype(d["kv_cache_dtype"])
+        pc = d.get("prefix_caching", False)
+        if not isinstance(pc, bool):
+            raise ConfigError(
+                f"prefix_caching must be a bool, got {pc!r} "
+                f"({type(pc).__name__})")
         qb = d.get("quant_bits", 8)
         if str(qb).strip().lower() == "fp8":
             d["quant_bits"] = "fp8"

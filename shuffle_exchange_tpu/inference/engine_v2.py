@@ -25,8 +25,9 @@ from ..utils.logging import logger
 from .config import InferenceConfig
 from .engine import (InferenceEngine, _bucket, _rope_rows,
                      _apply_rope_batched)
-from .paged import (BlockedAllocator, PagedKVCache, append_token_kv, blocks_needed,
-                    paged_decode_attention, write_prefill_kv)
+from .paged import (BlockedAllocator, PagedKVCache, _chain_key, append_token_kv,
+                    blocks_needed, chain_block_keys, kv_parts,
+                    paged_decode_attention, quantize_kv, write_prefill_kv)
 
 
 
@@ -42,12 +43,25 @@ def _donate_cache():
 
 @dataclasses.dataclass
 class SequenceDescriptor:
-    """Host state for one live sequence (ragged/sequence_descriptor.py:59)."""
+    """Host state for one live sequence (ragged/sequence_descriptor.py:59).
+
+    Round 11 prefix-cache fields: ``tokens`` is the full written-token
+    history (every KV slot this sequence has filled — prompt plus decode
+    inputs), ``committed`` counts the full blocks already registered in
+    the allocator's content index, and ``last_key`` is the chained hash
+    of the last committed block (parent for the next registration)."""
 
     uid: int
     seen_tokens: int = 0
     blocks: List[int] = dataclasses.field(default_factory=list)
     last_logits: Optional[np.ndarray] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    committed: int = 0
+    last_key: bytes = b""
+    # a sequence that lived through a force reload_weights() carries KV
+    # from MIXED weights: its blocks must never enter the content index
+    # (a fresh admission would hash the same tokens and hit stale KV)
+    no_commit: bool = False
 
 
 class InferenceEngineV2(InferenceEngine):
@@ -66,8 +80,15 @@ class InferenceEngineV2(InferenceEngine):
         if cfg.max_seq_len % cfg.kv_block_size:
             raise ValueError("max_seq_len must be a multiple of kv_block_size")
         self.cache = PagedKVCache.create(mcfg.n_layers, cfg.num_kv_blocks, cfg.kv_block_size,
-                                         mcfg.kv_heads, mcfg.head_dim, cfg.jax_dtype())
+                                         mcfg.kv_heads, mcfg.head_dim, cfg.jax_dtype(),
+                                         kv_cache_dtype=cfg.kv_cache_dtype)
         self.allocator = BlockedAllocator(cfg.num_kv_blocks)
+        # prefix-cache observability (the scheduler's prefix_cache/* group
+        # and bench's hit-rate read these; cow_copies also counts fork
+        # divergence with prefix_caching off)
+        self.prefix_hit_tokens = 0
+        self.prefix_miss_tokens = 0
+        self.cow_copies = 0
         # block 0 is scratch: padding table entries scribble here, never read.
         self._scratch = self.allocator.allocate(1)[0]
         self._seqs: Dict[int, SequenceDescriptor] = {}
@@ -112,14 +133,21 @@ class InferenceEngineV2(InferenceEngine):
         """Admission check (engine_v2.py:184 can_schedule)."""
         return self._admission_detail(uids, lengths)[0]
 
-    def _admission_detail(self, uids: Sequence[int],
-                          lengths: Sequence[int]) -> Tuple[bool, int, str]:
-        """(ok, blocks_needed, why-not): the named-numbers admission check
-        behind can_schedule/put()/step() — failures say how many KV blocks
-        the batch wants vs how many are free and which uid asks for the
-        most (decode_loop's error discipline, ISSUE 5 satellite)."""
+    def _admission_detail(self, uids: Sequence[int], lengths: Sequence[int],
+                          new_tokens: Optional[Dict[int, Sequence[int]]] = None
+                          ) -> Tuple[bool, int, str]:
+        """(ok, blocks_from_free_pool, why-not): the named-numbers
+        admission check behind can_schedule/put()/step() — failures say
+        how many KV blocks the batch wants vs how many are free and which
+        uid asks for the most (decode_loop's error discipline). With
+        ``new_tokens`` (uid -> prompt for NEW uids) and prefix_caching on,
+        prefix-cached blocks are netted out: a live shared hit costs zero
+        free-pool slots, a parked hit costs its revival slot but no
+        prefill, and the message names cached vs new. A known uid whose
+        next write lands in a still-shared block budgets one extra block
+        for the copy-on-write clone."""
         bs = self.cache.block_size
-        need, worst_uid, worst_ask = 0, None, -1
+        need, worst_uid, worst_ask, worst_cached = 0, None, -1, 0
         for uid, n in zip(uids, lengths):
             desc = self._seqs.get(uid)
             seen = desc.seen_tokens if desc else 0
@@ -129,18 +157,50 @@ class InferenceEngineV2(InferenceEngine):
                     f"uid {uid} would overrun max_seq_len: {seen} seen + {n} "
                     f"new > {self.config.max_seq_len} (split the request or "
                     f"raise max_seq_len)")
-            ask = max(0, blocks_needed(seen + n, bs) - have)
+            cached = 0
+            if desc is None and new_tokens and uid in new_tokens:
+                _, live, parked = self.prefix_peek(new_tokens[uid])
+                cached = live + parked
+                # only the LIVE hits are free; parked revivals consume a
+                # slot from the free pool (they are counted free until
+                # acquired)
+                ask = max(0, blocks_needed(n, bs) - live)
+            else:
+                ask = max(0, blocks_needed(seen + n, bs) - have)
+                if desc is not None and desc.blocks:
+                    first, last = seen // bs, (seen + n - 1) // bs
+                    ask += sum(
+                        1 for i in range(first, min(last + 1, len(desc.blocks)))
+                        if self.allocator.ref_count(desc.blocks[i]) > 1)
             need += ask
             if ask > worst_ask:
-                worst_uid, worst_ask = uid, ask
+                worst_uid, worst_ask, worst_cached = uid, ask, cached
         if need > self.allocator.free_blocks:
+            cache_note = (f" after {worst_cached} prefix-cached" if worst_cached
+                          else "")
             return False, need, (
                 f"needs {need} KV blocks, {self.allocator.free_blocks} free "
-                f"(largest single ask: uid {worst_uid} wants {worst_ask}); "
-                f"flush finished sequences or raise num_kv_blocks")
+                f"(largest single ask: uid {worst_uid} wants {worst_ask} new"
+                f"{cache_note}); flush finished sequences or raise "
+                f"num_kv_blocks")
         return True, need, ""
 
     # -- device programs ----------------------------------------------
+
+    def _kv_xs(self, cache: PagedKVCache):
+        """Per-layer KV operands for the layer scans: bf16 pools scan the
+        bare [L, ...] arrays; quantized pools scan ``(data, scale)`` pairs
+        so every layer body sees the pair the kernels take."""
+        if cache.quantized:
+            return (cache.k, cache.k_scale), (cache.v, cache.v_scale)
+        return cache.k, cache.v
+
+    @staticmethod
+    def _cache_of(kp, vp) -> PagedKVCache:
+        """Rebuild the pool from stacked scan outputs (pair-aware)."""
+        if isinstance(kp, tuple):
+            return PagedKVCache(kp[0], vp[0], kp[1], vp[1])
+        return PagedKVCache(kp, vp)
 
     def _paged_prefill_fn(self, p: int, tpad: int):
         fn = self._prefill_cache.get((p, tpad))
@@ -181,19 +241,37 @@ class InferenceEngineV2(InferenceEngine):
                             .transpose(0, 1, 3, 2, 4)
                             .reshape(P * nblk_pad, KV, bs, Dh))
 
+                def sblocks(s):  # [P,tpad,KV] scale rows -> [P*nblk,KV,bs]
+                    return (s.reshape(P, nblk_pad, bs, KV)
+                            .transpose(0, 1, 3, 2)
+                            .reshape(P * nblk_pad, KV, bs))
+
                 flat = btables.reshape(-1)
-                ck2 = ck.at[flat].set(blocks(k).astype(ck.dtype))
-                cv2 = cv.at[flat].set(blocks(v).astype(cv.dtype))
+                kq, ksc = kv_parts(ck)
+                vq, vsc = kv_parts(cv)
+                kw, vw = k, v
+                if ksc is not None:
+                    # quantize on write; attention below still uses the
+                    # full-precision chunk (storage is what's compressed)
+                    kw, sk = quantize_kv(k, kq.dtype)
+                    vw, sv = quantize_kv(v, vq.dtype)
+                    ksc = ksc.at[flat].set(sblocks(sk))
+                    vsc = vsc.at[flat].set(sblocks(sv))
+                kq2 = kq.at[flat].set(blocks(kw).astype(kq.dtype))
+                vq2 = vq.at[flat].set(blocks(vw).astype(vq.dtype))
+                ck2 = kq2 if ksc is None else (kq2, ksc)
+                cv2 = vq2 if vsc is None else (vq2, vsc)
                 return flash_attention(q, k, v, causal=True,
                                        impl=self.config.attention_impl,
                                        alibi_slopes=self._alibi), (ck2, cv2)
 
             return self._layer_body(lw, h, cos, sin, positions, attn_fn)
 
-        x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
+        x, (kp, vp) = jax.lax.scan(layer_fn, x,
+                                   (params["layers"],) + self._kv_xs(cache))
         x_last = jnp.take_along_axis(x, (plen - 1)[:, None, None].astype(jnp.int32), axis=1)
         logits = self.model.head(params, x_last)[:, 0]
-        return PagedKVCache(kp, vp), logits
+        return self._cache_of(kp, vp), logits
 
     def _extend_fn(self, c: int):
         fn = self._extend_cache.get(c)
@@ -227,12 +305,25 @@ class InferenceEngineV2(InferenceEngine):
                                       axis=1)                 # [B,C]
             blk = jnp.where(valid, blk, self._scratch)
             off = pos % bs
+            kq, ksc = kv_parts(ck)
+            vq, vsc = kv_parts(cv)
+            kw, vw = k, v
+            if ksc is not None:
+                # quantize on write: one scale per (token, kv head) row
+                kw, sk = quantize_kv(k, kq.dtype)             # [B,C,KV]
+                vw, sv = quantize_kv(v, vq.dtype)
+                ksc = ksc.at[blk.reshape(-1), :, off.reshape(-1)].set(
+                    sk.reshape(B * C, sk.shape[2]))
+                vsc = vsc.at[blk.reshape(-1), :, off.reshape(-1)].set(
+                    sv.reshape(B * C, sv.shape[2]))
             # [nblk,KV,bs,Dh] pool: advanced (blk, off) around the KV
             # slice yields [B*C, KV, Dh] rows, matching the new K/V
-            ck2 = ck.at[blk.reshape(-1), :, off.reshape(-1)].set(
-                k.reshape(B * C, *k.shape[2:]).astype(ck.dtype))
-            cv2 = cv.at[blk.reshape(-1), :, off.reshape(-1)].set(
-                v.reshape(B * C, *v.shape[2:]).astype(cv.dtype))
+            kq2 = kq.at[blk.reshape(-1), :, off.reshape(-1)].set(
+                kw.reshape(B * C, *kw.shape[2:]).astype(kq.dtype))
+            vq2 = vq.at[blk.reshape(-1), :, off.reshape(-1)].set(
+                vw.reshape(B * C, *vw.shape[2:]).astype(vq.dtype))
+            ck2 = kq2 if ksc is None else (kq2, ksc)
+            cv2 = vq2 if vsc is None else (vq2, vsc)
             # paged extend: q chunk attends the pool through the
             # block table — no [B, S_max, KV, Dh] gather (r2 weak #7);
             # ALiBi slopes ride the kernel (round 5)
@@ -261,10 +352,11 @@ class InferenceEngineV2(InferenceEngine):
             return self._extend_layer(lw, h, ck, cv, cos, sin, positions,
                                       start, nnew, btables)
 
-        x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
+        x, (kp, vp) = jax.lax.scan(layer_fn, x,
+                                   (params["layers"],) + self._kv_xs(cache))
         x_last = jnp.take_along_axis(x, (nnew - 1)[:, None, None].astype(jnp.int32), axis=1)
         logits = self.model.head(params, x_last)[:, 0]
-        return PagedKVCache(kp, vp), logits
+        return self._cache_of(kp, vp), logits
 
     def _paged_decode_fn(self, b: int):
         fn = self._decode_cache.get(b)
@@ -305,9 +397,10 @@ class InferenceEngineV2(InferenceEngine):
             lw, ck, cv = layer_and_cache
             return self._decode_layer(lw, h, ck, cv, cos, sin, pos, btables)
 
-        x, (kp, vp) = jax.lax.scan(layer_fn, x, (params["layers"], cache.k, cache.v))
+        x, (kp, vp) = jax.lax.scan(layer_fn, x,
+                                   (params["layers"],) + self._kv_xs(cache))
         logits = self.model.head(params, x)[:, 0]
-        return PagedKVCache(kp, vp), logits
+        return self._cache_of(kp, vp), logits
 
     def _decode_layer(self, lw, h, ck, cv, cos, sin, pos, btables):
         """One decode layer (one token per sequence): fused Pallas path
@@ -371,30 +464,176 @@ class InferenceEngineV2(InferenceEngine):
         y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm,
                   eps=cfg.norm_eps)
         bs = self.cache.block_size
-        blk = jnp.take_along_axis(jnp.maximum(btables, 0),
-                                  (pos // bs)[:, None], axis=1)[:, 0]
-        off = pos % bs
+        quantized = isinstance(ck, tuple)
         try:
-            q, k, v, ck2, cv2 = fd.fused_qkv_rope(
-                y[:, 0], lw["wq"], lw["wk"], lw["wv"], cos=cosr, sin=sinr,
-                n_heads=cfg.n_heads, kv_heads=cfg.kv_heads,
-                pool_k=ck, pool_v=cv, blk=blk, off=off, **bias)
+            if quantized:
+                # int8/fp8 pool: the in-kernel pool DMA would write raw
+                # projections without the scale plane, so the append goes
+                # through the XLA quantize-on-write scatter (one token's
+                # rows — negligible next to the streamed KV read, which
+                # stays fused and dequantizes in-register below)
+                q, k, v = fd.fused_qkv_rope(
+                    y[:, 0], lw["wq"], lw["wk"], lw["wv"], cos=cosr,
+                    sin=sinr, n_heads=cfg.n_heads, kv_heads=cfg.kv_heads,
+                    **bias)
+                ck2, cv2 = append_token_kv(ck, cv, k, v, btables, pos)
+            else:
+                blk = jnp.take_along_axis(jnp.maximum(btables, 0),
+                                          (pos // bs)[:, None], axis=1)[:, 0]
+                off = pos % bs
+                q, k, v, ck2, cv2 = fd.fused_qkv_rope(
+                    y[:, 0], lw["wq"], lw["wk"], lw["wv"], cos=cosr, sin=sinr,
+                    n_heads=cfg.n_heads, kv_heads=cfg.kv_heads,
+                    pool_k=ck, pool_v=cv, blk=blk, off=off, **bias)
             attn = fd.fused_paged_decode_attention(
                 q[:, None], ck2, cv2, btables, pos + 1,
                 alibi_slopes=self._alibi)
         except Exception as e:
             warning_once(f"fused decode: paged layer kernels failed with "
                          f"{type(e).__name__} (D={y.shape[-1]}, "
-                         f"pool={tuple(ck.shape)}); using the XLA path")
+                         f"pool={tuple(kv_parts(ck)[0].shape)}); using the "
+                         "XLA path")
             return None
         return self._block_tail(lw, h, y, attn), (ck2, cv2)
 
     # -- host-side scheduling ------------------------------------------
 
+    def _clone_block(self, src: int, dst: int) -> None:
+        """Device copy of one pool block (all layers, data + scale planes)
+        — the copy half of copy-on-write. One cached jitted program with
+        the pool donated (same discipline as every other cache-updating
+        program here): XLA updates the pool in place and moves O(block)
+        bytes, where an eager ``at[].set`` would materialize a full pool
+        copy per clone — a transient 2x-pool allocation that could OOM a
+        pool sized near HBM capacity. src/dst ride as i32 operands so
+        every clone hits the same executable."""
+        fn = getattr(self, "_clone_prog", None)
+        if fn is None:
+            import jax
+
+            from ..utils.placement import cache_safe_donate_argnums
+
+            def impl(cache, src_, dst_):
+                def cp(x):
+                    return x.at[:, dst_].set(x[:, src_])
+
+                return PagedKVCache(*[cp(x) if not isinstance(x, tuple)
+                                      else x for x in cache])
+
+            fn = jax.jit(impl,
+                         donate_argnums=cache_safe_donate_argnums((0,)))
+            self._clone_prog = fn
+        self.cache = fn(self.cache, np.int32(src), np.int32(dst))
+
     def _ensure_blocks(self, desc: SequenceDescriptor, total_tokens: int) -> None:
-        need = blocks_needed(total_tokens, self.cache.block_size) - len(desc.blocks)
+        """Grow ``desc`` to cover ``total_tokens``, copy-on-write first:
+        the coming write spans [seen, total) — any EXISTING block in that
+        span still shared with another sequence (a fork's partial tail, or
+        a mid-block divergence from a shared prefix) gets a private clone
+        before the dispatch writes into it. Committed full blocks are
+        never in the write span (committed <= seen // block), so the
+        content registry stays consistent without rollback."""
+        bs = self.cache.block_size
+        first = desc.seen_tokens // bs
+        last = (max(total_tokens, 1) - 1) // bs
+        for i in range(first, min(last + 1, len(desc.blocks))):
+            b = desc.blocks[i]
+            if self.allocator.ref_count(b) > 1:
+                assert i >= desc.committed, (desc.uid, i, desc.committed)
+                [nb] = self.allocator.allocate(1)
+                self._clone_block(b, nb)
+                self.allocator.free([b])
+                desc.blocks[i] = nb
+                self.cow_copies += 1
+        need = blocks_needed(total_tokens, bs) - len(desc.blocks)
         if need > 0:
             desc.blocks.extend(self.allocator.allocate(need))
+
+    # -- prefix cache (content-addressed block reuse) -------------------
+
+    def prefix_peek(self, tokens: Sequence[int]) -> Tuple[int, int, int]:
+        """(hit_tokens, live_blocks, parked_blocks): the longest committed
+        prefix of ``tokens`` currently reusable from the block store. Live
+        blocks cost an admission ZERO free-pool slots (another sequence
+        holds them resident); parked ones consume a free slot on revival
+        but no prefill compute either way. Capped one token short of the
+        full prompt so an admission always prefills at least the last
+        token (the logits position)."""
+        if not self.config.prefix_caching:
+            return 0, 0, 0
+        bs = self.cache.block_size
+        max_full = (len(tokens) - 1) // bs
+        if max_full <= 0:
+            return 0, 0, 0
+        keys = chain_block_keys(list(tokens)[:max_full * bs], bs)
+        live, parked = self.allocator.peek(keys)
+        return (live + parked) * bs, live, parked
+
+    def acquire_prefix(self, uid: int, tokens: Sequence[int]) -> int:
+        """Admit ``uid`` with the longest committed prefix of ``tokens``
+        acquired from the block store (live hits gain a reference, parked
+        hits revive): the descriptor starts at ``seen_tokens == hit`` and
+        the caller prefills only the suffix. Returns the hit token count
+        (0 admits a cold descriptor). The sequence's own continuation
+        commits new full blocks back to the store as it grows."""
+        if uid in self._seqs:
+            raise ValueError(f"uid {uid} is already live")
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError(f"new uid {uid} with no tokens")
+        desc = SequenceDescriptor(uid=uid)
+        if self.config.prefix_caching:
+            bs = self.cache.block_size
+            max_full = (len(tokens) - 1) // bs
+            keys = chain_block_keys(tokens[:max_full * bs], bs)
+            blocks = self.allocator.acquire(keys)
+            hit = len(blocks) * bs
+            desc.blocks = blocks
+            desc.seen_tokens = hit
+            desc.tokens = tokens[:hit]
+            desc.committed = len(blocks)
+            desc.last_key = keys[len(blocks) - 1] if blocks else b""
+            self.prefix_hit_tokens += hit
+            self.prefix_miss_tokens += len(tokens) - hit
+        self._seqs[uid] = desc
+        return desc.seen_tokens
+
+    def _commit(self, desc: SequenceDescriptor) -> None:
+        """Register every newly-FULL block of ``desc`` under its chained
+        content key (first writer wins; a lost race keeps the block
+        private). Committed blocks are immutable from here on — the write
+        paths never touch positions below ``seen_tokens`` and COW guards
+        forks — so a later admission can share them by hash alone."""
+        if not self.config.prefix_caching or desc.no_commit:
+            return
+        bs = self.cache.block_size
+        nfull = min(desc.seen_tokens, len(desc.tokens)) // bs
+        while desc.committed < nfull:
+            i = desc.committed
+            key = _chain_key(desc.last_key, desc.tokens[i * bs:(i + 1) * bs])
+            self.allocator.register(key, desc.blocks[i])
+            desc.last_key = key
+            desc.committed += 1
+
+    def fork(self, parent_uid: int, new_uid: int) -> None:
+        """Clone a live sequence's host state sharing ALL its KV blocks
+        (parallel sampling / beam candidates / speculative branches) —
+        including the partial tail block, which stays shared until either
+        side writes into it and triggers the copy-on-write clone in
+        ``_ensure_blocks``."""
+        parent = self._seqs.get(parent_uid)
+        if parent is None:
+            raise ValueError(f"unknown parent uid {parent_uid}")
+        if new_uid in self._seqs:
+            raise ValueError(f"uid {new_uid} is already live")
+        self.allocator.retain(parent.blocks)
+        self._seqs[new_uid] = SequenceDescriptor(
+            uid=new_uid, seen_tokens=parent.seen_tokens,
+            blocks=list(parent.blocks),
+            last_logits=None if parent.last_logits is None
+            else np.array(parent.last_logits),
+            tokens=list(parent.tokens), committed=parent.committed,
+            last_key=parent.last_key, no_commit=parent.no_commit)
 
     def _table(self, desc: SequenceDescriptor,
                width: Optional[int] = None) -> np.ndarray:
@@ -486,31 +725,44 @@ class InferenceEngineV2(InferenceEngine):
         if len(set(uids)) != len(uids):
             raise ValueError("duplicate uid in one put() batch: a sequence can "
                              "advance at most one decode position per engine step")
-        ok, _, why = self._admission_detail(uids, [len(t) for t in tokens])
+        for uid, toks in zip(uids, tokens):
+            if uid not in self._seqs and not len(toks):
+                raise ValueError(f"new uid {uid} with no tokens")
+        new_tokens = {u: list(map(int, t)) for u, t in zip(uids, tokens)
+                      if u not in self._seqs}
+        # Admission check BEFORE any KV mutation (prefix acquisition
+        # included): a rejected put() must leave the engine untouched so
+        # the caller can retry it verbatim.
+        ok, _, why = self._admission_detail(uids, [len(t) for t in tokens],
+                                            new_tokens=new_tokens)
         if not ok:
             raise RuntimeError(f"cannot schedule put() batch: {why}")
+        n_ext = sum(1 for uid, toks in zip(uids, tokens)
+                    if uid in self._seqs and len(toks))
+        n_ext += sum(1 for toks in new_tokens.values()
+                     if self.prefix_peek(toks)[0] > 0)
+        if n_ext > self.config.max_batch_size:
+            raise ValueError(f"decode batch {n_ext} exceeds max_batch_size "
+                             f"{self.config.max_batch_size} (raise it in the inference config)")
         bs = self.cache.block_size
         prefills: List[Tuple[SequenceDescriptor, List[int]]] = []
         extends: List[Tuple[SequenceDescriptor, List[int]]] = []
-        new_uids = []
         for uid, toks in zip(uids, tokens):
-            toks = list(map(int, toks))
-            if uid in self._seqs:
+            if uid in self._seqs and uid not in new_tokens:
+                toks = list(map(int, toks))
                 if toks:
                     extends.append((self._seqs[uid], toks))
+        for uid, toks in new_tokens.items():
+            # a prefix hit admits the descriptor at the cached boundary and
+            # prefills ONLY the suffix through the extend/decode programs
+            # (acquire_prefix is a no-op admission when prefix_caching is
+            # off); cold prompts take the batched flash-prefill program
+            hit = self.acquire_prefix(uid, toks)
+            desc = self._seqs[uid]
+            if hit:
+                extends.append((desc, toks[hit:]))
             else:
-                if not toks:
-                    raise ValueError(f"new uid {uid} with no tokens")
-                new_uids.append(uid)
-                desc = SequenceDescriptor(uid=uid)
                 prefills.append((desc, toks))
-        # Admission check BEFORE any KV mutation: a rejected put() must leave
-        # the engine untouched so the caller can retry it verbatim.
-        if len(extends) > self.config.max_batch_size:
-            raise ValueError(f"decode batch {len(extends)} exceeds max_batch_size "
-                             f"{self.config.max_batch_size} (raise it in the inference config)")
-        for uid, (desc, _) in zip(new_uids, prefills):
-            self._seqs[uid] = desc
 
         # ---- ALL pending prefills: one bucketed batched program ---------
         if prefills:
@@ -522,7 +774,9 @@ class InferenceEngineV2(InferenceEngine):
             logits = np.asarray(logits)
             for i, (desc, toks) in enumerate(prefills):
                 desc.seen_tokens = len(toks)
+                desc.tokens = list(toks)
                 desc.last_logits = logits[i]
+                self._commit(desc)
 
         # ---- single-token extensions: one batched decode program --------
         singles = [(d, toks[0]) for d, toks in extends if len(toks) == 1]
@@ -537,9 +791,11 @@ class InferenceEngineV2(InferenceEngine):
             self.dispatch_count += 1
             self._program_keys.add(("decode", B, W))
             logits = np.asarray(logits)
-            for i, (d, _) in enumerate(singles):
+            for i, (d, t) in enumerate(singles):
                 d.seen_tokens += 1
+                d.tokens.append(int(t))
                 d.last_logits = logits[i]
+                self._commit(d)
 
         # ---- multi-token extensions: chunked prefill, one program/chunk --
         # (reference runs these as ragged atoms in the same batch; we batch
@@ -563,7 +819,9 @@ class InferenceEngineV2(InferenceEngine):
             logits = np.asarray(logits)
             for i, (d, chunk) in enumerate(batch):
                 d.seen_tokens += len(chunk)
+                d.tokens.extend(chunk)
                 d.last_logits = logits[i]
+                self._commit(d)
 
         return np.stack([self._seqs[uid].last_logits for uid in uids])
 
@@ -610,12 +868,12 @@ class InferenceEngineV2(InferenceEngine):
             return (hd2, hp2), (ck3, cv3)
 
         (xd, xp), (kp, vp) = jax.lax.scan(layer_fn, (xd, xp),
-                                          (params["layers"], cache.k, cache.v))
+                                          (params["layers"],) + self._kv_xs(cache))
         dlogits = self.model.head(params, xd)[:, 0]
         x_last = jnp.take_along_axis(xp, (pnnew - 1)[:, None, None].astype(jnp.int32),
                                      axis=1)
         plogits = self.model.head(params, x_last)[:, 0]
-        return PagedKVCache(kp, vp), dlogits, plogits
+        return self._cache_of(kp, vp), dlogits, plogits
 
     def step(self, decode_uids: Sequence[int], decode_tokens: Sequence[int],
              prefills: Sequence[Tuple[int, Sequence[int]]] = ()
@@ -709,10 +967,14 @@ class InferenceEngineV2(InferenceEngine):
 
         for i, d in enumerate(ddescs):
             d.seen_tokens += 1
+            d.tokens.append(int(decode_tokens[i]))
             d.last_logits = dlogits[i]
+            self._commit(d)
         for i, (d, (_, chunk)) in enumerate(zip(pdescs, prefills)):
             d.seen_tokens += len(chunk)
+            d.tokens.extend(chunk)
             d.last_logits = plogits[i]
+            self._commit(d)
         return dlogits[:len(ddescs)], plogits[:len(pdescs)]
 
     # -- fused multi-token decode --------------------------------------
@@ -762,23 +1024,16 @@ class InferenceEngineV2(InferenceEngine):
         shape a serving process should prefer for long generations."""
         descs = [self._seqs[u] for u in uids]
         # Admission control BEFORE any mutation (same contract as put():
-        # a rejected call leaves allocator + descriptors untouched). The
-        # length cap matters doubly here — in-jit btable indexing clamps
-        # instead of erroring, so an overrun would silently write another
-        # sequence's KV blocks.
-        bs = self.cache.block_size
-        need = 0
-        for d in descs:
-            total = d.seen_tokens + n_steps
-            if total > self.config.max_seq_len:
-                raise RuntimeError(
-                    f"decode_loop would overrun max_seq_len: uid {d.uid} at "
-                    f"{d.seen_tokens} + {n_steps} > {self.config.max_seq_len}")
-            need += max(0, blocks_needed(total, bs) - len(d.blocks))
-        if need > self.allocator.free_blocks:
-            raise RuntimeError(
-                f"cannot schedule decode_loop: needs {need} KV blocks, "
-                f"{self.allocator.free_blocks} free")
+        # a rejected call leaves allocator + descriptors untouched), via
+        # _admission_detail so the copy-on-write surcharge for shared
+        # write-span blocks (forked tails) is budgeted too — a bare
+        # blocks_needed count would admit, then fail mid-COW with earlier
+        # descriptors already cloned. The length cap matters doubly here —
+        # in-jit btable indexing clamps instead of erroring, so an overrun
+        # would silently write another sequence's KV blocks.
+        ok, _, why = self._admission_detail(uids, [n_steps] * len(uids))
+        if not ok:
+            raise RuntimeError(f"cannot schedule decode_loop: {why}")
         for d in descs:
             self._ensure_blocks(d, d.seen_tokens + n_steps)
         # binned table width (round 9): the decode kernels stream every
@@ -796,10 +1051,16 @@ class InferenceEngineV2(InferenceEngine):
         self.dispatch_count += 1
         self._program_keys.add(("decode_loop", len(uids), int(n_steps), W))
         last_logits = np.asarray(last_logits)
+        toks = np.asarray(toks)
         for i, d in enumerate(descs):
             d.seen_tokens += n_steps
+            # written KV slots: the seed token plus every generated token
+            # except the last (which has logits but no KV entry yet)
+            d.tokens.append(int(tok0[i]))
+            d.tokens.extend(int(t) for t in toks[i, :-1])
             d.last_logits = last_logits[i]
-        return np.asarray(toks)
+            self._commit(d)
+        return toks
 
     def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None,
                        force: bool = False) -> bool:
@@ -816,7 +1077,18 @@ class InferenceEngineV2(InferenceEngine):
                 "from the current weights; refusing the hot-swap (drain or "
                 "flush() them, or pass force=True)")
             return False
-        return super().reload_weights(ckpt_dir, tag=tag)
+        ok = super().reload_weights(ckpt_dir, tag=tag)
+        if ok:
+            # the content index points at KV computed under the OLD
+            # weights; keys are pure functions of token history, so a
+            # post-swap admission hashing the same system prompt would
+            # silently reuse stale KV — drop every registration and
+            # parked block, and bar force-swapped live sequences (mixed-
+            # weight KV) from ever committing their blocks
+            self.allocator.invalidate_registry()
+            for d in self._seqs.values():
+                d.no_commit = True
+        return ok
 
     def flush(self, uids: Sequence[int]) -> None:
         """Free all state for finished sequences (engine_v2.py:242)."""

@@ -29,7 +29,12 @@ uninterrupted run (tests/test_serving_scheduler.py pins this).
 Counters (always observable through the in-process monitor, reference
 ``monitor/monitor.py:13``): ``serving/ttft_s``, ``serving/tpot_s``,
 ``serving/queue_depth``, ``serving/running``, ``serving/budget_fill``,
-``serving/kv_free_blocks``, ``serving/tick_s``, ``serving/preemptions``.
+``serving/kv_free_blocks``, ``serving/tick_s``, ``serving/preemptions``,
+and the prefix-cache group ``prefix_cache/{hit_tokens, miss_tokens,
+cow_copies, shared_blocks}`` (ISSUE 6: with ``prefix_caching`` on,
+admission reuses committed shared-prefix KV blocks ref-counted — zero new
+allocations for the shared span — and prefill starts from the first
+non-cached token, shrinking both TTFT and per-tick prefill spend).
 """
 
 from __future__ import annotations
@@ -233,7 +238,7 @@ class ContinuousBatchingScheduler:
         # head-of-line order — a request never overtakes an earlier one
         # into the prefill lane, so admission is starvation-free.
         prefills: List[Tuple[ServingRequest, List[int]]] = []
-        admitted: List[ServingRequest] = []
+        admitted: List[Tuple[ServingRequest, int]] = []
         for r in [a for a in self.active if a.state == PREFILL] + list(self.queue):
             if budget_left <= 0:
                 break
@@ -241,26 +246,43 @@ class ContinuousBatchingScheduler:
             if from_queue and len(self.active) + len(admitted) >= cfg.max_running:
                 break
             target = r.prefill_target
-            remaining = len(target) - r.prefill_done
+            if from_queue:
+                # prefix cache: plan the admission from the first
+                # NON-CACHED token — a LIVE shared block costs zero free
+                # slots, a parked one only its revival slot (the engine
+                # acquisition happens at the admission commit below, so a
+                # packing loop that breaks early mutates nothing)
+                hit, live, _parked = eng.prefix_peek(target)
+                pd, free_have = hit, live
+            else:
+                pd, free_have = r.prefill_done, self._have_blocks(r)
+            remaining = len(target) - pd
             chunk = min(budget_left, remaining)
             # a leftover-budget sliver that does not finish the prompt is
             # not worth a dispatch slot — wait for a fuller tick
             if chunk < remaining and chunk < cfg.chunk_min:
                 break
-            have = self._have_blocks(r)
-            fit = (free_left + have) * bs - r.prefill_done
+            fit = (free_left + free_have) * bs - pd
             chunk = min(chunk, fit)
             if chunk <= 0 or (chunk < remaining and chunk < cfg.chunk_min):
                 break
-            free_left -= max(0, blocks_needed(r.prefill_done + chunk, bs) - have)
+            free_left -= max(0, blocks_needed(pd + chunk, bs) - free_have)
             budget_left -= chunk
-            prefills.append((r, target[r.prefill_done:r.prefill_done + chunk]))
+            prefills.append((r, target[pd:pd + chunk]))
             if from_queue:
-                admitted.append(r)
-        for r in admitted:
+                admitted.append((r, pd))
+        for r, hit in admitted:
             self.queue.remove(r)
             self.active.append(r)
             r.state = PREFILL
+            # admit in the engine NOW so shared prefix blocks are
+            # ref-counted before the dispatch: the descriptor starts at
+            # the cached boundary and this tick's chunk prefills only the
+            # suffix (acquire_prefix is a cold admission when
+            # prefix_caching is off — hit is 0 either way then)
+            got = eng.acquire_prefix(r.uid, r.prefill_target)
+            assert got == hit, (r.uid, got, hit)
+            r.prefill_done = hit
 
         # 3) nothing packable?
         if not decodes and not prefills:
@@ -305,6 +327,15 @@ class ContinuousBatchingScheduler:
             ("serving/kv_free_blocks", eng.free_blocks, self.ticks),
             ("serving/tick_s", tick_s, self.ticks),
             ("serving/preemptions", self.preemptions, self.ticks),
+            # prefix-cache group (cumulative engine counters; ISSUE 6):
+            # hit/miss tokens say how much prefill the cache absorbed,
+            # cow_copies counts divergence clones, shared_blocks is the
+            # CURRENT cross-sequence sharing in the pool
+            ("prefix_cache/hit_tokens", eng.prefix_hit_tokens, self.ticks),
+            ("prefix_cache/miss_tokens", eng.prefix_miss_tokens, self.ticks),
+            ("prefix_cache/cow_copies", eng.cow_copies, self.ticks),
+            ("prefix_cache/shared_blocks", eng.allocator.shared_blocks,
+             self.ticks),
         ]
         self._write_events(events)
         return bool(self.active or self.queue)
@@ -355,10 +386,12 @@ class ContinuousBatchingScheduler:
     def stats(self) -> Dict[str, object]:
         """Serving-quality summary over finished requests: sustained
         tokens/s (wall span from first submit to last finish), TTFT/TPOT
-        p50, preemption and tick counts."""
+        p50/p95/p99 (tail latency is what a production SLO binds on, not
+        the median), prefix-cache effectiveness, preemption and tick
+        counts."""
 
-        def p50(xs):
-            return float(np.percentile(xs, 50)) if len(xs) else None
+        def pct(xs, q):
+            return float(np.percentile(xs, q)) if len(xs) else None
 
         done = [r for r in self.requests.values() if r.state == FINISHED]
         ttft = [r.first_token_at - r.submitted_at for r in done
@@ -367,13 +400,26 @@ class ContinuousBatchingScheduler:
         total = sum(len(r.generated) for r in done)
         span = (max(r.finished_at for r in done)
                 - min(r.submitted_at for r in done)) if done else 0.0
+        eng = self.engine
+        hit, miss = eng.prefix_hit_tokens, eng.prefix_miss_tokens
         return {
             "requests": len(done),
             "generated_tokens": total,
             "sustained_tokens_per_sec": (total / span) if span > 0 else None,
-            "ttft_p50_s": p50(ttft),
-            "tpot_p50_s": p50(tpot),
+            "ttft_p50_s": pct(ttft, 50),
+            "ttft_p95_s": pct(ttft, 95),
+            "ttft_p99_s": pct(ttft, 99),
+            "tpot_p50_s": pct(tpot, 50),
+            "tpot_p95_s": pct(tpot, 95),
+            "tpot_p99_s": pct(tpot, 99),
             "ticks": self.ticks,
             "preemptions": self.preemptions,
             "compiled_programs": len(self.engine.program_shapes),
+            "prefix_cache": {
+                "hit_tokens": hit,
+                "miss_tokens": miss,
+                "hit_rate": (hit / (hit + miss)) if (hit + miss) else None,
+                "cow_copies": eng.cow_copies,
+                "shared_blocks": eng.allocator.shared_blocks,
+            },
         }

@@ -323,11 +323,15 @@ def fused_qkv_rope_pallas(y, wq, wk, wv, bq=None, bk=None, bv=None,
 
 def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
                                         alibi_slopes=None, layer=None,
+                                        k_scale=None, v_scale=None,
                                         num_splits: int = 2,
                                         interpret: bool = False):
     """q [B,1,H,Dh] against the paged pool ck/cv [nblk,KV,bs,Dh] (or the
     stacked [L,...] pool with ``layer``); block_table [B,maxblk] (-1 pad);
-    kv_len [B] -> [B,1,H,Dh].
+    kv_len [B] -> [B,1,H,Dh]. int8/fp8 pools ride with per-token-per-head
+    ``k_scale``/``v_scale`` planes [(L,) nblk, KV, bs]: each streamed
+    block dequantizes IN-REGISTER, so KV crosses HBM at storage width
+    (kv_cache_dtype — decode is KV-bandwidth-bound).
 
     Differences from ``ops.paged_attention.paged_decode_attention_pallas``
     (which stays as the per-kv-head streaming form):
@@ -367,6 +371,13 @@ def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
     layer_in = ((jnp.asarray(layer, jnp.int32).reshape(1),) if pooled else ())
     n_prefetch = 3 if pooled else 2
     has_alibi = alibi_slopes is not None
+    quant = k_scale is not None
+    scales_in = ()
+    if quant:
+        from .paged_attention import _scale_operand
+
+        scales_in = (_scale_operand(k_scale, pooled),
+                     _scale_operand(v_scale, pooled))
     slopes_in = ()
     if has_alibi:
         slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1),)
@@ -376,6 +387,8 @@ def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
             _layer_ref, q_ref, k_ref, v_ref, *rest = rest
         else:
             q_ref, k_ref, v_ref, *rest = rest
+        if quant:
+            ks_ref, vs_ref, *rest = rest
         if has_alibi:
             sl_ref, o_ref, m_out, l_out, m_ref, l_ref, acc_ref = rest
         else:
@@ -398,10 +411,19 @@ def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
             kv_blk = (lambda r: r[0, 0]) if pooled else (lambda r: r[0])
             kb = kv_blk(k_ref)                               # [KV, bs, Dh]
             vb = kv_blk(v_ref)
+            if quant:
+                # per-token-per-head dequant in-register: the streamed
+                # block crossed HBM at storage width (kv_cache_dtype)
+                ksb = kv_blk(ks_ref)                         # [KV, 1, bs]
+                vsb = kv_blk(vs_ref)
             for kv in range(KV):
                 rows = slice(kv * G, (kv + 1) * G)
                 qv = q_ref[0, rows, :].astype(jnp.float32) * scale   # [G, Dh]
                 kk = kb[kv].astype(jnp.float32)                      # [bs, Dh]
+                vv = vb[kv].astype(jnp.float32)
+                if quant:
+                    kk = kk * ksb[kv, 0][:, None]
+                    vv = vv * vsb[kv, 0][:, None]
                 sc = jax.lax.dot_general(
                     qv, kk, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)              # [G, bs]
@@ -417,7 +439,7 @@ def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
                 l_ref[rows, :] = l_ref[rows, :] * alpha + p.sum(
                     axis=1, keepdims=True)
                 pv = jax.lax.dot_general(
-                    p, vb[kv].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                    p, vv, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)              # [G, Dh]
                 acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
                 m_ref[rows, :] = m_new
@@ -442,6 +464,11 @@ def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         pl.BlockSpec(kv_block, kv_index),
         pl.BlockSpec(kv_block, kv_index),
     ]
+    if quant:
+        # scale planes ride the same clamped block index; the singleton
+        # second-minor axis keeps the (…, 1, bs) block Mosaic-legal
+        scale_block = (1, 1, KV, 1, bs) if pooled else (1, KV, 1, bs)
+        in_specs += [pl.BlockSpec(scale_block, kv_index)] * 2
     if has_alibi:
         in_specs.append(pl.BlockSpec((H, 1), lambda b, s, jj, *_: (0, 0)))
     part_spec = lambda last: pl.BlockSpec(
@@ -466,7 +493,7 @@ def fused_paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(bt, kvl, *layer_in, q3, ck, cv, *slopes_in)
+    )(bt, kvl, *layer_in, q3, ck, cv, *scales_in, *slopes_in)
 
     # split-K merge: renormalize each split's partial sums to the global
     # row max, then combine (empty splits carry m=-inf, l=0 -> weight 0)
@@ -764,8 +791,13 @@ def fused_qkv_rope(y, wq, wk, wv, **kw):
 
 
 def fused_paged_decode_attention(q, ck, cv, block_table, kv_len, **kw):
+    from ..inference.paged import kv_parts
+
+    kq, ks = kv_parts(ck)
+    vq, vs = kv_parts(cv)
     return fused_paged_decode_attention_pallas(
-        q, ck, cv, block_table, kv_len, interpret=_interpret_forced(), **kw)
+        q, kq, vq, block_table, kv_len, k_scale=ks, v_scale=vs,
+        interpret=_interpret_forced(), **kw)
 
 
 def fused_mlp(resid, y_src, ln_w, ln_b, w_up, w_down, w_gate=None, **kw):

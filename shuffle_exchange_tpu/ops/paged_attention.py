@@ -22,12 +22,34 @@ from __future__ import annotations
 import functools
 
 
+def _scale_operand(s, pooled: bool):
+    """Scale plane [(L,) nblk, KV, bs] -> kernel operand with a singleton
+    axis before the block_size minor dim, so the per-(block, kv-head)
+    BlockSpec is (…, 1, 1, bs) — a second-minor block of 1 over an array
+    dim of 1 satisfies Mosaic's divisible-by-8-or-equal rule (the same
+    trick as the ALiBi slope operand)."""
+    import jax.numpy as jnp
+
+    if pooled:
+        L, nblk, KV, bs = s.shape
+        return s.reshape(L, nblk, KV, 1, bs).astype(jnp.float32)
+    nblk, KV, bs = s.shape
+    return s.reshape(nblk, KV, 1, bs).astype(jnp.float32)
+
+
 def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
                                   alibi_slopes=None, layer=None,
+                                  k_scale=None, v_scale=None,
                                   interpret: bool = False):
     """q [B,1,H,Dh]; ck/cv [nblk,KV,bs,Dh] (or the WHOLE stacked pool
     [L,nblk,KV,bs,Dh] with ``layer`` an i32 scalar — see below);
     block_table [B,maxblk] (-1 pad); kv_len [B] -> out [B,1,H,Dh].
+
+    Quantized KV (round 11): int8/fp8 pools ride with per-token-per-head
+    ``k_scale``/``v_scale`` planes [(L,) nblk, KV, bs]; each streamed block
+    dequantizes IN-REGISTER (q.astype(f32) * scale) so KV crosses HBM at
+    storage width — the whole point of the kv_cache_dtype mode (decode is
+    KV-bandwidth-bound). The gather path below is the numerics oracle.
 
     H % KV == 0 (GQA groups map h -> h * KV // H). Softmax/accumulation in
     f32; output in q.dtype. ``alibi_slopes`` [H]: adds slope_h * j at
@@ -73,6 +95,11 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
     kvl = kv_len.astype(jnp.int32)
     layer_in = ((jnp.asarray(layer, jnp.int32).reshape(1),) if pooled else ())
     has_alibi = alibi_slopes is not None
+    quant = k_scale is not None
+    scales_in = ()
+    if quant:
+        scales_in = (_scale_operand(k_scale, pooled),
+                     _scale_operand(v_scale, pooled))
     slopes_in = ()
     if has_alibi:
         # [KV, G]: q head h = kv * G + g (the _repeat_kv convention)
@@ -83,6 +110,8 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
             _layer_ref, q_ref, k_ref, v_ref, *rest = rest
         else:
             q_ref, k_ref, v_ref, *rest = rest
+        if quant:
+            ks_ref, vs_ref, *rest = rest
         if has_alibi:
             sl_ref, o_ref, m_ref, l_ref, acc_ref = rest
         else:
@@ -100,6 +129,12 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         kv_blk = (lambda r: r[0, 0, 0]) if pooled else (lambda r: r[0, 0])
         kb = kv_blk(k_ref).astype(jnp.float32)               # [bs, Dh]
         vb = kv_blk(v_ref).astype(jnp.float32)               # [bs, Dh]
+        if quant:
+            # per-token-per-head dequant in-register: the streamed block
+            # crossed HBM at storage width
+            s_blk = (lambda r: r[0, 0, 0, 0]) if pooled else (lambda r: r[0, 0, 0])
+            kb = kb * s_blk(ks_ref)[:, None]
+            vb = vb * s_blk(vs_ref)[:, None]
 
         s = jax.lax.dot_general(
             qv, kb, (((1,), (1,)), ((), ())),
@@ -136,6 +171,9 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         kv_spec = pl.BlockSpec(
             (1, 1, 1, bs, Dh),
             lambda b, kv, j, bt_ref, kvl_ref, lr: (lr[0], bt_ref[b, j], kv, 0, 0))
+        scale_spec = pl.BlockSpec(
+            (1, 1, 1, 1, bs),
+            lambda b, kv, j, bt_ref, kvl_ref, lr: (lr[0], bt_ref[b, j], kv, 0, 0))
         sl_map = lambda b, kv, j, bt_ref, kvl_ref, lr: (kv, 0, 0)
         n_prefetch = 3
     else:
@@ -143,9 +181,14 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         kv_spec = pl.BlockSpec(
             (1, 1, bs, Dh),
             lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0))
+        scale_spec = pl.BlockSpec(
+            (1, 1, 1, bs),
+            lambda b, kv, j, bt_ref, kvl_ref: (bt_ref[b, j], kv, 0, 0))
         sl_map = lambda b, kv, j, bt_ref, kvl_ref: (kv, 0, 0)
         n_prefetch = 2
     in_specs = [pl.BlockSpec((1, 1, G, Dh), q_map), kv_spec, kv_spec]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
     if has_alibi:
         # [KV, 1, G] with a (1, 1, G) block: a (1, G) block over [KV, G]
         # has second-minor block size 1 vs array dim KV, which Mosaic's
@@ -167,12 +210,13 @@ def paged_decode_attention_pallas(q, ck, cv, block_table, kv_len, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=interpret,
-    )(bt, kvl, *layer_in, q4, ck, cv, *slopes_in)
+    )(bt, kvl, *layer_in, q4, ck, cv, *scales_in, *slopes_in)
     return out.reshape(B, 1, H, Dh)
 
 
 def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
                                   alibi_slopes=None,
+                                  k_scale=None, v_scale=None,
                                   interpret: bool = False):
     """Chunked-prefill extension over paged KV WITHOUT gathering the cache
     (VERDICT r2 weak #7: the gather path allocates [B, S_max, KV, Dh] per
@@ -204,11 +248,18 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
     bt = jnp.maximum(block_table, 0).astype(jnp.int32)
     start = start.astype(jnp.int32)
     has_alibi = alibi_slopes is not None
+    quant = k_scale is not None
+    scales_in = ()
+    if quant:
+        scales_in = (_scale_operand(k_scale, False),
+                     _scale_operand(v_scale, False))
     slopes_in = ()
     if has_alibi:
         slopes_in = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, 1, G),)
 
     def kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, *rest = rest
         if has_alibi:
             sl_ref, o_ref, m_ref, l_ref, acc_ref = rest
         else:
@@ -225,6 +276,9 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         qv = q_ref[0, 0].astype(jnp.float32) * scale         # [GC, Dh]
         kb = k_ref[0, 0].astype(jnp.float32)                 # [bs, Dh]
         vb = v_ref[0, 0].astype(jnp.float32)                 # [bs, Dh]
+        if quant:
+            kb = kb * ks_ref[0, 0, 0][:, None]
+            vb = vb * vs_ref[0, 0, 0][:, None]
 
         s = jax.lax.dot_general(
             qv, kb, (((1,), (1,)), ((), ())),
@@ -262,6 +316,10 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         pl.BlockSpec((1, 1, bs, Dh),
                      lambda b, kv, j, bt_ref, st_ref: (bt_ref[b, j], kv, 0, 0)),
     ]
+    if quant:
+        in_specs += [pl.BlockSpec(
+            (1, 1, 1, bs),
+            lambda b, kv, j, bt_ref, st_ref: (bt_ref[b, j], kv, 0, 0))] * 2
     if has_alibi:
         in_specs.append(pl.BlockSpec(
             (1, 1, G), lambda b, kv, j, bt_ref, st_ref: (kv, 0, 0)))
@@ -282,23 +340,29 @@ def paged_extend_attention_pallas(q, ck, cv, block_table, start, nnew, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, GC, Dh), q.dtype),
         interpret=interpret,
-    )(bt, start, q5, ck, cv, *slopes_in)
+    )(bt, start, q5, ck, cv, *scales_in, *slopes_in)
     return out.reshape(B, KV, G, C, Dh).transpose(0, 3, 1, 2, 4).reshape(B, C, H, Dh)
 
 
 def paged_extend_attention(q, ck, cv, block_table, start, nnew, *,
                            alibi_slopes=None, impl: str = "auto"):
     """Dispatching wrapper: Pallas paged-extend on TPU; gather + dense
-    extend_attention oracle elsewhere. ``alibi_slopes`` rides the kernel
-    (BLOOM serving: no cache gather)."""
+    extend_attention oracle elsewhere. Quantized pools ride as
+    ``(data, scale)`` pairs (in-register dequant in the kernel; dequant
+    after the gather on the oracle path). ``alibi_slopes`` rides the
+    kernel (BLOOM serving: no cache gather)."""
+    from ..inference.paged import kv_parts
     from .dispatch import pallas_enabled
 
+    kq, ks = kv_parts(ck)
+    vq, vs = kv_parts(cv)
     if impl == "pallas" or (impl == "auto" and pallas_enabled()
-                            and q.shape[2] % ck.shape[1] == 0):
+                            and q.shape[2] % kq.shape[1] == 0):
         try:
-            return paged_extend_attention_pallas(q, ck, cv, block_table,
+            return paged_extend_attention_pallas(q, kq, vq, block_table,
                                                  start, nnew,
-                                                 alibi_slopes=alibi_slopes)
+                                                 alibi_slopes=alibi_slopes,
+                                                 k_scale=ks, v_scale=vs)
         except Exception as e:
             if impl == "pallas":
                 raise
@@ -310,7 +374,7 @@ def paged_extend_attention(q, ck, cv, block_table, start, nnew, *,
             warning_once(
                 "paged_extend_attention: Pallas kernel failed with "
                 f"{type(e).__name__} (q={tuple(q.shape)} "
-                f"kv_pool={tuple(ck.shape)} "
+                f"kv_pool={tuple(kq.shape)} "
                 f"table={tuple(block_table.shape)}); falling back to the "
                 "gather path, which materializes the layer's KV")
     from ..inference.engine import extend_attention
@@ -325,27 +389,33 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
                            alibi_slopes=None, layer=None, impl: str = "auto"):
     """Dispatching wrapper: Pallas kernel on TPU (no materialized gather),
     jnp gather+dense oracle elsewhere. ck/cv are [nblk, KV, bs, Dh] pool
-    blocks (PagedKVCache layout), or the stacked [L, nblk, KV, bs, Dh]
-    pool with ``layer`` set (the decode loop's in-place-carry mode). See
+    blocks (PagedKVCache layout) — or quantized ``(data, scale)`` pairs
+    (in-register dequant in the kernel, dequant-after-gather on the
+    oracle path) — or the stacked [L, nblk, KV, bs, Dh] pool with
+    ``layer`` set (the decode loop's in-place-carry mode). See
     inference/paged.py for the gather path it replaces (VERDICT r1
     missing #4). ``alibi_slopes`` rides the kernel (BLOOM serving: no
     cache gather)."""
+    from ..inference.paged import kv_parts
     from .dispatch import pallas_enabled
 
-    pooled = ck.ndim == 5
+    kq, ks = kv_parts(ck)
+    vq, vs = kv_parts(cv)
+    pooled = kq.ndim == 5
     if pooled and layer is None:
         # validate BEFORE dispatch: the auto path's except would swallow
         # the kernel's informative error and the gather fallback would
         # crash opaquely on a None index
         raise ValueError("stacked [L, nblk, KV, bs, Dh] pool needs a "
                          "layer index (layer=...)")
-    kv_heads = ck.shape[2] if pooled else ck.shape[1]
+    kv_heads = kq.shape[2] if pooled else kq.shape[1]
     if impl == "pallas" or (impl == "auto" and pallas_enabled()
                             and q.shape[2] % kv_heads == 0):
         try:
-            return paged_decode_attention_pallas(q, ck, cv, block_table,
+            return paged_decode_attention_pallas(q, kq, vq, block_table,
                                                  kv_len, layer=layer,
-                                                 alibi_slopes=alibi_slopes)
+                                                 alibi_slopes=alibi_slopes,
+                                                 k_scale=ks, v_scale=vs)
         except Exception as e:
             if impl == "pallas":
                 raise
@@ -357,7 +427,7 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
             warning_once(
                 "paged_decode_attention: Pallas kernel failed with "
                 f"{type(e).__name__} (q={tuple(q.shape)} "
-                f"kv_pool={tuple(ck.shape)} pooled={pooled} "
+                f"kv_pool={tuple(kq.shape)} pooled={pooled} "
                 f"table={tuple(block_table.shape)}); falling back to the "
                 "gather path, which materializes the layer's KV")
     from ..inference.paged import gather_kv
@@ -366,7 +436,10 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
     if pooled:
         import jax
 
-        ck = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+        def _idx(x):
+            return jax.lax.dynamic_index_in_dim(x, layer, 0, keepdims=False)
+
+        ck = _idx(kq) if ks is None else (_idx(kq), _idx(ks))
+        cv = _idx(vq) if vs is None else (_idx(vq), _idx(vs))
     k, v = gather_kv(ck, cv, block_table)
     return decode_attention(q, k, v, kv_len, alibi_slopes=alibi_slopes)

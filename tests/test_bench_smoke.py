@@ -109,6 +109,47 @@ def test_serving_goodput_row_runs_at_toy_size():
     assert row["sustained_tokens_per_sec"] > 0
     assert row["capacity_tokens_per_sec"] > 0
     assert row["ttft_p50_s"] > 0 and row["tpot_p50_s"] > 0
+    assert row["ttft_p95_s"] >= row["ttft_p50_s"]
     assert 0 < row["budget_fill_mean"] <= 1
     assert row["n_requests"] == 6 and row["chunk_bins"] == [4, 8, 16]
     assert row["compiled_programs"] >= 1
+    # random prompts share nothing and the config has prefix_caching off
+    assert row["prefix_hit_rate"] is None
+
+
+def test_prefix_cache_row_runs_at_toy_size():
+    """The config-5 prefix-cache row (bench.prefix_cache_row) at toy size:
+    the shared-system-prompt trace served with and without prefix_caching
+    must report a real hit-rate, identical tokens both ways, and the TTFT
+    comparison — on CPU, so the published row cannot rot on the driver
+    box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import prefix_cache_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=64,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = prefix_cache_row(model, params, icfg, mcfg.vocab_size,
+                           n_requests=4, sys_prompt_len=16, suffix_lo=4,
+                           suffix_hi=12, max_new=5, load=2.0)
+    # every admission past the first reuses the 2-block system prompt
+    # (counters are engine-cumulative over warm + capacity + trace passes,
+    # so 3 x 16 from the first pass is the floor)
+    assert row["prefix_hit_rate"] > 0
+    assert row["prefix_hit_tokens"] >= 3 * 16
+    assert row["ttft_p50_s_no_cache"] > 0 and row["ttft_p50_s_cached"] > 0
+    assert row["sustained_tokens_per_sec_cached"] > 0
+    assert row["cow_copies"] == 0
+    # bf16 KV mode: cached and uncached serves are exactly token-equal
+    assert row["token_mismatches_vs_no_cache"] == 0

@@ -1,0 +1,406 @@
+"""Quantized KV cache (ISSUE 6, ``kv_cache_dtype: bf16|int8|fp8``):
+int8/fp8 pools store 1 byte/element with per-token-per-head scale planes,
+the write paths quantize on write, and the decode/extend kernels
+dequantize IN-REGISTER on stream — the XLA gather path is the CPU
+numerics oracle (the established lowering-gate pattern).
+
+Pinned here:
+  - quantize/dequantize roundtrip error bounds (int8 rel ~1/127, fp8
+    e4m3 rel ~2^-3) and the zero-row guard;
+  - pool bytes: int8/fp8 pools are <= 0.55x the bf16 pool and <= 0.3x
+    the fp32 pool, scale planes included (the resident-batch arithmetic
+    in BASELINE.md builds on this);
+  - kernel parity: decode / extend / fused split-K kernels over a
+    quantized pool match the gather-dequant oracle on the SAME stored
+    bytes (interpret mode, float-epsilon);
+  - engine parity: int8/fp8 engines produce the same greedy tokens as
+    the bf16-mode engine on the tiny model, with logits drift within a
+    pinned envelope;
+  - config: kv_cache_dtype normalization/rejection and the
+    prefix_caching bool check, through __post_init__ AND from_dict.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shuffle_exchange_tpu.config import ConfigError
+from shuffle_exchange_tpu.inference import (InferenceConfig,
+                                            InferenceEngineV2)
+from shuffle_exchange_tpu.inference.paged import (PagedKVCache,
+                                                  append_token_kv,
+                                                  dequantize_kv, gather_kv,
+                                                  quantize_kv,
+                                                  write_prefill_kv)
+from shuffle_exchange_tpu.models import Transformer, tiny
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize primitives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("qdtype,rel", [(jnp.int8, 1.5 / 127),
+                                        (jnp.float8_e4m3fn, 0.13)])
+def test_roundtrip_error_bound(qdtype, rel):
+    """Symmetric per-row quantization: |x - dq(q(x))| <= rel * row_absmax
+    (int8: half a step of absmax/127; e4m3: 2^-3 relative precision)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((5, 4, 64)) * 3.0, jnp.float32)
+    q, s = quantize_kv(x, qdtype)
+    assert q.dtype == qdtype and s.shape == (5, 4)
+    back = dequantize_kv(q, s)
+    bound = rel * np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    assert (np.abs(np.asarray(back) - np.asarray(x)) <= bound + 1e-7).all()
+
+
+def test_zero_row_quantizes_to_zero():
+    """An all-zero row must not divide by zero and must roundtrip to 0."""
+    x = jnp.zeros((2, 3, 16), jnp.float32)
+    for qdtype in (jnp.int8, jnp.float8_e4m3fn):
+        q, s = quantize_kv(x, qdtype)
+        assert np.asarray(s).min() > 0
+        assert (np.asarray(dequantize_kv(q, s)) == 0).all()
+
+
+def test_absmax_maps_to_dtype_max():
+    x = jnp.asarray([[3.0] + [0.0] * 15], jnp.float32)
+    q, _ = quantize_kv(x, jnp.int8)
+    assert int(np.asarray(q)[0, 0]) == 127
+
+
+# ---------------------------------------------------------------------------
+# pool bytes (the acceptance criterion's halve-or-quarter assertion)
+# ---------------------------------------------------------------------------
+
+
+def _pool(kv_cache_dtype, dtype=jnp.bfloat16, L=2, nblk=16, KV=2, bs=16,
+          Dh=64):
+    return PagedKVCache.create(L, nblk, bs, KV, Dh, dtype,
+                               kv_cache_dtype=kv_cache_dtype)
+
+
+def test_pool_bytes_halve_and_quarter():
+    bf16 = _pool("bf16").pool_nbytes()
+    fp32 = _pool("bf16", dtype=jnp.float32).pool_nbytes()
+    for mode in ("int8", "fp8"):
+        qb = _pool(mode).pool_nbytes()
+        # 1 byte/elt + one f32 scale per Dh=64 row = 1.0625 B/elt vs 2 (bf16)
+        # and 4 (fp32): the "halve (or quarter) resident KV bytes" claim,
+        # scale planes included
+        assert qb <= 0.55 * bf16, (mode, qb, bf16)
+        assert qb <= 0.30 * fp32, (mode, qb, fp32)
+        assert _pool(mode).quantized and not _pool("bf16").quantized
+
+
+def test_pool_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        _pool("int4")
+
+
+# ---------------------------------------------------------------------------
+# write paths: quantize-on-write roundtrips through the pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode,rel", [("int8", 1.5 / 127), ("fp8", 0.13)])
+def test_write_prefill_roundtrip(mode, rel):
+    pool = _pool(mode, nblk=8, bs=4, Dh=32)
+    rng = np.random.default_rng(1)
+    T, KV, Dh = 8, 2, 32
+    ks = jnp.asarray(rng.standard_normal((T, KV, Dh)), jnp.float32)
+    vs = jnp.asarray(rng.standard_normal((T, KV, Dh)), jnp.float32)
+    bt = jnp.asarray([2, 5], jnp.int32)
+    ck, cv = write_prefill_kv((pool.k[0], pool.k_scale[0]),
+                              (pool.v[0], pool.v_scale[0]), ks, vs, bt)
+    k, v = gather_kv(ck, cv, bt[None])     # dequantized [1, T, KV, Dh]
+    for got, want in ((k[0], ks), (v[0], vs)):
+        bound = rel * np.abs(np.asarray(want)).max(-1, keepdims=True)
+        assert (np.abs(np.asarray(got) - np.asarray(want))
+                <= bound + 1e-7).all()
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_append_token_roundtrip_pooled(mode):
+    """Single-token append into the STACKED pool (the decode loop's
+    in-place-carry mode) quantizes the new rows and scatters the matching
+    scale plane."""
+    pool = _pool(mode, L=2, nblk=8, bs=4, Dh=32)
+    rng = np.random.default_rng(2)
+    B, KV, Dh = 2, 2, 32
+    nk = jnp.asarray(rng.standard_normal((B, KV, Dh)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((B, KV, Dh)), jnp.float32)
+    bt = jnp.asarray([[1, -1], [3, 4]], jnp.int32)
+    pos = jnp.asarray([2, 5], jnp.int32)   # seq 1 writes block 4, slot 1
+    ck, cv = append_token_kv((pool.k, pool.k_scale),
+                             (pool.v, pool.v_scale), nk, nv, bt, pos,
+                             layer=1)
+    kq, ksc = ck
+    got = dequantize_kv(kq[1, 4, :, 1], ksc[1, 4, :, 1])
+    rel = (1.5 / 127) if mode == "int8" else 0.13
+    bound = rel * np.abs(np.asarray(nk[1])).max(-1, keepdims=True)
+    assert (np.abs(np.asarray(got) - np.asarray(nk[1])) <= bound + 1e-7).all()
+    # layer 0 untouched
+    assert (np.asarray(kq[0]) == np.asarray(pool.k[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# kernel parity vs the gather-dequant oracle (interpret mode, same bytes)
+# ---------------------------------------------------------------------------
+
+
+def _quant_pool(nblk, KV, bs, Dh, qdtype, seed=0):
+    rng = np.random.default_rng(seed)
+    k = jnp.asarray(rng.standard_normal((nblk, KV, bs, Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((nblk, KV, bs, Dh)), jnp.float32)
+    kq, ks = quantize_kv(k, qdtype)
+    vq, vs = quantize_kv(v, qdtype)
+    return (kq, ks), (vq, vs)
+
+
+def _bt(kv_lens, bs, nblk):
+    maxblk = max(-(-int(l) // bs) for l in kv_lens)
+    bt = np.full((len(kv_lens), maxblk), -1, np.int32)
+    nxt = iter(range(1, nblk))
+    for b, l in enumerate(kv_lens):
+        for j in range(-(-int(l) // bs)):
+            bt[b, j] = next(nxt)
+    return jnp.asarray(bt)
+
+
+@pytest.mark.parametrize("qdtype", [jnp.int8, jnp.float8_e4m3fn])
+@pytest.mark.parametrize("kv_lens", [[16], [30, 49, 16]])
+def test_decode_kernel_parity_quantized(qdtype, kv_lens):
+    """The streaming kernel's in-register dequant must match dequant-
+    after-gather on the SAME stored bytes to float epsilon — quantization
+    error cancels exactly, so parity here is the oracle contract."""
+    from shuffle_exchange_tpu.inference.engine import decode_attention
+    from shuffle_exchange_tpu.ops.paged_attention import \
+        paged_decode_attention_pallas
+
+    B, H, KV, Dh, bs, nblk = len(kv_lens), 8, 2, 64, 16, 32
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), jnp.float32)
+    ck, cv = _quant_pool(nblk, KV, bs, Dh, qdtype)
+    bt = _bt(kv_lens, bs, nblk)
+    kvl = jnp.asarray(np.asarray(kv_lens, np.int32))
+    out = paged_decode_attention_pallas(q, ck[0], cv[0], bt, kvl,
+                                        k_scale=ck[1], v_scale=cv[1],
+                                        interpret=True)
+    k, v = gather_kv(ck, cv, bt)
+    ref = decode_attention(q, k, v, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("qdtype", [jnp.int8, jnp.float8_e4m3fn])
+def test_extend_kernel_parity_quantized(qdtype):
+    from shuffle_exchange_tpu.inference.engine import extend_attention
+    from shuffle_exchange_tpu.ops.paged_attention import \
+        paged_extend_attention_pallas
+
+    B, C, H, KV, Dh, bs, nblk = 2, 8, 8, 2, 64, 16, 16
+    starts = jnp.asarray([5, 0], jnp.int32)
+    nnew = np.asarray([8, 3], np.int32)
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((B, C, H, Dh)), jnp.float32)
+    ck, cv = _quant_pool(nblk, KV, bs, Dh, qdtype)
+    bt = _bt((np.asarray(starts) + nnew).tolist(), bs, nblk)
+    out = paged_extend_attention_pallas(q, ck[0], cv[0], bt, starts,
+                                        jnp.asarray(nnew),
+                                        k_scale=ck[1], v_scale=cv[1],
+                                        interpret=True)
+    k, v = gather_kv(ck, cv, bt)
+    ref = extend_attention(q, k, v, starts, starts + jnp.asarray(nnew))
+    for b in range(B):
+        np.testing.assert_allclose(np.asarray(out)[b, :nnew[b]],
+                                   np.asarray(ref)[b, :nnew[b]],
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("qdtype", [jnp.int8, jnp.float8_e4m3fn])
+def test_fused_splitk_parity_quantized(qdtype):
+    """The split-K flash-decode kernel (all KV heads per DMA, stacked
+    pool + layer index) with in-register dequant."""
+    from shuffle_exchange_tpu.inference.engine import decode_attention
+    from shuffle_exchange_tpu.ops.fused_decode import \
+        fused_paged_decode_attention_pallas
+
+    B, H, KV, Dh, bs, nblk, L = 2, 8, 2, 64, 16, 16, 2
+    kv_lens = [33, 47]
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((L, nblk, KV, bs, Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((L, nblk, KV, bs, Dh)), jnp.float32)
+    kq, ks = quantize_kv(k, qdtype)
+    vq, vs = quantize_kv(v, qdtype)
+    bt = _bt(kv_lens, bs, nblk)
+    kvl = jnp.asarray(np.asarray(kv_lens, np.int32))
+    out = fused_paged_decode_attention_pallas(
+        q, kq, vq, bt, kvl, layer=1, k_scale=ks, v_scale=vs,
+        num_splits=2, interpret=True)
+    kg, vg = gather_kv((kq[1], ks[1]), (vq[1], vs[1]), bt)
+    ref = decode_attention(q, kg, vg, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# engine-level parity vs the bf16-mode oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _icfg(kv_cache_dtype="bf16", **kw):
+    return InferenceConfig(dtype="float32", max_seq_len=64, kv_block_size=8,
+                           num_kv_blocks=40,
+                           kv_cache_dtype=kv_cache_dtype, **kw)
+
+
+# measured on the tiny model: int8 2.8e-3, fp8 1.3e-2 after 8 decode
+# steps — pinned with ~3x headroom; a real dequant bug is orders worse
+@pytest.mark.parametrize("mode,atol", [("int8", 1e-2), ("fp8", 5e-2)])
+def test_engine_decode_parity_vs_bf16_oracle(model_and_params, mode, atol):
+    """The acceptance criterion: int8 and fp8 KV modes track the bf16-mode
+    engine — prefill logits BIT-IDENTICAL (quantization touches storage,
+    not the prefill compute), greedy tokens equal, decode logits within
+    the pinned envelope."""
+    model, params = model_and_params
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(1, 90, size=21).tolist()
+
+    ref = InferenceEngineV2(model, params, _icfg("bf16"))
+    lg_ref = ref.put([0], [prompt])
+    first = int(np.argmax(lg_ref[0]))
+    toks_ref = ref.decode_loop([0], [first], 7)
+
+    eng = InferenceEngineV2(model, params, _icfg(mode))
+    lg = eng.put([0], [prompt])
+    np.testing.assert_array_equal(np.asarray(lg), np.asarray(lg_ref))
+    toks = eng.decode_loop([0], [first], 7)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks_ref))
+    drift = np.max(np.abs(eng._seqs[0].last_logits
+                          - ref._seqs[0].last_logits))
+    assert drift <= atol, f"{mode} decode logits drift {drift} > {atol}"
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_engine_mixed_step_and_prefix_cache_compose(model_and_params, mode):
+    """kv_cache_dtype composes with prefix caching: the shared-prefix
+    admission reuses QUANTIZED blocks and still matches the same-mode
+    cold engine. Token equality is seed-pinned: the suffix extend reads
+    the shared blocks back dequantized while the cold put() attends its
+    full-precision in-flight chunk, so the logits differ at quantization
+    noise — small enough here that greedy argmax agrees (CPU CI is one
+    fixed platform; a flip on new seeds would mean real drift growth)."""
+    model, params = model_and_params
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 90, size=16).tolist()
+    p1 = shared + rng.integers(1, 90, size=5).tolist()
+    p2 = shared + rng.integers(1, 90, size=9).tolist()
+
+    def run_cold(p):
+        e = InferenceEngineV2(model, params, _icfg(mode))
+        lg = e.put([0], [p])
+        first = int(np.argmax(lg[0]))
+        t = e.decode_loop([0], [first], 5)
+        return [first] + [int(x) for x in t[0]]
+
+    want = [run_cold(p1), run_cold(p2)]
+    eng = InferenceEngineV2(model, params, _icfg(mode, prefix_caching=True))
+    out = []
+    for uid, p in enumerate((p1, p2)):
+        lg = eng.put([uid], [p])
+        first = int(np.argmax(lg[0]))
+        t = eng.decode_loop([uid], [first], 5)
+        out.append([first] + [int(x) for x in t[0]])
+    assert out == want
+    assert eng.prefix_hit_tokens == 16
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_engine_fused_pallas_path_quantized(mode, monkeypatch):
+    """decode_kernel="pallas" (interpret hook) over a quantized pool:
+    the fused split-K attention dequantizes in-register and the append
+    rides the XLA quantize-on-write scatter — tokens must match the XLA
+    path exactly (same stored bytes on both). Dh=16 keeps the model on
+    the fused path's eligibility (the d=32 fixture's Dh=8 is below it)."""
+    cfg = tiny(vocab=97, d=64, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    monkeypatch.setenv("SXT_FUSED_INTERPRET", "1")
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 90, size=12).tolist()
+
+    outs = {}
+    for dk in ("xla", "pallas"):
+        eng = InferenceEngineV2(model, params,
+                                _icfg(mode, decode_kernel=dk))
+        lg = eng.put([0], [prompt])
+        first = int(np.argmax(lg[0]))
+        toks = eng.decode_loop([0], [first], 6)
+        outs[dk] = ([first] + [int(t) for t in toks[0]],
+                    np.asarray(eng._seqs[0].last_logits))
+    assert outs["xla"][0] == outs["pallas"][0]
+    np.testing.assert_allclose(outs["pallas"][1], outs["xla"][1],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_engine_pool_bytes_published(model_and_params):
+    model, params = model_and_params
+    b_bf16 = InferenceEngineV2(model, params, _icfg("bf16")).cache.pool_nbytes()
+    b_int8 = InferenceEngineV2(model, params, _icfg("int8")).cache.pool_nbytes()
+    # fp32 serving dtype here: storage shrinks 81920 -> 30720 (Dh=8 at
+    # tiny shapes carries a heavy scale-plane tax; Dh>=64 reaches ~2x vs
+    # bf16 — the pool-level test above pins that)
+    assert b_int8 < b_bf16
+
+
+# ---------------------------------------------------------------------------
+# config validation (the from_dict discipline satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_kv_cache_dtype_normalizes(self):
+        for raw, want in (("bfloat16", "bf16"), ("INT8", "int8"),
+                          ("float8", "fp8"), ("e4m3", "fp8")):
+            assert _icfg(raw).kv_cache_dtype == want
+            assert InferenceConfig.from_dict(
+                {"kv_cache_dtype": raw}).kv_cache_dtype == want
+
+    def test_kv_cache_dtype_rejects_unknown(self):
+        with pytest.raises(ConfigError, match="kv_cache_dtype"):
+            _icfg("int4")
+        with pytest.raises(ConfigError, match="kv_cache_dtype"):
+            InferenceConfig.from_dict({"kv_cache_dtype": "q4"})
+
+    def test_prefix_caching_must_be_bool(self):
+        with pytest.raises(ConfigError, match="prefix_caching"):
+            InferenceConfig(dtype="float32", prefix_caching="yes")
+        with pytest.raises(ConfigError, match="prefix_caching"):
+            InferenceConfig.from_dict({"prefix_caching": 1})
+
+    def test_from_dict_serving_unknown_keys_still_reject(self):
+        """The new top-level keys ride from_dict's existing contract
+        (unknown TOP-LEVEL keys are CUDA-compat-ignored with a log line);
+        the serving section keeps strict unknown-key rejection."""
+        cfg = InferenceConfig.from_dict({"kv_cache_dtype": "int8",
+                                         "prefix_caching": True,
+                                         "serving": {"token_budget": 32}})
+        assert cfg.kv_cache_dtype == "int8" and cfg.prefix_caching
+        with pytest.raises(ConfigError, match="unknown serving"):
+            InferenceConfig.from_dict({"kv_cache_dtype": "int8",
+                                       "serving": {"token_budgt": 32}})

@@ -286,3 +286,45 @@ def test_grouped_gemm_lowers():
     gs = jnp.zeros((4,), jnp.int32)
     _tpu_lower(jax.grad(lambda x, w: _grouped_matmul_gmm(
         x, w, gs).astype(jnp.float32).sum() ** 2, argnums=(0, 1)), x, w)
+
+
+@pytest.mark.parametrize("store", [jnp.int8, jnp.float8_e4m3fn])
+def test_paged_kernels_quantized_kv_lower(store):
+    """kv_cache_dtype int8/fp8 (ISSUE 6): every streaming kernel that
+    dequantizes scale planes in-register must pass the real Mosaic block
+    checks — the (…, 1, bs) scale block leans on the singleton-second-
+    minor trick, which only the TPU lowering validates."""
+    from shuffle_exchange_tpu.ops.fused_decode import (
+        fused_paged_decode_attention_pallas)
+    from shuffle_exchange_tpu.ops.paged_attention import (
+        paged_decode_attention_pallas, paged_extend_attention_pallas)
+
+    B, H, KV, Dh, bs, nblk, L = 2, 8, 4, 128, 64, 10, 3
+    q1 = jnp.zeros((B, 1, H, Dh), jnp.bfloat16)
+    ck = jnp.zeros((nblk, KV, bs, Dh), store)
+    sc = jnp.zeros((nblk, KV, bs), jnp.float32)
+    bt = jnp.zeros((B, 3), jnp.int32)
+    kvl = jnp.zeros((B,), jnp.int32)
+    _tpu_lower(lambda q, k, v, ks, vs, bt, kvl: paged_decode_attention_pallas(
+        q, k, v, bt, kvl, k_scale=ks, v_scale=vs), q1, ck, ck, sc, sc, bt, kvl)
+
+    qc = jnp.zeros((B, 4, H, Dh), jnp.bfloat16)
+    st = jnp.zeros((B,), jnp.int32)
+    nn = jnp.zeros((B,), jnp.int32)
+    _tpu_lower(lambda q, k, v, ks, vs, bt, st, nn: paged_extend_attention_pallas(
+        q, k, v, bt, st, nn, k_scale=ks, v_scale=vs),
+        qc, ck, ck, sc, sc, bt, st, nn)
+
+    # stacked pools (the decode loop's in-place-carry mode): per-kv-head
+    # streaming decode AND the all-kv-head split-K flash form
+    ck5 = jnp.zeros((L, nblk, KV, bs, Dh), store)
+    sc5 = jnp.zeros((L, nblk, KV, bs), jnp.float32)
+    lyr = jnp.zeros((), jnp.int32)
+    _tpu_lower(lambda q, k, v, ks, vs, bt, kvl, lyr:
+               paged_decode_attention_pallas(
+                   q, k, v, bt, kvl, layer=lyr, k_scale=ks, v_scale=vs),
+               q1, ck5, ck5, sc5, sc5, bt, kvl, lyr)
+    _tpu_lower(lambda q, k, v, ks, vs, bt, kvl, lyr:
+               fused_paged_decode_attention_pallas(
+                   q, k, v, bt, kvl, layer=lyr, k_scale=ks, v_scale=vs,
+                   num_splits=2), q1, ck5, ck5, sc5, sc5, bt, kvl, lyr)

@@ -1,0 +1,465 @@
+"""Prefix-cached paged KV (ISSUE 6): the ref-counted, content-addressed
+``BlockedAllocator`` and the engine/scheduler reuse path built on it.
+
+Contracts pinned here:
+  - allocator: per-id double-free detection, refcount-gated free, the
+    content registry (first-writer-wins), and the cached-free LRU
+    (park / revive / evict);
+  - engine: a second admission sharing an N-block committed prefix
+    acquires those blocks with ZERO fresh allocations, prefills only the
+    suffix, and produces byte-identical tokens to a cold run;
+  - copy-on-write: a forked sequence diverging mid-block clones the
+    shared tail before its first write — both sides match independent
+    references;
+  - scheduler: preempt -> requeue of a sequence holding shared blocks
+    replays correctly (refcounts survive), the prefix_cache/* counter
+    group flows through the always-on monitor, and stats() publishes
+    p95/p99 tails plus hit-rate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                            InferenceConfig,
+                                            InferenceEngineV2)
+from shuffle_exchange_tpu.inference.paged import (BlockedAllocator,
+                                                  chain_block_keys)
+from shuffle_exchange_tpu.models import Transformer, tiny
+
+
+# ---------------------------------------------------------------------------
+# Allocator unit tests (no jax programs)
+# ---------------------------------------------------------------------------
+
+
+class TestAllocator:
+    def test_double_free_raises_per_id(self):
+        """The ISSUE 6 satellite: freeing a specific id twice must raise
+        even when aggregate counts stay legal (the old total-count assert
+        missed exactly this)."""
+        a = BlockedAllocator(4)
+        blocks = a.allocate(2)
+        a.free([blocks[0]])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([blocks[0]])   # id freed twice, total still <= 4
+        # and the failed call mutated nothing: the OTHER block stays live
+        assert a.ref_count(blocks[1]) == 1
+
+    def test_free_validates_before_mutating(self):
+        a = BlockedAllocator(4)
+        blocks = a.allocate(2)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([blocks[0], blocks[0]])  # second entry is invalid
+        # atomic: the first entry was NOT freed by the failed call
+        assert a.ref_count(blocks[0]) == 1
+
+    def test_free_rejects_out_of_range_id(self):
+        a = BlockedAllocator(4)
+        a.allocate(1)
+        with pytest.raises(ValueError, match="bad block id"):
+            a.free([99])
+
+    def test_retain_shares_and_free_decrements(self):
+        a = BlockedAllocator(4)
+        [b] = a.allocate(1)
+        a.retain([b])
+        assert a.ref_count(b) == 2
+        assert a.shared_blocks == 1
+        a.free([b])
+        assert a.ref_count(b) == 1      # still live: the other holder
+        assert a.free_blocks == 3
+        a.free([b])
+        assert a.free_blocks == 4
+
+    def test_retain_unallocated_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="retain of unallocated"):
+            a.retain([0])
+
+    def test_register_first_writer_wins(self):
+        a = BlockedAllocator(4)
+        b1, b2 = a.allocate(2)
+        key = chain_block_keys(list(range(8)), 8)[0]
+        assert a.register(key, b1)
+        assert not a.register(key, b2)   # lost the race: stays private
+        assert a.peek([key]) == (1, 0)
+
+    def test_registered_block_parks_then_revives(self):
+        """A freed registered block parks in the cached-free LRU (still
+        allocatable) and acquire() revives it at refcount 1 — the KV
+        content survives the owner's flush."""
+        a = BlockedAllocator(4)
+        [b] = a.allocate(1)
+        key = chain_block_keys(list(range(8)), 8)[0]
+        a.register(key, b)
+        a.free([b])
+        assert a.free_blocks == 4        # parked still counts allocatable
+        assert a.cached_blocks == 1
+        assert a.peek([key]) == (0, 1)   # parked, not live
+        got = a.acquire([key])
+        assert got == [b] and a.ref_count(b) == 1
+        assert a.revives == 1
+
+    def test_parked_block_evicted_by_fresh_allocation(self):
+        """Capacity pressure recycles the LRU-oldest parked block and
+        drops its registration — a later acquire of that key misses."""
+        a = BlockedAllocator(2)
+        b1, b2 = a.allocate(2)
+        k1, k2 = chain_block_keys(list(range(16)), 8)
+        a.register(k1, b1)
+        a.register(k2, b2)
+        a.free([b1])                     # parks b1 (oldest)
+        a.free([b2])                     # parks b2
+        fresh = a.allocate(1)            # no truly-free blocks: evicts b1
+        assert fresh == [b1] and a.evictions == 1
+        assert a.acquire([k1]) == []     # registration gone with the KV
+        assert a.acquire([k2]) == [b2]   # younger park survived
+
+    def test_acquire_stops_at_first_miss(self):
+        a = BlockedAllocator(8)
+        blocks = a.allocate(3)
+        keys = chain_block_keys(list(range(24)), 8)
+        a.register(keys[0], blocks[0])
+        a.register(keys[2], blocks[2])   # hole at keys[1]
+        assert a.acquire(keys) == [blocks[0]]
+        assert a.ref_count(blocks[0]) == 2
+        assert a.ref_count(blocks[2]) == 1   # untouched past the hole
+
+    def test_chain_keys_are_position_dependent(self):
+        """Identical token blocks at different depths never collide."""
+        toks = [5] * 16
+        k = chain_block_keys(toks, 8)
+        assert k[0] != k[1]
+        # and the chain is deterministic
+        assert chain_block_keys(toks, 8) == k
+
+
+# ---------------------------------------------------------------------------
+# Engine + scheduler integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _icfg(num_kv_blocks=40, prefix_caching=True, **kw):
+    serving = {"token_budget": 16, "max_running": 4, "chunk_min": 4}
+    serving.update(kw.pop("serving", {}))
+    return InferenceConfig(dtype="float32", max_seq_len=64, kv_block_size=8,
+                           num_kv_blocks=num_kv_blocks,
+                           prefix_caching=prefix_caching, serving=serving,
+                           **kw)
+
+
+def _cold_reference(model, params, prompt, n_new):
+    """Uncached single-request reference: put() prefill + decode_loop."""
+    eng = InferenceEngineV2(model, params, _icfg(prefix_caching=False))
+    lg = eng.put([0], [prompt])
+    first = int(np.argmax(lg[0]))
+    if n_new == 1:
+        return [first]
+    toks = eng.decode_loop([0], [first], n_new - 1)
+    return [first] + [int(t) for t in toks[0]]
+
+
+def _decode(eng, uid, logits, n_new):
+    first = int(np.argmax(logits))
+    toks = eng.decode_loop([uid], [first], n_new - 1)
+    return [first] + [int(t) for t in toks[0]]
+
+
+class TestPrefixHit:
+    def test_shared_prefix_zero_new_blocks_and_exact_tokens(self, model_and_params):
+        """The acceptance criterion: a second request sharing a 2-block
+        committed prefix acquires it LIVE (zero fresh allocations for the
+        shared span), prefills only the suffix, and its tokens are
+        byte-identical to a cold run."""
+        model, params = model_and_params
+        rng = np.random.default_rng(0)
+        shared = rng.integers(1, 90, size=16).tolist()     # 2 full blocks
+        p1 = shared + rng.integers(1, 90, size=5).tolist()
+        p2 = shared + rng.integers(1, 90, size=9).tolist()
+        want1 = _cold_reference(model, params, p1, 6)
+        want2 = _cold_reference(model, params, p2, 6)
+
+        eng = InferenceEngineV2(model, params, _icfg())
+        got1 = _decode(eng, 0, eng.put([0], [p1])[0], 6)
+        assert got1 == want1
+
+        # uid 0 is still live: its committed blocks are shareable in place
+        hit_tokens, live, parked = eng.prefix_peek(p2)
+        assert (hit_tokens, live, parked) == (16, 2, 0)
+        fresh0 = eng.allocator.fresh_allocs
+        got2 = _decode(eng, 1, eng.put([1], [p2])[0], 6)
+        assert got2 == want2
+        # fresh allocations cover ONLY the suffix + decode growth, never
+        # the 2 shared blocks (suffix 9 tokens + 5 decode writes = 14
+        # tokens past the shared 16 -> blocks 3..4 of the sequence)
+        suffix_blocks = eng.allocator.fresh_allocs - fresh0
+        assert suffix_blocks == 2, suffix_blocks
+        assert eng.allocator.shared_acquires == 2
+        assert eng.prefix_hit_tokens == 16
+        assert eng.allocator.shared_blocks == 2
+
+        # refcounts gate free(): flushing uid 0 keeps the shared blocks
+        # live for uid 1, flushing uid 1 parks them (registered content)
+        eng.flush([0])
+        assert eng.allocator.shared_blocks == 0
+        assert eng.prefix_peek(p2)[1] >= 2      # still live via uid 1
+        eng.flush([1])
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_parked_prefix_revives_after_flush(self, model_and_params):
+        """Flush -> the committed blocks park in the LRU; a later
+        admission of the same prefix revives them (no re-prefill) and
+        still matches the cold reference."""
+        model, params = model_and_params
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, 90, size=20).tolist()
+        want = _cold_reference(model, params, prompt, 5)
+
+        eng = InferenceEngineV2(model, params, _icfg())
+        got = _decode(eng, 0, eng.put([0], [prompt])[0], 5)
+        assert got == want
+        eng.flush([0])
+        hit_tokens, live, parked = eng.prefix_peek(prompt)
+        assert live == 0 and parked == 2 and hit_tokens == 16
+        got2 = _decode(eng, 1, eng.put([1], [prompt])[0], 5)
+        assert got2 == want
+        assert eng.allocator.revives == 2
+
+    def test_put_admission_atomic_on_reject(self, model_and_params):
+        """A rejected put() must leave the engine untouched — prefix
+        acquisition included — so the caller can retry verbatim."""
+        model, params = model_and_params
+        rng = np.random.default_rng(2)
+        shared = rng.integers(1, 90, size=16).tolist()
+        eng = InferenceEngineV2(model, params, _icfg(num_kv_blocks=6))
+        eng.put([0], [shared + [3, 4]])          # 3 blocks + scratch
+        with pytest.raises(RuntimeError, match="KV blocks"):
+            # shares 2 blocks but the 40-token suffix cannot fit
+            eng.put([1], [shared + rng.integers(1, 90, size=30).tolist()])
+        assert 1 not in eng._seqs
+        assert eng.allocator.shared_acquires == 0
+        # named numbers + the cached-vs-new note in the message
+        try:
+            eng.put([1], [shared + rng.integers(1, 90, size=30).tolist()])
+        except RuntimeError as e:
+            assert "prefix-cached" in str(e) and "free" in str(e)
+
+    def test_caching_off_is_cold(self, model_and_params):
+        model, params = model_and_params
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(1, 90, size=20).tolist()
+        eng = InferenceEngineV2(model, params, _icfg(prefix_caching=False))
+        eng.put([0], [prompt])
+        assert eng.prefix_peek(prompt) == (0, 0, 0)
+        eng.put([1], [prompt])
+        assert eng.allocator.shared_acquires == 0
+        assert eng.prefix_hit_tokens == 0
+
+
+class TestCopyOnWrite:
+    def test_fork_divergence_mid_block_clones_before_write(self, model_and_params):
+        """fork() shares ALL blocks including the partial tail; the first
+        write after divergence clones it. Both branches must match
+        independent single-sequence references computed from their full
+        histories."""
+        model, params = model_and_params
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(1, 90, size=13).tolist()   # mid-block tail
+
+        eng = InferenceEngineV2(model, params, _icfg())
+        lg = eng.put([0], [prompt])
+        eng.fork(0, 1)
+        assert eng.allocator.shared_blocks == len(eng._seqs[0].blocks)
+        cow0 = eng.cow_copies
+
+        # diverge: feed DIFFERENT continuations into the shared tail block
+        eng.put([0, 1], [[7], [11]])
+        assert eng.cow_copies > cow0     # tail block cloned before write
+        assert eng.allocator.shared_blocks == 1  # only the committed block
+        out0 = _decode(eng, 0, eng._seqs[0].last_logits, 4)
+        out1 = _decode(eng, 1, eng._seqs[1].last_logits, 4)
+
+        # references: cold engines fed the full diverged histories
+        ref = InferenceEngineV2(model, params, _icfg(prefix_caching=False))
+        r0 = _decode(ref, 0, ref.put([0], [prompt + [7]])[0], 4)
+        r1 = _decode(ref, 1, ref.put([1], [prompt + [11]])[0], 4)
+        assert out0 == r0
+        assert out1 == r1
+
+        eng.flush([0, 1])
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_decode_loop_budgets_cow_clones_before_mutating(self, model_and_params):
+        """decode_loop admission must charge the copy-on-write clone for
+        every shared write-span block UP FRONT: with 1 free block and two
+        forked sequences both needing a tail clone, the call must reject
+        atomically — not admit, clone one side, then die mid-COW."""
+        model, params = model_and_params
+        rng = np.random.default_rng(10)
+        prompt = rng.integers(1, 90, size=13).tolist()   # 2 blocks, tail shared
+        eng = InferenceEngineV2(model, params, _icfg(num_kv_blocks=4))
+        eng.put([0], [prompt])
+        eng.fork(0, 1)
+        assert eng.free_blocks == 1
+        cow0 = eng.cow_copies
+        refs0 = {b: eng.allocator.ref_count(b) for b in eng._seqs[0].blocks}
+        with pytest.raises(RuntimeError, match="KV blocks"):
+            eng.decode_loop([0, 1], [7, 11], 1)
+        # rejected call mutated nothing
+        assert eng.cow_copies == cow0
+        assert {b: eng.allocator.ref_count(b)
+                for b in eng._seqs[0].blocks} == refs0
+
+    def test_fork_refcounts_survive_one_side_flush(self, model_and_params):
+        model, params = model_and_params
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(1, 90, size=10).tolist()
+        eng = InferenceEngineV2(model, params, _icfg())
+        eng.put([0], [prompt])
+        eng.fork(0, 1)
+        eng.flush([0])
+        # the fork still owns every block: decoding it must work
+        out = _decode(eng, 1, eng._seqs[1].last_logits, 3)
+        ref = InferenceEngineV2(model, params, _icfg(prefix_caching=False))
+        assert out == _decode(ref, 0, ref.put([0], [prompt])[0], 3)
+
+
+class TestScheduler:
+    def test_warmed_scheduler_prefix_hit_prefills_only_suffix(self, model_and_params):
+        """The acceptance scenario end-to-end: serve request A, then B
+        sharing A's 2-block prefix on the warmed scheduler — B's admission
+        allocates nothing for the shared span, the prefill-token counters
+        show only the suffix was prefilled, and outputs are identical to
+        the cold references."""
+        model, params = model_and_params
+        rng = np.random.default_rng(6)
+        shared = rng.integers(1, 90, size=16).tolist()
+        p1 = shared + rng.integers(1, 90, size=5).tolist()
+        p2 = shared + rng.integers(1, 90, size=9).tolist()
+        want = {p: _cold_reference(model, params, p, 6)
+                for p in (tuple(p1), tuple(p2))}
+
+        eng = InferenceEngineV2(model, params, _icfg())
+        sched = ContinuousBatchingScheduler(eng)
+        u1 = sched.submit(p1, max_new_tokens=6)
+        while sched.tick():
+            pass
+        assert sched.requests[u1].generated == want[tuple(p1)]
+
+        # warmed: admit B. A finished (its blocks parked), so the shared
+        # span revives from the LRU — zero FRESH allocations for it.
+        fresh0 = eng.allocator.fresh_allocs
+        hits0 = eng.prefix_hit_tokens
+        u2 = sched.submit(p2, max_new_tokens=6)
+        while sched.tick():
+            pass
+        assert sched.requests[u2].generated == want[tuple(p2)]
+        assert eng.prefix_hit_tokens - hits0 == 16
+        # only suffix + decode growth allocated fresh
+        assert eng.allocator.fresh_allocs - fresh0 == 2
+        # prefill spend: of B's 25 tokens, 16 came from the cache and only
+        # the 9-token suffix was dispatched as prefill
+        assert eng.prefix_miss_tokens >= 9
+        st = sched.stats()
+        assert st["prefix_cache"]["hit_tokens"] == eng.prefix_hit_tokens
+        assert st["prefix_cache"]["hit_rate"] is not None
+        for k in ("ttft_p50_s", "ttft_p95_s", "ttft_p99_s",
+                  "tpot_p95_s", "tpot_p99_s"):
+            assert k in st
+
+    def test_concurrent_shared_prefix_zero_new_blocks_live(self, model_and_params):
+        """Both requests in flight at once: B's shared span is LIVE in
+        A's descriptor — admission takes references, not allocations."""
+        model, params = model_and_params
+        rng = np.random.default_rng(7)
+        shared = rng.integers(1, 90, size=16).tolist()
+        prompts = [shared + rng.integers(1, 90, size=n).tolist()
+                   for n in (5, 9, 7)]
+        want = [_cold_reference(model, params, p, 6) for p in prompts]
+
+        eng = InferenceEngineV2(model, params, _icfg())
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=6)
+        assert [out[u] for u in out] == want
+        # the 2 shared blocks were acquired (live or revived), never
+        # re-allocated, by the 2nd and 3rd admissions
+        assert (eng.allocator.shared_acquires + eng.allocator.revives) >= 4
+        assert eng.prefix_hit_tokens == 32
+        mm = sched.memory_monitor
+        assert mm.latest("prefix_cache/hit_tokens") == 32
+        assert mm.latest("prefix_cache/cow_copies") == 0
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_preempt_requeue_with_shared_blocks(self, model_and_params):
+        """KV pressure preempts a sequence HOLDING shared prefix blocks:
+        the refcounted free must leave the survivor's blocks intact, the
+        replay re-acquires the (parked or live) prefix, and every output
+        still matches the cold reference."""
+        model, params = model_and_params
+        rng = np.random.default_rng(8)
+        shared = rng.integers(1, 90, size=16).tolist()
+        prompts = [shared + rng.integers(1, 90, size=4).tolist(),
+                   shared + rng.integers(1, 90, size=6).tolist()]
+        want = [_cold_reference(model, params, p, 12) for p in prompts]
+
+        # 7 blocks: scratch + 6 usable < the two sequences' peak demand
+        eng = InferenceEngineV2(model, params, _icfg(num_kv_blocks=7))
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=12)
+        assert sched.preemptions > 0, "pool was sized to force preemption"
+        assert [out[u] for u in out] == want
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_reload_weights_invalidates_prefix_cache(self, model_and_params,
+                                                     monkeypatch):
+        """A weight hot-swap must drop the content registry: keys are pure
+        functions of token history, so a post-swap admission hashing the
+        same prompt would otherwise silently reuse KV computed under the
+        OLD weights. Parked blocks return to the free list; a force-swap
+        under live sequences bars them from ever committing."""
+        from shuffle_exchange_tpu.inference.engine import InferenceEngine
+
+        model, params = model_and_params
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(1, 90, size=20).tolist()
+        eng = InferenceEngineV2(model, params, _icfg())
+        _decode(eng, 0, eng.put([0], [prompt])[0], 4)
+        eng.flush([0])
+        assert eng.prefix_peek(prompt)[0] == 16   # parked and addressable
+
+        monkeypatch.setattr(InferenceEngine, "reload_weights",
+                            lambda self, d, tag=None: True)
+        assert eng.reload_weights("/does/not/matter")
+        assert eng.prefix_peek(prompt) == (0, 0, 0)
+        assert eng.allocator.cached_blocks == 0
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+        # force-swap under a LIVE sequence: its mixed-weight blocks never
+        # enter the index even as it keeps decoding
+        eng.put([1], [prompt])
+        assert eng.reload_weights("/does/not/matter", force=True)
+        _decode(eng, 1, eng._seqs[1].last_logits, 4)
+        assert eng.prefix_peek(prompt) == (0, 0, 0)
+
+    def test_prefix_caching_off_scheduler_unchanged(self, model_and_params):
+        model, params = model_and_params
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, 90, size=n).tolist() for n in (12, 9)]
+        want = [_cold_reference(model, params, p, 5) for p in prompts]
+        eng = InferenceEngineV2(model, params, _icfg(prefix_caching=False))
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=5)
+        assert [out[u] for u in out] == want
+        assert sched.stats()["prefix_cache"]["hit_rate"] is None
