@@ -480,6 +480,74 @@ def prefix_cache_row(model, params, icfg, vocab, *, n_requests=16,
     }
 
 
+def serving_fleet_row(model, params, icfg, vocab, *, n_requests=24,
+                      prompt_lo=64, prompt_hi=512, max_new=32,
+                      load=2.0, seed=0):
+    """Config-5 serving-fleet row (ISSUE 7): the SAME Poisson trace served
+    by a 1-replica and a 2-replica ``ReplicaRouter`` fleet, at arrivals
+    calibrated on the single-replica capacity. The 2-replica fleet splits
+    the queue across engines (placement by queue depth + KV pressure), so
+    goodput should rise and the TTFT tails — queueing time, mostly — should
+    fall; the row publishes both plus the speedup. Token parity with the
+    1-replica serve is reported (greedy routing is token-identical under
+    the scheduler contract). Reused at toy size by
+    tests/test_bench_smoke.py so the published row cannot rot on CPU."""
+    from shuffle_exchange_tpu.inference import InferenceEngineV2
+    from shuffle_exchange_tpu.serving import ReplicaRouter
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+    eng_a = InferenceEngineV2(model, params, icfg)
+    eng_b = InferenceEngineV2(model, params, icfg)
+    # throwaway pass per engine: warm each replica's shape-bin ladder so
+    # no measured fleet carries JIT wall-time (same trace -> same shapes)
+    ReplicaRouter([eng_a]).serve(prompts, max_new_tokens=max_new)
+    ReplicaRouter([eng_b]).serve(prompts, max_new_tokens=max_new)
+    # capacity: everything up front on ONE replica, arrivals calibrated on
+    # it and reused for both fleets so the comparison is at identical load
+    cap_router = ReplicaRouter([eng_a])
+    cap_router.serve(prompts, max_new_tokens=max_new)
+    cap = cap_router.stats()["sustained_tokens_per_sec"]
+    span = n_requests * max_new / cap / load
+    arrivals = np.cumsum(rng.exponential(span / n_requests,
+                                         size=n_requests)).tolist()
+
+    def fleet(engines):
+        router = ReplicaRouter(engines)
+        out = router.serve(prompts, max_new_tokens=max_new,
+                           arrivals=list(arrivals))
+        return out, router.stats()
+
+    out1, st1 = fleet([eng_a])
+    out2, st2 = fleet([eng_a, eng_b])
+    mismatches = sum(out2[u] != out1[u] for u in out2)
+    return {
+        "n_requests": n_requests,
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "capacity_tokens_per_sec": round(cap, 1),
+        "replicas_used": [st1["replicas"], st2["replicas"]],
+        "sustained_tokens_per_sec_1r": round(
+            st1["sustained_tokens_per_sec"], 1),
+        "sustained_tokens_per_sec_2r": round(
+            st2["sustained_tokens_per_sec"], 1),
+        "fleet_speedup_x": round(st2["sustained_tokens_per_sec"]
+                                 / st1["sustained_tokens_per_sec"], 2),
+        "ttft_p50_s_1r": round(st1["ttft_p50_s"], 4),
+        "ttft_p95_s_1r": round(st1["ttft_p95_s"], 4),
+        "ttft_p99_s_1r": round(st1["ttft_p99_s"], 4),
+        "ttft_p50_s_2r": round(st2["ttft_p50_s"], 4),
+        "ttft_p95_s_2r": round(st2["ttft_p95_s"], 4),
+        "ttft_p99_s_2r": round(st2["ttft_p99_s"], 4),
+        "tpot_p50_s_1r": round(st1["tpot_p50_s"], 4),
+        "tpot_p50_s_2r": round(st2["tpot_p50_s"], 4),
+        "token_mismatches_vs_1r": mismatches,
+    }
+
+
 def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
     """Config #5: engine_v2 paged prefill + decode tokens/s.
 
@@ -701,6 +769,16 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         prefix_row = None
 
+    # ---- serving fleet: 1 vs 2 router replicas on the same Poisson
+    # trace (ISSUE 7) — goodput + TTFT tails; the multi-replica answer to
+    # arrivals that outpace one engine's capacity
+    try:
+        fleet_row = serving_fleet_row(model, params, icfg, cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving fleet bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        fleet_row = None
+
     # decode FLOPs ≈ 2*N per token (fwd only) -> model-bandwidth utilization
     best_tps = max([decode_tps, fused_tps]
                    + [r["tokens_per_sec"] for r in engine_rows])
@@ -739,6 +817,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "engine_decode_sweep": engine_rows,
         "serving_goodput": goodput,
         "serving_prefix_cache": prefix_row,
+        "serving_fleet": fleet_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
                                 if eng_best else None),
         "decode_hbm_util": (eng_best or {}).get("hbm_util"),

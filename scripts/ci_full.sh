@@ -29,6 +29,11 @@ python -m pytest tests/test_serving_scheduler.py -q "$@"
 # zero-new-allocation assert, COW divergence, preempt/requeue with shared
 # blocks, and int8/fp8 KV decode parity vs the bf16 gather oracle.
 python -m pytest tests/test_prefix_cache.py tests/test_kv_quant.py -q "$@"
+# Multi-host serving front gates (ISSUE 7): router placement/sticky/parity
+# + SIGTERM drain with zero lost requests, and the disaggregated
+# prefill->decode transfer (wire-format roundtrip incl. quantized scale
+# planes, handshake atomicity on reject, crash-mid-transfer cleanliness).
+python -m pytest tests/test_serving_router.py tests/test_disagg.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \
@@ -39,4 +44,6 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_elasticity_drill.py \
     --ignore=tests/test_serving_scheduler.py \
     --ignore=tests/test_prefix_cache.py \
-    --ignore=tests/test_kv_quant.py "$@"
+    --ignore=tests/test_kv_quant.py \
+    --ignore=tests/test_serving_router.py \
+    --ignore=tests/test_disagg.py "$@"

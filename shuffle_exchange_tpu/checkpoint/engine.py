@@ -207,7 +207,11 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             # the checkpoint may have been written from any device layout, so
             # rebuild an abstract target from metadata placed on the local
             # device instead of replaying the original sharding.
-            meta = self._ckptr.metadata(path).item_metadata
+            # orbax-API drift: Checkpointer.metadata() returns the metadata
+            # tree directly on 0.7.x; newer releases wrap it in a
+            # StepMetadata whose ``item_metadata`` holds the tree
+            meta = self._ckptr.metadata(path)
+            meta = getattr(meta, "item_metadata", meta)
             sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
             def to_abstract(m):

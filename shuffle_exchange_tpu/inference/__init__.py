@@ -10,21 +10,25 @@ Capability analog of the reference's two inference stacks:
     state manager, and a continuous-batching ``put/query/flush`` API.
 """
 
-from .config import InferenceConfig, ServingConfig
+from .config import InferenceConfig, RouterConfig, ServingConfig
 from .engine import InferenceEngine, init_inference, load_serving_weights
 from .paged import BlockedAllocator, PagedKVCache
-from .engine_v2 import InferenceEngineV2, SequenceDescriptor
+from .engine_v2 import (ImportReservation, InferenceEngineV2, KVBlockPayload,
+                        SequenceDescriptor)
 from .scheduler import ContinuousBatchingScheduler, ServingRequest
 
 __all__ = [
     "InferenceConfig",
+    "RouterConfig",
     "ServingConfig",
     "InferenceEngine",
     "init_inference",
     "load_serving_weights",
     "BlockedAllocator",
     "PagedKVCache",
+    "ImportReservation",
     "InferenceEngineV2",
+    "KVBlockPayload",
     "SequenceDescriptor",
     "ContinuousBatchingScheduler",
     "ServingRequest",

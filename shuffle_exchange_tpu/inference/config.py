@@ -98,6 +98,65 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class RouterConfig:
+    """Multi-replica serving-front knobs (``serving/router.py`` — the
+    ISSUE 7 replica router: N engine+scheduler replicas behind a placement
+    policy, the Splitwise/DistServe-style fleet layer over the launcher's
+    hostfile fan-out, SURVEY §1/§5.3).
+
+    Placement scores every ACTIVE replica and picks the max:
+    ``prefix_affinity_weight * hit_fraction - queue_depth_weight *
+    normalized_queue - kv_pressure_weight * pool_fill``. Sticky sessions
+    pin a ``session_id``'s later turns to the replica already holding its
+    KV (the multi-turn prefix-cache win); drained/stopped replicas lose
+    their stickiness. The autoscale bounds feed
+    ``launcher/elastic_agent.AutoscalePolicy``."""
+
+    num_replicas: int = 1
+    sticky_sessions: bool = True
+    prefix_affinity: bool = True
+    prefix_affinity_weight: float = 1.0
+    queue_depth_weight: float = 1.0
+    kv_pressure_weight: float = 1.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_up_queue_depth: float = 8.0    # mean queued reqs/replica to grow
+    scale_down_queue_depth: float = 1.0  # mean queued reqs/replica to shrink
+    # long-lived-process bounds: finished requests retained for result
+    # pickup (oldest evicted past the cap — keep it above any serve()
+    # batch size; 0 = unbounded), and sticky-session pins kept
+    # least-recently-used (0 = unbounded)
+    retain_finished: int = 4096
+    max_sessions: int = 65536
+
+    def __post_init__(self):
+        if self.num_replicas < 1:
+            raise ConfigError(
+                f"router.num_replicas must be >= 1, got {self.num_replicas}")
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ConfigError(
+                f"router needs 1 <= min_replicas <= max_replicas, got "
+                f"min={self.min_replicas} max={self.max_replicas}")
+        for name in ("prefix_affinity_weight", "queue_depth_weight",
+                     "kv_pressure_weight"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ConfigError(f"router.{name} must be >= 0, got {v!r}")
+        if self.scale_down_queue_depth >= self.scale_up_queue_depth:
+            raise ConfigError(
+                f"router.scale_down_queue_depth "
+                f"({self.scale_down_queue_depth}) must be below "
+                f"scale_up_queue_depth ({self.scale_up_queue_depth}) — equal "
+                f"thresholds make the autoscaler oscillate every step")
+        for name in ("retain_finished", "max_sessions"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 0:
+                raise ConfigError(
+                    f"router.{name} must be an int >= 0 (0 = unbounded), "
+                    f"got {v!r}")
+
+
+@dataclasses.dataclass
 class InferenceConfig:
     # shared
     dtype: str = "bfloat16"
@@ -158,6 +217,9 @@ class InferenceConfig:
     prefix_caching: bool = False
     # continuous-batching scheduler (inference/scheduler.py, engine_v2.step)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    # multi-replica serving front (serving/router.py: placement, sticky
+    # sessions, elastic drain/scale — ISSUE 7)
+    router: RouterConfig = dataclasses.field(default_factory=RouterConfig)
     # misc
     seed: int = 0
 
@@ -169,6 +231,10 @@ class InferenceConfig:
             self.serving = ServingConfig()
         elif isinstance(self.serving, dict):
             self.serving = ServingConfig(**self.serving)
+        if self.router is None:
+            self.router = RouterConfig()
+        elif isinstance(self.router, dict):
+            self.router = RouterConfig(**self.router)
         self.kv_cache_dtype = _normalize_kv_cache_dtype(self.kv_cache_dtype)
         if not isinstance(self.prefix_caching, bool):
             raise ConfigError(
@@ -244,6 +310,20 @@ class InferenceConfig:
         elif sv is not None and not isinstance(sv, ServingConfig):
             raise ConfigError(f"serving must be a dict or ServingConfig, "
                               f"got {type(sv).__name__}")
+        rt = d.get("router")
+        if rt is None:
+            d.pop("router", None)   # empty section -> defaults
+        elif isinstance(rt, dict):
+            allowed = {f.name for f in dataclasses.fields(RouterConfig)}
+            unknown = set(rt) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown router config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            d["router"] = RouterConfig(**rt)
+        elif not isinstance(rt, RouterConfig):
+            raise ConfigError(f"router must be a dict or RouterConfig, "
+                              f"got {type(rt).__name__}")
         known = {f.name for f in dataclasses.fields(cls)}
         ignored = {k: d.pop(k) for k in list(d) if k not in known}
         if ignored:

@@ -64,6 +64,52 @@ class SequenceDescriptor:
     no_commit: bool = False
 
 
+@dataclasses.dataclass
+class KVBlockPayload:
+    """One sequence's KV blocks in the POOL's own storage layout — the
+    disaggregated prefill→decode wire format (ISSUE 7). ``k``/``v`` are
+    [L, nb, KV, block, Dh] in the pool's storage dtype (bf16, or int8/fp8
+    raw bytes), ``k_scale``/``v_scale`` the matching [L, nb, KV, block]
+    f32 scale planes for quantized pools (None for bf16). Because the
+    payload is a straight gather of pool storage, a transfer is bit-exact
+    for bf16 and byte-exact (payload + scales) for quantized modes —
+    nothing is ever re-quantized on the wire."""
+
+    uid: int
+    tokens: List[int]
+    seen_tokens: int
+    last_logits: Optional[np.ndarray]
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: Optional[np.ndarray]
+    v_scale: Optional[np.ndarray]
+    kv_cache_dtype: str
+    block_size: int
+
+    def arrays(self) -> List[np.ndarray]:
+        """The device payload planes in wire order (data, then scales)."""
+        out = [self.k, self.v]
+        if self.k_scale is not None:
+            out += [self.k_scale, self.v_scale]
+        return out
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays())
+
+
+@dataclasses.dataclass
+class ImportReservation:
+    """Decode-side half of the disagg admission handshake: KV blocks
+    acquired (``begin_import``) before any payload bytes move, released
+    by ``abort_import`` or consumed by ``commit_import``."""
+
+    uid: int
+    blocks: List[int]
+    n_tokens: int
+    done: bool = False
+
+
 class InferenceEngineV2(InferenceEngine):
     """Paged continuous-batching engine.
 
@@ -1061,6 +1107,159 @@ class InferenceEngineV2(InferenceEngine):
             d.last_logits = last_logits[i]
             self._commit(d)
         return toks
+
+    # -- disaggregated prefill/decode: block export / import -----------
+    # (ISSUE 7: the PagedKVCache block IS the wire format — a prefill
+    # worker exports a finished sequence's blocks, the transfer substrate
+    # moves the bytes (serving/disagg.py stages them through the AIO
+    # pinned-buffer pool), and a decode worker imports them under an
+    # admission handshake: blocks are acquired BEFORE any payload bytes
+    # move, atomic-on-reject with _admission_detail-named errors.)
+
+    def export_kv_blocks(self, uid: int) -> "KVBlockPayload":
+        """Snapshot ``uid``'s written KV blocks + host state for a
+        disaggregated transfer. The payload arrays are the pool's OWN
+        storage layout ([L, nb, KV, block, Dh] data, [L, nb, KV, block]
+        scale planes for quantized pools) pulled to host — bf16 pools
+        round-trip bit-exactly, quantized pools byte-exactly (payload and
+        scales are copied, never re-quantized). The source sequence stays
+        live; the caller flushes it when the handoff is done."""
+        desc = self._seqs.get(uid)
+        if desc is None:
+            raise ValueError(f"unknown uid {uid}")
+        bs = self.cache.block_size
+        nb = blocks_needed(desc.seen_tokens, bs)
+        assert len(desc.blocks) >= nb, (uid, len(desc.blocks), nb)
+        idx = np.asarray(desc.blocks[:nb], np.int32)
+        kq, ksc = kv_parts((self.cache.k, self.cache.k_scale)
+                           if self.cache.quantized else self.cache.k)
+        vq, vsc = kv_parts((self.cache.v, self.cache.v_scale)
+                           if self.cache.quantized else self.cache.v)
+        return KVBlockPayload(
+            uid=uid,
+            tokens=list(desc.tokens),
+            seen_tokens=desc.seen_tokens,
+            last_logits=None if desc.last_logits is None
+            else np.asarray(desc.last_logits),
+            k=np.asarray(kq[:, idx]),
+            v=np.asarray(vq[:, idx]),
+            k_scale=None if ksc is None else np.asarray(ksc[:, idx]),
+            v_scale=None if vsc is None else np.asarray(vsc[:, idx]),
+            kv_cache_dtype=self.config.kv_cache_dtype,
+            block_size=bs,
+        )
+
+    def begin_import(self, uid: int, n_tokens: int) -> "ImportReservation":
+        """The admission half of the disagg handshake: acquire the KV
+        blocks a ``n_tokens``-token import needs BEFORE any payload bytes
+        move. Atomic-on-reject — a refused reservation mutates nothing,
+        and the error names needed-vs-free blocks via the same
+        ``_admission_detail`` discipline as put()/step(). The transfer
+        then either ``commit_import``s the payload into the reserved
+        blocks or ``abort_import``s to release them."""
+        if uid in self._seqs:
+            raise ValueError(f"uid {uid} is already live")
+        if n_tokens < 1:
+            raise ValueError(f"import of {n_tokens} tokens")
+        ok, _, why = self._admission_detail([uid], [n_tokens])
+        if not ok:
+            raise RuntimeError(f"cannot reserve KV import for uid {uid}: "
+                               f"{why}")
+        blocks = self.allocator.allocate(
+            blocks_needed(n_tokens, self.cache.block_size))
+        return ImportReservation(uid=uid, blocks=blocks,
+                                 n_tokens=int(n_tokens))
+
+    def abort_import(self, resv: "ImportReservation") -> None:
+        """Release a reservation's blocks (transfer failed or was vetoed).
+        Idempotent via the ``done`` flag so cleanup paths can call it
+        unconditionally."""
+        if not resv.done:
+            resv.done = True
+            self.allocator.free(resv.blocks)
+
+    def _import_fn(self, nb: int, quantized: bool):
+        key = ("import", nb, quantized)
+        fn = self._mixed_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        from ..utils.placement import cache_safe_donate_argnums
+
+        if quantized:
+            def impl(cache, idx, k, v, ks, vs):
+                return PagedKVCache(cache.k.at[:, idx].set(k),
+                                    cache.v.at[:, idx].set(v),
+                                    cache.k_scale.at[:, idx].set(ks),
+                                    cache.v_scale.at[:, idx].set(vs))
+        else:
+            def impl(cache, idx, k, v):
+                return PagedKVCache(cache.k.at[:, idx].set(k),
+                                    cache.v.at[:, idx].set(v))
+        # the pool is argument 0 here (no params operand), unlike the
+        # layer-scan programs where it rides at 1
+        fn = jax.jit(impl, donate_argnums=cache_safe_donate_argnums((0,)))
+        self._mixed_cache[key] = fn
+        return fn
+
+    def commit_import(self, resv: "ImportReservation",
+                      payload: "KVBlockPayload") -> None:
+        """Write a transferred payload into the reserved blocks and bring
+        the sequence live. Validates the wire format against THIS pool
+        (block size, kv_cache_dtype, per-block shape) before touching
+        device state — a mismatch raises with both sides named and the
+        reservation still held, so the caller's cleanup path aborts it.
+        The imported descriptor commits its full blocks to the prefix
+        registry like any locally-prefilled sequence would (disagg
+        requires identical weights fleet-wide for token parity, which is
+        exactly the prefix cache's validity condition)."""
+        if resv.done:
+            raise RuntimeError(f"reservation for uid {resv.uid} already "
+                               f"committed or aborted")
+        if resv.uid in self._seqs:
+            raise ValueError(f"uid {resv.uid} is already live")
+        if payload.block_size != self.cache.block_size:
+            raise ValueError(
+                f"wire-format mismatch: payload blocks are "
+                f"{payload.block_size} tokens, this pool's are "
+                f"{self.cache.block_size}")
+        if payload.kv_cache_dtype != self.config.kv_cache_dtype:
+            raise ValueError(
+                f"wire-format mismatch: payload kv_cache_dtype "
+                f"{payload.kv_cache_dtype!r}, this pool stores "
+                f"{self.config.kv_cache_dtype!r}")
+        if payload.seen_tokens != resv.n_tokens:
+            raise ValueError(
+                f"payload carries {payload.seen_tokens} tokens but the "
+                f"reservation was for {resv.n_tokens}")
+        nb = len(resv.blocks)
+        want = (self.cache.k.shape[0], nb) + self.cache.k.shape[2:]
+        if tuple(payload.k.shape) != want:
+            raise ValueError(
+                f"wire-format mismatch: payload k is "
+                f"{tuple(payload.k.shape)}, this pool expects {want}")
+        idx = np.asarray(resv.blocks, np.int32)
+        quantized = self.cache.quantized
+        fn = self._import_fn(nb, quantized)
+        if quantized:
+            self.cache = fn(self.cache, idx,
+                            payload.k.astype(self.cache.k.dtype),
+                            payload.v.astype(self.cache.v.dtype),
+                            payload.k_scale, payload.v_scale)
+        else:
+            self.cache = fn(self.cache, idx,
+                            payload.k.astype(self.cache.k.dtype),
+                            payload.v.astype(self.cache.v.dtype))
+        resv.done = True
+        desc = SequenceDescriptor(
+            uid=resv.uid, seen_tokens=payload.seen_tokens,
+            blocks=list(resv.blocks),
+            last_logits=None if payload.last_logits is None
+            else np.asarray(payload.last_logits),
+            tokens=list(payload.tokens))
+        self._seqs[resv.uid] = desc
+        self._commit(desc)
 
     def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None,
                        force: bool = False) -> bool:

@@ -95,11 +95,20 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine: InferenceEngineV2,
                  on_token: Optional[Callable[[int, int], None]] = None,
                  monitor: Optional[Monitor] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 replica_id: int = 0):
         if not isinstance(engine, InferenceEngineV2):
             raise TypeError("ContinuousBatchingScheduler needs the paged "
                             f"InferenceEngineV2, got {type(engine).__name__}")
         self.engine = engine
+        # machine-readable replica identity (ISSUE 7): the serving router
+        # runs N of these side by side and aggregates their stats() —
+        # every summary and admission error can then name which replica
+        # it talks about
+        self.replica_id = int(replica_id)
+        # a draining replica (SIGTERM'd, or scaled away) admits nothing
+        # new; its unfinished requests are exported for requeue elsewhere
+        self.draining = False
         self.cfg: ServingConfig = engine.config.serving
         self.queue: Deque[ServingRequest] = deque()  # FIFO; preempted at front
         self.active: List[ServingRequest] = []       # admission order
@@ -122,6 +131,10 @@ class ContinuousBatchingScheduler:
         """Queue one request; returns its uid. Validates against the
         engine's hard caps up front so impossible requests fail at submit
         time with named numbers, not mid-serve."""
+        if self.draining:
+            raise RuntimeError(
+                f"replica {self.replica_id} is draining and admits no new "
+                f"requests (route to a surviving replica)")
         prompt = list(map(int, prompt))
         if not prompt:
             raise ValueError("empty prompt")
@@ -131,13 +144,19 @@ class ContinuousBatchingScheduler:
         total = len(prompt) + max_new_tokens
         if total > eng.config.max_seq_len:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} = "
+                f"replica {self.replica_id}: prompt {len(prompt)} + "
+                f"max_new_tokens {max_new_tokens} = "
                 f"{total} exceeds max_seq_len {eng.config.max_seq_len}")
         usable = eng.allocator.num_blocks - 1  # block 0 is scratch
         need_max = blocks_needed(total, eng.cache.block_size)
         if need_max > usable:
+            # named numbers per replica (ISSUE 7 satellite): the router
+            # aggregates these verbatim when NO replica can ever take the
+            # request, so the fleet-level error still says which replica
+            # wanted how many blocks against how many it has
             raise ValueError(
-                f"request needs up to {need_max} KV blocks but the pool has "
+                f"replica {self.replica_id}: request needs up to {need_max} "
+                f"KV blocks but the pool has "
                 f"{usable} usable (num_kv_blocks={eng.allocator.num_blocks} "
                 f"minus scratch); raise num_kv_blocks or shorten the request")
         if uid is None:
@@ -340,6 +359,97 @@ class ContinuousBatchingScheduler:
         self._write_events(events)
         return bool(self.active or self.queue)
 
+    # -- elastic drain / requeue (ISSUE 7) ------------------------------
+
+    def export_requests(self) -> List[ServingRequest]:
+        """Stop admitting, preempt every admitted sequence, and hand back
+        ALL unfinished requests as requeue-able descriptors, oldest first.
+
+        The elastic-drain half of the scheduler contract: a SIGTERM'd (or
+        scaled-away) replica frees its whole KV pool here and the router
+        front-requeues the returned requests on surviving replicas — each
+        carries its generated continuation, so the replay elsewhere is
+        token-identical under greedy decoding (the same discipline as
+        ``_preempt``, applied fleet-wide). After this call the scheduler
+        refuses new submits (``draining``) and holds no requests: nothing
+        can be lost or served twice."""
+        self.draining = True
+        # active is admission order (oldest first); preempting frees KV and
+        # folds the continuation into each request's prefill target
+        exported: List[ServingRequest] = []
+        for r in list(self.active):
+            if r.uid in self.engine._seqs:
+                self.engine.flush([r.uid])
+            r.state = QUEUED
+            r.prefill_done = 0
+            r.preemptions += 1
+            self.preemptions += 1
+            exported.append(r)
+        exported.extend(self.queue)
+        self.active.clear()
+        self.queue.clear()
+        for r in exported:
+            self.requests.pop(r.uid, None)
+        self._write_events([
+            ("serving/drained_requests", len(exported), self.ticks),
+            ("serving/queue_depth", 0, self.ticks),
+        ])
+        if exported:
+            logger.info(
+                f"serving: replica {self.replica_id} drained — "
+                f"{len(exported)} unfinished requests exported for requeue")
+        return exported
+
+    def inject(self, r: ServingRequest, front: bool = True) -> None:
+        """Adopt a request exported from another replica, by default at the
+        FRONT of the queue (a drained request is older than anything queued
+        here — front placement preserves fleet-wide FIFO fairness). The
+        request's generated continuation rides along in its prefill target,
+        so serving resumes token-identically."""
+        if self.draining:
+            raise RuntimeError(
+                f"replica {self.replica_id} is draining and admits no new "
+                f"requests (route to a surviving replica)")
+        if r.uid in self.requests or r.uid in self.engine._seqs:
+            raise ValueError(f"uid {r.uid} is already live on replica "
+                             f"{self.replica_id}")
+        eng = self.engine
+        total = len(r.prompt) + r.max_new_tokens
+        if total > eng.config.max_seq_len:
+            raise ValueError(
+                f"replica {self.replica_id}: request {r.uid} needs "
+                f"{total} tokens but max_seq_len is "
+                f"{eng.config.max_seq_len}; route it to a bigger replica")
+        usable = eng.allocator.num_blocks - 1
+        need_max = blocks_needed(total, eng.cache.block_size)
+        if need_max > usable:
+            raise ValueError(
+                f"replica {self.replica_id}: request needs up to {need_max} "
+                f"KV blocks but the pool has {usable} usable; route it to a "
+                f"bigger replica")
+        r.state = QUEUED
+        r.prefill_done = 0
+        self.requests[r.uid] = r
+        if front:
+            self.queue.appendleft(r)
+        else:
+            self.queue.append(r)
+
+    def load(self) -> Dict[str, object]:
+        """Cheap placement snapshot for the router: queue depth, running
+        set, and KV-pool pressure, every tick-independent number the
+        placement score needs."""
+        eng = self.engine
+        usable = max(1, eng.allocator.num_blocks - 1)
+        return {
+            "replica_id": self.replica_id,
+            "queue_depth": len(self.queue),
+            "running": len(self.active),
+            "free_blocks": eng.free_blocks,
+            "kv_pressure": 1.0 - (eng.free_blocks / usable),
+            "draining": self.draining,
+        }
+
     # -- drivers --------------------------------------------------------
 
     def drain(self) -> None:
@@ -403,6 +513,10 @@ class ContinuousBatchingScheduler:
         eng = self.engine
         hit, miss = eng.prefix_hit_tokens, eng.prefix_miss_tokens
         return {
+            "replica_id": self.replica_id,
+            "queue_depth": len(self.queue),
+            "running": len(self.active),
+            "draining": self.draining,
             "requests": len(done),
             "generated_tokens": total,
             "sustained_tokens_per_sec": (total / span) if span > 0 else None,

@@ -1,9 +1,10 @@
 """Launcher: multi-host runner CLI + elastic supervision (reference
 ``launcher/`` + ``elasticity/elastic_agent.py``)."""
 
-from .elastic_agent import ElasticAgent, run_elastic
+from .elastic_agent import AutoscalePolicy, ElasticAgent, run_elastic
 from .runner import (build_commands, collect_env, filter_hosts, main,
                      parse_args, parse_hostfile)
 
-__all__ = ["ElasticAgent", "run_elastic", "build_commands", "collect_env",
-           "filter_hosts", "main", "parse_args", "parse_hostfile"]
+__all__ = ["AutoscalePolicy", "ElasticAgent", "run_elastic", "build_commands",
+           "collect_env", "filter_hosts", "main", "parse_args",
+           "parse_hostfile"]

@@ -88,6 +88,67 @@ class ElasticAgent:
                 time.sleep(delay)
 
 
+class AutoscalePolicy:
+    """Queue-depth-driven replica-count policy for the serving front
+    (ISSUE 7; the serving-side counterpart of the reference ElasticAgent's
+    scale-against-load loop, SURVEY §5.3).
+
+    ``desired(current, queue_depth_per_replica)`` returns the replica
+    count the fleet should run: above ``scale_up_queue_depth`` mean queued
+    requests per ACTIVE replica it grows by one, below
+    ``scale_down_queue_depth`` it shrinks by one, clamped to
+    [min_replicas, max_replicas]. ``patience`` consecutive observations on
+    the same side of a threshold are required before a move (hysteresis —
+    a Poisson burst should not thrash drain/spawn cycles, each of which
+    costs a full KV-pool requeue on the drained replica). The policy is
+    deliberately engine-agnostic: the router feeds it numbers and applies
+    its verdict (``serving/lifecycle.py``)."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 8,
+                 scale_up_queue_depth: float = 8.0,
+                 scale_down_queue_depth: float = 1.0,
+                 patience: int = 2):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}")
+        if scale_down_queue_depth >= scale_up_queue_depth:
+            raise ValueError(
+                f"scale_down_queue_depth ({scale_down_queue_depth}) must be "
+                f"below scale_up_queue_depth ({scale_up_queue_depth})")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.scale_up_queue_depth = scale_up_queue_depth
+        self.scale_down_queue_depth = scale_down_queue_depth
+        self.patience = patience
+        self._streak = 0          # +n consecutive over, -n consecutive under
+
+    @classmethod
+    def from_router_config(cls, rcfg, patience: int = 2) -> "AutoscalePolicy":
+        """Build from an ``inference.config.RouterConfig`` section."""
+        return cls(min_replicas=rcfg.min_replicas,
+                   max_replicas=rcfg.max_replicas,
+                   scale_up_queue_depth=rcfg.scale_up_queue_depth,
+                   scale_down_queue_depth=rcfg.scale_down_queue_depth,
+                   patience=patience)
+
+    def desired(self, current: int, queue_depth_per_replica: float) -> int:
+        if queue_depth_per_replica > self.scale_up_queue_depth:
+            self._streak = max(1, self._streak + 1)
+        elif queue_depth_per_replica < self.scale_down_queue_depth:
+            self._streak = min(-1, self._streak - 1)
+        else:
+            self._streak = 0
+        target = current
+        if self._streak >= self.patience:
+            target, self._streak = current + 1, 0
+        elif self._streak <= -self.patience:
+            target, self._streak = current - 1, 0
+        return max(self.min_replicas, min(self.max_replicas, target))
+
+
 def run_elastic(train_fn: Callable, max_restarts: int = 3, backoff_s: float = 2.0,
                 on_failure: Optional[Callable] = None, max_backoff_s: float = 60.0,
                 healthy_reset_s: Optional[float] = None, monitor=None):

@@ -1,7 +1,7 @@
 """Experiment monitoring fan-out (reference ``monitor/monitor.py:13,30``)."""
 
 from .monitor import (Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor,
-                      CSVMonitor, InMemoryMonitor)
+                      CSVMonitor, InMemoryMonitor, FleetMonitor)
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
-           "CSVMonitor", "InMemoryMonitor"]
+           "CSVMonitor", "InMemoryMonitor", "FleetMonitor"]

@@ -193,6 +193,101 @@ class InMemoryMonitor(Monitor):
         return [value for lbl, value, _ in self.events if lbl == label]
 
 
+class _ReplicaSink(Monitor):
+    """Per-replica adapter handed to each scheduler: prefixes every label
+    with ``replica{r}/`` and forwards into the fleet ring."""
+
+    def __init__(self, fleet: "FleetMonitor", replica_id: int):
+        super().__init__(enabled=True)
+        self._fleet = fleet
+        self._prefix = f"replica{replica_id}/"
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        self._fleet.write_events([(self._prefix + label, value, step)
+                                  for label, value, step in event_list])
+
+
+class FleetMonitor(Monitor):
+    """Fleet-aggregated sink for the multi-replica serving front (ISSUE 7).
+
+    Each replica's scheduler writes its ``serving/*`` counters through a
+    per-replica adapter (``sink(replica_id)``) that namespaces them
+    ``replica{r}/serving/...`` into one shared ring; ``aggregate()`` folds
+    the ring into fleet-level tails (p50/p95/p99 TTFT/TPOT across every
+    replica's recent window) plus per-replica queue depth, and
+    ``publish()`` writes those as ``fleet/*`` events to a downstream
+    ``MonitorMaster`` (or any ``write_events`` sink) — so a production
+    fleet's SLO numbers land in TensorBoard/W&B/CSV exactly like a single
+    engine's do."""
+
+    def __init__(self, downstream: "Monitor | None" = None,
+                 maxlen: int = 8192):
+        super().__init__(enabled=True)
+        import threading
+
+        self.memory_monitor = InMemoryMonitor(maxlen=maxlen)
+        self.downstream = downstream
+        self._replica_ids: set = set()
+        self._step = 0
+        # threaded fleets write from one tick thread per replica while
+        # aggregate()/publish() read — iterating the deque during an
+        # append raises RuntimeError, so both sides take this lock
+        self._mu = threading.Lock()
+
+    def sink(self, replica_id: int) -> Monitor:
+        self._replica_ids.add(int(replica_id))
+        return _ReplicaSink(self, int(replica_id))
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        with self._mu:
+            self.memory_monitor.write_events(event_list)
+
+    def aggregate(self) -> dict:
+        """Fleet tails over the retained window + per-replica queue depth."""
+        import numpy as np
+
+        with self._mu:
+            events = list(self.memory_monitor.events)
+
+        def pct(xs, q):
+            return float(np.percentile(xs, q)) if len(xs) else None
+
+        def fleet_values(suffix):
+            return [v for lbl, v, _ in events
+                    if lbl.endswith(suffix) and lbl.startswith("replica")]
+
+        ttft = fleet_values("serving/ttft_s")
+        tpot = fleet_values("serving/tpot_s")
+        out = {
+            "ttft_p50_s": pct(ttft, 50), "ttft_p95_s": pct(ttft, 95),
+            "ttft_p99_s": pct(ttft, 99),
+            "tpot_p50_s": pct(tpot, 50), "tpot_p95_s": pct(tpot, 95),
+            "tpot_p99_s": pct(tpot, 99),
+            "queue_depth": {}, "kv_free_blocks": {},
+        }
+        for r in sorted(self._replica_ids):
+            for key in ("queue_depth", "kv_free_blocks"):
+                label = f"replica{r}/serving/{key}"
+                vals = [v for lbl, v, _ in events if lbl == label]
+                if vals:
+                    out[key][r] = vals[-1]
+        return out
+
+    def publish(self, step: "int | None" = None) -> dict:
+        """Write the current aggregate downstream as ``fleet/*`` events;
+        returns the aggregate dict."""
+        agg = self.aggregate()
+        self._step = self._step + 1 if step is None else int(step)
+        events = [(f"fleet/{k}", v, self._step) for k, v in agg.items()
+                  if isinstance(v, (int, float)) and v is not None]
+        events += [(f"fleet/replica{r}/queue_depth", v, self._step)
+                   for r, v in agg["queue_depth"].items()]
+        if self.downstream is not None and events:
+            self.downstream.write_events(events)
+        self.write_events(events)
+        return agg
+
+
 class MonitorMaster(Monitor):
     """Fan-out to every enabled backend (reference monitor/monitor.py:30).
 

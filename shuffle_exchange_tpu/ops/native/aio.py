@@ -163,6 +163,7 @@ class PinnedBufferPool:
     def __init__(self):
         self._lib = load_native()
         self._ptrs: List[int] = []
+        self._staging: Dict[object, np.ndarray] = {}
 
     @property
     def native(self) -> bool:
@@ -182,9 +183,38 @@ class PinnedBufferPool:
         buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
         return np.frombuffer(buf, dtype=dtype).reshape(shape)
 
+    def staging(self, key, shape, dtype) -> np.ndarray:
+        """Keyed REUSABLE staging buffer: the first call under ``key``
+        allocates, later calls hand the same aligned buffer back as long
+        as (shape, dtype) still fit byte-wise (reshaped views of one
+        allocation — a serving process's repeated KV-block transfers of
+        one wire shape stage through one long-lived buffer instead of
+        allocating per transfer). A key whose byte size grows reallocates;
+        shrinking reuses a prefix view."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        buf = self._staging.get(key)
+        if buf is None or buf.nbytes < nbytes:
+            buf = self.empty((max(1, nbytes),), np.uint8)
+            self._staging[key] = buf
+        return buf[:nbytes].view(dtype).reshape(shape)
+
     def close(self) -> None:
         # Caller contract: no numpy views of the buffers outlive the pool.
+        self._staging.clear()
         if self._lib is not None:
             for ptr in self._ptrs:
                 self._lib.sxt_aligned_free(ptr)
         self._ptrs.clear()
+
+
+_DEFAULT_POOL: Optional[PinnedBufferPool] = None
+
+
+def get_buffer_pool() -> PinnedBufferPool:
+    """Process-wide shared pinned pool (the KV-transfer channel and the
+    host-offload pipeline stage through one allocator)."""
+    global _DEFAULT_POOL
+    if _DEFAULT_POOL is None:
+        _DEFAULT_POOL = PinnedBufferPool()
+    return _DEFAULT_POOL

@@ -365,12 +365,9 @@ class PipelinedModel:
         layer_ids = jnp.arange(self.config.n_layers, dtype=jnp.int32)
         if not self._even:
             # Uneven partition (partition_method="parameters"/"type:regex"
-            # or L % S != 0): gather each stage's rows into a padded
-            # [S * stage_size] stack (pad rows = zeros, masked to identity
-            # by stack_apply's layer_keep), so the manual region still
-            # shards an even dim over "pipe". The gather/scatter pair is
-            # O(params) data movement once per step — noise next to the
-            # stage compute.
+            # or L % S != 0): each stage runs a padded [stage_size] row
+            # block (pad rows = zeros, masked to identity by stack_apply's
+            # layer_keep), so the manual region still scans an even count.
             S_sz = self.stage_size
             pad_idx, keep = [], []
             L_total = self.config.n_layers
@@ -378,16 +375,43 @@ class PipelinedModel:
                 rows = list(range(self._bounds[s], self._bounds[s + 1]))
                 keep += [True] * len(rows) + [False] * (S_sz - len(rows))
                 pad_idx += rows + [L_total] * (S_sz - len(rows))
-            pad_idx = jnp.asarray(pad_idx, jnp.int32)
             keep_flags = jnp.asarray(keep)
-            layer_ids = pad_idx     # pad rows: id == n_layers -> flags off
+            layer_ids = jnp.asarray(pad_idx, jnp.int32)
+            # pad rows: id == n_layers -> per-layer flags off
+            if not flat:
+                # native shard_map (jax >= 0.5): gather the padded
+                # [S * stage_size] stack out here and shard it over
+                # "pipe" — each device holds only its stage's rows
+                def pad_stack(a):
+                    zero_row = jnp.zeros((1,) + a.shape[1:], a.dtype)
+                    return jnp.concatenate([a, zero_row])[layer_ids]
 
-            def pad_stack(a):
-                zero_row = jnp.zeros((1,) + a.shape[1:], a.dtype)
-                return jnp.concatenate([a, zero_row])[pad_idx]
-
-            layer_params = jax.tree_util.tree_map(pad_stack, layer_params)
-        layer_specs = jax.tree_util.tree_map(lambda _: P(self.axis_name), layer_params)
+                layer_params = jax.tree_util.tree_map(pad_stack,
+                                                      layer_params)
+        # jax 0.4.x only (the flat region): an in-graph concatenate+gather
+        # that PRODUCES a P("pipe") region operand is silently
+        # mis-partitioned when a live batch axis shares the flat manual
+        # region — wrong VALUES, no error (the even path is unaffected
+        # because its stacks enter the region ungathered). Ship the RAW
+        # [L] stacks replicated there instead and gather each stage's
+        # rows INSIDE the manual region, where layer_ids
+        # (P("pipe")-sharded) is this stage's local row map and the
+        # gather is a purely local op. Memory cost (full stack resident
+        # per pipe device) is confined to uneven-on-0.4.x.
+        uneven_replicated = (not self._even) and flat
+        layer_specs = jax.tree_util.tree_map(
+            lambda _: P() if uneven_replicated else P(self.axis_name),
+            layer_params)
+        if uneven_replicated:
+            # replicated float region inputs ride in at fp32 like
+            # other_params below (same convert-feeds-replicated-input
+            # partitioner hazard), re-cast inside the region
+            layer_dtypes = jax.tree_util.tree_map(
+                lambda v: v.dtype, layer_params)
+            layer_params = jax.tree_util.tree_map(
+                lambda v: (v.astype(jnp.float32)
+                           if jnp.issubdtype(v.dtype, jnp.floating) else v),
+                layer_params)
 
         # XLA's partial-manual partitioner CHECK-fails when a convert feeds a
         # replicated (P()) shard_map input whose cotangent must psum over the
@@ -403,6 +427,20 @@ class PipelinedModel:
                   inputs, labels):
             other_params = jax.tree_util.tree_map(
                 lambda v, d: v.astype(d), other_params, other_dtypes)
+            if uneven_replicated:
+                # this stage's padded row block, gathered locally from the
+                # replicated raw stacks (see the 0.4.x note above):
+                # layer_ids holds the stage's global row ids, n_layers
+                # selecting the appended zero (identity-masked) pad row
+                layer_params = jax.tree_util.tree_map(
+                    lambda v, d: v.astype(d), layer_params, layer_dtypes)
+
+                def gather_stage(a):
+                    zero_row = jnp.zeros((1,) + a.shape[1:], a.dtype)
+                    return jnp.concatenate([a, zero_row])[layer_ids]
+
+                layer_params = jax.tree_util.tree_map(gather_stage,
+                                                      layer_params)
             # this device's stage number, threaded as a P("pipe")-sharded
             # operand (see spmd_pipeline: axis_index lowers to PartitionId,
             # which jax 0.4.x rejects under partial-manual)

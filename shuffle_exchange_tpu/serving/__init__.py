@@ -1,0 +1,28 @@
+"""Multi-host serving front (ISSUE 7): replica router, disaggregated
+prefill/decode, elastic drain/scale.
+
+The fleet layer over the paged serving stack — ``router.py`` places
+requests across N engine+scheduler replicas (KV-pressure + prefix-affinity
+placement, sticky sessions, the Poisson-trace ``serve`` contract),
+``disagg.py`` streams finished KV blocks from prefill workers to decode
+workers (the ``PagedKVCache`` block is the wire format, staged through the
+AIO pinned-buffer pool under an atomic admission handshake), and
+``lifecycle.py`` wires SIGTERM to drain-and-requeue and the queue-depth
+autoscaler to the fleet (``launcher.elastic_agent.AutoscalePolicy``).
+"""
+
+from .disagg import DisaggregatedServer, KVTransferChannel
+from .lifecycle import (ElasticServingSupervisor, install_sigterm_drain,
+                        uninstall_sigterm_drain)
+from .router import Replica, ReplicaRouter, fleet_commands
+
+__all__ = [
+    "DisaggregatedServer",
+    "KVTransferChannel",
+    "ElasticServingSupervisor",
+    "install_sigterm_drain",
+    "uninstall_sigterm_drain",
+    "Replica",
+    "ReplicaRouter",
+    "fleet_commands",
+]

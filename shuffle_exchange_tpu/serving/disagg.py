@@ -1,0 +1,314 @@
+"""Disaggregated prefill/decode: stream finished KV blocks between workers.
+
+Prefill-induced TPOT spikes are the mixed-batch scheduler's one remaining
+latency tax: a tick that absorbs a long prompt chunk stretches every
+running sequence's token interval. The Splitwise/DistServe answer is phase
+disaggregation — dedicated PREFILL workers chew prompts (chunked, at high
+budget fill) and ship the finished KV to DECODE workers whose ticks then
+contain nothing but decode tokens.
+
+The transfer substrate is deliberately boring: the ``PagedKVCache`` block
+is already the wire format (``engine_v2.export_kv_blocks`` gathers pool
+storage verbatim — int8/fp8 scale planes included), the bytes stage
+through the AIO pinned-buffer pool (``ops/native/aio.PinnedBufferPool``,
+the reference's DeepNVMe substrate, SURVEY §2.13 — aligned, long-lived,
+O_DIRECT-capable buffers reused across transfers), and an optional
+file-backed spill path rides the ``AsyncIOEngine`` for cross-host moves.
+
+Correctness contract (tests/test_disagg.py + dryrun config 11):
+
+  - **Admission handshake**: the decode side RESERVES its blocks
+    (``begin_import``) before a single payload byte moves —
+    atomic-on-reject with ``_admission_detail``-named errors. A transfer
+    that dies mid-flight (``kv_transfer`` fault site) aborts the
+    reservation; the decode engine is left byte-identically clean.
+  - **Bit-exactness**: bf16 pools round-trip bit-exactly; quantized pools
+    byte-exactly (payload + scales copied, never re-quantized).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..inference.engine_v2 import (ImportReservation, InferenceEngineV2,
+                                   KVBlockPayload)
+from ..monitor.monitor import InMemoryMonitor, Monitor
+from ..testing import faults
+from ..utils.logging import logger
+
+
+class KVTransferChannel:
+    """Moves ``KVBlockPayload``s between engines through pinned staging.
+
+    ``send``/``recv`` are split so a real deployment can put a fabric
+    between them; in-process they hand over the SAME staged buffers. With
+    ``spill_dir`` set, ``send`` writes the staged bytes through the
+    ``AsyncIOEngine`` (one file per transfer) and ``recv`` reads them back
+    — the cross-host wire at its simplest, and the fault-injection point
+    for torn transfers. Counters ride the ``kv_transfer/*`` group."""
+
+    _next_channel_id = itertools.count()
+
+    def __init__(self, spill_dir: Optional[str] = None,
+                 monitor: Optional[Monitor] = None,
+                 clock=time.perf_counter):
+        from ..ops.native.aio import get_buffer_pool
+
+        self.pool = get_buffer_pool()
+        # the pool is process-wide, so staging keys must carry a channel
+        # identity: two channels (split send/recv deployments, or two
+        # DisaggregatedServers) staging the same wire shape must never
+        # share a buffer
+        self._chan = next(KVTransferChannel._next_channel_id)
+        self._mu = threading.Lock()
+        self.spill_dir = spill_dir
+        self.clock = clock
+        self.memory_monitor = InMemoryMonitor(maxlen=1024)
+        self._sinks: List[Monitor] = [monitor] if monitor is not None else []
+        self.transfers = 0
+        self.rejects = 0
+        self.bytes_moved = 0
+        self.blocks_moved = 0
+        self._inflight: Dict[int, Tuple[KVBlockPayload, List[np.ndarray],
+                                        Optional[str], int]] = {}
+        self._ticket = 0
+        # staging-slot ids held by in-flight transfers: two concurrent
+        # sends of the SAME wire shape must not share a buffer (the
+        # second would overwrite the first's staged bytes), while the
+        # steady-state one-at-a-time case keeps reusing slot 0's
+        # long-lived allocations
+        self._slots_in_use: set = set()
+
+    def _alloc_slot(self) -> int:
+        slot = 0
+        while slot in self._slots_in_use:
+            slot += 1
+        self._slots_in_use.add(slot)
+        return slot
+
+    def _emit(self, events) -> None:
+        self.memory_monitor.write_events(events)
+        for s in self._sinks:
+            s.write_events(events)
+
+    def send(self, payload: KVBlockPayload) -> int:
+        """Stage a payload for transfer; returns a ticket for ``recv``.
+        The staging buffers are keyed by (channel, slot, plane): the slot
+        is per-in-flight-transfer, so a serving process's steady-state
+        (sequential) transfers reuse one set of pinned allocations —
+        resized in place by ``staging()`` as wire shapes vary — while
+        concurrent sends (and other channels sharing the process pool)
+        get disjoint buffers."""
+        with self._mu:
+            slot = self._alloc_slot()
+            self._ticket += 1
+            ticket = self._ticket
+        staged: List[np.ndarray] = []
+        for i, arr in enumerate(payload.arrays()):
+            buf = self.pool.staging(("kv_transfer", self._chan, slot, i),
+                                    arr.shape, arr.dtype)
+            np.copyto(buf, arr)
+            staged.append(buf)
+        path = None
+        if self.spill_dir is not None:
+            import os
+
+            from ..ops.native.aio import get_io_engine
+
+            path = os.path.join(self.spill_dir,
+                                f"kv_transfer_{self._chan}_{ticket}.bin")
+            io = get_io_engine()
+            off = 0
+            reqs = []
+            for buf in staged:
+                reqs.append(io.submit_write(path, buf, offset=off))
+                off += buf.nbytes
+            for r in reqs:
+                io.wait(r)
+        with self._mu:
+            self._inflight[ticket] = (payload, staged, path, slot)
+        return ticket
+
+    def recv(self, ticket: int) -> KVBlockPayload:
+        """Take delivery of a staged transfer. File-spilled transfers are
+        read back through the AIO engine into the pinned buffers (and the
+        spill file deleted), so the received payload is the byte-identical
+        wire content either way."""
+        with self._mu:
+            payload, staged, path, slot = self._inflight.pop(ticket)
+        if path is not None:
+            from ..ops.native.aio import get_io_engine
+
+            io = get_io_engine()
+            off = 0
+            reqs = []
+            for buf in staged:
+                reqs.append(io.submit_read(path, buf, offset=off))
+                off += buf.nbytes
+            for r in reqs:
+                io.wait(r)
+            self._unlink(path)
+        arrays = [np.array(b) for b in staged]   # own the bytes past reuse
+        with self._mu:
+            self._slots_in_use.discard(slot)
+        scales = arrays[2:] if payload.k_scale is not None else [None, None]
+        return dataclasses.replace(payload, k=arrays[0], v=arrays[1],
+                                   k_scale=scales[0], v_scale=scales[1])
+
+    @staticmethod
+    def _unlink(path: str) -> None:
+        import os
+
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def cancel(self, ticket: int) -> None:
+        """Drop a staged transfer that will never be received: releases
+        its staging slot, forgets the payload copy, and deletes any spill
+        file. Safe to call for unknown/already-delivered tickets (the
+        failed-transfer cleanup path calls it unconditionally)."""
+        with self._mu:
+            entry = self._inflight.pop(ticket, None)
+            if entry is None:
+                return
+            _, _, path, slot = entry
+            self._slots_in_use.discard(slot)
+        if path is not None:
+            self._unlink(path)
+
+    def transfer(self, src: InferenceEngineV2, dst: InferenceEngineV2,
+                 uid: int, dst_uid: Optional[int] = None,
+                 flush_src: bool = True) -> int:
+        """One complete prefill→decode handoff for ``uid``:
+
+        1. decode side reserves blocks (``begin_import`` — admission
+           BEFORE bytes move; a reject raises here, nothing staged);
+        2. prefill side exports + stages the payload through the pinned
+           pool (and the spill file, when configured);
+        3. decode side commits the payload into its reserved blocks;
+        4. prefill side flushes the sequence (unless ``flush_src=False``).
+
+        Any failure after the reservation aborts it — the decode engine
+        holds no descriptor and no blocks (the ``kv_transfer`` fault site
+        drills exactly this). Returns the decode-side uid."""
+        dst_uid = uid if dst_uid is None else dst_uid
+        desc = src._seqs.get(uid)
+        if desc is None:
+            raise ValueError(f"unknown uid {uid} on the prefill engine")
+        t0 = self.clock()
+        try:
+            resv = dst.begin_import(dst_uid, desc.seen_tokens)
+        except RuntimeError:
+            self.rejects += 1
+            self._emit([("kv_transfer/rejects", self.rejects,
+                         self.transfers)])
+            raise
+        ticket = None
+        try:
+            faults.maybe_crash("kv_transfer", 0)
+            payload = src.export_kv_blocks(uid)
+            ticket = self.send(payload)
+            faults.maybe_crash("kv_transfer", 1)
+            wire = self.recv(ticket)
+            wire = dataclasses.replace(wire, uid=dst_uid)
+            dst.commit_import(resv, wire)
+        except BaseException:
+            dst.abort_import(resv)
+            if ticket is not None:
+                self.cancel(ticket)   # undelivered: free slot + spill file
+            raise
+        if flush_src:
+            src.flush([uid])
+        self.transfers += 1
+        self.bytes_moved += payload.nbytes
+        self.blocks_moved += len(resv.blocks)
+        self._emit([
+            ("kv_transfer/transfers", self.transfers, self.transfers),
+            ("kv_transfer/blocks", self.blocks_moved, self.transfers),
+            ("kv_transfer/bytes", self.bytes_moved, self.transfers),
+            ("kv_transfer/transfer_s", self.clock() - t0, self.transfers),
+        ])
+        return dst_uid
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "transfers": self.transfers,
+            "rejects": self.rejects,
+            "blocks": self.blocks_moved,
+            "bytes": self.bytes_moved,
+            "pinned_staging": self.pool.native,
+            "spill_dir": self.spill_dir,
+        }
+
+
+class DisaggregatedServer:
+    """Prefill workers + decode workers behind one ``serve`` front.
+
+    Each request runs CHUNKED prefill on a prefill engine (the scheduler's
+    chunk ladder, so the prefill worker's programs are the same shape-
+    binned set a mixed server compiles), hands its KV to a decode engine
+    through the channel, and greedy-decodes there. Decode ticks never
+    contain prefill work — the TPOT isolation that motivates
+    disaggregation — and the transfer is the only added step.
+
+    Greedy token parity with a single engine running the same chunk
+    schedule is exact (bf16): the decode side attends the byte-identical
+    pool content. tests/test_disagg.py pins it."""
+
+    def __init__(self, prefill_engine: InferenceEngineV2,
+                 decode_engine: InferenceEngineV2,
+                 channel: Optional[KVTransferChannel] = None):
+        if prefill_engine is decode_engine:
+            raise ValueError("prefill and decode must be distinct engines")
+        self.prefill = prefill_engine
+        self.decode = decode_engine
+        self.channel = channel or KVTransferChannel()
+        self._next_uid = 0
+
+    def prefill_chunked(self, uid: int, prompt: Sequence[int]) -> None:
+        """Run one prompt through the prefill engine in scheduler-ladder
+        chunks (every chunk one ``step()`` dispatch)."""
+        sv = self.prefill.config.serving
+        prompt = list(map(int, prompt))
+        pos = 0
+        while pos < len(prompt):
+            chunk = prompt[pos:pos + sv.token_budget]
+            self.prefill.step([], [], [(uid, chunk)])
+            pos += len(chunk)
+
+    def serve_one(self, prompt: Sequence[int],
+                  max_new_tokens: int = 32) -> List[int]:
+        """Prefill → transfer → decode for one request; returns its
+        greedy-decoded tokens."""
+        uid = self._next_uid
+        self._next_uid += 1
+        self.prefill_chunked(uid, prompt)
+        self.channel.transfer(self.prefill, self.decode, uid)
+        desc = self.decode._seqs[uid]
+        first = int(np.argmax(desc.last_logits))
+        out = [first]
+        if max_new_tokens > 1:
+            toks = self.decode.decode_loop([uid], [first],
+                                           max_new_tokens - 1)
+            out += [int(t) for t in toks[0]]
+        self.decode.flush([uid])
+        return out
+
+    def serve(self, prompts: Sequence[Sequence[int]],
+              max_new_tokens: int = 32) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {}
+        for p in prompts:
+            uid = self._next_uid
+            out[uid] = self.serve_one(p, max_new_tokens=max_new_tokens)
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        return {"channel": self.channel.stats()}

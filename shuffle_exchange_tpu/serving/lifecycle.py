@@ -1,0 +1,139 @@
+"""Elastic serving lifecycle: SIGTERM drain + queue-depth autoscale.
+
+The resilience layer's production story, applied to serving (ISSUE 7):
+preemptible hosts get SIGTERM ahead of reclaim (runtime/resilience.py
+handles the TRAINING side with a final synchronous save); a serving
+replica's equivalent of "save and exit" is **drain** — stop admitting,
+preempt running sequences, and front-requeue every unfinished request on
+surviving replicas. Token-identical replay is the scheduler's existing
+preemption contract, so a reclaimed replica costs queue time, never
+output fidelity (tests/test_serving_router.py drills zero lost requests).
+
+Scaling the other way, ``ElasticServingSupervisor`` periodically feeds the
+router's queue depth to a ``launcher.elastic_agent.AutoscalePolicy`` (the
+serving counterpart of the reference ElasticAgent's scale-against-load
+loop, SURVEY §5.3) and applies the verdict through ``router.scale_to`` —
+growth spawns replicas from the router's engine factory, shrink drains the
+newest replica back onto the fleet.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+from ..launcher.elastic_agent import AutoscalePolicy
+from ..utils.logging import logger
+from .router import ReplicaRouter
+
+_DRAIN_HOOKS = {}   # replica_id -> router (module-level for the handler)
+_PREV_HANDLER = None
+_INSTALLED = False
+
+
+def _sigterm_handler(signum, frame):
+    hooks = dict(_DRAIN_HOOKS)
+    _DRAIN_HOOKS.clear()
+    for replica_id, router in hooks.items():
+        # only RECORD the drain — the handler runs on the main thread
+        # mid-bytecode, where mutating router state directly could
+        # interleave with a half-finished submit()/scale_to() frame
+        # underneath it (the reentrant lock would let it through). The
+        # router applies pending drains at its next tick().
+        router.request_drain(replica_id)
+        logger.warning(
+            f"SIGTERM: drain of replica {replica_id} requested "
+            f"(applied at the next tick)")
+    if callable(_PREV_HANDLER):
+        _PREV_HANDLER(signum, frame)
+
+
+def install_sigterm_drain(router: ReplicaRouter, replica_id: int) -> bool:
+    """Arrange for SIGTERM to drain ``replica_id`` through ``router``
+    (requests requeue on survivors; the process keeps serving them). The
+    handler records the request; the router applies it at its next
+    ``tick()``. Chains any previously-installed handler — the training
+    preemption hook (runtime/resilience.py) and this one compose. Returns
+    False when not callable from this thread (signal.signal is
+    main-thread-only)."""
+    global _PREV_HANDLER, _INSTALLED
+    if threading.current_thread() is not threading.main_thread():
+        logger.warning("install_sigterm_drain: not on the main thread; "
+                       "call router.drain() from your own handler instead")
+        return False
+    _DRAIN_HOOKS[replica_id] = router
+    if not _INSTALLED:
+        _PREV_HANDLER = signal.signal(signal.SIGTERM, _sigterm_handler)
+        _INSTALLED = True
+    return True
+
+
+def uninstall_sigterm_drain() -> None:
+    """Remove the drain hook and restore the previous SIGTERM handler
+    (test hygiene; safe to call when nothing is installed). Off the main
+    thread only the hooks are cleared — the handler stays installed (a
+    no-op with no hooks) and the bookkeeping stays TRUE, so a later
+    ``install_sigterm_drain`` cannot re-capture our own handler as the
+    "previous" one and make SIGTERM recurse."""
+    global _PREV_HANDLER, _INSTALLED
+    _DRAIN_HOOKS.clear()
+    if not _INSTALLED:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return
+    signal.signal(signal.SIGTERM, _PREV_HANDLER or signal.SIG_DFL)
+    _PREV_HANDLER = None
+    _INSTALLED = False
+
+
+class ElasticServingSupervisor:
+    """Drive a router's replica count against its queue depth.
+
+    ``step()`` makes one autoscale observation (call it on your serving
+    loop's cadence — every tick is fine, the policy's patience hysteresis
+    debounces); ``run_background(interval_s)`` runs the observations on a
+    daemon thread for threaded fleets. The policy defaults to the router
+    config's bounds (``router.min_replicas`` .. ``max_replicas``,
+    thresholds ``scale_up/down_queue_depth``)."""
+
+    def __init__(self, router: ReplicaRouter,
+                 policy: Optional[AutoscalePolicy] = None):
+        self.router = router
+        self.policy = policy or AutoscalePolicy.from_router_config(
+            router.rcfg)
+        self.scale_events = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self) -> int:
+        before = len(self.router.active_replicas)
+        after = self.router.autoscale_step(self.policy)
+        if after != before:
+            self.scale_events += 1
+            self.router.fleet.write_events([
+                ("fleet/scale_events", self.scale_events, self.scale_events),
+                ("fleet/active_replicas", after, self.scale_events)])
+        return after
+
+    def run_background(self, interval_s: float = 1.0) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    logger.exception("autoscale step failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

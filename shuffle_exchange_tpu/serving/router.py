@@ -1,0 +1,528 @@
+"""Replica router: N engine+scheduler replicas behind one serving front.
+
+One engine on one mesh cannot serve the north star's "heavy traffic from
+millions of users": the reference runs N ranks behind the launcher's
+hostfile fan-out (SURVEY §1) and scales workers against load with its
+ElasticAgent (§5.3). This module is that fleet layer for the paged serving
+stack — each replica is an ``InferenceEngineV2`` +
+``ContinuousBatchingScheduler`` pair, and the router:
+
+  - **places** every incoming request by per-replica KV-block pressure and
+    queue depth, prefix-cache-aware: with ``prefix_caching`` on, the
+    replica whose content registry already holds the prompt's block-key
+    chain (``engine.prefix_peek``) wins the tiebreak, so shared system
+    prompts keep landing where their KV lives;
+  - **pins sticky sessions**: a ``session_id``'s later turns return to the
+    replica already holding that conversation's blocks (the multi-turn
+    prefix-cache win), until that replica drains;
+  - **preserves the bench contract**: ``serve(requests, arrivals=...)`` is
+    the same Poisson-trace front the single-engine scheduler exposes, so
+    bench rows compare 1-replica and N-replica fleets on identical traces;
+  - **drains elastically**: ``drain(replica_id)`` stops admission on one
+    replica, preempts its running sequences, and front-requeues every
+    unfinished request on the surviving replicas — token-identical replay
+    is the scheduler's existing preemption contract, applied fleet-wide
+    (``serving/lifecycle.py`` wires this to SIGTERM and the autoscaler).
+
+On the driver box replicas are in-process (cooperative ticking, or one
+thread each via ``start()``/``stop()``); a real multi-host fleet launches
+one serving worker per host through the launcher's hostfile machinery
+(``fleet_commands`` below reuses ``launcher/runner.py`` parsing — SURVEY
+§1's ``deepspeed`` runner shape).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..inference.config import RouterConfig
+from ..inference.engine_v2 import InferenceEngineV2
+from ..inference.scheduler import ContinuousBatchingScheduler, ServingRequest
+from ..monitor.monitor import FleetMonitor, Monitor
+from ..utils.logging import logger
+
+ACTIVE, DRAINING, STOPPED = "active", "draining", "stopped"
+
+
+class Replica:
+    """One serving replica: engine + scheduler + lifecycle state."""
+
+    def __init__(self, replica_id: int, engine: InferenceEngineV2,
+                 scheduler: ContinuousBatchingScheduler):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.scheduler = scheduler
+        self.state = ACTIVE
+        self.thread: Optional[threading.Thread] = None
+        # guards this replica's scheduler (tick vs submit/inject/export):
+        # per-replica so N threaded replicas tick CONCURRENTLY — the
+        # router-wide lock covers only membership/placement bookkeeping
+        self.lock = threading.RLock()
+
+    @property
+    def active(self) -> bool:
+        return self.state == ACTIVE
+
+
+class ReplicaRouter:
+    """Place requests across replicas; tick them; aggregate their stats.
+
+    ``engines``: the replica engines (same model+weights — token-identical
+    routing requires it). ``engine_factory`` (optional) builds additional
+    engines for scale-up. ``monitor``: a downstream sink (e.g.
+    ``MonitorMaster``) for the fleet-aggregated ``fleet/*`` events.
+    """
+
+    def __init__(self, engines: Sequence[InferenceEngineV2],
+                 engine_factory: Optional[Callable[[], InferenceEngineV2]] = None,
+                 monitor: Optional[Monitor] = None,
+                 on_token: Optional[Callable[[int, int], None]] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        if not engines:
+            raise ValueError("ReplicaRouter needs at least one engine")
+        self.rcfg: RouterConfig = engines[0].config.router
+        self.engine_factory = engine_factory
+        self.clock = clock
+        self.on_token = on_token
+        self.fleet = FleetMonitor(downstream=monitor)
+        self.replicas: List[Replica] = []
+        self.requests: Dict[int, ServingRequest] = {}   # uid -> live object
+        self.owner: Dict[int, int] = {}                 # uid -> replica_id
+        self.sessions: Dict[object, int] = {}           # session -> replica_id
+        self._session_of: Dict[int, object] = {}        # uid -> session
+        self._next_uid = 0
+        self._stop = threading.Event()
+        self._lock = threading.RLock()
+        # replica ids whose drain was REQUESTED from a signal handler
+        # (serving/lifecycle.py): the handler only records the id — a
+        # handler that mutated router state directly could interleave
+        # with a half-finished submit()/scale_to() on the same thread
+        # through the reentrant lock. Consumed at the next tick().
+        self._pending_drains: set = set()
+        self.drains = 0
+        self.requeued = 0
+        for eng in engines:
+            self._add_replica(eng)
+
+    # -- fleet membership ----------------------------------------------
+
+    def _add_replica(self, engine: InferenceEngineV2) -> Replica:
+        rid = len(self.replicas)
+        sched = ContinuousBatchingScheduler(
+            engine, on_token=self._emit_token, clock=self.clock,
+            monitor=self.fleet.sink(rid), replica_id=rid)
+        rep = Replica(rid, engine, sched)
+        self.replicas.append(rep)
+        return rep
+
+    def _emit_token(self, uid: int, tok: int) -> None:
+        if self.on_token is not None:
+            self.on_token(uid, tok)
+
+    @property
+    def active_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.active]
+
+    # -- placement ------------------------------------------------------
+
+    def _score(self, rep: Replica, prompt: Sequence[int]) -> float:
+        """Placement score (higher wins): prefix-cache affinity minus
+        queue-depth and KV-pressure penalties, per the router config's
+        weights. Deterministic, so placement decisions are testable."""
+        cfg = self.rcfg
+        load = rep.scheduler.load()
+        score = 0.0
+        if cfg.prefix_affinity and rep.engine.config.prefix_caching:
+            hit, _, _ = rep.engine.prefix_peek(list(prompt))
+            score += cfg.prefix_affinity_weight * (hit / max(1, len(prompt)))
+        max_running = rep.engine.config.serving.max_running
+        score -= cfg.queue_depth_weight * (
+            (load["queue_depth"] + load["running"]) / max(1, max_running))
+        score -= cfg.kv_pressure_weight * load["kv_pressure"]
+        return score
+
+    def place(self, prompt: Sequence[int],
+              session_id: Optional[object] = None) -> Replica:
+        """Pick the replica a request should land on (no mutation)."""
+        cfg = self.rcfg
+        candidates = self.active_replicas
+        if not candidates:
+            raise RuntimeError("no ACTIVE replicas (all drained/stopped)")
+        if cfg.sticky_sessions and session_id is not None:
+            rid = self.sessions.get(session_id)
+            if rid is not None and self.replicas[rid].active:
+                return self.replicas[rid]
+        # stable max: ties go to the lowest replica id
+        return max(candidates, key=lambda r: (self._score(r, prompt),
+                                              -r.replica_id))
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               session_id: Optional[object] = None) -> int:
+        """Route one request; returns its fleet-global uid. When NO active
+        replica can ever take the request, the error aggregates every
+        replica's own needed-vs-free numbers (the ``_admission_detail``
+        discipline carried across the fleet boundary)."""
+        with self._lock:
+            rep = self.place(prompt, session_id=session_id)
+            uid = self._next_uid
+            self._next_uid += 1
+            try:
+                with rep.lock:
+                    rep.scheduler.submit(prompt,
+                                         max_new_tokens=max_new_tokens,
+                                         uid=uid)
+            except ValueError as first_err:
+                # the chosen replica can never take it — try the rest and
+                # aggregate every refusal with its numbers (satellite:
+                # admission errors name the replica considered)
+                reasons = [str(first_err)]
+                for other in self.active_replicas:
+                    if other is rep:
+                        continue
+                    try:
+                        with other.lock:
+                            other.scheduler.submit(
+                                prompt, max_new_tokens=max_new_tokens,
+                                uid=uid)
+                        rep = other
+                        break
+                    except ValueError as e:
+                        reasons.append(str(e))
+                else:
+                    raise ValueError(
+                        "no replica can admit the request — "
+                        + "; ".join(reasons)) from first_err
+            self.requests[uid] = rep.scheduler.requests[uid]
+            self.owner[uid] = rep.replica_id
+            if session_id is not None:
+                # delete-then-set keeps the dict in recency order, so the
+                # bound below evicts the LEAST-recently-pinned session
+                self.sessions.pop(session_id, None)
+                self.sessions[session_id] = rep.replica_id
+                self._session_of[uid] = session_id
+            self._evict_finished()
+            return uid
+
+    def _evict_finished(self) -> None:
+        """Long-lived-process bounds (router config): drop the oldest
+        FINISHED requests past ``retain_finished`` (their results have
+        had the whole window to be picked up; keep the cap above any
+        ``serve()`` batch size) and the least-recently-pinned sessions
+        past ``max_sessions``. Live requests are never evicted."""
+        cap = self.rcfg.retain_finished
+        if cap and len(self.requests) > cap:
+            excess = len(self.requests) - cap
+            done = [u for u, r in self.requests.items()
+                    if r.state == "finished"][:excess]
+            for u in done:
+                del self.requests[u]
+                self.owner.pop(u, None)
+                self._session_of.pop(u, None)
+        scap = self.rcfg.max_sessions
+        while scap and len(self.sessions) > scap:
+            self.sessions.pop(next(iter(self.sessions)))
+
+    # -- ticking --------------------------------------------------------
+
+    def tick(self) -> bool:
+        """Tick every non-stopped replica once (round-robin); True while
+        any replica holds work. Signal-requested drains (SIGTERM hook)
+        are applied here, at a point where no router mutation is half
+        done."""
+        self._process_pending_drains()
+        busy = False
+        for rep in list(self.replicas):
+            if rep.state == STOPPED:
+                continue
+            with rep.lock:
+                if rep.state != STOPPED:
+                    busy = rep.scheduler.tick() or busy
+        return busy
+
+    def request_drain(self, replica_id: int) -> None:
+        """Record a drain request to apply at the next tick. The ONLY
+        router entry point that is safe from a signal handler: a handler
+        runs on the main thread mid-bytecode, where the reentrant lock
+        would let a direct drain() interleave with a half-finished
+        submit()/scale_to() frame underneath it."""
+        self._pending_drains.add(int(replica_id))
+
+    def _process_pending_drains(self) -> None:
+        if not self._pending_drains:
+            return
+        with self._lock:
+            pending, self._pending_drains = self._pending_drains, set()
+        for rid in sorted(pending):
+            try:
+                n = self.drain(rid)
+                logger.warning(f"requested drain: replica {rid} drained, "
+                               f"{n} requests requeued on survivors")
+            except Exception:
+                logger.exception(f"requested drain of replica {rid} failed")
+
+    def serve(self, requests: Sequence[Union[Sequence[int],
+                                             Tuple[Sequence[int], int]]],
+              max_new_tokens: int = 32,
+              arrivals: Optional[Sequence[float]] = None,
+              session_ids: Optional[Sequence[object]] = None
+              ) -> Dict[int, List[int]]:
+        """Serve a batch to completion across the fleet — the scheduler's
+        Poisson-trace ``serve`` contract, routed. Returns ``{uid: tokens}``
+        in submission order. Results survive mid-serve drains: the router
+        tracks the live ``ServingRequest`` objects, wherever they run."""
+        items = []
+        for req in requests:
+            if (isinstance(req, tuple) and len(req) == 2
+                    and not isinstance(req[1], (list, np.ndarray))):
+                items.append((list(req[0]), int(req[1])))
+            else:
+                items.append((list(req), int(max_new_tokens)))
+        if arrivals is not None and len(arrivals) != len(items):
+            raise ValueError("arrivals must align with requests")
+        if session_ids is not None and len(session_ids) != len(items):
+            raise ValueError("session_ids must align with requests")
+        pending = deque(enumerate(items))
+        t0 = self.clock()
+        uids: List[int] = []
+        while pending or any(r.scheduler.active or r.scheduler.queue
+                             for r in self.replicas if r.state != STOPPED):
+            while pending and (arrivals is None
+                               or self.clock() - t0 >= arrivals[pending[0][0]]):
+                i, (prompt, mn) = pending.popleft()
+                sid = session_ids[i] if session_ids is not None else None
+                uids.append(self.submit(prompt, max_new_tokens=mn,
+                                        session_id=sid))
+            if not self.tick() and pending and arrivals is not None:
+                wait = arrivals[pending[0][0]] - (self.clock() - t0)
+                if wait > 0:
+                    time.sleep(wait)
+        return {uid: self.requests[uid].generated for uid in uids}
+
+    # -- threaded drivers ----------------------------------------------
+
+    def start(self) -> None:
+        """One worker thread per replica, each ticking its own scheduler
+        until ``stop()`` — the in-process analog of one serving process
+        per host. Placement/submit stay on the caller's thread (the
+        scheduler queue is the handoff point)."""
+        self._stop.clear()
+        for rep in self.replicas:
+            if rep.thread is None or not rep.thread.is_alive():
+                rep.thread = threading.Thread(
+                    target=self._replica_loop, args=(rep,), daemon=True,
+                    name=f"serving-replica-{rep.replica_id}")
+                rep.thread.start()
+
+    def _replica_loop(self, rep: Replica) -> None:
+        while not self._stop.is_set() and rep.state != STOPPED:
+            self._process_pending_drains()
+            with rep.lock:
+                busy = rep.scheduler.tick() if rep.state != STOPPED else False
+            if not busy:
+                time.sleep(0.001)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout=5.0)
+                rep.thread = None
+
+    # -- elastic lifecycle ---------------------------------------------
+
+    def drain(self, replica_id: int) -> int:
+        """Drain one replica: stop admission, preempt its sequences, and
+        front-requeue every unfinished request on surviving replicas
+        (oldest first, so fleet FIFO order is preserved). Returns the
+        number of requeued requests; zero requests are lost or duplicated
+        — the moved ``ServingRequest`` objects keep their uids, generated
+        continuations, and router bookkeeping."""
+        with self._lock:
+            rep = self.replicas[replica_id]
+            if rep.state == STOPPED:
+                return 0
+            # validate BEFORE mutating anything: a refused drain must
+            # leave the fleet exactly as it was (requests still live on
+            # this replica), never preempt-then-discover-no-home
+            survivors = [r for r in self.active_replicas if r is not rep]
+            with rep.lock:
+                has_work = bool(rep.scheduler.active or rep.scheduler.queue)
+                if has_work and not survivors:
+                    raise RuntimeError(
+                        f"cannot drain replica {replica_id}: it holds "
+                        f"unfinished requests and no surviving replica "
+                        f"could take them")
+                rep.state = DRAINING
+                exported = rep.scheduler.export_requests()
+            # front-requeue => inject in REVERSE so the oldest exported
+            # request ends up at the very front of its new queue
+            moved_uids: set = set()
+            try:
+                for r in reversed(exported):
+                    refusals = []
+                    for target in sorted(
+                            survivors,
+                            key=lambda s: (s.scheduler.load()["queue_depth"]
+                                           + s.scheduler.load()["running"],
+                                           s.replica_id)):
+                        try:
+                            with target.lock:
+                                target.scheduler.inject(r, front=True)
+                        except ValueError as e:
+                            refusals.append(str(e))
+                            continue
+                        moved_uids.add(r.uid)
+                        self.owner[r.uid] = target.replica_id
+                        sid = self._session_of.get(r.uid)
+                        if sid is not None:
+                            self.sessions[sid] = target.replica_id
+                        break
+                    else:
+                        raise RuntimeError(
+                            f"no surviving replica can adopt request "
+                            f"{r.uid} from draining replica {replica_id} — "
+                            + "; ".join(refusals))
+            except BaseException:
+                # roll back: everything not yet moved returns to this
+                # replica (front, original order) and it stays ACTIVE —
+                # already-moved requests are validly queued on survivors,
+                # so nothing is lost either way
+                unmoved = [r for r in exported if r.uid not in moved_uids]
+                with rep.lock:
+                    rep.scheduler.draining = False
+                    for r in reversed(unmoved):
+                        rep.scheduler.inject(r, front=True)
+                    rep.state = ACTIVE
+                raise
+            # stickiness to a drained replica is gone for everyone else
+            for sid, rid in list(self.sessions.items()):
+                if rid == replica_id:
+                    del self.sessions[sid]
+            rep.state = STOPPED
+            self.drains += 1
+            self.requeued += len(exported)
+            self.fleet.write_events([
+                ("fleet/drains", self.drains, self.drains),
+                ("fleet/requeued", self.requeued, self.drains)])
+            logger.info(f"router: replica {replica_id} drained, "
+                        f"{len(exported)} requests requeued on "
+                        f"{len(survivors)} survivors")
+            return len(exported)
+
+    def scale_to(self, n: int) -> int:
+        """Grow or shrink the ACTIVE fleet to ``n`` replicas. Growth needs
+        ``engine_factory``; shrink drains the newest active replicas
+        (their requests requeue on the survivors). Returns the active
+        count after scaling."""
+        if n < 1:
+            raise ValueError(f"cannot scale to {n} replicas")
+        with self._lock:
+            while len(self.active_replicas) < n:
+                if self.engine_factory is None:
+                    raise RuntimeError(
+                        "scale-up needs an engine_factory (the router only "
+                        "drains without one)")
+                rep = self._add_replica(self.engine_factory())
+                if any(r.thread is not None and r.thread.is_alive()
+                       for r in self.replicas):
+                    self.start()   # threaded mode: give the newcomer a loop
+                logger.info(f"router: scaled up — replica "
+                            f"{rep.replica_id} joined")
+            while len(self.active_replicas) > n:
+                victim = self.active_replicas[-1]
+                self.drain(victim.replica_id)
+            return len(self.active_replicas)
+
+    def autoscale_step(self, policy) -> int:
+        """One autoscale observation: feed the policy the mean queue depth
+        per active replica (``launcher/elastic_agent.AutoscalePolicy``)
+        and apply its verdict. Returns the active count."""
+        with self._lock:
+            active = self.active_replicas
+            depth = (sum(r.scheduler.load()["queue_depth"] for r in active)
+                     / max(1, len(active)))
+            want = policy.desired(len(active), depth)
+            if want != len(active):
+                self.scale_to(want)
+            return len(self.active_replicas)
+
+    # -- observability --------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """Fleet summary: aggregated tails over every replica's finished
+        requests plus the per-replica breakdown (satellite: fleet p50/p95/
+        p99 TTFT/TPOT + per-replica queue depth through the monitor)."""
+
+        def pct(xs, q):
+            return float(np.percentile(xs, q)) if len(xs) else None
+
+        done = [r for r in self.requests.values() if r.state == "finished"]
+        ttft = [r.first_token_at - r.submitted_at for r in done
+                if r.first_token_at is not None]
+        tpot = [t for r in done for t in r.tpot_s]
+        total = sum(len(r.generated) for r in done)
+        span = (max(r.finished_at for r in done)
+                - min(r.submitted_at for r in done)) if done else 0.0
+        return {
+            "replicas": len(self.replicas),
+            "active_replicas": len(self.active_replicas),
+            "requests": len(done),
+            "generated_tokens": total,
+            "sustained_tokens_per_sec": (total / span) if span > 0 else None,
+            "ttft_p50_s": pct(ttft, 50), "ttft_p95_s": pct(ttft, 95),
+            "ttft_p99_s": pct(ttft, 99),
+            "tpot_p50_s": pct(tpot, 50), "tpot_p95_s": pct(tpot, 95),
+            "tpot_p99_s": pct(tpot, 99),
+            "drains": self.drains,
+            "requeued": self.requeued,
+            "per_replica": [dict(r.scheduler.load(), state=r.state,
+                                 preemptions=r.scheduler.preemptions)
+                            for r in self.replicas],
+        }
+
+    def publish(self) -> dict:
+        """Push the fleet aggregate downstream (``fleet/*`` events)."""
+        return self.fleet.publish()
+
+
+def fleet_commands(hostfile, script: str, script_args: Sequence[str] = (),
+                   include: str = "", exclude: str = "",
+                   num_replicas: int = -1,
+                   extra_env: Optional[Dict[str, str]] = None
+                   ) -> List[Tuple[str, List[str]]]:
+    """Per-host launch commands for a real multi-host serving fleet — one
+    serving worker per hostfile host, through the SAME parsing/filtering
+    the training launcher uses (``launcher/runner.py``, SURVEY §1's
+    ``deepspeed`` runner). Each worker sees ``SXT_REPLICA_ID`` /
+    ``SXT_NUM_REPLICAS`` instead of the trainer's PROCESS_ID pair: serving
+    replicas are independent processes behind the router, not one SPMD
+    job, so they must NOT join ``jax.distributed``."""
+    import shlex
+    import sys
+
+    from ..launcher.runner import filter_hosts, parse_hostfile
+
+    hosts = parse_hostfile(hostfile)
+    if not hosts:
+        hosts = {"localhost": 1}
+    hosts = filter_hosts(hosts, include, exclude, num_replicas)
+    host_list = list(hosts)
+    cmds: List[Tuple[str, List[str]]] = []
+    for idx, host in enumerate(host_list):
+        env = {"SXT_REPLICA_ID": str(idx),
+               "SXT_NUM_REPLICAS": str(len(host_list))}
+        env.update(extra_env or {})
+        envs = [f"{k}={shlex.quote(v)}" for k, v in env.items()]
+        inner = ["env"] + envs + [sys.executable, script] + list(script_args)
+        if len(host_list) == 1:
+            cmds.append((host, inner))
+        else:
+            cmds.append((host, ["ssh", host,
+                                " ".join(shlex.quote(c) if i > 0 else c
+                                         for i, c in enumerate(inner))]))
+    return cmds

@@ -26,6 +26,10 @@ Sites (``Fault.site``):
   pipeline before bucket ``index``'s host update (runtime/zero/overlap.py);
   the error surfaces at the next pipeline join and poisons the pipeline, so
   a half-applied step can never reach a checkpoint.
+- ``kv_transfer``         — kill a disaggregated prefill→decode KV-block
+  transfer (serving/disagg.py) after the decode side's blocks are reserved
+  but before the payload commits; the transfer's cleanup must abort the
+  reservation, so the decode engine is left clean (tests/test_disagg.py).
 - ``corrupt_manifest`` / ``drop_manifest`` / ``corrupt_shard`` — post-commit
   damage to an already-committed tag (drives checksum verification and the
   newest-complete-tag fallback on load). ``index`` selects the manifest
@@ -58,6 +62,7 @@ SITES = (
     "ckpt_pre_commit", "ckpt_pre_latest",
     "nan_loss", "sigterm_mid_step", "offload_bucket_update",
     "corrupt_manifest", "drop_manifest", "corrupt_shard",
+    "kv_transfer",
 )
 
 

@@ -117,6 +117,44 @@ def test_serving_goodput_row_runs_at_toy_size():
     assert row["prefix_hit_rate"] is None
 
 
+def test_serving_fleet_row_runs_at_toy_size():
+    """The config-5 serving-fleet row (bench.serving_fleet_row) at toy
+    size: the same Poisson trace served by a 1-replica and a 2-replica
+    router fleet — goodput + TTFT tails both ways, token parity across
+    fleet widths — runs on CPU, so the published row cannot rot on the
+    driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_fleet_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = serving_fleet_row(model, params, icfg, mcfg.vocab_size,
+                            n_requests=6, prompt_lo=4, prompt_hi=20,
+                            max_new=5, load=2.0)
+    assert row["capacity_tokens_per_sec"] > 0
+    assert row["sustained_tokens_per_sec_1r"] > 0
+    assert row["sustained_tokens_per_sec_2r"] > 0
+    assert row["fleet_speedup_x"] > 0
+    assert row["replicas_used"] == [1, 2]
+    assert row["ttft_p95_s_1r"] >= row["ttft_p50_s_1r"] > 0
+    assert row["ttft_p95_s_2r"] >= row["ttft_p50_s_2r"] > 0
+    assert row["tpot_p50_s_1r"] > 0 and row["tpot_p50_s_2r"] > 0
+    # identical weights + greedy decoding: routing is token-identical
+    assert row["token_mismatches_vs_1r"] == 0
+
+
 def test_prefix_cache_row_runs_at_toy_size():
     """The config-5 prefix-cache row (bench.prefix_cache_row) at toy size:
     the shared-system-prompt trace served with and without prefix_caching

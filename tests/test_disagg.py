@@ -1,0 +1,296 @@
+"""Disaggregated prefill/decode (ISSUE 7): the KV-block wire format must
+round-trip bit-exactly (bf16) / byte-exactly (int8/fp8 payload + scale
+planes), the admission handshake must be atomic on reject, and a crash
+mid-transfer must leave the decode engine clean.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.inference import (InferenceConfig,
+                                            InferenceEngineV2)
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.serving import (DisaggregatedServer,
+                                          KVTransferChannel)
+from shuffle_exchange_tpu.testing import faults
+from shuffle_exchange_tpu.testing.faults import InjectedFault
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _icfg(num_kv_blocks=40, kv_cache_dtype="bf16"):
+    return InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8,
+        num_kv_blocks=num_kv_blocks, kv_cache_dtype=kv_cache_dtype,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+
+
+def _pool_blocks(eng, uid):
+    """Host copy of uid's written pool blocks (data + scale planes)."""
+    desc = eng._seqs[uid]
+    idx = np.asarray(desc.blocks, np.int32)
+    out = [np.asarray(eng.cache.k[:, idx]), np.asarray(eng.cache.v[:, idx])]
+    if eng.cache.quantized:
+        out += [np.asarray(eng.cache.k_scale[:, idx]),
+                np.asarray(eng.cache.v_scale[:, idx])]
+    return out
+
+
+class TestWireFormat:
+    @pytest.mark.parametrize("kv_dtype", ["bf16", "int8", "fp8"])
+    def test_block_roundtrip_exact(self, model_and_params, kv_dtype):
+        """Transfer reproduces the decode-side KV bit-exactly (bf16) /
+        byte-exactly including scale planes (int8/fp8): the payload is a
+        straight gather of pool storage, never re-quantized."""
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg(kv_cache_dtype=kv_dtype))
+        dst = InferenceEngineV2(model, params, _icfg(kv_cache_dtype=kv_dtype))
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, 90, size=21).tolist()
+        src.put([3], [prompt])
+        want = _pool_blocks(src, 3)
+        ch = KVTransferChannel()
+        ch.transfer(src, dst, 3, flush_src=False)
+        got = _pool_blocks(dst, 3)
+        assert len(want) == len(got)
+        for w, g in zip(want, got):
+            assert w.dtype == g.dtype and w.shape == g.shape
+            np.testing.assert_array_equal(
+                w.view(np.uint8), g.view(np.uint8))
+        # host state came along: tokens, seen, logits
+        assert dst._seqs[3].tokens == src._seqs[3].tokens
+        assert dst._seqs[3].seen_tokens == src._seqs[3].seen_tokens
+        np.testing.assert_array_equal(dst._seqs[3].last_logits,
+                                      src._seqs[3].last_logits)
+        assert ch.stats()["transfers"] == 1
+
+    def test_file_spilled_transfer_identical(self, model_and_params,
+                                             tmp_path):
+        """The AsyncIOEngine-backed spill path (the cross-host wire)
+        delivers the same bytes the in-memory staging does."""
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg(kv_cache_dtype="int8"))
+        dst = InferenceEngineV2(model, params, _icfg(kv_cache_dtype="int8"))
+        rng = np.random.default_rng(1)
+        src.put([1], [rng.integers(1, 90, size=17).tolist()])
+        want = _pool_blocks(src, 1)
+        ch = KVTransferChannel(spill_dir=str(tmp_path))
+        ch.transfer(src, dst, 1)
+        got = _pool_blocks(dst, 1)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w.view(np.uint8), g.view(np.uint8))
+        assert 1 not in src._seqs   # flushed after handoff
+
+    def test_wire_format_mismatch_rejected_cleanly(self, model_and_params):
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg(kv_cache_dtype="bf16"))
+        dst = InferenceEngineV2(model, params, _icfg(kv_cache_dtype="int8"))
+        rng = np.random.default_rng(2)
+        src.put([1], [rng.integers(1, 90, size=12).tolist()])
+        free0 = dst.free_blocks
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            KVTransferChannel().transfer(src, dst, 1)
+        assert dst.free_blocks == free0 and 1 not in dst._seqs
+        assert 1 in src._seqs, "prefill side untouched by a failed handoff"
+
+
+class TestHandshake:
+    def test_reject_is_atomic_and_names_numbers(self, model_and_params):
+        """Admission runs BEFORE bytes move: a decode pool too full for
+        the import rejects with needed-vs-free numbers and mutates
+        nothing on either side."""
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg())
+        dst = InferenceEngineV2(model, params, _icfg(num_kv_blocks=4))
+        rng = np.random.default_rng(3)
+        src.put([7], [rng.integers(1, 90, size=30).tolist()])
+        free0 = dst.free_blocks
+        ch = KVTransferChannel()
+        with pytest.raises(RuntimeError,
+                           match=r"uid 7.*needs \d+ KV blocks, \d+ free"):
+            ch.transfer(src, dst, 7)
+        assert dst.free_blocks == free0 and 7 not in dst._seqs
+        assert ch.stats()["rejects"] == 1 and ch.stats()["transfers"] == 0
+        assert ch.memory_monitor.latest("kv_transfer/rejects") == 1
+
+    def test_reservation_lifecycle(self, model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngineV2(model, params, _icfg())
+        free0 = eng.free_blocks
+        resv = eng.begin_import(9, 20)     # 3 blocks at block_size 8
+        assert eng.free_blocks == free0 - 3
+        assert 9 not in eng._seqs, "no descriptor until commit"
+        eng.abort_import(resv)
+        assert eng.free_blocks == free0
+        eng.abort_import(resv)             # idempotent
+        assert eng.free_blocks == free0
+        with pytest.raises(ValueError, match="import of 0 tokens"):
+            eng.begin_import(9, 0)
+        eng.put([9], [[1, 2, 3]])
+        with pytest.raises(ValueError, match="already live"):
+            eng.begin_import(9, 8)
+
+    def test_commit_validates_before_touching_device(self, model_and_params):
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg())
+        dst = InferenceEngineV2(model, params, _icfg())
+        rng = np.random.default_rng(4)
+        src.put([1], [rng.integers(1, 90, size=12).tolist()])
+        payload = src.export_kv_blocks(1)
+        resv = dst.begin_import(1, payload.seen_tokens + 8)  # wrong size
+        with pytest.raises(ValueError, match="reservation was for"):
+            dst.commit_import(resv, payload)
+        assert not resv.done, "failed commit must leave the reservation"
+        dst.abort_import(resv)
+        assert dst.free_blocks == dst.allocator.num_blocks - 1
+
+    @pytest.mark.parametrize("site_index", [0, 1])
+    def test_crash_mid_transfer_leaves_decode_clean(self, model_and_params,
+                                                    site_index):
+        """faults: a transfer killed after the reservation (before export,
+        or after staging but before commit) aborts the reserved blocks —
+        the decode engine ends byte-identical to untouched."""
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg())
+        dst = InferenceEngineV2(model, params, _icfg())
+        rng = np.random.default_rng(5)
+        src.put([2], [rng.integers(1, 90, size=19).tolist()])
+        pool0 = [np.asarray(dst.cache.k).copy(), np.asarray(dst.cache.v).copy()]
+        free0 = dst.free_blocks
+        faults.arm("kv_transfer", index=site_index)
+        ch = KVTransferChannel()
+        with pytest.raises(InjectedFault):
+            ch.transfer(src, dst, 2)
+        assert dst.free_blocks == free0 and 2 not in dst._seqs
+        np.testing.assert_array_equal(pool0[0], np.asarray(dst.cache.k))
+        np.testing.assert_array_equal(pool0[1], np.asarray(dst.cache.v))
+        assert 2 in src._seqs, "prefill side keeps the sequence for retry"
+        # the retry (fault disarmed) succeeds on the same channel
+        ch.transfer(src, dst, 2)
+        assert 2 in dst._seqs
+
+
+class TestDisaggServing:
+    @pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+    def test_token_parity_with_single_engine(self, model_and_params,
+                                             kv_dtype):
+        """Prefill worker + transfer + decode worker emit exactly the
+        tokens one engine running the same chunked schedule does."""
+        model, params = model_and_params
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (12, 26, 7)]
+        # reference: same chunk schedule on ONE engine
+        ref = InferenceEngineV2(model, params, _icfg(kv_cache_dtype=kv_dtype))
+        budget = ref.config.serving.token_budget
+        want = []
+        for uid, p in enumerate(prompts):
+            for pos in range(0, len(p), budget):
+                ref.step([], [], [(uid, p[pos:pos + budget])])
+            first = int(np.argmax(ref._seqs[uid].last_logits))
+            toks = [first] + [int(t)
+                              for t in ref.decode_loop([uid], [first], 5)[0]]
+            want.append(toks)
+            ref.flush([uid])
+        srv = DisaggregatedServer(
+            InferenceEngineV2(model, params, _icfg(kv_cache_dtype=kv_dtype)),
+            InferenceEngineV2(model, params, _icfg(kv_cache_dtype=kv_dtype)))
+        out = srv.serve(prompts, max_new_tokens=6)
+        assert list(out.values()) == want
+        st = srv.stats()["channel"]
+        assert st["transfers"] == len(prompts) and st["bytes"] > 0
+
+    def test_prefill_engine_drains_its_pool(self, model_and_params):
+        """After each handoff the prefill worker holds nothing — its pool
+        is a flow-through buffer, not a residency."""
+        model, params = model_and_params
+        pe = InferenceEngineV2(model, params, _icfg())
+        de = InferenceEngineV2(model, params, _icfg())
+        srv = DisaggregatedServer(pe, de)
+        rng = np.random.default_rng(7)
+        srv.serve([rng.integers(1, 90, size=14).tolist() for _ in range(3)],
+                  max_new_tokens=3)
+        assert pe.free_blocks == pe.allocator.num_blocks - 1
+        assert de.free_blocks == de.allocator.num_blocks - 1
+        assert not pe._seqs and not de._seqs
+
+    def test_concurrent_sends_use_disjoint_staging(self, model_and_params):
+        """Two in-flight sends of the SAME wire shape must not share a
+        staging buffer: recv(t1) has to return the FIRST payload's bytes
+        even though a second send happened in between (the send/recv
+        split exists so a fabric can sit between them)."""
+        model, params = model_and_params
+        eng = InferenceEngineV2(model, params, _icfg())
+        rng = np.random.default_rng(13)
+        pa = rng.integers(1, 90, size=9).tolist()
+        pb = rng.integers(1, 90, size=9).tolist()   # same block count
+        eng.put([0, 1], [pa, pb])
+        ch = KVTransferChannel()
+        pay_a = eng.export_kv_blocks(0)
+        pay_b = eng.export_kv_blocks(1)
+        t_a = ch.send(pay_a)
+        t_b = ch.send(pay_b)            # same shapes, concurrent in-flight
+        got_a = ch.recv(t_a)
+        got_b = ch.recv(t_b)
+        assert np.array_equal(got_a.k, pay_a.k)
+        assert np.array_equal(got_b.k, pay_b.k)
+        assert not np.array_equal(got_a.k, got_b.k)
+        # sequential steady state goes back to reusing slot 0
+        t_c = ch.send(pay_a)
+        ch.recv(t_c)
+        assert ch._slots_in_use == set()
+
+    def test_failed_transfer_releases_staging_and_inflight(
+            self, model_and_params, tmp_path):
+        """A transfer that dies after send() must not leak its in-flight
+        payload copy, its staging slot, or its spill file."""
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg())
+        dst = InferenceEngineV2(model, params, _icfg())
+        rng = np.random.default_rng(14)
+        src.put([5], [rng.integers(1, 90, size=12).tolist()])
+        ch = KVTransferChannel(spill_dir=str(tmp_path))
+        faults.arm("kv_transfer", index=1)   # after send, before recv
+        with pytest.raises(InjectedFault):
+            ch.transfer(src, dst, 5)
+        assert ch._inflight == {}
+        assert ch._slots_in_use == set()
+        assert list(tmp_path.iterdir()) == []   # spill file cleaned up
+        faults.clear()
+        # the channel still works afterwards
+        ch.transfer(src, dst, 5)
+        assert ch.transfers == 1
+        assert list(tmp_path.iterdir()) == []   # delivered spill removed
+
+    def test_staging_buffers_are_reused(self, model_and_params):
+        """Same wire shape twice -> the channel stages through the SAME
+        pinned buffers (keyed reuse), not fresh allocations."""
+        model, params = model_and_params
+        src = InferenceEngineV2(model, params, _icfg())
+        dst = InferenceEngineV2(model, params, _icfg())
+        rng = np.random.default_rng(8)
+        ch = KVTransferChannel()
+        pool = ch.pool
+        src.put([1], [rng.integers(1, 90, size=20).tolist()])
+        ch.transfer(src, dst, 1)
+        n_bufs = len(pool._staging)
+        dst.flush([1])
+        src.put([2], [rng.integers(1, 90, size=20).tolist()])
+        ch.transfer(src, dst, 2)
+        assert len(pool._staging) == n_bufs, "same shape must reuse staging"

@@ -671,6 +671,16 @@ def test_megatron_expert_interval_import_parity():
     m_mixed, p_mixed = from_hf((dict(base_cfg, num_experts=[E]), sd_mixed))
     m_dense, p_dense = from_hf((base_cfg, sd_dense))
     assert m_mixed.config.moe_layer_pattern == (False, True, False, True)
+    # moe_impl=auto resolves to the capacity path under scanned stacks,
+    # which DROPS overflow tokens at the default capacity_factor — parity
+    # with the dense import needs every token served, so give the experts
+    # full capacity (identical experts make routing itself irrelevant)
+    import dataclasses as _dc
+
+    from shuffle_exchange_tpu.models import Transformer
+
+    m_mixed = Transformer(_dc.replace(m_mixed.config,
+                                      capacity_factor=float(E)))
     assert p_mixed["layers"]["moe_w_up"].shape == (L, E, D, F)
     # dense layers: slot 0 carries the FFN, other slots zero
     assert np.abs(np.asarray(p_mixed["layers"]["moe_w_up"][0, 1:])).max() == 0

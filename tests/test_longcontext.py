@@ -201,9 +201,11 @@ def test_sparse_attention_splash_path_matches_dense():
                                                            sparse_attention)
 
     rng = np.random.default_rng(4)
-    q = jnp.asarray(rng.standard_normal((1, 256, 4, 64)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.float32)
+    # head_dim 128: this jaxlib's splash kernel requires head_dim to be a
+    # multiple of its 128 lanes even in interpret mode
+    q = jnp.asarray(rng.standard_normal((1, 256, 4, 128)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 256, 2, 128)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 256, 2, 128)), jnp.float32)
     cfg = FixedSparsityConfig(block=16)
     dense = sparse_attention(q, k, v, cfg, causal=True, impl="dense")
     splash = sparse_attention(q, k, v, cfg, causal=True, impl="splash")

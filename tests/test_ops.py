@@ -471,9 +471,11 @@ def test_attn_bwd_block_override(monkeypatch):
 
     monkeypatch.setenv("SXT_ATTN_BLOCK_BWD", "128")
     rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.normal(size=(1, 256, 4, 64)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
+    # head_dim 128: this jaxlib's splash kernel requires head_dim to be a
+    # multiple of its 128 lanes even in interpret mode
+    q = jnp.asarray(rng.normal(size=(1, 256, 4, 128)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 256, 2, 128)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 256, 2, 128)), jnp.float32)
     out = splash_attention_gqa(q, k, v, causal=True, interpret=True)
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

@@ -1,0 +1,402 @@
+"""Multi-replica serving front (ISSUE 7): placement must be load- and
+prefix-aware, sticky sessions must pin multi-turn traffic, routed serving
+must be token-identical to a single engine, and a SIGTERM'd replica must
+drain with zero lost or duplicated requests.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.config import ConfigError
+from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                            InferenceConfig,
+                                            InferenceEngineV2)
+from shuffle_exchange_tpu.launcher import AutoscalePolicy
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.monitor import InMemoryMonitor
+from shuffle_exchange_tpu.serving import (ElasticServingSupervisor,
+                                          ReplicaRouter, fleet_commands,
+                                          install_sigterm_drain,
+                                          uninstall_sigterm_drain)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _icfg(num_kv_blocks=40, prefix_caching=False, **router):
+    return InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8,
+        num_kv_blocks=num_kv_blocks, prefix_caching=prefix_caching,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+        router=router or None)
+
+
+def _engines(model, params, n=2, **kw):
+    return [InferenceEngineV2(model, params, _icfg(**kw)) for _ in range(n)]
+
+
+def _reference(model, params, prompt, n_new, **kw):
+    eng = InferenceEngineV2(model, params, _icfg(**kw))
+    lg = eng.put([0], [prompt])
+    first = int(np.argmax(lg[0]))
+    if n_new == 1:
+        return [first]
+    toks = eng.decode_loop([0], [first], n_new - 1)
+    return [first] + [int(t) for t in toks[0]]
+
+
+class TestParity:
+    def test_routed_serving_matches_single_engine(self, model_and_params):
+        """Token-identical routing: every request served through the
+        2-replica router emits exactly the tokens one engine would."""
+        model, params = model_and_params
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (12, 5, 22, 9, 15)]
+        want = [_reference(model, params, p, 8) for p in prompts]
+        router = ReplicaRouter(_engines(model, params, 2))
+        out = router.serve(prompts, max_new_tokens=8)
+        assert [out[u] for u in out] == want
+        # the fleet actually spread the work
+        assert len({router.owner[u] for u in out}) == 2
+        for rep in router.replicas:
+            assert rep.engine.free_blocks == rep.engine.allocator.num_blocks - 1
+
+    def test_streaming_via_router(self, model_and_params):
+        model, params = model_and_params
+        streamed = []
+        router = ReplicaRouter(_engines(model, params, 2),
+                               on_token=lambda u, t: streamed.append((u, t)))
+        rng = np.random.default_rng(1)
+        out = router.serve([rng.integers(1, 90, size=7).tolist()
+                            for _ in range(3)], max_new_tokens=4)
+        for uid, toks in out.items():
+            assert [t for u, t in streamed if u == uid] == toks
+
+
+class TestPlacement:
+    def test_balances_by_queue_depth(self, model_and_params):
+        """With no prefix signal, submissions alternate onto the emptier
+        replica (queue-depth penalty) instead of piling on one."""
+        model, params = model_and_params
+        router = ReplicaRouter(_engines(model, params, 2))
+        rng = np.random.default_rng(2)
+        owners = [router.owner[router.submit(
+            rng.integers(1, 90, size=6).tolist(), max_new_tokens=2)]
+            for _ in range(4)]
+        assert owners == [0, 1, 0, 1]
+        while router.tick():
+            pass
+
+    def test_prefix_affinity_prefers_cache_holder(self, model_and_params):
+        """A prompt whose block-key chain is already committed on replica
+        0 routes there, even though both replicas are idle (the
+        prefix-affinity term breaks the tie)."""
+        model, params = model_and_params
+        router = ReplicaRouter(_engines(model, params, 2,
+                                        prefix_caching=True))
+        rng = np.random.default_rng(3)
+        shared = rng.integers(1, 90, size=16).tolist()   # 2 full blocks
+        first = router.submit(shared + rng.integers(1, 90, size=5).tolist(),
+                              max_new_tokens=2)
+        assert router.owner[first] == 0
+        while router.tick():
+            pass
+        # same shared prefix again: replica 0 holds the chain
+        nxt = router.submit(shared + rng.integers(1, 90, size=9).tolist(),
+                            max_new_tokens=2)
+        assert router.owner[nxt] == 0
+        while router.tick():
+            pass
+        assert router.replicas[0].engine.prefix_hit_tokens == 16
+        # an unrelated prompt still balances away from the busier replica
+        other = router.submit(rng.integers(1, 90, size=6).tolist(),
+                              max_new_tokens=2)
+        assert router.owner[other] in (0, 1)
+        while router.tick():
+            pass
+
+    def test_sticky_sessions_pin_and_remap_on_drain(self, model_and_params):
+        model, params = model_and_params
+        router = ReplicaRouter(_engines(model, params, 2))
+        rng = np.random.default_rng(4)
+        u1 = router.submit(rng.integers(1, 90, size=8).tolist(),
+                           max_new_tokens=2, session_id="conv-A")
+        home = router.owner[u1]
+        # load the home replica so pure load-balance would pick the other
+        for _ in range(2):
+            router.submit(rng.integers(1, 90, size=8).tolist(),
+                          max_new_tokens=2)
+        u2 = router.submit(rng.integers(1, 90, size=8).tolist(),
+                           max_new_tokens=2, session_id="conv-A")
+        assert router.owner[u2] == home, "sticky session must pin"
+        while router.tick():
+            pass
+        router.drain(home)
+        u3 = router.submit(rng.integers(1, 90, size=8).tolist(),
+                           max_new_tokens=2, session_id="conv-A")
+        assert router.owner[u3] != home, "stickiness to a drained replica"
+        while router.tick():
+            pass
+
+    def test_admission_error_names_every_replica(self, model_and_params):
+        """Satellite: when NO replica can ever take a request, the error
+        aggregates each replica's needed-vs-free numbers."""
+        model, params = model_and_params
+        router = ReplicaRouter(_engines(model, params, 2, num_kv_blocks=5))
+        with pytest.raises(ValueError) as ei:
+            router.submit(list(range(1, 33)), max_new_tokens=8)
+        msg = str(ei.value)
+        assert "replica 0" in msg and "replica 1" in msg
+        assert "KV blocks" in msg and "no replica can admit" in msg
+
+
+class TestDrain:
+    def test_drain_requeues_and_finishes_everything(self, model_and_params):
+        """Mid-serve drain: zero lost, zero duplicated, token-identical."""
+        model, params = model_and_params
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (12, 5, 22, 9)]
+        want = [_reference(model, params, p, 8) for p in prompts]
+        router = ReplicaRouter(_engines(model, params, 2))
+        uids = [router.submit(p, max_new_tokens=8) for p in prompts]
+        for _ in range(2):
+            router.tick()
+        moved = router.drain(0)
+        assert moved > 0, "replica 0 held work when drained"
+        assert router.replicas[0].state == "stopped"
+        # the drained engine's pool is fully free (scratch block aside)
+        eng0 = router.replicas[0].engine
+        assert eng0.free_blocks == eng0.allocator.num_blocks - 1
+        while router.tick():
+            pass
+        out = {u: router.requests[u].generated for u in uids}
+        assert [out[u] for u in uids] == want
+        st = router.stats()
+        assert st["drains"] == 1 and st["requeued"] == moved
+        assert st["requests"] == len(prompts)
+
+    def test_refused_drain_leaves_fleet_intact(self, model_and_params):
+        """Draining the only active replica while it holds work must
+        refuse BEFORE preempting anything: the replica stays ACTIVE,
+        every request stays live and finishes token-identically."""
+        model, params = model_and_params
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (8, 11)]
+        want = [_reference(model, params, p, 6) for p in prompts]
+        router = ReplicaRouter(_engines(model, params, 1))
+        uids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.tick()
+        with pytest.raises(RuntimeError, match="no surviving replica"):
+            router.drain(0)
+        assert router.replicas[0].state == "active"
+        assert not router.replicas[0].scheduler.draining
+        while router.tick():
+            pass
+        assert [router.requests[u].generated for u in uids] == want
+
+    def test_sigterm_triggers_drain(self, model_and_params):
+        """The lifecycle hook: SIGTERM drains the registered replica and
+        every request still finishes with the right tokens."""
+        model, params = model_and_params
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (10, 7, 14)]
+        want = [_reference(model, params, p, 6) for p in prompts]
+        router = ReplicaRouter(_engines(model, params, 2))
+        try:
+            assert install_sigterm_drain(router, 0)
+            uids = [router.submit(p, max_new_tokens=6) for p in prompts]
+            router.tick()
+            os.kill(os.getpid(), signal.SIGTERM)
+            while router.tick():   # handler fires between ticks
+                pass
+        finally:
+            uninstall_sigterm_drain()
+        assert router.replicas[0].state == "stopped"
+        out = {u: router.requests[u].generated for u in uids}
+        assert [out[u] for u in uids] == want
+
+    def test_scheduler_export_inject_roundtrip(self, model_and_params):
+        """Scheduler-level drain contract: export preempts + frees the
+        pool, the exported descriptors replay token-identically after
+        inject into another scheduler, and the drained one refuses new
+        work."""
+        model, params = model_and_params
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist() for n in (9, 13)]
+        want = [_reference(model, params, p, 6) for p in prompts]
+        eng_a = InferenceEngineV2(model, params, _icfg())
+        a = ContinuousBatchingScheduler(eng_a, replica_id=0)
+        uids = [a.submit(p, max_new_tokens=6) for p in prompts]
+        a.tick()
+        exported = a.export_requests()
+        assert len(exported) == 2
+        assert eng_a.free_blocks == eng_a.allocator.num_blocks - 1
+        assert a.stats()["draining"] is True
+        with pytest.raises(RuntimeError, match="replica 0 is draining"):
+            a.submit([1, 2, 3])
+        b = ContinuousBatchingScheduler(
+            InferenceEngineV2(model, params, _icfg()), replica_id=1)
+        for r in reversed(exported):
+            b.inject(r, front=True)
+        assert [r.uid for r in b.queue] == uids
+        b.drain()
+        assert [b.requests[u].generated for u in uids] == want
+
+
+class TestElasticScale:
+    def test_autoscale_policy_hysteresis_and_bounds(self):
+        pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                              scale_up_queue_depth=4.0,
+                              scale_down_queue_depth=0.5, patience=2)
+        assert pol.desired(1, 10.0) == 1      # first over-threshold tick
+        assert pol.desired(1, 10.0) == 2      # patience reached
+        assert pol.desired(3, 10.0) == 3      # max bound
+        assert pol.desired(2, 0.0) == 2
+        assert pol.desired(2, 0.0) == 1       # shrink after patience
+        assert pol.desired(1, 0.0) == 1       # never below min
+        pol2 = AutoscalePolicy(patience=2)
+        assert pol2.desired(1, 100.0) == 1
+        assert pol2.desired(1, 2.0) == 1      # in-band resets the streak
+        assert pol2.desired(1, 100.0) == 1    # streak restarted, not grown
+        with pytest.raises(ValueError, match="scale_down_queue_depth"):
+            AutoscalePolicy(scale_up_queue_depth=1.0,
+                            scale_down_queue_depth=2.0)
+
+    def test_supervisor_scales_up_then_drains_back(self, model_and_params):
+        model, params = model_and_params
+
+        def factory():
+            return InferenceEngineV2(model, params, _icfg())
+
+        router = ReplicaRouter([factory()], engine_factory=factory)
+        sup = ElasticServingSupervisor(
+            router, AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                    scale_up_queue_depth=2.0,
+                                    scale_down_queue_depth=0.5, patience=1))
+        rng = np.random.default_rng(8)
+        uids = [router.submit(rng.integers(1, 90, size=6).tolist(),
+                              max_new_tokens=3) for _ in range(5)]
+        assert sup.step() == 2, "queue depth 4 > 2 must add a replica"
+        assert router.replicas[1].state == "active"
+        while router.tick():
+            pass
+        assert all(len(router.requests[u].generated) == 3 for u in uids)
+        assert sup.step() == 1, "idle fleet must shrink to min_replicas"
+        assert router.replicas[1].state == "stopped"
+
+
+class TestFleetObservability:
+    def test_fleet_monitor_aggregates_and_publishes(self, model_and_params):
+        model, params = model_and_params
+        sink = InMemoryMonitor(maxlen=1024)
+        router = ReplicaRouter(_engines(model, params, 2), monitor=sink)
+        rng = np.random.default_rng(9)
+        router.serve([rng.integers(1, 90, size=8).tolist()
+                      for _ in range(4)], max_new_tokens=4)
+        agg = router.publish()
+        assert agg["ttft_p50_s"] > 0 and agg["tpot_p99_s"] > 0
+        assert set(agg["queue_depth"]) == {0, 1}
+        # downstream got the fleet/* events, replica queue depths included
+        assert sink.latest("fleet/ttft_p50_s") == agg["ttft_p50_s"]
+        assert sink.latest("fleet/replica0/queue_depth") == 0
+        assert sink.latest("fleet/replica1/queue_depth") == 0
+        # per-replica identity is machine-readable end to end
+        st = router.stats()
+        assert [r["replica_id"] for r in st["per_replica"]] == [0, 1]
+        assert st["ttft_p99_s"] >= st["ttft_p50_s"]
+        for rep in router.replicas:
+            s = rep.scheduler.stats()
+            assert s["replica_id"] == rep.replica_id
+
+    def test_threaded_fleet_serves_everything(self, model_and_params):
+        """start()/stop(): one thread per replica drains the same work
+        (no token assertion — threads interleave ticks with submissions,
+        which changes chunking; the contract here is liveness + count)."""
+        model, params = model_and_params
+        import time as _time
+
+        router = ReplicaRouter(_engines(model, params, 2))
+        rng = np.random.default_rng(10)
+        router.start()
+        try:
+            uids = [router.submit(rng.integers(1, 90, size=7).tolist(),
+                                  max_new_tokens=4) for _ in range(4)]
+            deadline = _time.time() + 60
+            while (_time.time() < deadline
+                   and not all(router.requests[u].state == "finished"
+                               for u in uids)):
+                _time.sleep(0.01)
+        finally:
+            router.stop()
+        assert all(len(router.requests[u].generated) == 4 for u in uids)
+
+
+class TestConfigAndFanout:
+    def test_router_config_validation(self):
+        with pytest.raises(ConfigError, match="unknown router config keys"):
+            InferenceConfig.from_dict({"router": {"num_replica": 2}})
+        with pytest.raises(ConfigError, match="scale_down_queue_depth"):
+            InferenceConfig.from_dict({"router": {
+                "scale_up_queue_depth": 1.0, "scale_down_queue_depth": 2.0}})
+        with pytest.raises(ConfigError, match="min_replicas"):
+            InferenceConfig.from_dict({"router": {"min_replicas": 5,
+                                                  "max_replicas": 2}})
+        cfg = InferenceConfig.from_dict({"router": {"num_replicas": 3,
+                                                    "sticky_sessions": False}})
+        assert cfg.router.num_replicas == 3
+        assert cfg.router.sticky_sessions is False
+        assert InferenceConfig.from_dict({"router": None}).router.num_replicas == 1
+
+    def test_finished_request_retention_bound(self, model_and_params):
+        """Long-lived-process bound: finished requests past
+        router.retain_finished are evicted oldest-first, session pins are
+        LRU-bounded by max_sessions; live requests always survive."""
+        model, params = model_and_params
+        router = ReplicaRouter(_engines(model, params, 1,
+                                        retain_finished=4, max_sessions=2))
+        uids = []
+        for i in range(8):
+            uids.append(router.submit([1 + i, 2, 3], max_new_tokens=2,
+                                      session_id=f"s{i}"))
+            while router.tick():
+                pass
+        assert len(router.requests) == 4
+        assert uids[-1] in router.requests       # newest retained
+        assert uids[0] not in router.requests    # oldest evicted
+        assert len(router.sessions) == 2
+        assert "s7" in router.sessions and "s0" not in router.sessions
+
+    def test_fleet_commands_reuse_hostfile_machinery(self, tmp_path):
+        """SURVEY §1: the serving fleet fans out over the SAME hostfile
+        format/filters the training launcher uses, one replica env per
+        host (not jax.distributed ranks)."""
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 slots=4\nworker-1 slots=4\n"
+                      "worker-2 slots=4  # spare\n")
+        cmds = fleet_commands(str(hf), "serve.py", ["--port", "80"],
+                              exclude="worker-2")
+        assert [h for h, _ in cmds] == ["worker-0", "worker-1"]
+        joined = [" ".join(argv) for _, argv in cmds]
+        assert all(a.startswith("ssh ") for a in joined)
+        assert "SXT_REPLICA_ID=0" in joined[0]
+        assert "SXT_REPLICA_ID=1" in joined[1]
+        assert all("SXT_NUM_REPLICAS=2" in a for a in joined)
+        assert all("serve.py --port 80" in a for a in joined)
+        # single host: local exec, no ssh
+        (local,) = fleet_commands(str(hf), "serve.py", include="worker-1")
+        assert local[0] == "worker-1" and local[1][0] == "env"
