@@ -548,6 +548,118 @@ def serving_fleet_row(model, params, icfg, vocab, *, n_requests=24,
     }
 
 
+def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
+                            period=5, prompt_lo=48, prompt_hi=96, max_new=48,
+                            k=4, load=2.0, seed=0):
+    """Config-5 speculative-serving row (ISSUE 8): the SAME Poisson trace
+    served at k=0 (speculation off) and k=4 with BOTH drafters — the
+    n-gram self-speculation drafter (zero extra weights) and a draft model
+    (here the target model itself, the acceptance-rate ceiling a
+    well-distilled draft approaches). The workload is repetitive-suffix
+    (period-``period`` cycling prompts — the code/structured-output/
+    multi-turn regime where suffixes repeat and decode steps are most
+    wasteful), because that is the regime the steps-per-token lever pays
+    in; acceptance on incompressible random text is near zero by
+    construction and would measure the drafter, not the machinery.
+
+    Headline figures: tokens/s/sequence (the per-sequence latency axis
+    batching cannot touch), steps-per-emitted-token (decode ticks per
+    token per sequence — the ISSUE bar is < 0.67 at k=4), acceptance
+    rate, and TTFT/TPOT p50/p95. Greedy acceptance keeps every variant
+    token-identical to k=0 (asserted). Reused at toy size by
+    tests/test_bench_smoke.py so the published row cannot rot on CPU."""
+    import dataclasses as _dc
+
+    from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                                DraftModelDrafter,
+                                                InferenceEngineV2)
+
+    rng = np.random.default_rng(seed)
+    prompts = []
+    for n in rng.integers(prompt_lo, prompt_hi + 1, size=n_requests):
+        cyc = rng.integers(1, vocab, size=period).tolist()
+        prompts.append((cyc * (int(n) // period + 1))[:int(n)])
+
+    def spec_cfg(enabled):
+        sv = _dc.replace(
+            icfg.serving,
+            token_budget=max(icfg.serving.token_budget,
+                             icfg.serving.max_running * (k + 1)),
+            speculative=_dc.replace(icfg.serving.speculative,
+                                    enabled=enabled, k=k))
+        return _dc.replace(icfg, serving=sv)
+
+    def run(enabled, drafter=None, arrivals=None):
+        eng = InferenceEngineV2(model, params, spec_cfg(enabled))
+        sched = ContinuousBatchingScheduler(eng, drafter=drafter)
+        out = sched.serve(prompts, max_new_tokens=max_new,
+                          arrivals=arrivals)
+        return out, sched.stats()
+
+    # throwaway + capacity passes at k=0 calibrate the arrivals every
+    # variant then replays, so all runs face identical offered load
+    run(False)
+    _, cold = run(False)
+    span = n_requests * max_new / cold["sustained_tokens_per_sec"] / load
+    arrivals = np.cumsum(rng.exponential(span / n_requests,
+                                         size=n_requests)).tolist()
+
+    def variant(enabled, drafter=None):
+        out, st = run(enabled, drafter=drafter, arrivals=list(arrivals))
+        sp = st["speculative"]
+        return out, {
+            # tpot_p50 can legitimately be 0.0 (multi-token ticks emit at
+            # one timestamp — the speculative win itself), so guard on
+            # None, not truthiness; ttft keeps the denominator positive
+            "tokens_per_sec_per_seq": round(
+                max_new / (st["ttft_p50_s"]
+                           + st["tpot_p50_s"] * (max_new - 1)), 2)
+            if st["tpot_p50_s"] is not None else None,
+            "sustained_tokens_per_sec": round(
+                st["sustained_tokens_per_sec"], 1),
+            "steps_per_emitted_token": (
+                round(sp["steps_per_emitted_token"], 3)
+                if sp["steps_per_emitted_token"] is not None else None),
+            "acceptance_rate": (round(sp["acceptance_rate"], 3)
+                                if sp["acceptance_rate"] is not None
+                                else None),
+            "proposed": sp["proposed"], "rollbacks": sp["rollbacks"],
+            "ttft_p50_s": round(st["ttft_p50_s"], 4),
+            "ttft_p95_s": round(st["ttft_p95_s"], 4),
+            "tpot_p50_s": round(st["tpot_p50_s"], 4),
+            "tpot_p95_s": round(st["tpot_p95_s"], 4),
+            "ticks": st["ticks"],
+        }
+
+    out0, base = variant(False)
+    out_ng, ngram_row = variant(True)
+    out_dm, draft_row = variant(
+        True, drafter=DraftModelDrafter.for_target(model, params,
+                                                   spec_cfg(True)))
+    tok0 = [out0[u] for u in out0]
+    return {
+        "n_requests": n_requests,
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "prompt_period": period,
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "k": k,
+        "baseline_k0": base,
+        "ngram_k4": ngram_row,
+        "draft_model_k4": draft_row,
+        "speedup_steps_ngram_x": round(
+            base["steps_per_emitted_token"]
+            / ngram_row["steps_per_emitted_token"], 2),
+        "speedup_steps_draft_x": round(
+            base["steps_per_emitted_token"]
+            / draft_row["steps_per_emitted_token"], 2),
+        "token_mismatches_ngram_vs_k0": sum(a != b for a, b in zip(
+            [out_ng[u] for u in out_ng], tok0)),
+        "token_mismatches_draft_vs_k0": sum(a != b for a, b in zip(
+            [out_dm[u] for u in out_dm], tok0)),
+    }
+
+
 def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
     """Config #5: engine_v2 paged prefill + decode tokens/s.
 
@@ -779,6 +891,17 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         fleet_row = None
 
+    # ---- speculative decoding: k=0 vs k=4 on the same repetitive-suffix
+    # Poisson trace (ISSUE 8) — the steps-per-token lever on per-sequence
+    # latency, with acceptance rate and the token-parity check
+    try:
+        spec_row = serving_speculative_row(model, params, icfg,
+                                           cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving speculative bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        spec_row = None
+
     # decode FLOPs ≈ 2*N per token (fwd only) -> model-bandwidth utilization
     best_tps = max([decode_tps, fused_tps]
                    + [r["tokens_per_sec"] for r in engine_rows])
@@ -818,6 +941,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_goodput": goodput,
         "serving_prefix_cache": prefix_row,
         "serving_fleet": fleet_row,
+        "serving_speculative": spec_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
                                 if eng_best else None),
         "decode_hbm_util": (eng_best or {}).get("hbm_util"),

@@ -34,6 +34,12 @@ python -m pytest tests/test_prefix_cache.py tests/test_kv_quant.py -q "$@"
 # prefill->decode transfer (wire-format roundtrip incl. quantized scale
 # planes, handshake atomicity on reject, crash-mid-transfer cleanliness).
 python -m pytest tests/test_serving_router.py tests/test_disagg.py -q "$@"
+# Speculative-decoding gates (ISSUE 8): exact-token parity vs decode_loop
+# across k, one-dispatch verify ticks + warmed-server zero-recompile,
+# the steps-per-token bar, rejected-draft KV rewind atomicity vs the
+# prefix-cache commit chain, and the prefix x speculative x kv-dtype
+# compose matrix.
+python -m pytest tests/test_speculative.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \
@@ -46,4 +52,5 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_prefix_cache.py \
     --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_serving_router.py \
-    --ignore=tests/test_disagg.py "$@"
+    --ignore=tests/test_disagg.py \
+    --ignore=tests/test_speculative.py "$@"

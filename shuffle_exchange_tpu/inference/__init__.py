@@ -10,17 +10,23 @@ Capability analog of the reference's two inference stacks:
     state manager, and a continuous-batching ``put/query/flush`` API.
 """
 
-from .config import InferenceConfig, RouterConfig, ServingConfig
+from .config import (InferenceConfig, RouterConfig, ServingConfig,
+                     SpeculativeConfig)
 from .engine import InferenceEngine, init_inference, load_serving_weights
 from .paged import BlockedAllocator, PagedKVCache
 from .engine_v2 import (ImportReservation, InferenceEngineV2, KVBlockPayload,
                         SequenceDescriptor)
 from .scheduler import ContinuousBatchingScheduler, ServingRequest
+from .speculative import DraftModelDrafter, NGramDrafter, make_drafter
 
 __all__ = [
     "InferenceConfig",
     "RouterConfig",
     "ServingConfig",
+    "SpeculativeConfig",
+    "DraftModelDrafter",
+    "NGramDrafter",
+    "make_drafter",
     "InferenceEngine",
     "init_inference",
     "load_serving_weights",

@@ -30,6 +30,99 @@ def _normalize_kv_cache_dtype(value) -> str:
 
 
 @dataclasses.dataclass
+class SpeculativeConfig:
+    """Speculative decoding inside the one-dispatch serving step (ISSUE 8).
+
+    A running sequence submits up to ``k`` draft tokens per tick; the
+    scheduler verifies them in the SAME compiled mixed-batch dispatch that
+    handles prefill chunks (the ``_extend_layer`` path is the verifier).
+    Greedy acceptance — accept the longest draft prefix matching the
+    verifier's argmax chain, then take the verifier's first correction —
+    keeps an exact-token-parity contract with sequential ``decode_loop``
+    under bf16 KV.
+
+    Drafts come from a pluggable source:
+      - ``drafter="ngram"`` — self-speculation / prompt-lookup: match the
+        trailing ``ngram`` tokens of the sequence's history against its own
+        earlier tokens and propose what followed (zero extra weights; wins
+        on repetitive suffixes — code, structured output, multi-turn).
+      - ``drafter="model"`` — a small draft model (``draft_model`` = an HF
+        path/dir loaded via ``models/hf.py:from_hf``, or pass a drafter
+        instance to the scheduler directly) running its own paged cache.
+
+    ``k_bins`` is the verify-width ladder the mixed step compiles against
+    (row width = k+1 for a k-draft row): like ``chunk_bins``, it bounds
+    the compiled program set so a warmed server never recompiles. None
+    derives powers of two up to ``k``."""
+
+    enabled: bool = False
+    k: int = 4                    # max draft tokens per sequence per tick
+    drafter: str = "ngram"        # "ngram" | "model"
+    ngram: int = 2                # match length for the prompt-lookup drafter
+    draft_model: Optional[str] = None   # HF model path for drafter="model"
+    k_bins: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if not isinstance(self.enabled, bool):
+            raise ConfigError(
+                f"serving.speculative.enabled must be a bool, got "
+                f"{self.enabled!r}")
+        if not isinstance(self.k, int) or self.k < 1:
+            raise ConfigError(
+                f"serving.speculative.k must be an int >= 1 (draft tokens "
+                f"per sequence per tick), got {self.k!r}")
+        if self.drafter not in ("ngram", "model"):
+            raise ConfigError(
+                f'serving.speculative.drafter must be "ngram" or "model", '
+                f"got {self.drafter!r}")
+        if not isinstance(self.ngram, int) or self.ngram < 1:
+            raise ConfigError(
+                f"serving.speculative.ngram must be an int >= 1, got "
+                f"{self.ngram!r}")
+        if self.drafter == "model" and self.enabled and not self.draft_model:
+            # a drafter INSTANCE passed to the scheduler overrides this,
+            # but a bare config asking for a model drafter with no model
+            # is a mistake worth naming at config time
+            logger.info(
+                "serving.speculative: drafter='model' with no draft_model "
+                "path — the scheduler needs an explicit drafter instance")
+        if self.k_bins is not None:
+            try:
+                bins = tuple(sorted({int(b) for b in self.k_bins}))
+            except (TypeError, ValueError) as e:
+                raise ConfigError(
+                    f"serving.speculative.k_bins must be a list of ints: "
+                    f"{e}") from e
+            if not bins or bins[0] < 1 or bins[-1] < self.k:
+                raise ConfigError(
+                    f"serving.speculative.k_bins must be positive and cover "
+                    f"k={self.k}, got {self.k_bins!r}")
+            self.k_bins = bins
+
+    def bins(self) -> Tuple[int, ...]:
+        """The draft-count ladder (ascending, covers k)."""
+        if self.k_bins:
+            return self.k_bins
+        out, b = [], 1
+        while b < self.k:
+            out.append(b)
+            b *= 2
+        out.append(self.k)
+        return tuple(dict.fromkeys(out))
+
+    def bin_k(self, j: int) -> int:
+        """Smallest ladder bin >= j (verify rows are padded to bin+1
+        tokens so the warmed server's program set stays bounded)."""
+        for b in self.bins():
+            if j <= b:
+                return b
+        out = self.bins()[-1]
+        while out < j:
+            out *= 2
+        return out
+
+
+@dataclasses.dataclass
 class ServingConfig:
     """Continuous-batching scheduler knobs (``inference/scheduler.py`` —
     the Dynamic-SplitFuse scheduler the reference FastGen engine runs,
@@ -48,8 +141,22 @@ class ServingConfig:
     max_running: int = 8          # cap on concurrently-decoding sequences
     chunk_min: int = 16           # smallest partial prefill chunk worth a slot
     chunk_bins: Optional[Tuple[int, ...]] = None
+    # speculative decoding (ISSUE 8): k draft tokens per running sequence
+    # per tick, verified in the same one-dispatch mixed step
+    speculative: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig)
 
     def __post_init__(self):
+        if self.speculative is None:
+            self.speculative = SpeculativeConfig()
+        elif isinstance(self.speculative, dict):
+            allowed = {f.name for f in dataclasses.fields(SpeculativeConfig)}
+            unknown = set(self.speculative) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown serving.speculative config keys "
+                    f"{sorted(unknown)} (allowed: {sorted(allowed)})")
+            self.speculative = SpeculativeConfig(**self.speculative)
         if self.token_budget < 1:
             raise ConfigError(f"serving.token_budget must be >= 1, got "
                               f"{self.token_budget}")
@@ -58,6 +165,16 @@ class ServingConfig:
                 f"serving.max_running must be in [1, token_budget="
                 f"{self.token_budget}] (every running sequence takes one "
                 f"budget slot per tick), got {self.max_running}")
+        if (self.speculative.enabled
+                and self.token_budget
+                < self.max_running * (self.speculative.k + 1)):
+            raise ConfigError(
+                f"serving.token_budget ({self.token_budget}) must cover "
+                f"max_running * (speculative.k + 1) = "
+                f"{self.max_running} * {self.speculative.k + 1} — every "
+                f"running sequence may submit k drafts plus its pending "
+                f"token per tick; raise token_budget or lower "
+                f"max_running/k")
         if not 1 <= self.chunk_min <= self.token_budget:
             raise ConfigError(
                 f"serving.chunk_min must be in [1, token_budget="

@@ -145,6 +145,10 @@ class InferenceEngine:
     # v2 overrides: its paged decode step can fuse ATTENTION (split-K paged
     # kernel + in-pool append) even when qkv/mlp fusion is structurally off
     _fused_attention = False
+    # v2 overrides: only the paged engine runs speculative verify rows, so
+    # only it gets the verify-width routing gate/warning (a v1 engine built
+    # from a speculative-enabled config has no verify lane to route)
+    _has_verify_lane = False
 
     def __init__(self, model, params, config: Optional[InferenceConfig] = None):
         import jax
@@ -176,11 +180,18 @@ class InferenceEngine:
         from ..utils.logging import warning_once
 
         requested = self.config.decode_kernel
-        self._decode_kernel = resolve_decode_kernel(requested)
+        # speculative verify width (ISSUE 8): k+1-token verify rows are
+        # outside the single-token fused decode kernels' contract — the
+        # resolver warns once and the eligibility dict records the gate,
+        # so the routing is explicit instead of shape-dependent
+        spec = self.config.serving.speculative
+        spec_k = spec.k if (spec.enabled and self._has_verify_lane) else 0
+        self._decode_kernel = resolve_decode_kernel(requested,
+                                                    speculative_k=spec_k)
         self._fuse_qkv = self._fuse_mlp = False
         if self._decode_kernel != "pallas":
             return
-        elig = decode_fusion_eligibility(self._mcfg)
+        elig = decode_fusion_eligibility(self._mcfg, speculative_k=spec_k)
         self._fuse_qkv = elig["qkv"] is None
         self._fuse_mlp = elig["mlp"] is None
         reasons = [r for r in (elig["qkv"], elig["mlp"]) if r]

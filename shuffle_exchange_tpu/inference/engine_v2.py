@@ -119,6 +119,7 @@ class InferenceEngineV2(InferenceEngine):
 
     _fused_attention = True   # the paged decode step has a fused-attention
     # form (split-K kernel + in-pool append) independent of qkv/mlp fusion
+    _has_verify_lane = True   # speculative verify rows exist here (ISSUE 8)
 
     def __init__(self, model, params, config: Optional[InferenceConfig] = None):
         super().__init__(model, params, config)
@@ -135,6 +136,12 @@ class InferenceEngineV2(InferenceEngine):
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
         self.cow_copies = 0
+        # speculative-decode observability (ISSUE 8): rewinds of rejected
+        # draft KV and the slots they returned (the scheduler's
+        # speculative/* counter group reads these alongside its own
+        # proposed/accepted tallies)
+        self.spec_rollbacks = 0
+        self.spec_rolled_tokens = 0
         # block 0 is scratch: padding table entries scribble here, never read.
         self._scratch = self.allocator.allocate(1)[0]
         self._seqs: Dict[int, SequenceDescriptor] = {}
@@ -595,6 +602,85 @@ class InferenceEngineV2(InferenceEngine):
         if need > 0:
             desc.blocks.extend(self.allocator.allocate(need))
 
+    # -- speculative rollback (ISSUE 8) ---------------------------------
+
+    def rewind(self, uid: int, n_tokens: int) -> None:
+        """Roll ``uid``'s written-token history back to its first
+        ``n_tokens`` slots — the rejected-draft half of speculative
+        decoding. Surplus blocks return to the allocator; the stale KV
+        bytes (data AND quantized scale planes) past the boundary are
+        never read again (every read path masks by ``seen_tokens``) and
+        the next write at those slots overwrites both planes.
+
+        Composition with the prefix-cache commit chain: rewinding INTO a
+        committed content-registered block invalidates its bytes-under-key
+        binding. An exclusively-held committed block is unregistered; a
+        REF-SHARED committed block is never touched — other sequences
+        (and future admissions) read it — so the rewind takes the
+        copy-on-write fallback: clone it privately first, or raise a
+        targeted error naming the block when the pool can't fund the
+        clone. Validation and the clone reservation happen BEFORE any
+        mutation, so a refused rewind leaves allocator + descriptor
+        untouched (the PR 6 free() atomicity discipline)."""
+        desc = self._seqs.get(uid)
+        if desc is None:
+            raise ValueError(f"unknown uid {uid}")
+        self._rewind(desc, int(n_tokens))
+
+    def _rewind(self, desc: SequenceDescriptor, n_tokens: int) -> None:
+        bs = self.cache.block_size
+        if not 1 <= n_tokens <= desc.seen_tokens:
+            raise ValueError(
+                f"rewind of uid {desc.uid} to {n_tokens} tokens: must be "
+                f"in [1, seen_tokens={desc.seen_tokens}]")
+        if n_tokens == desc.seen_tokens:
+            return
+        new_nb = blocks_needed(n_tokens, bs)
+        nc = n_tokens // bs            # full blocks that stay fully valid
+        # ---- plan (validate + decide the COW before any mutation) ----
+        tail_cow = tail_unregister = None
+        if nc < desc.committed and n_tokens % bs:
+            # the partial tail lands INSIDE a committed block: its tail
+            # slots will be rewritten by the sequence's continuation
+            b = desc.blocks[nc]
+            if self.allocator.ref_count(b) > 1:
+                if self.allocator.free_blocks < 1:
+                    raise RuntimeError(
+                        f"cannot rewind uid {desc.uid} to {n_tokens} "
+                        f"tokens: block {b} is a committed prefix block "
+                        f"shared by {self.allocator.ref_count(b)} "
+                        "sequences and the pool has no free block for the "
+                        "copy-on-write clone; flush finished sequences or "
+                        "raise num_kv_blocks")
+                tail_cow = b
+            else:
+                tail_unregister = b
+        # ---- mutate ----
+        if tail_cow is not None:
+            [nb] = self.allocator.allocate(1)
+            self._clone_block(tail_cow, nb)
+            self.allocator.free([tail_cow])
+            desc.blocks[nc] = nb
+            self.cow_copies += 1
+        elif tail_unregister is not None:
+            self.allocator.unregister(tail_unregister)
+        if new_nb < len(desc.blocks):
+            # committed blocks PAST the boundary are freed intact: their
+            # registered content still matches its key (the key hashes
+            # exactly the tokens written there), so a ref-0 registered
+            # block parks reusable in the allocator's cached-free LRU —
+            # a re-proposed draft chain can hit it again for free
+            self.allocator.free(desc.blocks[new_nb:])
+            del desc.blocks[new_nb:]
+        self.spec_rolled_tokens += desc.seen_tokens - n_tokens
+        self.spec_rollbacks += 1
+        desc.seen_tokens = n_tokens
+        del desc.tokens[n_tokens:]
+        if desc.committed > nc:
+            desc.committed = nc
+            keys = chain_block_keys(desc.tokens[:nc * bs], bs)
+            desc.last_key = keys[-1] if keys else b""
+
     # -- prefix cache (content-addressed block reuse) -------------------
 
     def prefix_peek(self, tokens: Sequence[int]) -> Tuple[int, int, int]:
@@ -921,9 +1007,100 @@ class InferenceEngineV2(InferenceEngine):
         plogits = self.model.head(params, x_last)[:, 0]
         return self._cache_of(kp, vp), dlogits, plogits
 
+    # -- speculative mixed step (ISSUE 8) ------------------------------
+
+    def _spec_fn(self, key):
+        fn = self._mixed_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        fn = jax.jit(self._spec_step_impl, donate_argnums=_donate_cache())
+        self._mixed_cache[key] = fn
+        return fn
+
+    def _spec_step_impl(self, params, cache: PagedKVCache, dops, pops, sops):
+        """The speculative mixed step: ONE program advances plain decode
+        rows by one token, absorbs prefill chunks, AND verifies draft
+        rows — each draft row is ``[pending_token, d1..dk]`` running
+        through the SAME ``_extend_layer`` body as prefill chunks (the
+        verifier is the chunked-prefill path; its intra-chunk causal mask
+        is exactly the draft-verification mask). Lanes are pytree-absent
+        (empty tuple) when unused, so every lane combination is its own
+        compiled program on the shape-bin ladder.
+
+        Verification is greedy and ON-DEVICE: per draft row the head runs
+        at EVERY chunk position (this is the verify cost — k+1 head
+        projections instead of 1), ``ver[j] = argmax`` after position j,
+        and the accepted length is the longest prefix where
+        ``ver[j] == ids[j+1]`` (draft j+1 matches the verifier). Returns
+        per-row ``(ver [Bs,Cs], accepted [Bs], last_logits [Bs,V])`` —
+        ``last_logits`` is the row's logits at its accepted position, so
+        the host emits ``drafts[:a] + [ver[a]]`` (the correction when
+        a < k, the bonus token when a == k) without shipping [Bs,Cs,V]
+        logits off device."""
+        import jax
+        import jax.numpy as jnp
+
+        dops, pops, sops = tuple(dops), tuple(pops), tuple(sops)
+        xd = xp = xs = None
+        cos = sin = None
+        if dops:
+            dtok, dpos, dtables = dops
+            xd, (cos, sin), _ = self._embed_at(params, dtok[:, None], dpos)
+        if pops:
+            pids, pstart, pnnew, ptables = pops
+            xp, (cos, sin), ppos = self._embed_at(params, pids, pstart)
+        if sops:
+            sids, sstart, snnew, stables = sops
+            xs, (cos, sin), spos = self._embed_at(params, sids, sstart)
+
+        def layer_fn(carry, layer_and_cache):
+            hd, hp, hs = carry
+            lw, ck, cv = layer_and_cache
+            if hd is not None:
+                hd, (ck, cv) = self._decode_layer(lw, hd, ck, cv, cos, sin,
+                                                  dpos, dtables)
+            if hp is not None:
+                hp, (ck, cv) = self._extend_layer(lw, hp, ck, cv, cos, sin,
+                                                  ppos, pstart, pnnew,
+                                                  ptables)
+            if hs is not None:
+                # the verify lane IS the extend path (ISSUE 8 satellite:
+                # k+1-wide rows are outside the single-token fused decode
+                # kernels — decode_fusion_eligibility's "verify" gate)
+                hs, (ck, cv) = self._extend_layer(lw, hs, ck, cv, cos, sin,
+                                                  spos, sstart, snnew,
+                                                  stables)
+            return (hd, hp, hs), (ck, cv)
+
+        (xd, xp, xs), (kp, vp) = jax.lax.scan(
+            layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache))
+        dlogits = self.model.head(params, xd)[:, 0] if dops else None
+        plogits = None
+        if pops:
+            x_last = jnp.take_along_axis(
+                xp, (pnnew - 1)[:, None, None].astype(jnp.int32), axis=1)
+            plogits = self.model.head(params, x_last)[:, 0]
+        sres = None
+        if sops:
+            slog = self.model.head(params, xs)          # [Bs, Cs, V]
+            ver = jnp.argmax(slog, axis=-1).astype(jnp.int32)
+            Bs, Cs = sids.shape
+            nxt = jnp.concatenate(
+                [sids[:, 1:], jnp.zeros((Bs, 1), sids.dtype)], axis=1)
+            j = jnp.arange(Cs)[None, :]
+            m = jnp.where(j < (snnew - 1)[:, None], ver == nxt, False)
+            accepted = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1),
+                               axis=1)                   # [Bs] in [0, k]
+            slast = jnp.take_along_axis(
+                slog, accepted[:, None, None], axis=1)[:, 0]
+            sres = (ver, accepted, slast)
+        return self._cache_of(kp, vp), dlogits, plogits, sres
+
     def step(self, decode_uids: Sequence[int], decode_tokens: Sequence[int],
-             prefills: Sequence[Tuple[int, Sequence[int]]] = ()
-             ) -> Tuple[np.ndarray, np.ndarray]:
+             prefills: Sequence[Tuple[int, Sequence[int]]] = (),
+             speculative: Sequence[Tuple[int, Sequence[int]]] = ()):
         """One continuous-batching tick: every uid in ``decode_uids``
         advances one token and every ``(uid, chunk)`` in ``prefills``
         absorbs a prompt chunk (new uids start chunked prefill at position
@@ -931,26 +1108,47 @@ class InferenceEngineV2(InferenceEngine):
         device dispatch — the serving loop's per-tick program
         (inference/scheduler.py packs these against the token budget).
 
+        ``speculative`` (ISSUE 8): ``(uid, [pending_token, d1..dk])`` rows
+        for KNOWN uids — the pending decode input plus k drafter
+        proposals, verified in the SAME dispatch via the extend path.
+        Greedy acceptance: the row advances by the longest draft prefix
+        matching the verifier's argmax chain plus the verifier's own next
+        token (correction on a reject, bonus on a full accept); rejected
+        drafts roll the paged-KV state back (written-token history, block
+        refcounts, prefix-cache commit chain — see ``rewind``) before the
+        commit, so the engine state after the tick is exactly as if only
+        the accepted tokens had ever been decoded.
+
         Shapes are binned so a serving process compiles a bounded program
-        set: decode rows and prefill rows round up a power-of-two ladder,
-        chunk length rounds up the ``serving.chunk_bins`` ladder, and
-        block-table widths round up powers of two covering the batch
-        (asserted in tests/test_serving_scheduler.py). Admission is
-        all-or-nothing BEFORE any state mutation, with errors naming
-        needed-vs-free KV blocks and the offending uid.
+        set: decode/prefill/verify row counts and block-table widths round
+        up a power-of-two ladder, chunk length rounds up the
+        ``serving.chunk_bins`` ladder, verify width rounds up the
+        ``serving.speculative.k_bins`` ladder (asserted in
+        tests/test_serving_scheduler.py + tests/test_speculative.py).
+        Admission is all-or-nothing BEFORE any state mutation, with errors
+        naming needed-vs-free KV blocks and the offending uid; the
+        admission charges every speculative row its FULL draft+verify
+        width (worst case, all accepted).
 
         Returns ``(decode_logits [len(decode_uids), V], prefill_logits
         [len(prefills), V])`` — prefill logits are at each chunk's last
         token (argmax of a final chunk's row is the sequence's first
-        generated token)."""
+        generated token). With ``speculative`` rows the return is a
+        3-tuple ``(decode_logits, prefill_logits, spec_results)`` where
+        ``spec_results[i] = (accepted_count, emitted_tokens)`` for row i —
+        ``emitted_tokens`` is the accepted drafts plus the verifier's
+        correction/bonus token, every one of them exactly the greedy
+        reference chain."""
         prefills = [(u, list(map(int, c))) for u, c in prefills]
+        speculative = [(u, list(map(int, c))) for u, c in speculative]
         if len(decode_uids) != len(decode_tokens):
             raise ValueError("decode_uids and decode_tokens must align")
-        all_uids = list(decode_uids) + [u for u, _ in prefills]
+        all_uids = (list(decode_uids) + [u for u, _ in prefills]
+                    + [u for u, _ in speculative])
         if len(set(all_uids)) != len(all_uids):
             raise ValueError(
-                "duplicate uid in one step(): a sequence is either decoding "
-                "or prefilling in a tick, never both")
+                "duplicate uid in one step(): a sequence is either decoding, "
+                "prefilling or verifying drafts in a tick, never two at once")
         for uid in decode_uids:
             if uid not in self._seqs:
                 raise ValueError(f"decode uid {uid} unknown — prefill it "
@@ -958,8 +1156,18 @@ class InferenceEngineV2(InferenceEngine):
         for uid, chunk in prefills:
             if not chunk:
                 raise ValueError(f"prefill uid {uid} with an empty chunk")
+        for uid, chunk in speculative:
+            if uid not in self._seqs:
+                raise ValueError(f"speculative uid {uid} unknown — a draft "
+                                 "row verifies an already-running sequence")
+            if len(chunk) < 2:
+                raise ValueError(
+                    f"speculative uid {uid} with {len(chunk)} tokens — a "
+                    "verify row is [pending_token, drafts...]; a row with "
+                    "no drafts belongs in decode_uids")
         ok, _, why = self._admission_detail(
-            all_uids, [1] * len(decode_uids) + [len(c) for _, c in prefills])
+            all_uids, [1] * len(decode_uids) + [len(c) for _, c in prefills]
+            + [len(c) for _, c in speculative])
         if not ok:
             raise RuntimeError(f"cannot schedule step(): {why}")
 
@@ -972,10 +1180,17 @@ class InferenceEngineV2(InferenceEngine):
                 self._seqs[uid] = desc
             pdescs.append(desc)
         ddescs = [self._seqs[u] for u in decode_uids]
+        sdescs = [self._seqs[u] for u, _ in speculative]
         for d in ddescs:
             self._ensure_blocks(d, d.seen_tokens + 1)
         for d, (_, chunk) in zip(pdescs, prefills):
             self._ensure_blocks(d, d.seen_tokens + len(chunk))
+        for d, (_, chunk) in zip(sdescs, speculative):
+            self._ensure_blocks(d, d.seen_tokens + len(chunk))
+
+        if sdescs:
+            return self._speculative_dispatch(
+                decode_tokens, ddescs, prefills, pdescs, speculative, sdescs)
 
         V = self._mcfg.vocab_size
         dlogits = np.zeros((0, V), np.float32)
@@ -1022,6 +1237,72 @@ class InferenceEngineV2(InferenceEngine):
             d.last_logits = plogits[i]
             self._commit(d)
         return dlogits[:len(ddescs)], plogits[:len(pdescs)]
+
+    def _speculative_dispatch(self, decode_tokens, ddescs, prefills, pdescs,
+                              speculative, sdescs):
+        """The spec-lane tail of step(): pack all three lanes, run ONE
+        ``_spec_step_impl`` dispatch, then apply acceptance — advance each
+        verify row by its full chunk, rewind the rejected suffix, commit,
+        and hand back ``(accepted, emitted_tokens)`` per row."""
+        sv = self.config.serving
+        V = self._mcfg.vocab_size
+        dops = pops = sops = ()
+        Bd = Wd = Bp = C = Wp = 0
+        if ddescs:
+            Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs,
+                                                          decode_tokens)
+            dops = (tok, pos, dtables)
+        if pdescs:
+            chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
+            cmax = max(len(c) for _, c in chunks)
+            Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
+                chunks, pad_chunk=sv.bin_chunk(cmax))
+            pops = (ids, start, nnew, ptables)
+        schunks = [(d, c) for d, (_, c) in zip(sdescs, speculative)]
+        # verify width off the k ladder: a row carrying j drafts is j+1
+        # tokens; pad to bin_k(max j) + 1 so the warmed server's verify
+        # programs stay bounded exactly like chunk lengths do
+        kmax = max(len(c) for _, c in schunks) - 1
+        Bs, Cs, Ws, sids, sstart, snnew, stables = self._pack_chunks(
+            schunks, pad_chunk=sv.speculative.bin_k(kmax) + 1)
+        sops = (sids, sstart, snnew, stables)
+
+        key = ("spec", Bd, Wd, Bp, C, Wp, Bs, Cs, Ws)
+        fn = self._spec_fn(key)
+        self.cache, dl, pl, sres = fn(self.params, self.cache, dops, pops,
+                                      sops)
+        self.dispatch_count += 1
+        self._program_keys.add(key)
+        dlogits = (np.asarray(dl) if dl is not None
+                   else np.zeros((0, V), np.float32))
+        plogits = (np.asarray(pl) if pl is not None
+                   else np.zeros((0, V), np.float32))
+        ver, accepted, slast = (np.asarray(x) for x in sres)
+
+        for i, d in enumerate(ddescs):
+            d.seen_tokens += 1
+            d.tokens.append(int(decode_tokens[i]))
+            d.last_logits = dlogits[i]
+            self._commit(d)
+        for i, (d, (_, chunk)) in enumerate(zip(pdescs, prefills)):
+            d.seen_tokens += len(chunk)
+            d.tokens.extend(chunk)
+            d.last_logits = plogits[i]
+            self._commit(d)
+        spec_results = []
+        for i, (d, chunk) in enumerate(schunks):
+            n, a = len(chunk), int(accepted[i])
+            d.seen_tokens += n
+            d.tokens.extend(chunk)
+            # keep [pending_token, d1..da]; roll back the n-1-a rejected
+            # draft slots BEFORE the commit so the content registry never
+            # sees a rejected token
+            if a < n - 1:
+                self._rewind(d, d.seen_tokens - (n - 1 - a))
+            d.last_logits = slast[i]
+            self._commit(d)
+            spec_results.append((a, chunk[1:1 + a] + [int(ver[i, a])]))
+        return dlogits[:len(ddescs)], plogits[:len(pdescs)], spec_results
 
     # -- fused multi-token decode --------------------------------------
 

@@ -209,6 +209,23 @@ class BlockedAllocator:
         if key is not None:
             self._block_of.pop(key, None)
 
+    def unregister(self, block: int) -> None:
+        """Drop a block's content registration (the block stays live under
+        its holder). For speculative-decode rewinds (ISSUE 8): a rejected
+        draft invalidates a committed block's bytes-under-key binding —
+        the rewinding sequence is about to overwrite part of the block, so
+        future admissions must not resolve the stale key to it. Only legal
+        on a block the caller holds exclusively (refcount 1); a ref-shared
+        committed block must be COW-cloned instead, never unregistered out
+        from under its other holders' future re-admissions."""
+        if self.ref_count(block) != 1:
+            raise ValueError(
+                f"unregister of block {block} with refcount "
+                f"{self.ref_count(block)}: only an exclusively-held block "
+                "may lose its registration (shared committed blocks take "
+                "the copy-on-write path)")
+        self._unregister(block)
+
     def peek(self, keys: Sequence[bytes]) -> Tuple[int, int]:
         """(live, parked) counts for the longest registered prefix of
         ``keys`` — live blocks cost an admission ZERO new allocations,

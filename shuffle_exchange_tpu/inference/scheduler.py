@@ -35,6 +35,16 @@ cow_copies, shared_blocks}`` (ISSUE 6: with ``prefix_caching`` on,
 admission reuses committed shared-prefix KV blocks ref-counted — zero new
 allocations for the shared span — and prefill starts from the first
 non-cached token, shrinking both TTFT and per-tick prefill spend).
+
+Speculative decoding (ISSUE 8, ``serving.speculative``): a running
+sequence may submit k draft tokens per tick — from the n-gram
+prompt-lookup self-drafter or a small draft model (``speculative.py``) —
+verified in the SAME one-dispatch mixed step via the extend path with
+greedy acceptance, so each tick emits 1..k+1 tokens per sequence at
+exact-token parity with sequential ``decode_loop`` (bf16 KV). The
+``speculative/{proposed, accepted, rejected, acceptance_rate,
+rollbacks}`` counter group tracks it; rejected drafts rewind paged-KV
+state through ``InferenceEngineV2.rewind`` before anything commits.
 """
 
 from __future__ import annotations
@@ -72,6 +82,10 @@ class ServingRequest:
     finished_at: Optional[float] = None
     tpot_s: List[float] = dataclasses.field(default_factory=list)
     preemptions: int = 0
+    # ticks this request spent in the decode/verify lane (ISSUE 8): with
+    # speculation on, decode_ticks / len(generated) is the per-sequence
+    # steps-per-emitted-token — the lever speculative decoding pulls
+    decode_ticks: int = 0
 
     @property
     def prefill_target(self) -> List[int]:
@@ -96,7 +110,8 @@ class ContinuousBatchingScheduler:
                  on_token: Optional[Callable[[int, int], None]] = None,
                  monitor: Optional[Monitor] = None,
                  clock: Callable[[], float] = time.perf_counter,
-                 replica_id: int = 0):
+                 replica_id: int = 0,
+                 drafter=None):
         if not isinstance(engine, InferenceEngineV2):
             raise TypeError("ContinuousBatchingScheduler needs the paged "
                             f"InferenceEngineV2, got {type(engine).__name__}")
@@ -123,6 +138,22 @@ class ContinuousBatchingScheduler:
         self.ticks = 0
         self.preemptions = 0
         self._next_uid = 0
+        # speculative decoding (ISSUE 8): k drafts per running sequence
+        # per tick, verified in the same one-dispatch mixed step. The
+        # drafter comes from the config (ngram self-speculation needs no
+        # weights; drafter="model" loads serving.speculative.draft_model
+        # via models/hf) unless an instance is passed in — the router
+        # hands each replica its engine's own serving config unchanged,
+        # so per-replica speculation follows the replica's engine.
+        self.spec = self.cfg.speculative
+        self.drafter = drafter
+        if self.spec.enabled and self.drafter is None:
+            from .speculative import make_drafter
+
+            self.drafter = make_drafter(self.spec, like=engine.config)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rejected = 0
 
     # -- request intake ------------------------------------------------
 
@@ -189,6 +220,8 @@ class ContinuousBatchingScheduler:
         replay under greedy decoding)."""
         if r.uid in self.engine._seqs:
             self.engine.flush([r.uid])
+        if self.drafter is not None:
+            self.drafter.forget(r.uid)
         self.active.remove(r)
         r.state = QUEUED
         r.prefill_done = 0
@@ -204,6 +237,8 @@ class ContinuousBatchingScheduler:
         r.finished_at = now
         if r.uid in self.engine._seqs:
             self.engine.flush([r.uid])
+        if self.drafter is not None:
+            self.drafter.forget(r.uid)
         if r in self.active:
             self.active.remove(r)
 
@@ -234,21 +269,66 @@ class ContinuousBatchingScheduler:
         eng, cfg = self.engine, self.cfg
         bs = eng.cache.block_size
 
-        # 1) decode set: every running sequence takes one budget slot. If
-        # their next tokens don't all fit in the KV pool, preempt the
-        # youngest admitted sequence (running or prefilling — both hold
-        # blocks) until they do.
+        # 1) decode set: every running sequence takes one budget slot — or
+        # 1+k slots when its drafter proposes k tokens this tick (ISSUE 8:
+        # the pending token plus the drafts are one verify row through the
+        # same dispatch). Draft+verify tokens are accounted — budget AND
+        # KV blocks — BEFORE any state mutation; if the pool can't hold
+        # them, preempt the youngest admitted sequence until it can.
+        spec_rows: Dict[int, List[int]] = {}
+        if self.spec.enabled and self.drafter is not None:
+            reqs = []
+            for r in self.active:
+                if r.state != RUNNING:
+                    continue
+                # cap the draft width so an accepted run can never emit
+                # past max_new_tokens or write past max_seq_len
+                cap = min(self.spec.k,
+                          r.max_new_tokens - len(r.generated) - 1,
+                          eng.config.max_seq_len - self._seen(r) - 1)
+                if cap >= 1:
+                    reqs.append((r, r.prompt + r.generated, cap))
+            if reqs:
+                # batch-shaped drafters (the draft-model one) propose the
+                # whole tick's rows in one pass — one sync put + one
+                # decode_loop dispatch per k, not one dispatch per row
+                many = getattr(self.drafter, "propose_many", None)
+                if many is not None:
+                    got = many([(r.uid, h, c) for r, h, c in reqs])
+                else:
+                    got = {r.uid: self.drafter.propose(r.uid, h, c)
+                           for r, h, c in reqs}
+                for r, _, cap in reqs:
+                    drafts = got.get(r.uid) or []
+                    if drafts:
+                        spec_rows[r.uid] = ([r.generated[-1]]
+                                            + [int(t) for t in drafts[:cap]])
+
+        def row_cost(r):
+            return len(spec_rows.get(r.uid, ())) or 1
+
         def decode_need(rs):
-            return sum(max(0, blocks_needed(self._seen(r) + 1, bs)
+            return sum(max(0, blocks_needed(self._seen(r) + row_cost(r), bs)
                            - self._have_blocks(r)) for r in rs)
 
         while True:
             decodes = [r for r in self.active if r.state == RUNNING]
             if decode_need(decodes) <= eng.free_blocks or not self.active:
                 break
+            # draft widths are OPTIONAL work: before preempting anyone,
+            # demote the youngest verify row to a plain decode token and
+            # recheck — dropping a proposal costs nothing (the drafter
+            # resyncs off the emitted history next tick), where a preempt
+            # flushes KV and replays the whole prefill
+            victim = next((r for r in reversed(self.active)
+                           if r.uid in spec_rows), None)
+            if victim is not None:
+                spec_rows.pop(victim.uid)
+                continue
             self._preempt(self.active[-1])
 
-        budget_left = cfg.token_budget - len(decodes)
+        decode_cost = sum(row_cost(r) for r in decodes)
+        budget_left = cfg.token_budget - decode_cost
         free_left = eng.free_blocks - decode_need(decodes)
 
         # 2) fill the remainder with prefill chunks: partially-prefilled
@@ -319,21 +399,43 @@ class ContinuousBatchingScheduler:
                 f"running to release more; raise num_kv_blocks or lower "
                 f"max_running/concurrency")
 
-        # 4) ONE mixed dispatch for the whole tick
+        # 4) ONE mixed dispatch for the whole tick: plain decode rows,
+        # prefill chunk rows, and speculative verify rows all ride it
         self.ticks += 1
-        packed = len(decodes) + sum(len(c) for _, c in prefills)
+        packed = decode_cost + sum(len(c) for _, c in prefills)
+        spec_batch = [(r, spec_rows[r.uid]) for r in decodes
+                      if r.uid in spec_rows]
+        plain = [r for r in decodes if r.uid not in spec_rows]
         t0 = self.clock()
-        dlogits, plogits = eng.step(
-            [r.uid for r in decodes], [r.generated[-1] for r in decodes],
-            [(r.uid, c) for r, c in prefills])
+        if spec_batch:
+            dlogits, plogits, sres = eng.step(
+                [r.uid for r in plain], [r.generated[-1] for r in plain],
+                [(r.uid, c) for r, c in prefills],
+                speculative=[(r.uid, c) for r, c in spec_batch])
+        else:
+            dlogits, plogits = eng.step(
+                [r.uid for r in plain], [r.generated[-1] for r in plain],
+                [(r.uid, c) for r, c in prefills])
+            sres = []
         tick_s = self.clock() - t0
 
-        # 5) results: decode tokens stream immediately; a finished prefill
+        # 5) results: decode tokens stream immediately; a verify row
+        # streams its accepted drafts plus the verifier's correction/bonus
+        # token (every one the exact greedy chain); a finished prefill
         # yields the sequence's next token (its FIRST for fresh requests)
         now = self.clock()
         events: list = []
-        for i, r in enumerate(decodes):
+        for i, r in enumerate(plain):
+            r.decode_ticks += 1
             self._emit(r, int(np.argmax(dlogits[i])), now, events)
+        for (r, chunk), (a, emitted) in zip(spec_batch, sres):
+            j = len(chunk) - 1
+            r.decode_ticks += 1
+            self.spec_proposed += j
+            self.spec_accepted += a
+            self.spec_rejected += j - a
+            for t in emitted:
+                self._emit(r, int(t), now, events)
         for i, (r, chunk) in enumerate(prefills):
             r.prefill_done += len(chunk)
             if r.prefill_done == len(r.prefill_target):
@@ -356,6 +458,18 @@ class ContinuousBatchingScheduler:
             ("prefix_cache/shared_blocks", eng.allocator.shared_blocks,
              self.ticks),
         ]
+        if self.spec.enabled:
+            # speculative group (cumulative; ISSUE 8): proposed/accepted/
+            # rejected count draft tokens, acceptance_rate is their ratio,
+            # rollbacks counts the engine's rejected-draft KV rewinds
+            events += [
+                ("speculative/proposed", self.spec_proposed, self.ticks),
+                ("speculative/accepted", self.spec_accepted, self.ticks),
+                ("speculative/rejected", self.spec_rejected, self.ticks),
+                ("speculative/acceptance_rate",
+                 self.spec_accepted / max(1, self.spec_proposed), self.ticks),
+                ("speculative/rollbacks", eng.spec_rollbacks, self.ticks),
+            ]
         self._write_events(events)
         return bool(self.active or self.queue)
 
@@ -380,6 +494,8 @@ class ContinuousBatchingScheduler:
         for r in list(self.active):
             if r.uid in self.engine._seqs:
                 self.engine.flush([r.uid])
+            if self.drafter is not None:
+                self.drafter.forget(r.uid)
             r.state = QUEUED
             r.prefill_done = 0
             r.preemptions += 1
@@ -535,5 +651,29 @@ class ContinuousBatchingScheduler:
                 "hit_rate": (hit / (hit + miss)) if (hit + miss) else None,
                 "cow_copies": eng.cow_copies,
                 "shared_blocks": eng.allocator.shared_blocks,
+            },
+            # ISSUE 8: the steps-per-token lever — with speculation on,
+            # ticks per emitted token falls below 1 as acceptance rises
+            # (the target is < 0.67 at k=4 on repetitive suffixes)
+            "speculative": {
+                "enabled": self.spec.enabled,
+                "k": self.spec.k if self.spec.enabled else 0,
+                "drafter": (type(self.drafter).__name__
+                            if self.drafter is not None else None),
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "rejected": self.spec_rejected,
+                "acceptance_rate": (self.spec_accepted / self.spec_proposed
+                                    if self.spec_proposed else None),
+                "rollbacks": eng.spec_rollbacks,
+                "rolled_back_tokens": eng.spec_rolled_tokens,
+                # per-sequence decode ticks per emitted token (the first
+                # token of each request comes from prefill, so a k=0 run
+                # measures (n-1)/n, and acceptance pushes it toward
+                # 1/(k+1)); batching does NOT deflate this the way
+                # ticks/total would
+                "steps_per_emitted_token": (
+                    sum(r.decode_ticks for r in done) / total if total
+                    else None),
             },
         }

@@ -1,0 +1,244 @@
+"""Draft-token sources for speculative serving (ISSUE 8).
+
+The scheduler's speculative tick needs k candidate continuations per
+running sequence; the verifier is the engine's own mixed-batch extend
+path (engine_v2._spec_step_impl), so a drafter only has to PROPOSE — the
+acceptance contract (greedy: longest draft prefix matching the verifier
+argmax chain) is enforced entirely on the target engine. Two sources,
+both behind ``serving.speculative``:
+
+  - :class:`NGramDrafter` — self-speculation / prompt-lookup (the LLMA /
+    prompt-lookup-decoding idiom): match the sequence's trailing n-gram
+    against its OWN earlier tokens and propose what followed. Zero extra
+    weights, zero extra device dispatches; wins exactly where decode is
+    most wasteful — repetitive suffixes (code, structured output,
+    multi-turn transcripts, retrieval-grounded answers that quote their
+    context).
+
+  - :class:`DraftModelDrafter` — a small draft model (the classic
+    Leviathan/Chen speculative-decoding shape) running its OWN paged
+    engine: proposals come from ``decode_loop`` (one fused dispatch per
+    tick per the SURVEY §2.9 inference-v1 generate-loop idiom), and the
+    draft cache tracks the target's accepted history via the same
+    ``rewind`` primitive the target uses for rejected drafts. Load the
+    model through ``models/hf.py:from_hf`` (``load_draft_model`` gates
+    the optional ``transformers`` dependency with a named error) or hand
+    the drafter an in-process ``(model, params)`` pair.
+
+A drafter is three methods — ``propose(uid, history, k) -> tokens``
+(``history`` = prompt + everything emitted so far, whose LAST entry is
+the sequence's pending decode input), ``forget(uid)`` (sequence finished
+or preempted), ``close()`` — and proposals are best-effort: returning
+``[]`` demotes the row to a plain decode token for that tick, never an
+error on the serving path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.config_utils import ConfigError
+from ..utils.logging import warning_once
+from .config import InferenceConfig, SpeculativeConfig
+
+
+class NGramDrafter:
+    """Prompt-lookup self-speculation: propose the continuation that
+    followed the most recent earlier occurrence of the sequence's
+    trailing ``ngram`` tokens. Stateless per sequence — the history
+    handed to ``propose`` is the whole state."""
+
+    def __init__(self, ngram: int = 2):
+        if ngram < 1:
+            raise ConfigError(f"ngram must be >= 1, got {ngram}")
+        self.ngram = int(ngram)
+
+    def propose(self, uid: int, history: Sequence[int],
+                k: int) -> List[int]:
+        h = np.asarray(history, dtype=np.int64)
+        n = self.ngram
+        if k < 1 or len(h) <= n:
+            return []
+        # most recent earlier occurrence wins: recent context is the best
+        # predictor of the immediate continuation (and a greedy loop's
+        # cycle is caught at its latest period). One vectorized sliding-
+        # window compare — this runs for every running sequence every
+        # tick, so a Python scan over a 4k-token history would cost host
+        # milliseconds between device dispatches.
+        win = np.lib.stride_tricks.sliding_window_view(h, n)
+        hits = np.nonzero((win[:-1] == h[-n:]).all(axis=1))[0]
+        if not len(hits):
+            return []
+        i = int(hits[-1])
+        return [int(t) for t in h[i + n:i + n + k]]
+
+    def forget(self, uid: int) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class DraftModelDrafter:
+    """Draft-model speculation: a small model serving from its own paged
+    engine proposes k greedy tokens per tick via ``decode_loop`` (one
+    device dispatch on the DRAFT model; the target engine's
+    one-dispatch-per-tick contract is untouched).
+
+    The draft cache mirrors the target's ACCEPTED history: ``propose``
+    diffs the caller's ``history`` against what the draft engine has
+    written, rewinds past any rejected suffix (the same
+    ``InferenceEngineV2.rewind`` primitive the target uses), extends with
+    the newly-accepted tokens, then decodes k drafts. Draft-side KV
+    pressure degrades to plain decode (``[]``) instead of erroring."""
+
+    def __init__(self, model, params,
+                 config: Optional[InferenceConfig] = None):
+        from .engine_v2 import InferenceEngineV2
+
+        self.engine = InferenceEngineV2(model, params,
+                                        config or InferenceConfig())
+        self._hist: Dict[int, List[int]] = {}
+
+    @classmethod
+    def for_target(cls, model, params,
+                   target: InferenceConfig) -> "DraftModelDrafter":
+        """Size the draft engine's cache to the target's serving geometry
+        (same max_seq_len / block size / pool depth, full-precision draft
+        KV — the draft pool is tiny next to the target's, and quantizing
+        it would only add acceptance noise)."""
+        import dataclasses
+
+        cfg = InferenceConfig(
+            dtype=target.dtype, max_seq_len=target.max_seq_len,
+            kv_block_size=target.kv_block_size,
+            num_kv_blocks=target.num_kv_blocks,
+            max_batch_size=target.max_batch_size,
+            decode_kernel=target.decode_kernel,
+            serving=dataclasses.replace(target.serving,
+                                        speculative=SpeculativeConfig()))
+        return cls(model, params, cfg)
+
+    def propose(self, uid: int, history: Sequence[int],
+                k: int) -> List[int]:
+        return self.propose_many([(uid, history, k)]).get(uid, [])
+
+    def propose_many(self, reqs: Sequence[Tuple[int, Sequence[int], int]]
+                     ) -> Dict[int, List[int]]:
+        """Batched proposals for one scheduler tick: ONE sync ``put()``
+        covering every divergent/new sequence, then ONE ``decode_loop``
+        dispatch per distinct k — the §2.9 fused-generate idiom at fleet
+        width. (A per-sequence propose() would pay one draft-engine
+        dispatch per running sequence per tick — exactly the
+        host-round-trip shape the target engine's one-dispatch contract
+        exists to kill.) The ``_hist`` invariant — it mirrors the draft
+        engine's written tokens — is maintained by mutating it only right
+        after each engine call succeeds, so a mid-batch failure degrades
+        those sequences to plain decode this tick and resyncs cold next
+        tick."""
+        eng = self.engine
+        live = []
+        for uid, history, k in reqs:
+            h = [int(t) for t in history]
+            if k < 1 or len(h) < 2:
+                continue
+            # decode_loop writes k slots past the current history tail
+            k = min(k, eng.config.max_seq_len - len(h))
+            if k >= 1:
+                live.append((uid, h, k))
+        out: Dict[int, List[int]] = {}
+        try:
+            puts: List[Tuple[int, List[int]]] = []
+            ready: List[Tuple[int, int, int]] = []    # (uid, seed, k)
+            for uid, h, k in live:
+                tgt, t0 = h[:-1], h[-1]
+                fed = self._hist.get(uid)
+                if fed is not None:
+                    p = 0
+                    for a, b in zip(fed, tgt):
+                        if a != b:
+                            break
+                        p += 1
+                    if p == 0:
+                        # diverged at the root (resubmitted uid) — resync
+                        self.forget(uid)
+                        fed = None
+                    else:
+                        if p < len(fed):
+                            # rejected drafts (or a requeue) left stale
+                            # draft KV past the accepted prefix — same
+                            # rollback primitive as the target engine
+                            eng.rewind(uid, p)
+                            del fed[p:]
+                        if p < len(tgt):
+                            puts.append((uid, tgt[p:]))
+                        ready.append((uid, t0, k))
+                if fed is None:
+                    puts.append((uid, list(tgt)))
+                    self._hist[uid] = []
+                    ready.append((uid, t0, k))
+            if puts:
+                eng.put([u for u, _ in puts], [c for _, c in puts])
+                for uid, chunk in puts:
+                    self._hist[uid].extend(chunk)
+            groups: Dict[int, List[Tuple[int, int]]] = {}
+            for uid, t0, k in ready:
+                groups.setdefault(k, []).append((uid, t0))
+            for k, rows in sorted(groups.items()):
+                toks = np.asarray(eng.decode_loop(
+                    [u for u, _ in rows], [t for _, t in rows], k))
+                for (uid, t0), row in zip(rows, toks):
+                    drafts = [int(x) for x in row]
+                    # written this dispatch: seed plus all drafts but last
+                    self._hist[uid].extend([t0] + drafts[:-1])
+                    out[uid] = drafts
+        except (RuntimeError, ValueError) as e:
+            # draft-side KV pressure / admission refusal: the affected
+            # sequences drop to plain decode for this tick and resync
+            # cold next tick — never fail the serving tick. The dedup'd
+            # warning stays STATIC (admission errors embed per-tick block
+            # counts; interpolating them would defeat warning_once and
+            # flood the log every tick under sustained pressure)
+            warning_once(
+                f"draft model: batched proposal failed "
+                f"({type(e).__name__}); affected sequences fall back to "
+                "plain decode while the pressure lasts")
+            for uid, _, _ in live:
+                if uid not in out:
+                    self.forget(uid)
+        return out
+
+    def forget(self, uid: int) -> None:
+        if self._hist.pop(uid, None) is not None and uid in self.engine._seqs:
+            self.engine.flush([uid])
+
+    def close(self) -> None:
+        for uid in list(self._hist):
+            self.forget(uid)
+
+
+def make_drafter(spec: SpeculativeConfig,
+                 like: Optional[InferenceConfig] = None,
+                 draft: Optional[Tuple[object, object]] = None):
+    """Build the drafter a ``serving.speculative`` section asks for.
+    ``like`` sizes a draft-model engine to the target's geometry;
+    ``draft`` = an in-process ``(model, params)`` pair that overrides the
+    ``draft_model`` checkpoint path (tests, co-located draft heads)."""
+    if spec.drafter == "ngram":
+        return NGramDrafter(ngram=spec.ngram)
+    if draft is not None:
+        model, params = draft
+    elif spec.draft_model:
+        from ..models.hf import load_draft_model
+
+        model, params = load_draft_model(spec.draft_model)
+    else:
+        raise ConfigError(
+            "serving.speculative.drafter='model' needs a draft_model "
+            "checkpoint path, or pass drafter=/draft= to the scheduler "
+            "with an in-process (model, params) pair")
+    if like is not None:
+        return DraftModelDrafter.for_target(model, params, like)
+    return DraftModelDrafter(model, params)

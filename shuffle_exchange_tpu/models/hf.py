@@ -897,6 +897,27 @@ def from_hf(model_or_path, dtype=None) -> Tuple[Transformer, Dict[str, Any]]:
     return Transformer(config), params
 
 
+def load_draft_model(model_or_path, dtype=None) -> Tuple[Transformer, Dict[str, Any]]:
+    """(Transformer, params) for a speculative-serving DRAFT model
+    (ISSUE 8): ``from_hf`` with the optional ``transformers`` dependency
+    gated up front — a serving config naming a ``draft_model`` checkpoint
+    on a box without transformers fails at drafter construction with the
+    fix named, not with an ImportError in the middle of a serve loop.
+    Accepts everything ``from_hf`` does (model object, (config,
+    state_dict) pair, local checkpoint dir)."""
+    if isinstance(model_or_path, str):
+        try:
+            import transformers  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                f"serving.speculative.draft_model={model_or_path!r} needs "
+                "the optional `transformers` package to load an HF "
+                "checkpoint; install it, or pass the scheduler a drafter "
+                "built from an in-process (model, params) pair "
+                "(inference.speculative.DraftModelDrafter)") from e
+    return from_hf(model_or_path, dtype=dtype)
+
+
 def _tree_cast(tree, dtype):
     import jax
     import jax.numpy as jnp

@@ -270,17 +270,29 @@ def alibi_slopes(n_heads: int):
     return np.asarray(s, np.float32)
 
 
-def decode_fusion_eligibility(cfg: "TransformerConfig") -> dict:
+def decode_fusion_eligibility(cfg: "TransformerConfig",
+                              speculative_k: int = 0) -> dict:
     """Which parts of the fused Pallas decode path (ops/fused_decode.py)
     this model STRUCTURE supports — the single source of truth both
     serving engines consult when ``decode_kernel`` resolves to "pallas".
 
-    Returns ``{"qkv": None | reason, "mlp": None | reason}``; ``None``
-    means fusable. Per-layer WEIGHT-form checks (dense vs QuantizedMatrix,
-    group sizes) happen at dispatch time in the engines — this classifies
-    only what is knowable from the config. Attention fusion has no
-    structural requirements beyond the engine-wide pre-LN layer body (GQA
-    H % KV == 0 is a construction invariant).
+    Returns ``{"qkv": None | reason, "mlp": None | reason,
+    "verify": None | reason}``; ``None`` means fusable. Per-layer
+    WEIGHT-form checks (dense vs QuantizedMatrix, group sizes) happen at
+    dispatch time in the engines — this classifies only what is knowable
+    from the config. Attention fusion has no structural requirements
+    beyond the engine-wide pre-LN layer body (GQA H % KV == 0 is a
+    construction invariant).
+
+    ``speculative_k`` (ISSUE 8 satellite): the serving config's draft
+    width. The fused decode kernels — QKV+RoPE+pool-append and the split-K
+    flash-decode — are SINGLE-token by construction (one row, one new KV
+    slot, ``kv_len = pos + 1``); a speculative verify row is ``k+1``
+    tokens wide and silently routing it through them would read a stale
+    kv_len and drop k appends. The ``"verify"`` entry makes that gate
+    explicit: with ``speculative_k > 0`` the verify rows must take the
+    paged-EXTEND path (the chunked-prefill kernel, which is multi-token
+    by construction), and only plain 1-token decode rows stay fused.
     """
     from ..ops.fused_decode import FUSABLE_ACTIVATIONS
 
@@ -296,7 +308,15 @@ def decode_fusion_eligibility(cfg: "TransformerConfig") -> dict:
                f"(fusable: {', '.join(FUSABLE_ACTIVATIONS)})")
     elif cfg.norm not in ("rmsnorm", "layernorm"):
         mlp = f"unknown norm {cfg.norm!r}"
-    return {"qkv": qkv, "mlp": mlp}
+    verify = None
+    if speculative_k > 0:
+        verify = (
+            f"speculative verify rows are {speculative_k + 1} tokens wide; "
+            "the fused decode kernels are single-token (one append, "
+            "kv_len = pos + 1) — verify rows route through the "
+            "paged-extend kernel; fused decode applies to plain decode "
+            "rows only")
+    return {"qkv": qkv, "mlp": mlp, "verify": verify}
 
 
 def causal_attention(q, k, v, attention_impl: str = "auto", alibi=None,

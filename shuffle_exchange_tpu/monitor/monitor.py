@@ -271,6 +271,26 @@ class FleetMonitor(Monitor):
                 vals = [v for lbl, v, _ in events if lbl == label]
                 if vals:
                     out[key][r] = vals[-1]
+        # speculative group (ISSUE 8): the scheduler counters are
+        # CUMULATIVE per replica, so the fleet figure is the sum of each
+        # replica's latest value, and acceptance is re-derived from the
+        # sums (token-weighted, not an average of rates)
+        spec = {}
+        for key in ("proposed", "accepted", "rejected", "rollbacks"):
+            total, seen = 0, False
+            for r in sorted(self._replica_ids):
+                label = f"replica{r}/speculative/{key}"
+                vals = [v for lbl, v, _ in events if lbl == label]
+                if vals:
+                    total += vals[-1]
+                    seen = True
+            if seen:
+                spec[key] = total
+        if spec:
+            spec["acceptance_rate"] = (
+                spec["accepted"] / spec["proposed"]
+                if spec.get("proposed") else None)
+            out["speculative"] = spec
         return out
 
     def publish(self, step: "int | None" = None) -> dict:
@@ -282,6 +302,9 @@ class FleetMonitor(Monitor):
                   if isinstance(v, (int, float)) and v is not None]
         events += [(f"fleet/replica{r}/queue_depth", v, self._step)
                    for r, v in agg["queue_depth"].items()]
+        events += [(f"fleet/speculative/{k}", v, self._step)
+                   for k, v in (agg.get("speculative") or {}).items()
+                   if isinstance(v, (int, float))]
         if self.downstream is not None and events:
             self.downstream.write_events(events)
         self.write_events(events)

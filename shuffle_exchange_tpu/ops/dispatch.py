@@ -22,7 +22,7 @@ def pallas_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def resolve_decode_kernel(mode: str) -> str:
+def resolve_decode_kernel(mode: str, speculative_k: int = 0) -> str:
     """Resolve the serving ``decode_kernel`` knob to "pallas" or "xla".
 
     - "xla": always the reference XLA layer body.
@@ -31,6 +31,15 @@ def resolve_decode_kernel(mode: str) -> str:
       makes sense with SXT_FUSED_INTERPRET=1 (the CPU test hook).
     - "auto": fused kernels iff the backend is TPU (and Pallas isn't
       kill-switched) — the working-fallback contract for CPU/GPU hosts.
+
+    ``speculative_k`` (ISSUE 8 satellite): when speculative serving is
+    configured (k >= 1 drafts per tick), the resolution STILL applies to
+    the plain 1-token decode rows, but the caller is warned once that
+    verify rows — k+1 tokens wide — are outside the fused decode kernels'
+    single-token contract and take the paged-extend kernel instead. The
+    old behavior would have let a width-(k+1) row reach the fused
+    QKV+append (one token written, k silently dropped); the gate makes
+    the routing explicit instead of shape-dependent.
 
     Caveat: the engines' runtime fallbacks catch TRACE-time kernel
     failures; a Mosaic failure at XLA-compile time still surfaces (the
@@ -42,6 +51,15 @@ def resolve_decode_kernel(mode: str) -> str:
     if mode not in ("auto", "pallas", "xla"):
         raise ValueError(
             f'decode_kernel must be "auto", "pallas" or "xla", got {mode!r}')
-    if mode == "auto":
-        return "pallas" if pallas_enabled() else "xla"
-    return mode
+    resolved = ("pallas" if pallas_enabled() else "xla") if mode == "auto" \
+        else mode
+    if resolved == "pallas" and speculative_k > 0:
+        from ..utils.logging import warning_once
+
+        warning_once(
+            f"decode_kernel resolves to the fused Pallas path with "
+            f"speculative k={speculative_k}: verify rows "
+            f"({speculative_k + 1} tokens wide) exceed the single-token "
+            "fused decode kernels and route through the paged-extend "
+            "kernel; fused decode applies to plain decode rows only")
+    return resolved

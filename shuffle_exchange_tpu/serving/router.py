@@ -82,11 +82,20 @@ class ReplicaRouter:
                  engine_factory: Optional[Callable[[], InferenceEngineV2]] = None,
                  monitor: Optional[Monitor] = None,
                  on_token: Optional[Callable[[int, int], None]] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 drafter_factory: Optional[Callable[[int], object]] = None):
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
         self.rcfg: RouterConfig = engines[0].config.router
         self.engine_factory = engine_factory
+        # speculative serving (ISSUE 8) rides each replica's OWN engine
+        # config unchanged — the scheduler builds its drafter from
+        # engine.config.serving.speculative. ``drafter_factory(replica_id)``
+        # overrides that per replica (a draft-model fleet shares one
+        # loaded (model, params) instead of re-importing the checkpoint
+        # N times; drafter STATE is never shared — draft KV is
+        # per-replica like every other cache).
+        self.drafter_factory = drafter_factory
         self.clock = clock
         self.on_token = on_token
         self.fleet = FleetMonitor(downstream=monitor)
@@ -113,9 +122,11 @@ class ReplicaRouter:
 
     def _add_replica(self, engine: InferenceEngineV2) -> Replica:
         rid = len(self.replicas)
+        drafter = (self.drafter_factory(rid)
+                   if self.drafter_factory is not None else None)
         sched = ContinuousBatchingScheduler(
             engine, on_token=self._emit_token, clock=self.clock,
-            monitor=self.fleet.sink(rid), replica_id=rid)
+            monitor=self.fleet.sink(rid), replica_id=rid, drafter=drafter)
         rep = Replica(rid, engine, sched)
         self.replicas.append(rep)
         return rep
@@ -480,9 +491,25 @@ class ReplicaRouter:
             "tpot_p99_s": pct(tpot, 99),
             "drains": self.drains,
             "requeued": self.requeued,
+            # fleet-aggregated speculative group (ISSUE 8): sums over
+            # replicas; acceptance_rate re-derived from the sums so it is
+            # token-weighted, not an average of per-replica averages
+            "speculative": self._spec_aggregate(),
             "per_replica": [dict(r.scheduler.load(), state=r.state,
                                  preemptions=r.scheduler.preemptions)
                             for r in self.replicas],
+        }
+
+    def _spec_aggregate(self) -> Dict[str, object]:
+        proposed = sum(r.scheduler.spec_proposed for r in self.replicas)
+        accepted = sum(r.scheduler.spec_accepted for r in self.replicas)
+        return {
+            "enabled": any(r.scheduler.spec.enabled for r in self.replicas),
+            "proposed": proposed,
+            "accepted": accepted,
+            "rejected": sum(r.scheduler.spec_rejected for r in self.replicas),
+            "acceptance_rate": (accepted / proposed) if proposed else None,
+            "rollbacks": sum(r.engine.spec_rollbacks for r in self.replicas),
         }
 
     def publish(self) -> dict:

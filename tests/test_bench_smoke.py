@@ -191,3 +191,45 @@ def test_prefix_cache_row_runs_at_toy_size():
     assert row["cow_copies"] == 0
     # bf16 KV mode: cached and uncached serves are exactly token-equal
     assert row["token_mismatches_vs_no_cache"] == 0
+
+
+def test_serving_speculative_row_runs_at_toy_size():
+    """The config-5 speculative row (bench.serving_speculative_row) at toy
+    size: the same repetitive-suffix Poisson trace at k=0 vs k=4 with the
+    n-gram self-drafter and a draft model — steps-per-token, acceptance
+    rate, TTFT/TPOT tails, and exact token parity across every variant —
+    runs on CPU, so the published row cannot rot on the driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_speculative_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=128, kv_block_size=8, num_kv_blocks=64,
+        serving={"token_budget": 24, "max_running": 4, "chunk_min": 4})
+    row = serving_speculative_row(model, params, icfg, mcfg.vocab_size,
+                                  n_requests=4, period=4, prompt_lo=16,
+                                  prompt_hi=24, max_new=16, k=4, load=2.0)
+    base, ng, dm = row["baseline_k0"], row["ngram_k4"], row["draft_model_k4"]
+    assert base["acceptance_rate"] is None and base["proposed"] == 0
+    assert ng["proposed"] > 0 and 0 <= ng["acceptance_rate"] <= 1
+    # the same-weights draft model is the acceptance ceiling: everything
+    # it proposes verifies, and steps/token collapses toward 1/(k+1)
+    assert dm["acceptance_rate"] == 1.0 and dm["rollbacks"] == 0
+    assert dm["steps_per_emitted_token"] < base["steps_per_emitted_token"]
+    assert row["speedup_steps_draft_x"] > 1.5
+    for v in (base, ng, dm):
+        assert v["ttft_p50_s"] > 0 and v["tpot_p95_s"] >= 0
+        assert v["sustained_tokens_per_sec"] > 0
+    # greedy acceptance: every variant emits the k=0 tokens exactly
+    assert row["token_mismatches_ngram_vs_k0"] == 0
+    assert row["token_mismatches_draft_vs_k0"] == 0

@@ -234,7 +234,8 @@ class TestAdmissionErrors:
         model, params = model_and_params
         eng = InferenceEngineV2(model, params, _icfg())
         eng.put([1], [[5, 6, 7]])
-        with pytest.raises(ValueError, match="either decoding or prefilling"):
+        with pytest.raises(ValueError,
+                           match="either decoding, prefilling or verifying"):
             eng.step([1], [9], [(1, [4, 4])])
         with pytest.raises(ValueError, match="decode uid 42 unknown"):
             eng.step([42], [1], [])
