@@ -3,6 +3,12 @@
 # The fast development gate is: pytest tests/ -q -m "not slow"
 set -e
 cd "$(dirname "$0")/.."
+# Static analysis first (ISSUE 10): sxt-check's invariant rules + the ruff
+# baseline must be clean before any suite burns compile time — a violation
+# here is a reintroduced bug class (see shuffle_exchange_tpu/analysis/
+# RULES.md), not a style nit. tests/test_analysis.py re-runs the self-clean
+# gate inside tier-1 with per-rule fixture coverage.
+sh scripts/lint.sh
 # Fused-decode parity + the resilience/offload suites first — a broken
 # serving kernel or a rotten crash-recovery path should fail the run before
 # the long tail does. test_resilience.py drives injected crash→restart→

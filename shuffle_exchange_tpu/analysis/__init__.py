@@ -1,0 +1,35 @@
+"""sxt-check: framework-aware static analysis for shuffle_exchange_tpu.
+
+Codifies the repo's hard-won distributed-correctness invariants (see
+``analysis/RULES.md`` for the catalog, each rule citing the incident
+that motivated it) as an AST pass that needs NO jax import and runs in
+well under a second over the whole package::
+
+    python -m shuffle_exchange_tpu.analysis shuffle_exchange_tpu/
+    scripts/lint.sh        # sxt-check + ruff (when installed)
+
+Per-line suppressions carry a mandatory rule id and reason::
+
+    x = jax.device_put(np.asarray(b), s)  # sxt: ignore[SXT003] not donated
+
+The tier-1 self-clean gate (``tests/test_analysis.py``) asserts the
+package itself has zero unsuppressed violations.
+"""
+
+from .report import Report, fold, render_text, write_json
+from .rules import RULES, FileChecker, Rule, Violation
+from .suppress import parse_suppressions
+from .walker import analyze, analyze_file, iter_python_files
+
+
+def run(paths, select=None) -> Report:
+    """Analyze ``paths`` (files or directories) and fold the results —
+    the one-call API the tests and the CLI share."""
+    return fold(analyze(paths, select=select), select=select)
+
+
+__all__ = [
+    "RULES", "Rule", "Violation", "FileChecker", "Report",
+    "analyze", "analyze_file", "iter_python_files", "fold",
+    "render_text", "write_json", "parse_suppressions", "run",
+]

@@ -1,0 +1,83 @@
+"""CLI: ``python -m shuffle_exchange_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (stale-suppression warnings allowed), 1 unsuppressed
+violations (or malformed suppressions), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .report import fold, render_text, write_json
+from .rules import RULES
+from .walker import analyze
+
+
+def _default_target() -> str:
+    # the package directory containing this module's parent
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m shuffle_exchange_tpu.analysis",
+        description="sxt-check: static analysis of the repo's "
+                    "distributed-correctness invariants (see "
+                    "shuffle_exchange_tpu/analysis/RULES.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to scan (default: the "
+                         "shuffle_exchange_tpu package)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write the machine-readable report to this file")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--verbose", "-v", action="store_true",
+                    help="print incident + fix advice under each finding")
+    ap.add_argument("--fail-on-stale", action="store_true",
+                    help="treat stale suppressions as failures too")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print(f"{rid}: {rule.title}")
+            print(f"    incident: {rule.incident}")
+            print(f"    fix: {rule.advice}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        select.add("SXT000")   # the meta-rule always runs
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    report = fold(analyze(paths, select=select), select=select)
+    out = render_text(report, verbose=args.verbose)
+    if out:
+        print(out)
+    if args.json_path:
+        write_json(report, args.json_path)
+    if args.fail_on_stale and report.stale:
+        return 1
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # e.g. `--list-rules | head`
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,128 @@
+"""Suppression application, stale detection, and report rendering."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Sequence
+
+from .rules import RULES, Violation
+from .suppress import build_index
+from .walker import FileResult
+
+
+@dataclasses.dataclass
+class SuppressedViolation:
+    violation: Violation
+    reason: str
+    suppression_line: int
+
+
+@dataclasses.dataclass
+class StaleSuppression:
+    path: str
+    line: int
+    rules: tuple
+    reason: str
+
+
+@dataclasses.dataclass
+class Report:
+    violations: List[Violation]              # unsuppressed (fail the run)
+    suppressed: List[SuppressedViolation]
+    stale: List[StaleSuppression]            # warnings (do not fail)
+    files_scanned: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.violations else 0
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.violations:
+            out[v.rule] = out.get(v.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "tool": "sxt-check",
+            "files_scanned": self.files_scanned,
+            "exit_code": self.exit_code,
+            "counts": self.counts(),
+            "violations": [dataclasses.asdict(v) for v in self.violations],
+            "suppressed": [{
+                **dataclasses.asdict(s.violation),
+                "reason": s.reason,
+                "suppression_line": s.suppression_line,
+            } for s in self.suppressed],
+            "stale_suppressions": [dataclasses.asdict(s) for s in self.stale],
+            "rules": {rid: {"title": r.title, "incident": r.incident,
+                            "advice": r.advice}
+                      for rid, r in sorted(RULES.items())},
+        }
+
+
+def fold(results: Sequence[FileResult], select=None) -> Report:
+    """Apply suppressions and collect stale ones. ``select`` is the rule
+    subset that RAN (None = all): a suppression for a rule that never ran
+    cannot be judged stale — without this, ``--select SXT001`` would
+    report every valid SXT005 suppression as deletable."""
+    violations: List[Violation] = []
+    suppressed: List[SuppressedViolation] = []
+    stale: List[StaleSuppression] = []
+    for fr in results:
+        idx = build_index(fr.suppressions)
+        used = set()
+        for v in fr.violations:
+            match = None
+            if v.rule != "SXT000":   # the meta-rule is unsuppressable
+                lo, hi = v.span()
+                for line in range(lo, hi + 1):
+                    for s in idx.get(line, ()):
+                        if v.rule in s.rules:
+                            match = s
+                            break
+                    if match:
+                        break
+            if match is not None:
+                used.add(id(match))
+                suppressed.append(SuppressedViolation(v, match.reason,
+                                                      match.line))
+            else:
+                violations.append(v)
+        for m in fr.malformed:
+            violations.append(Violation("SXT000", fr.path, m.line, 0,
+                                        m.problem))
+        for s in fr.suppressions:
+            ran = select is None or any(r in select for r in s.rules)
+            if ran and id(s) not in used:
+                stale.append(StaleSuppression(fr.path, s.line, s.rules,
+                                              s.reason))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return Report(violations, suppressed, stale, files_scanned=len(results))
+
+
+def render_text(report: Report, verbose: bool = False) -> str:
+    lines: List[str] = []
+    for v in report.violations:
+        rule = RULES.get(v.rule)
+        lines.append(f"{v.path}:{v.line}:{v.col + 1}: {v.rule} {v.message}")
+        if verbose and rule is not None:
+            lines.append(f"    incident: {rule.incident}")
+            lines.append(f"    fix: {rule.advice}")
+    for s in report.stale:
+        lines.append(f"{s.path}:{s.line}: warning: stale suppression "
+                     f"[{','.join(s.rules)}] — the rule no longer fires "
+                     f"here; delete it (reason was: {s.reason})")
+    n, ns, nw = len(report.violations), len(report.suppressed), len(report.stale)
+    lines.append(
+        f"sxt-check: {report.files_scanned} files, {n} violation"
+        f"{'s' if n != 1 else ''}, {ns} suppressed, {nw} stale-suppression "
+        f"warning{'s' if nw != 1 else ''}")
+    return "\n".join(lines)
+
+
+def write_json(report: Report, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report.to_json(), f, indent=2, sort_keys=False)
+        f.write("\n")

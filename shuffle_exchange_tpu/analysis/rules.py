@@ -1,0 +1,685 @@
+"""sxt-check rule catalog + the single-pass AST checker.
+
+Every rule codifies an invariant this repo paid to learn — the
+originating incident is cited in each rule's ``incident`` field and in
+``analysis/RULES.md``. The checker is purely syntactic (no imports, no
+jax) and conservative by design: it matches the concrete patterns that
+caused the bugs, and the sanctioned replacements, by name. Anything it
+cannot prove derived/guarded is flagged; intentionally-divergent sites
+carry a ``# sxt: ignore[RULE] reason`` with the written rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..utils.invariants import DEFAULT_ADMISSION_CHECKS
+from .scopes import (ImportTable, build_import_table, decorator_call,
+                     decorator_name, is_constant_string, self_attr)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    incident: str       # which PR/bug this guards against (see RULES.md)
+    advice: str         # the sanctioned pattern
+
+
+RULES: Dict[str, Rule] = {r.id: r for r in [
+    Rule("SXT000", "malformed suppression / unparseable file",
+         "meta-rule: a suppression without a rule id and reason suppresses "
+         "everything, which is how guardrails rot",
+         "write `# sxt: ignore[SXTnnn] reason` (both parts mandatory)"),
+    Rule("SXT001", "shard_map outside the parallel/mesh.py facade",
+         "PR 4: jax 0.4.x has no jax.shard_map, and raw "
+         "jax.experimental.shard_map call sites were the bulk of 55 tier-1 "
+         "failures; every manual-region feature must route through the "
+         "capability facade",
+         "from ..parallel.mesh import shard_map (the facade maps "
+         "axis_names/check_vma onto the 0.4.x auto=/check_rep form)"),
+    Rule("SXT002", "donate_argnums not derived from cache_safe_donate_argnums",
+         "PR 2: donated executables deserialized from the persistent "
+         "compile cache race donated-buffer frees on jax 0.4.x CPU — "
+         "resumed runs trained on garbage/NaN and segfaulted",
+         "jax.jit(f, donate_argnums=cache_safe_donate_argnums(...)) or a "
+         "value provably derived from it"),
+    Rule("SXT003", "raw jax.device_put of host numpy",
+         "PR 2: on CPU, device_put of aligned numpy can zero-copy ALIAS "
+         "the host buffer; a donating executable then writes through freed "
+         "memory once the numpy side is collected",
+         "utils.placement.owned_device_put (materializes an XLA-owned "
+         "buffer; no-op overhead off CPU)"),
+    Rule("SXT004", "collective in a partial-manual shard_map region",
+         "PR 4: ppermute/all_gather/all_to_all with a LIVE auto axis "
+         "hard-abort XLA on jax 0.4.x (spmd_partitioner.cc:512 CHECK), a "
+         "process abort, not an exception — scripts/repro_*.py hold the "
+         "minimized repros",
+         "gate on parallel.mesh.native_shard_map() and fall back (or make "
+         "the region full-manual)"),
+    Rule("SXT005", "warning_once with a non-constant message",
+         "PR 8: a per-call-varying message defeats the lru_cache dedup — "
+         "the draft-pressure fallback warning spammed once per tick until "
+         "it was made a static string",
+         "pass a constant string; put varying detail in a one-time "
+         "logger.info or a counter"),
+    Rule("SXT006", "state mutation before the admission check",
+         "PRs 5-8: put()/step()/decode_loop()/begin_import() must be "
+         "atomic-on-reject — a refused batch retried verbatim found "
+         "double-frees and mid-COW deaths whenever mutation leaked ahead "
+         "of the _admission_detail check",
+         "validate and run the admission check before touching any "
+         "allocator/descriptor/queue state (@atomic_on_reject marks the "
+         "contract)"),
+    Rule("SXT007", "lock-guarded attribute written outside its lock",
+         "PR 7: threaded replica fleets corrupted router bookkeeping and "
+         "raised mid-iteration RuntimeErrors until every shared structure "
+         "got a lock discipline (@locked_by marks it)",
+         "wrap the write in `with self.<lock>:` or mark the helper "
+         "@requires_lock(<lock>) when every caller provably holds it"),
+    Rule("SXT008", "host-only call inside a jitted body",
+         "PR 1/PR 5 reviews: time.*/np.random inside a traced body bake "
+         "trace-time constants (a timestamp or one fixed 'random' draw), "
+         "and int()/float() on a tracer is a concretization error at best",
+         "hoist host work out of the jitted function; use jax.random / "
+         "shape-derived ints inside"),
+]}
+
+#: mutating method names counted as writes for SXT006/SXT007
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "add", "update",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "insert",
+    "setdefault", "write_events",
+})
+
+COLLECTIVES = frozenset({
+    "jax.lax.ppermute", "jax.lax.all_gather", "jax.lax.all_to_all",
+})
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    end_line: int = 0
+
+    def span(self) -> Tuple[int, int]:
+        return (self.line, max(self.line, self.end_line))
+
+
+def _last_attr(node: ast.AST) -> Optional[str]:
+    """Rightmost attribute/name of a callee, e.g. begin_import for
+    dst.begin_import(...)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _subscript_base_attr(node: ast.AST) -> Optional[str]:
+    """"x" for self.x[...] (arbitrarily deep subscripting), else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return self_attr(node)
+
+
+def _iter_mutations(stmt: ast.stmt):
+    """Yield (node, attr_name) for every ``self``-state write inside one
+    statement, excluding nested function/lambda bodies (those run later,
+    under their own discipline)."""
+
+    def flat_targets(targets):
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                yield from flat_targets(t.elts)
+            else:
+                yield t
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = list(flat_targets(
+                node.targets if isinstance(node, (ast.Assign, ast.Delete))
+                else [node.target]))
+            for t in targets:
+                attr = self_attr(t) or _subscript_base_attr(t)
+                if attr is not None:
+                    yield node, attr
+            for child in ast.iter_child_nodes(node):
+                if child not in targets:
+                    yield from walk(child)
+            return
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in MUTATORS:
+                attr = self_attr(node.func.value)
+                if attr is not None:
+                    yield node, attr
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child)
+
+    yield from walk(stmt)
+
+
+def _iter_skipping(node: ast.AST, skip):
+    """Yield ``node`` and descendants, PRUNING whole subtrees whose root
+    matches ``skip`` — unlike ``ast.walk`` + ``continue``, which only
+    skips the node itself and still yields its children. Nested
+    function/lambda bodies execute later under their own discipline, so
+    their raises/calls must not leak into the enclosing analysis."""
+    if isinstance(node, skip):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _iter_skipping(child, skip)
+
+
+def _contains_call_named(node: ast.AST, names: Sequence[str]) -> bool:
+    """Any call whose rightmost callee name is in ``names``, nested
+    function/lambda bodies excluded (a closure that merely references the
+    checker has not RUN it)."""
+    for sub in _iter_skipping(node, _NESTED):
+        if isinstance(sub, ast.Call) and _last_attr(sub.func) in names:
+            return True
+    return False
+
+
+def _contains_raise(stmts: Sequence[ast.stmt]) -> bool:
+    """Any ``raise`` reachable in these statements, excluding except
+    handlers (the reject path may legitimately update counters) and
+    nested function bodies (a closure's raise fires at call time, not
+    here)."""
+    skip = _NESTED + (ast.ExceptHandler,)
+    for st in stmts:
+        for sub in _iter_skipping(st, skip):
+            if isinstance(sub, ast.Raise):
+                return True
+    return False
+
+
+class _AtomicChecker:
+    """SXT006 body analysis for one @atomic_on_reject method."""
+
+    def __init__(self, checker: "FileChecker", fn: ast.FunctionDef,
+                 check: Optional[str]):
+        self.c = checker
+        self.fn = fn
+        self.check = check
+
+    def run(self) -> None:
+        if self.check == "validate":
+            self._walk_validate(self.fn.body, raises_after=False)
+        else:
+            names = ((self.check,) if self.check
+                     else DEFAULT_ADMISSION_CHECKS)
+            self._walk_named(self.fn.body, names, checked=False)
+
+    # -- named-check mode: no mutation before the first admission call --
+
+    def _walk_named(self, stmts, names, checked: bool) -> bool:
+        for st in stmts:
+            if isinstance(st, ast.If):
+                test_check = _contains_call_named(st.test, names)
+                self._walk_named(st.body, names, checked or test_check)
+                self._walk_named(st.orelse, names, checked or test_check)
+                # a check inside ONE branch does not cover code after the
+                # If (the other branch may have skipped it)
+                checked = checked or test_check
+            elif isinstance(st, ast.Try):
+                inner = self._walk_named(st.body, names, checked)
+                self._walk_named(st.orelse, names, inner)
+                self._walk_named(st.finalbody, names, inner)
+                # handlers are the reject path; counter updates there are
+                # fine by construction
+                checked = checked or inner
+            elif isinstance(st, (ast.For, ast.While, ast.With)):
+                inner = self._walk_named(list(st.body), names, checked)
+                self._walk_named(getattr(st, "orelse", []) or [], names, inner)
+                checked = checked or inner
+            else:
+                if not checked:
+                    for node, attr in _iter_mutations(st):
+                        self.c.add("SXT006", node,
+                                   f"`self.{attr}` mutated before the "
+                                   f"admission check ({'/'.join(names)}) "
+                                   f"in @atomic_on_reject method "
+                                   f"`{self.fn.name}` — a rejected call "
+                                   f"must leave state untouched")
+                if _contains_call_named(st, names):
+                    checked = True
+        return checked
+
+    # -- validate mode: no mutation while a validation raise is ahead --
+
+    def _walk_validate(self, stmts, raises_after: bool) -> None:
+        for i, st in enumerate(stmts):
+            ahead = raises_after or _contains_raise(stmts[i + 1:])
+            if isinstance(st, ast.If):
+                self._walk_validate(st.body, ahead)
+                self._walk_validate(st.orelse, ahead)
+            elif isinstance(st, ast.Try):
+                self._walk_validate(st.body, ahead)
+                self._walk_validate(st.orelse, ahead)
+                self._walk_validate(st.finalbody, ahead)
+            elif isinstance(st, (ast.For, ast.While, ast.With)):
+                body = list(getattr(st, "body", []))
+                # a raise anywhere in the loop body is "ahead" of the
+                # body's own mutations (iteration n+1 can still reject)
+                self._walk_validate(body, ahead or (
+                    isinstance(st, (ast.For, ast.While))
+                    and _contains_raise(body)))
+                self._walk_validate(getattr(st, "orelse", []) or [], ahead)
+            else:
+                if ahead:
+                    for node, attr in _iter_mutations(st):
+                        self.c.add("SXT006", node,
+                                   f"`self.{attr}` mutated while a "
+                                   f"validation raise is still ahead in "
+                                   f"@atomic_on_reject(check=\"validate\") "
+                                   f"method `{self.fn.name}` — validate "
+                                   f"everything, then mutate")
+
+
+class FileChecker(ast.NodeVisitor):
+    """One pass over one file, all rules. Construct, call ``run()``,
+    read ``violations`` (raw — suppressions are applied by report.py)."""
+
+    def __init__(self, path: str, tree: ast.Module, module_path: str = "",
+                 select: Optional[Set[str]] = None):
+        self.path = path
+        self.tree = tree
+        self.module_path = module_path
+        self.select = select
+        self.imports: ImportTable = build_import_table(tree, module_path)
+        self.violations: List[Violation] = []
+        self._seen: Set[Tuple[str, int, int]] = set()
+        # context stacks
+        self._class_locks: List[Dict[str, Tuple[str, ...]]] = []  # lock->attrs
+        self._attr_to_lock: List[Dict[str, str]] = []
+        # multiset per function scope: re-entrant `with self._mu:` nesting
+        # must not drop the outer hold when the inner block exits
+        self._held_locks: List[List[str]] = [[]]
+        self._init_exempt: List[bool] = [False]
+        self._fn_stack: List[ast.FunctionDef] = []
+        self._derived_vars: List[Set[str]] = [set()]
+        self._numpy_vars: List[Set[str]] = [set()]
+        self._local_fns: List[Dict[str, ast.FunctionDef]] = [{}]
+        # prepass facts
+        self._deriving_fns: Set[str] = set()
+        self._jit_names: Set[str] = set()
+        self._jitted_fns: Set[int] = set()
+        self._in_mesh_facade = module_path.endswith("parallel.mesh")
+
+    # -- public ---------------------------------------------------------
+
+    def run(self) -> List[Violation]:
+        self._prepass()
+        self.visit(self.tree)
+        return self.violations
+
+    def add(self, rule: str, node: ast.AST, message: str) -> None:
+        if self.select is not None and rule not in self.select:
+            return
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        key = (rule, line, col)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.violations.append(Violation(
+            rule, self.path, line, col, message,
+            end_line=getattr(node, "end_lineno", line) or line))
+
+    # -- prepass --------------------------------------------------------
+
+    def _prepass(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Return) and sub.value is not None
+                            and self._derives_donate(sub.value)):
+                        self._deriving_fns.add(node.name)
+                        break
+                for dec in node.decorator_list:
+                    if self._is_jit_decorator(dec):
+                        self._jitted_fns.add(id(node))
+            if isinstance(node, ast.Call):
+                name = self.imports.canonical(node.func)
+                if name == "jax.jit" and node.args:
+                    tgt = node.args[0]
+                    if isinstance(tgt, ast.Name):
+                        self._jit_names.add(tgt.id)
+                    else:
+                        attr = self_attr(tgt)
+                        if attr:
+                            self._jit_names.add(attr)
+        if self._jit_names:
+            for node in ast.walk(self.tree):
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name in self._jit_names):
+                    self._jitted_fns.add(id(node))
+
+    def _is_jit_decorator(self, dec: ast.AST) -> bool:
+        name = self.imports.canonical(dec if not isinstance(dec, ast.Call)
+                                      else dec.func)
+        if name == "jax.jit":
+            return True
+        if isinstance(dec, ast.Call) and name == "functools.partial" and dec.args:
+            return self.imports.canonical(dec.args[0]) == "jax.jit"
+        return False
+
+    def _derives_donate(self, node: ast.AST) -> bool:
+        """Value provably derived from cache_safe_donate_argnums: a direct
+        call, a call to a same-module function that returns one, or a
+        name assigned from either in the current scope chain."""
+        if isinstance(node, ast.Call):
+            name = self.imports.canonical(node.func)
+            if name and name.endswith("cache_safe_donate_argnums"):
+                return True
+            last = _last_attr(node.func)
+            if last in self._deriving_fns:
+                return True
+        if isinstance(node, ast.Name):
+            return any(node.id in scope for scope in self._derived_vars)
+        return False
+
+    # -- scope bookkeeping ---------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        locks: Dict[str, Tuple[str, ...]] = {}
+        dec = decorator_call(node, "locked_by")
+        if isinstance(dec, ast.Call) and dec.args:
+            lock = dec.args[0]
+            if isinstance(lock, ast.Constant) and isinstance(lock.value, str):
+                attrs = tuple(a.value for a in dec.args[1:]
+                              if isinstance(a, ast.Constant)
+                              and isinstance(a.value, str))
+                locks[lock.value] = attrs
+        self._class_locks.append(locks)
+        self._attr_to_lock.append(
+            {a: lk for lk, attrs in locks.items() for a in attrs})
+        self.generic_visit(node)
+        self._class_locks.pop()
+        self._attr_to_lock.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        in_class = bool(self._class_locks)
+        held: List[str] = []
+        for dec in node.decorator_list:
+            if decorator_name(dec) == "requires_lock" and isinstance(dec, ast.Call):
+                for a in dec.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        held.append(a.value)
+        atomic = decorator_call(node, "atomic_on_reject")
+        if atomic is not None and in_class:
+            check: Optional[str] = None
+            if isinstance(atomic, ast.Call):
+                for kw in atomic.keywords:
+                    if kw.arg == "check" and isinstance(kw.value, ast.Constant):
+                        check = kw.value.value
+            _AtomicChecker(self, node, check).run()
+        self._local_fns[-1][node.name] = node
+        self._fn_stack.append(node)
+        self._held_locks.append(held)
+        self._init_exempt.append(in_class and node.name == "__init__"
+                                 or (self._init_exempt[-1] if not in_class
+                                     else False))
+        self._derived_vars.append(set())
+        self._numpy_vars.append(set())
+        self._local_fns.append({})
+        self.generic_visit(node)
+        self._local_fns.pop()
+        self._numpy_vars.pop()
+        self._derived_vars.pop()
+        self._init_exempt.pop()
+        self._held_locks.pop()
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = []
+        for item in node.items:
+            attr = self_attr(item.context_expr)
+            if attr is not None:
+                self._held_locks[-1].append(attr)
+                pushed.append(attr)
+        self.generic_visit(node)
+        for attr in pushed:
+            self._held_locks[-1].remove(attr)
+
+    # -- imports (SXT001) ----------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if not self._in_mesh_facade:
+            for alias in node.names:
+                if "jax.experimental.shard_map" in alias.name:
+                    self.add("SXT001", node,
+                             f"import of {alias.name} outside the "
+                             f"parallel/mesh.py facade")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if not self._in_mesh_facade:
+            base = node.module or ""
+            for alias in node.names:
+                full = f"{base}.{alias.name}" if base else alias.name
+                if (node.level == 0
+                        and ("jax.experimental.shard_map" in full
+                             or full == "jax.shard_map"
+                             or (base == "jax.experimental"
+                                 and alias.name == "shard_map"))):
+                    self.add("SXT001", node,
+                             f"import of {full} outside the parallel/"
+                             f"mesh.py facade")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if not self._in_mesh_facade:
+            name = self.imports.canonical(node)
+            if name and (name == "jax.shard_map"
+                         or name.startswith("jax.experimental.shard_map")):
+                self.add("SXT001", node,
+                         f"use of {name} outside the parallel/mesh.py "
+                         f"facade")
+        self.generic_visit(node)
+
+    # -- statements -----------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._track_assignment(node.targets, node.value)
+        self._check_guarded_mutation(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_guarded_mutation(node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        self._check_guarded_mutation(node)
+        self.generic_visit(node)
+
+    def _track_assignment(self, targets, value) -> None:
+        if len(targets) == 1 and isinstance(targets[0], ast.Name):
+            name = targets[0].id
+            if self._derives_donate(value):
+                self._derived_vars[-1].add(name)
+            if self._is_host_numpy(value):
+                self._numpy_vars[-1].add(name)
+
+    def _check_guarded_mutation(self, stmt: ast.stmt) -> None:
+        if not self._attr_to_lock or not self._attr_to_lock[-1]:
+            return
+        if self._init_exempt[-1]:
+            return
+        table = self._attr_to_lock[-1]
+        for node, attr in _iter_mutations(stmt):
+            lock = table.get(attr)
+            if lock is None:
+                continue
+            if lock in self._held_locks[-1]:
+                continue
+            self.add("SXT007", node,
+                     f"`self.{attr}` is registered @locked_by(\"{lock}\") "
+                     f"but written outside `with self.{lock}:` (mark the "
+                     f"helper @requires_lock(\"{lock}\") if every caller "
+                     f"holds it)")
+
+    # -- calls (SXT002/3/4/5/7-mutators/8) -------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self.imports.canonical(node.func)
+        if name == "jax.jit":
+            self._check_jit(node)
+        elif name == "functools.partial" and node.args and \
+                self.imports.canonical(node.args[0]) == "jax.jit":
+            self._check_jit(node)
+        elif name == "jax.device_put":
+            self._check_device_put(node)
+        last = _last_attr(node.func)
+        if last == "warning_once":
+            self._check_warning_once(node)
+        if last == "shard_map" and not self._in_mesh_facade:
+            self._check_shard_map_region(node)
+        if self._in_jit():
+            self._check_jit_body_call(node, name)
+        # mutator calls on guarded attrs (the assignment forms are handled
+        # in the statement visitors; calls arrive here)
+        if (self._attr_to_lock and self._attr_to_lock[-1]
+                and not self._init_exempt[-1]
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATORS):
+            attr = self_attr(node.func.value)
+            lock = self._attr_to_lock[-1].get(attr) if attr else None
+            if lock is not None and lock not in self._held_locks[-1]:
+                self.add("SXT007", node,
+                         f"`self.{attr}.{node.func.attr}(...)` is "
+                         f"registered @locked_by(\"{lock}\") but called "
+                         f"outside `with self.{lock}:` (mark the helper "
+                         f"@requires_lock(\"{lock}\") if every caller "
+                         f"holds it)")
+        self.generic_visit(node)
+
+    def _check_jit(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            if not self._derives_donate(kw.value):
+                self.add("SXT002", node,
+                         "donate_argnums must route through "
+                         "cache_safe_donate_argnums (or a value derived "
+                         "from it): raw donation corrupts memory under "
+                         "the persistent compile cache on jax 0.4.x CPU")
+
+    def _is_host_numpy(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            name = self.imports.canonical(node.func)
+            if name and (name.startswith("numpy.") or name == "numpy"):
+                return True
+        if isinstance(node, ast.Name):
+            return any(node.id in scope for scope in self._numpy_vars)
+        return False
+
+    def _check_device_put(self, node: ast.Call) -> None:
+        if node.args and self._is_host_numpy(node.args[0]):
+            self.add("SXT003", node,
+                     "raw jax.device_put of host numpy — on CPU the result "
+                     "can alias the host buffer; donated state then writes "
+                     "through freed memory. Use "
+                     "utils.placement.owned_device_put")
+
+    def _check_warning_once(self, node: ast.Call) -> None:
+        if not node.args:
+            return
+        if not is_constant_string(node.args[0]):
+            self.add("SXT005", node,
+                     "warning_once with a non-constant message: dedup is "
+                     "by exact string, so a per-call-varying message warns "
+                     "every call (pass a constant; put detail in "
+                     "logger.info or a counter)")
+
+    # -- SXT004 ---------------------------------------------------------
+
+    def _check_shard_map_region(self, node: ast.Call) -> None:
+        partial_manual = False
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                partial_manual = True
+            if kw.arg == "auto" and not (
+                    isinstance(kw.value, (ast.Tuple, ast.List, ast.Set))
+                    and not kw.value.elts):
+                partial_manual = True
+        if not partial_manual or not node.args:
+            return
+        fn = self._resolve_function(node.args[0])
+        if fn is None:
+            return
+        bad = None
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                cname = self.imports.canonical(sub.func)
+                if cname in COLLECTIVES:
+                    bad = cname
+                    break
+        if bad is None:
+            return
+        # capability-gated sites reference native_shard_map() in the
+        # enclosing function — the author consulted the matrix
+        for scope in self._fn_stack:
+            for sub in ast.walk(scope):
+                if (isinstance(sub, (ast.Name, ast.Attribute))
+                        and _last_attr(sub) == "native_shard_map"):
+                    return
+        self.add("SXT004", node,
+                 f"{bad} inside a PARTIAL-manual shard_map region: with a "
+                 f"live auto axis this CHECK-aborts XLA on jax 0.4.x "
+                 f"(spmd_partitioner.cc:512). Gate on native_shard_map() "
+                 f"or make the region full-manual")
+
+    def _resolve_function(self, node: ast.AST) -> Optional[ast.AST]:
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            for scope in reversed(self._local_fns):
+                if node.id in scope:
+                    return scope[node.id]
+        return None
+
+    # -- SXT008 ---------------------------------------------------------
+
+    def _in_jit(self) -> bool:
+        return any(id(fn) in self._jitted_fns for fn in self._fn_stack)
+
+    def _check_jit_body_call(self, node: ast.Call, name: Optional[str]) -> None:
+        if name and name.startswith("time."):
+            self.add("SXT008", node,
+                     f"{name}() inside a jitted body runs at TRACE time — "
+                     f"the compiled program reuses one frozen timestamp")
+            return
+        if name and name.startswith("numpy.random"):
+            self.add("SXT008", node,
+                     f"{name}(...) inside a jitted body bakes ONE draw "
+                     f"into the compiled program — use jax.random with a "
+                     f"threaded key")
+            return
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float", "bool")
+                and len(node.args) == 1 and isinstance(node.args[0], ast.Name)):
+            fn = self._fn_stack[-1] if self._fn_stack else None
+            if fn is not None:
+                params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                          + fn.args.kwonlyargs)} - {"self"}
+                if node.args[0].id in params:
+                    self.add("SXT008", node,
+                             f"{node.func.id}({node.args[0].id}) coerces a "
+                             f"traced argument inside a jitted body — a "
+                             f"ConcretizationTypeError at best, a baked "
+                             f"constant at worst")

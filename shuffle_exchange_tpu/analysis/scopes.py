@@ -1,0 +1,129 @@
+"""Import and name resolution for sxt-check.
+
+Everything here is best-effort SYNTACTIC resolution: the analyzer never
+imports the code it checks (a lint pass must not need a jax backend, and
+must run on files that would crash on import). Names are canonicalized
+to dotted paths through the file's import table so rules can match
+``jax.jit`` / ``jax.experimental.shard_map.shard_map`` /
+``...utils.placement.cache_safe_donate_argnums`` regardless of aliasing
+(``import jax.numpy as jnp``, ``from x import y as z``, relative
+imports).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+
+class ImportTable:
+    """Maps local names to canonical dotted module paths for one file.
+
+    Relative imports (``from ..utils.placement import x``) resolve
+    against ``module_path`` (the file's own dotted module name) when
+    known, else degrade to the bare suffix — rules match by suffix, so
+    either form works.
+    """
+
+    def __init__(self, module_path: str = ""):
+        self.module_path = module_path
+        self.names: Dict[str, str] = {}
+
+    def _resolve_relative(self, level: int, module: str) -> str:
+        if level == 0:
+            return module
+        parts = self.module_path.split(".") if self.module_path else []
+        # "from . import x" in pkg/mod.py: level 1 strips the module name
+        base = parts[:-level] if len(parts) >= level else []
+        return ".".join(base + ([module] if module else []))
+
+    def add_import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            # "import jax.numpy as jnp" binds jnp -> jax.numpy;
+            # "import jax.numpy" binds jax -> jax
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.names[local] = target
+
+    def add_import_from(self, node: ast.ImportFrom) -> None:
+        base = self._resolve_relative(node.level, node.module or "")
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            self.names[local] = f"{base}.{alias.name}" if base else alias.name
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name/Attribute chain with the root expanded
+        through the import table; None when the chain is not a plain
+        name chain (calls, subscripts...). ``self.x`` chains canonicalize
+        to ``self.x`` — rules treat ``self`` specially."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = node.id
+        parts.append(self.names.get(root, root))
+        return ".".join(reversed(parts))
+
+
+def build_import_table(tree: ast.Module, module_path: str = "") -> ImportTable:
+    table = ImportTable(module_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            table.add_import(node)
+        elif isinstance(node, ast.ImportFrom):
+            table.add_import_from(node)
+    return table
+
+
+def call_name(node: ast.Call, imports: ImportTable) -> Optional[str]:
+    """Canonical dotted name of a call's callee (None if not a name chain)."""
+    return imports.canonical(node.func)
+
+
+def decorator_name(dec: ast.AST) -> Optional[str]:
+    """Bare (rightmost) name of a decorator, unwrapping calls:
+    ``@atomic_on_reject(check="x")`` -> "atomic_on_reject"."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    while isinstance(dec, ast.Attribute):
+        if isinstance(dec.value, ast.Name) or isinstance(dec.value, ast.Attribute):
+            return dec.attr
+        return dec.attr
+    if isinstance(dec, ast.Name):
+        return dec.id
+    return None
+
+
+def decorator_call(node: "ast.FunctionDef | ast.AsyncFunctionDef | ast.ClassDef",
+                   name: str) -> Optional[ast.AST]:
+    """The decorator node matching ``name`` on a def/class, else None."""
+    for dec in node.decorator_list:
+        if decorator_name(dec) == name:
+            return dec
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def is_constant_string(node: ast.AST) -> bool:
+    """True for a plain string literal (implicit concatenation of
+    literals parses as one Constant, so it counts). f-strings, ``+``
+    concatenation, names, calls, and ``%``/``.format`` all count as
+    dynamic — their dedup cardinality is unknowable statically."""
+    return isinstance(node, ast.Constant) and isinstance(node.value, str)
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """"x" when ``node`` is exactly ``self.x``, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None

@@ -1,0 +1,102 @@
+"""Per-line suppression comments for sxt-check.
+
+Grammar (one comment, end-of-line or on a standalone line immediately
+above the flagged statement)::
+
+    # sxt: ignore[SXT005] interpolates a fixed-per-process config value
+
+  - the rule id list is mandatory: ``# sxt: ignore`` without
+    ``[RULE,...]`` is ITSELF a violation (SXT000) — a suppression that
+    does not say what it suppresses suppresses everything, which is how
+    guardrails rot;
+  - the free-text reason after the bracket is mandatory for the same
+    reason: the next reader must learn WHY the sanctioned pattern does
+    not apply here without archaeology;
+  - a suppression that no longer matches any violation on its line is
+    reported as a STALE warning (satellite: stale suppressions must not
+    accumulate silently), without failing the run.
+
+SXT000 findings are not themselves suppressible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Tuple
+
+#: matches the marker anywhere in a comment; groups: rules (optional), reason
+_MARKER = re.compile(
+    r"#\s*sxt:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s]*)\])?\s*(?P<reason>.*)$")
+
+_RULE_ID = re.compile(r"^SXT\d{3}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    #: True when this comment sits alone on its line — it then also
+    #: applies to the statement starting on the NEXT line
+    standalone: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class MalformedSuppression:
+    line: int
+    problem: str
+
+
+def parse_suppressions(source: str):
+    """-> (suppressions, malformed). Tokenize-based so strings that merely
+    CONTAIN the marker text (this module, tests) never match."""
+    sups: List[Suppression] = []
+    bad: List[MalformedSuppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return sups, bad
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _MARKER.search(tok.string)
+        if m is None:
+            continue
+        line = tok.start[0]
+        standalone = tok.string.strip() == tok.line.strip()
+        rules_raw = m.group("rules")
+        reason = (m.group("reason") or "").strip()
+        if rules_raw is None:
+            bad.append(MalformedSuppression(
+                line, "missing rule id: write `# sxt: ignore[SXTnnn] reason`"))
+            continue
+        rules = tuple(r.strip().upper() for r in rules_raw.split(",") if r.strip())
+        invalid = [r for r in rules if not _RULE_ID.match(r)]
+        if not rules or invalid:
+            bad.append(MalformedSuppression(
+                line, f"bad rule id list {rules_raw!r}: expected SXTnnn"
+                      " (comma-separated)"))
+            continue
+        if not reason:
+            bad.append(MalformedSuppression(
+                line, f"missing reason: `# sxt: ignore[{','.join(rules)}]`"
+                      " must say WHY the rule does not apply here"))
+            continue
+        sups.append(Suppression(line, rules, reason, standalone))
+    return sups, bad
+
+
+def build_index(sups: List[Suppression]) -> Dict[int, List[Suppression]]:
+    """line -> suppressions applying to that line. A standalone comment
+    on line N covers line N+1 (the statement it precedes); an end-of-line
+    comment covers its own line. Multi-line statements are handled by the
+    caller matching any line in the node's [lineno, end_lineno] span."""
+    idx: Dict[int, List[Suppression]] = {}
+    for s in sups:
+        idx.setdefault(s.line, []).append(s)
+        if s.standalone:
+            idx.setdefault(s.line + 1, []).append(s)
+    return idx

@@ -1,0 +1,71 @@
+"""File discovery and per-file analysis for sxt-check."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator, List, Optional, Sequence, Set
+
+from .rules import FileChecker, Violation
+from .suppress import (MalformedSuppression, Suppression, parse_suppressions)
+
+PACKAGE = "shuffle_exchange_tpu"
+
+
+@dataclasses.dataclass
+class FileResult:
+    path: str
+    violations: List[Violation]          # raw; suppressions applied later
+    suppressions: List[Suppression]
+    malformed: List[MalformedSuppression]
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if not d.startswith(".") and d != "__pycache__")
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def module_path_of(path: str) -> str:
+    """Dotted module path rooted at the package dir when the file lives
+    under it (used for relative-import resolution and the mesh-facade
+    exemption); best-effort otherwise."""
+    norm = os.path.normpath(os.path.abspath(path))
+    parts = norm.split(os.sep)
+    if PACKAGE in parts:
+        parts = parts[parts.index(PACKAGE):]
+    else:
+        parts = parts[-1:]
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def analyze_file(path: str, select: Optional[Set[str]] = None) -> FileResult:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    sups, malformed = parse_suppressions(source)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return FileResult(path, [Violation(
+            "SXT000", path, e.lineno or 1, e.offset or 0,
+            f"file does not parse: {e.msg}")], sups, malformed)
+    checker = FileChecker(path, tree, module_path_of(path), select=select)
+    return FileResult(path, checker.run(), sups, malformed)
+
+
+def analyze(paths: Sequence[str],
+            select: Optional[Set[str]] = None) -> List[FileResult]:
+    return [analyze_file(p, select=select) for p in iter_python_files(paths)]

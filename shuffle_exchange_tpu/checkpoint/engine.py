@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.logging import log_dist, logger
+from ..utils.logging import logger
 
 LATEST_FILE = "latest"
 STAGING_MARKER = ".tmp-"

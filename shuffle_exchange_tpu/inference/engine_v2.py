@@ -16,18 +16,18 @@ equivalent of the reference's CUDA-graph strategy).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils.invariants import atomic_on_reject
 from ..utils.logging import logger
 from .config import InferenceConfig
-from .engine import (InferenceEngine, _bucket, _rope_rows,
-                     _apply_rope_batched)
-from .paged import (BlockedAllocator, PagedKVCache, _chain_key, append_token_kv,
-                    blocks_needed, chain_block_keys, kv_parts,
-                    paged_decode_attention, quantize_kv, write_prefill_kv)
+from .engine import InferenceEngine, _bucket
+from .paged import (BlockedAllocator,
+                    PagedKVCache, _chain_key, append_token_kv, blocks_needed,
+                    chain_block_keys, kv_parts, paged_decode_attention,
+                    quantize_kv)
 
 
 
@@ -482,6 +482,7 @@ class InferenceEngineV2(InferenceEngine):
                 except Exception as e:
                     from ..utils.logging import warning_once
 
+                    # sxt: ignore[SXT005] exception class name only — bounded dedup cardinality
                     warning_once(
                         "fused decode: split-K attention kernel failed "
                         f"with {type(e).__name__}; using the streaming "
@@ -542,6 +543,7 @@ class InferenceEngineV2(InferenceEngine):
                 q[:, None], ck2, cv2, btables, pos + 1,
                 alibi_slopes=self._alibi)
         except Exception as e:
+            # sxt: ignore[SXT005] exception class + pool/model dims are fixed per process — bounded dedup
             warning_once(f"fused decode: paged layer kernels failed with "
                          f"{type(e).__name__} (D={y.shape[-1]}, "
                          f"pool={tuple(kv_parts(ck)[0].shape)}); using the "
@@ -846,6 +848,7 @@ class InferenceEngineV2(InferenceEngine):
             btables[i, :len(desc.blocks)] = desc.blocks[:nblk_pad]
         return P, tpad, ids, plen, btables
 
+    @atomic_on_reject
     def put(self, uids: Sequence[int], tokens: Sequence[Sequence[int]]) -> np.ndarray:
         """Serve one engine step (engine_v2.py:107). New uids are prefilled;
         known uids extended by their new tokens. Returns fp32 logits
@@ -1098,6 +1101,7 @@ class InferenceEngineV2(InferenceEngine):
             sres = (ver, accepted, slast)
         return self._cache_of(kp, vp), dlogits, plogits, sres
 
+    @atomic_on_reject
     def step(self, decode_uids: Sequence[int], decode_tokens: Sequence[int],
              prefills: Sequence[Tuple[int, Sequence[int]]] = (),
              speculative: Sequence[Tuple[int, Sequence[int]]] = ()):
@@ -1335,6 +1339,7 @@ class InferenceEngineV2(InferenceEngine):
         self._loop_cache[key] = fn
         return fn
 
+    @atomic_on_reject
     def decode_loop(self, uids: Sequence[int], tokens: Sequence[int],
                     n_steps: int) -> np.ndarray:
         """Greedy-decode ``n_steps`` tokens for known uids in ONE device
@@ -1430,6 +1435,7 @@ class InferenceEngineV2(InferenceEngine):
             block_size=bs,
         )
 
+    @atomic_on_reject
     def begin_import(self, uid: int, n_tokens: int) -> "ImportReservation":
         """The admission half of the disagg handshake: acquire the KV
         blocks a ``n_tokens``-token import needs BEFORE any payload bytes

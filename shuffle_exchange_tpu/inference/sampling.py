@@ -7,7 +7,6 @@ All samplers take fp32 logits [B, V] and return int32 tokens [B].
 
 from __future__ import annotations
 
-from typing import Optional
 
 
 def greedy(logits):

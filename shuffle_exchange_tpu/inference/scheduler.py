@@ -57,6 +57,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..monitor import InMemoryMonitor, Monitor
+from ..utils.invariants import atomic_on_reject
 from ..utils.logging import logger
 from .config import ServingConfig
 from .engine_v2 import InferenceEngineV2
@@ -157,6 +158,7 @@ class ContinuousBatchingScheduler:
 
     # -- request intake ------------------------------------------------
 
+    @atomic_on_reject(check="validate")
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                uid: Optional[int] = None) -> int:
         """Queue one request; returns its uid. Validates against the
@@ -516,6 +518,7 @@ class ContinuousBatchingScheduler:
                 f"{len(exported)} unfinished requests exported for requeue")
         return exported
 
+    @atomic_on_reject(check="validate")
     def inject(self, r: ServingRequest, front: bool = True) -> None:
         """Adopt a request exported from another replica, by default at the
         FRONT of the queue (a drained request is older than anything queued

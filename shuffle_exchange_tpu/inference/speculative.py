@@ -201,6 +201,7 @@ class DraftModelDrafter:
             # warning stays STATIC (admission errors embed per-tick block
             # counts; interpolating them would defeat warning_once and
             # flood the log every tick under sustained pressure)
+            # sxt: ignore[SXT005] exception class name only; the per-tick block counts are deliberately NOT interpolated (see comment above)
             warning_once(
                 f"draft model: batched proposal failed "
                 f"({type(e).__name__}); affected sequences fall back to "

@@ -19,7 +19,7 @@ a local checkpoint directory — no network access is assumed.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 

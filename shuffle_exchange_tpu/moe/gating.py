@@ -14,7 +14,7 @@ XLA-friendly (no dynamic token routing; drops are masked, not ragged).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple
 
 
 class GateOutput(NamedTuple):

@@ -18,9 +18,9 @@ moe_gemm → the per-expert matmul is a single batched einsum on the MXU).
 from __future__ import annotations
 
 import math
-from typing import Callable, NamedTuple, Optional
+from typing import NamedTuple, Optional
 
-from .gating import GateOutput, topk_gating
+from .gating import topk_gating
 
 
 def init_expert_mlp(rng, n_experts: int, d_model: int, d_ff: int, activation: str = "swiglu",

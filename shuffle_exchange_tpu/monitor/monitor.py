@@ -19,6 +19,7 @@ import os
 from abc import ABC, abstractmethod
 from typing import Any, List, Sequence, Tuple
 
+from ..utils.invariants import locked_by
 from ..utils.logging import logger
 
 Event = Tuple[str, Any, int]
@@ -207,6 +208,7 @@ class _ReplicaSink(Monitor):
                                   for label, value, step in event_list])
 
 
+@locked_by("_mu", "memory_monitor")
 class FleetMonitor(Monitor):
     """Fleet-aggregated sink for the multi-replica serving front (ISSUE 7).
 

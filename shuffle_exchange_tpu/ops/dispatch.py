@@ -56,6 +56,7 @@ def resolve_decode_kernel(mode: str, speculative_k: int = 0) -> str:
     if resolved == "pallas" and speculative_k > 0:
         from ..utils.logging import warning_once
 
+        # sxt: ignore[SXT005] k comes from the serving config, fixed per process — dedup cardinality 1
         warning_once(
             f"decode_kernel resolves to the fused Pallas path with "
             f"speculative k={speculative_k}: verify rows "

@@ -21,7 +21,7 @@ not fit HBM. Two complementary mechanisms:
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

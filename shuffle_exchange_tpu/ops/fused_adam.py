@@ -17,8 +17,7 @@ Exposed two ways:
 
 from __future__ import annotations
 
-import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 LANES = 128
 SUBLANES = 8

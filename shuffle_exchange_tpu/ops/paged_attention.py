@@ -19,7 +19,6 @@ gather path; parity is tested in CPU interpret mode and on chip.
 
 from __future__ import annotations
 
-import functools
 
 
 def _scale_operand(s, pooled: bool):
@@ -371,6 +370,7 @@ def paged_extend_attention(q, ck, cv, block_table, start, nnew, *,
             # a silent per-step degrade to the gather path hides real
             # kernel regressions (ADVICE r5 #3) — say so once, with enough
             # shape context to reproduce
+            # sxt: ignore[SXT005] shape context is deliberate (ADVICE r5 #3) and bounded by the shape-bin ladder
             warning_once(
                 "paged_extend_attention: Pallas kernel failed with "
                 f"{type(e).__name__} (q={tuple(q.shape)} "
@@ -424,6 +424,7 @@ def paged_decode_attention(q, ck, cv, block_table, kv_len, *,
             # the bare except also swallows stacked-pool kernel failures —
             # exactly the whole-layer KV copy the pooled mode exists to
             # avoid (ADVICE r5 #3); make the degrade visible once
+            # sxt: ignore[SXT005] shape context is deliberate (ADVICE r5 #3) and bounded by the shape-bin ladder
             warning_once(
                 "paged_decode_attention: Pallas kernel failed with "
                 f"{type(e).__name__} (q={tuple(q.shape)} "

@@ -28,8 +28,6 @@ structure and the kernel's k-loop stay identical to int8's.
 
 from __future__ import annotations
 
-import functools
-from typing import Tuple
 
 
 class QuantizedMatrix:

@@ -88,7 +88,6 @@ def _rmsnorm_pallas(x, weight, eps):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     orig_shape = x.shape
     d = orig_shape[-1]

@@ -188,6 +188,7 @@ def sparse_attention(q, k, v, config: Optional[SparsityConfig] = None, causal: b
             except Exception as e:  # pragma: no cover - fallback safety
                 if impl == "splash":
                     raise
+                # sxt: ignore[SXT005] exception class name only — bounded dedup cardinality
                 warning_once(f"splash blocksparse unavailable "
                              f"({type(e).__name__}); dense-mask fallback")
 

@@ -15,7 +15,7 @@ unknown names stay replicated, mirroring AutoTP's conservative fallback.
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 # Column-parallel: output features split over "tensor" (last dim of an
 # [in, out] matrix). Reference: qkv + up/gate projections.

@@ -23,7 +23,6 @@ bound); pure-jit callers get the same math when the axis is size 1.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 from . import comm

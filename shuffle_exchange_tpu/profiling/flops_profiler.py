@@ -20,11 +20,9 @@ API of the same name.
 
 from __future__ import annotations
 
-import sys
-import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from ..utils.logging import log_dist, logger
+from ..utils.logging import log_dist
 
 
 # -- formatting helpers (reference profiler.py number/flops/params_to_string) --

@@ -18,7 +18,6 @@ models/transformer.py) so the schedule never recompiles.
 
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional
 
 from ..config.config_utils import ConfigError
@@ -71,7 +70,6 @@ def curriculum_truncate(batch, difficulty: int, seq_keys=("input_ids", "labels",
                                                          "attention_mask", "position_ids")):
     """Truncate the sequence dim of known keys to ``difficulty`` tokens
     (reference legacy curriculum truncation)."""
-    import numpy as np
 
     def trunc(key, x):
         if key in seq_keys and hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > difficulty:

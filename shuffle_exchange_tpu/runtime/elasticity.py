@@ -10,7 +10,6 @@ checkpoint-resume with a recomputed plan (reference §5.3).
 
 from __future__ import annotations
 
-import math
 from typing import Dict, List, Tuple
 
 from ..config.config_utils import ConfigError

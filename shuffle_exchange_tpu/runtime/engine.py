@@ -26,9 +26,8 @@ per-step R×R mixing matrix couples the replicas (see runtime/sync/).
 
 from __future__ import annotations
 
-import functools
 import time
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import numpy as np
 

@@ -12,7 +12,7 @@ optax path here is the reference implementation.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Any, Callable, Optional
 
 import optax
 

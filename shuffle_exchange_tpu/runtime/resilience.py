@@ -24,7 +24,6 @@ import os
 import shutil
 import signal
 import threading
-import time
 import weakref
 from typing import Callable, List, Optional, Sequence
 

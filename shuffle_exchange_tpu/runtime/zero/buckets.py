@@ -26,7 +26,6 @@ the per-leaf two-level schedule; group boundaries follow the bucket flat).
 
 from __future__ import annotations
 
-import math
 from typing import List, Optional, Sequence, Tuple
 
 

@@ -28,7 +28,6 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ...utils.logging import log_dist, logger
 
 
 def _snapshot(arr) -> Tuple[Any, List[Tuple[List[Any], np.ndarray]]]:

@@ -27,7 +27,6 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, Optional, Tuple
 
-from ...utils.logging import logger
 
 
 def _axis_size(mesh_axis_sizes: Dict[str, int], axes) -> int:

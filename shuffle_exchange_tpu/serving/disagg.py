@@ -36,13 +36,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..inference.engine_v2 import (ImportReservation, InferenceEngineV2,
-                                   KVBlockPayload)
+from ..inference.engine_v2 import InferenceEngineV2, KVBlockPayload
 from ..monitor.monitor import InMemoryMonitor, Monitor
 from ..testing import faults
-from ..utils.logging import logger
+from ..utils.invariants import atomic_on_reject, locked_by, requires_lock
 
 
+@locked_by("_mu", "_inflight", "_ticket", "_slots_in_use")
 class KVTransferChannel:
     """Moves ``KVBlockPayload``s between engines through pinned staging.
 
@@ -85,6 +85,7 @@ class KVTransferChannel:
         # long-lived allocations
         self._slots_in_use: set = set()
 
+    @requires_lock("_mu")
     def _alloc_slot(self) -> int:
         slot = 0
         while slot in self._slots_in_use:
@@ -184,6 +185,7 @@ class KVTransferChannel:
         if path is not None:
             self._unlink(path)
 
+    @atomic_on_reject(check="begin_import")
     def transfer(self, src: InferenceEngineV2, dst: InferenceEngineV2,
                  uid: int, dst_uid: Optional[int] = None,
                  flush_src: bool = True) -> int:

@@ -44,6 +44,7 @@ from ..inference.config import RouterConfig
 from ..inference.engine_v2 import InferenceEngineV2
 from ..inference.scheduler import ContinuousBatchingScheduler, ServingRequest
 from ..monitor.monitor import FleetMonitor, Monitor
+from ..utils.invariants import locked_by, requires_lock
 from ..utils.logging import logger
 
 ACTIVE, DRAINING, STOPPED = "active", "draining", "stopped"
@@ -69,6 +70,8 @@ class Replica:
         return self.state == ACTIVE
 
 
+@locked_by("_lock", "requests", "owner", "sessions", "_session_of",
+           "_next_uid", "drains", "requeued")
 class ReplicaRouter:
     """Place requests across replicas; tick them; aggregate their stats.
 
@@ -219,6 +222,7 @@ class ReplicaRouter:
             self._evict_finished()
             return uid
 
+    @requires_lock("_lock")
     def _evict_finished(self) -> None:
         """Long-lived-process bounds (router config): drop the oldest
         FINISHED requests past ``retain_finished`` (their results have

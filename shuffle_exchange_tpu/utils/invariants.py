@@ -1,0 +1,92 @@
+"""Machine-checkable invariant markers for the static analyzer (sxt-check).
+
+Eight PRs of growth left this repo with a catalog of non-local
+correctness invariants — atomic-on-reject admission (PRs 5-8), lock
+discipline in the threaded serving fleet (PR 7), the donated-buffer/
+compile-cache corruption rules (PR 2) — that used to live only in
+CHANGES.md and reviewer memory. These decorators put the contract ON the
+code, where ``shuffle_exchange_tpu.analysis`` (rules SXT006/SXT007) can
+machine-check every call site against it.
+
+All markers are runtime no-ops: they attach metadata attributes and
+return the target unchanged, so decorating costs nothing on any hot
+path. The analyzer reads them SYNTACTICALLY (decorator names in the
+AST) — importing this module is never required for the check to run.
+"""
+
+from __future__ import annotations
+
+#: attribute names the analyzer looks for (kept in one place so the
+#: analysis package and any runtime introspection agree)
+ATOMIC_ATTR = "__sxt_atomic_on_reject__"
+LOCKED_BY_ATTR = "__sxt_locked_by__"
+REQUIRES_LOCK_ATTR = "__sxt_requires_lock__"
+
+#: the default admission-check method names for :func:`atomic_on_reject`
+DEFAULT_ADMISSION_CHECKS = ("_admission_detail", "can_schedule")
+
+#: ``check="validate"`` selects raise-barrier mode: the method must not
+#: mutate ``self`` state on any path where a validation ``raise`` is
+#: still ahead (validate-everything-then-mutate).
+VALIDATE = "validate"
+
+
+def atomic_on_reject(fn=None, *, check: "str | None" = None):
+    """Declare a method atomic-on-reject: a refused call mutates nothing.
+
+    The admission discipline PRs 5-8 paid to establish — ``put()``/
+    ``step()``/``decode_loop()``/``begin_import()`` check KV-block
+    pressure via ``_admission_detail`` BEFORE touching any allocator or
+    descriptor state, so a rejected batch can be retried verbatim.
+    sxt-check rule SXT006 flags ``self`` state mutation before the
+    admission check in any method carrying this marker.
+
+    ``check`` names the admission-check method (default: any of
+    ``DEFAULT_ADMISSION_CHECKS``); ``check="validate"`` instead asserts
+    the validate-then-mutate shape — no mutation while a validation
+    ``raise`` is reachable ahead on the same path.
+    """
+
+    def mark(f):
+        setattr(f, ATOMIC_ATTR, check or DEFAULT_ADMISSION_CHECKS)
+        return f
+
+    if fn is not None:   # bare @atomic_on_reject
+        return mark(fn)
+    return mark
+
+
+def locked_by(lock_attr: str, *attrs: str):
+    """Register ``attrs`` of the decorated class as guarded by
+    ``self.<lock_attr>`` (the PR 7 serving-fleet lock discipline).
+
+    sxt-check rule SXT007 flags any write to a registered attribute —
+    assignment, augmented assignment, ``del``, subscript store, or a
+    mutating-method call (``append``/``pop``/``add``/...) — outside a
+    ``with self.<lock_attr>:`` block. ``__init__`` is exempt (the object
+    is not yet shared); helper methods whose CALLERS hold the lock carry
+    :func:`requires_lock`.
+    """
+
+    def mark(cls):
+        registered = dict(getattr(cls, LOCKED_BY_ATTR, ()) or {})
+        registered[lock_attr] = tuple(attrs)
+        setattr(cls, LOCKED_BY_ATTR, registered)
+        return cls
+
+    return mark
+
+
+def requires_lock(lock_attr: str):
+    """Declare that every caller of this method already holds
+    ``self.<lock_attr>`` — the analyzer treats the whole body as inside
+    the lock (the ``GUARDED_BY``/``REQUIRES`` split of thread-safety
+    annotations). Use sparingly and only where the call graph really
+    guarantees it."""
+
+    def mark(fn):
+        held = tuple(getattr(fn, REQUIRES_LOCK_ATTR, ()) or ()) + (lock_attr,)
+        setattr(fn, REQUIRES_LOCK_ATTR, held)
+        return fn
+
+    return mark

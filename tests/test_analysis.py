@@ -1,0 +1,669 @@
+"""sxt-check (ISSUE 10): the self-clean gate + per-rule fixture coverage.
+
+Three layers:
+
+1. **Self-clean gate** — the analyzer runs over the whole
+   ``shuffle_exchange_tpu`` package and must report ZERO unsuppressed
+   violations (every suppression carries a rule id + written reason).
+   This is the machine check that keeps the CHANGES.md bug catalog from
+   being re-learned the hard way.
+2. **Per-rule fixtures** — for every rule in RULES.md, a positive
+   fixture proving it FIRES and a negative fixture proving the
+   sanctioned pattern stays quiet.
+3. **Regression drill** — a fixture COPY of the real
+   ``inference/engine_v2.py`` with the ``cache_safe_donate_argnums``
+   routing deleted at one jit site must fail the gate (the acceptance
+   criterion: the analyzer would have caught the PR 2 corruption bug
+   being reintroduced).
+
+Everything here is pure AST work — no jax import, no device programs —
+so the whole file runs in seconds on the tier-1 clock.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from shuffle_exchange_tpu.analysis import RULES, analyze_file, fold, run
+from shuffle_exchange_tpu.analysis.suppress import parse_suppressions
+
+PKG_DIR = os.path.join(os.path.dirname(__file__), "..", "shuffle_exchange_tpu")
+
+
+def check_source(tmp_path, source, name="fixture.py", select=None):
+    """Write a fixture and return its folded report."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return fold([analyze_file(str(p), select=select)])
+
+
+def rule_ids(report):
+    return sorted({v.rule for v in report.violations})
+
+
+# ---------------------------------------------------------------------------
+# 1. the self-clean gate
+# ---------------------------------------------------------------------------
+
+def test_package_is_self_clean():
+    report = run([PKG_DIR])
+    msgs = "\n".join(f"{v.path}:{v.line}: {v.rule} {v.message}"
+                     for v in report.violations)
+    assert not report.violations, (
+        f"sxt-check found unsuppressed violations in the package:\n{msgs}")
+    # suppressions must not rot either: every one still matches a firing
+    # rule on its line
+    stale = "\n".join(f"{s.path}:{s.line}: [{','.join(s.rules)}]"
+                      for s in report.stale)
+    assert not report.stale, f"stale suppressions:\n{stale}"
+    assert report.files_scanned > 80   # the whole package, not a subdir
+
+
+def test_every_rule_documented_in_rules_md():
+    md = open(os.path.join(PKG_DIR, "analysis", "RULES.md")).read()
+    for rid in RULES:
+        assert rid in md, f"{rid} missing from analysis/RULES.md"
+
+
+# ---------------------------------------------------------------------------
+# 2. per-rule fixtures: each fires AND its sanctioned pattern passes
+# ---------------------------------------------------------------------------
+
+def test_sxt001_fires_on_raw_shard_map(tmp_path):
+    rep = check_source(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+    """)
+    assert rule_ids(rep) == ["SXT001"]
+    rep = check_source(tmp_path, """
+        import jax
+
+        def f(g, mesh):
+            return jax.shard_map(g, mesh=mesh)
+    """)
+    assert "SXT001" in rule_ids(rep)
+
+
+def test_sxt001_quiet_on_facade_import(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.parallel.mesh import shard_map
+
+        def f(g, mesh):
+            return shard_map(g, mesh=mesh, in_specs=None, out_specs=None)
+    """)
+    assert "SXT001" not in rule_ids(rep)
+
+
+def test_sxt001_exempts_the_facade_module_itself():
+    mesh_py = os.path.join(PKG_DIR, "parallel", "mesh.py")
+    rep = fold([analyze_file(mesh_py)])
+    assert "SXT001" not in rule_ids(rep)
+
+
+def test_sxt002_fires_on_raw_donate(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            return jax.jit(f, donate_argnums=(0,))
+    """)
+    assert rule_ids(rep) == ["SXT002"]
+
+
+def test_sxt002_quiet_on_derived_donate(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+        from shuffle_exchange_tpu.utils.placement import cache_safe_donate_argnums
+
+        def _donate():
+            return cache_safe_donate_argnums((1,))
+
+        def build(f, g, h):
+            a = jax.jit(f, donate_argnums=cache_safe_donate_argnums((0,)))
+            donate = cache_safe_donate_argnums((0,))
+            b = jax.jit(g, donate_argnums=donate)
+            c = jax.jit(h, donate_argnums=_donate())
+            return a, b, c
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt003_fires_on_numpy_device_put(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+        import numpy as np
+
+        def place(x, s):
+            jax.device_put(np.asarray(x), s)       # direct
+            arr = np.zeros((4,))
+            return jax.device_put(arr, s)          # via a tracked name
+    """)
+    assert rule_ids(rep) == ["SXT003"]
+    assert len(rep.violations) == 2
+
+
+def test_sxt003_quiet_on_owned_device_put(tmp_path):
+    rep = check_source(tmp_path, """
+        import numpy as np
+        from shuffle_exchange_tpu.utils.placement import owned_device_put
+
+        def place(x, s):
+            return owned_device_put(np.asarray(x), s)
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt004_fires_on_partial_manual_collective(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+        from shuffle_exchange_tpu.parallel.mesh import shard_map
+
+        def wire(x, mesh):
+            def body(x):
+                return jax.lax.ppermute(x, "seq", [(0, 1)])
+            return shard_map(body, mesh=mesh, in_specs=None, out_specs=None,
+                             axis_names=frozenset(("seq",)))(x)
+    """)
+    assert rule_ids(rep) == ["SXT004"]
+
+
+def test_sxt004_quiet_on_full_manual_and_gated(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+        from shuffle_exchange_tpu.parallel.mesh import shard_map, native_shard_map
+
+        def full_manual(x, mesh):
+            def body(x):
+                return jax.lax.ppermute(x, "seq", [(0, 1)])
+            # no axis_names: every axis manual -> 0.4.x lowers it fine
+            return shard_map(body, mesh=mesh, in_specs=None, out_specs=None)(x)
+
+        def gated(x, mesh):
+            if not native_shard_map():
+                return x
+            def body(x):
+                return jax.lax.ppermute(x, "seq", [(0, 1)])
+            return shard_map(body, mesh=mesh, in_specs=None, out_specs=None,
+                             axis_names=frozenset(("seq",)))(x)
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt005_fires_on_dynamic_message(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.logging import warning_once
+
+        def warn(k):
+            warning_once(f"value {k} changed")
+            warning_once("prefix" + str(k))
+    """)
+    assert rule_ids(rep) == ["SXT005"]
+    assert len(rep.violations) == 2
+
+
+def test_sxt005_quiet_on_constant_message(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.logging import warning_once
+
+        def warn():
+            warning_once("static message")
+            warning_once("implicit "
+                         "concatenation is one literal")
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt006_fires_on_mutation_before_check(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import atomic_on_reject
+
+        class Engine:
+            @atomic_on_reject
+            def put(self, uids):
+                self._seqs[0] = object()          # mutation BEFORE the check
+                ok, _, why = self._admission_detail(uids, [])
+                if not ok:
+                    raise RuntimeError(why)
+                self.done = True
+    """)
+    assert rule_ids(rep) == ["SXT006"]
+    assert rep.violations[0].line == 7
+
+
+def test_sxt006_quiet_on_check_first(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import atomic_on_reject
+
+        class Engine:
+            @atomic_on_reject
+            def put(self, uids):
+                if not uids:
+                    raise ValueError("empty")
+                ok, _, why = self._admission_detail(uids, [])
+                if not ok:
+                    raise RuntimeError(why)
+                self._seqs[0] = object()
+                self.counters.append(1)
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt006_validate_mode(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import atomic_on_reject
+
+        class Sched:
+            @atomic_on_reject(check="validate")
+            def bad_submit(self, prompt):
+                self.queue.append(prompt)          # mutates...
+                if not prompt:
+                    raise ValueError("empty")      # ...then validates
+
+            @atomic_on_reject(check="validate")
+            def good_submit(self, prompt, uid):
+                if not prompt:
+                    raise ValueError("empty")
+                if uid is None:
+                    while self._next_uid in self.requests:
+                        self._next_uid += 1        # branch with no raise ahead
+                elif uid in self.requests:
+                    raise ValueError("live")
+                self.requests[uid] = prompt
+                self.queue.append(prompt)
+    """)
+    assert rule_ids(rep) == ["SXT006"]
+    assert len(rep.violations) == 1
+    assert rep.violations[0].line == 7
+
+
+def test_sxt006_nested_defs_do_not_leak(tmp_path):
+    """A closure's raise fires at call time, and a closure that merely
+    references the checker has not run it — neither may leak into the
+    enclosing method's analysis (review-round soundness fix)."""
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import atomic_on_reject
+
+        class Sched:
+            @atomic_on_reject(check="validate")
+            def ok(self, p):
+                if not p:
+                    raise ValueError("empty")
+                self.queue.append(p)            # after ALL validation
+
+                def closure(x):                 # its raise is not "ahead"
+                    raise RuntimeError(x)
+                self.hooks.append(closure)
+
+        class Eng:
+            @atomic_on_reject
+            def bad(self, uids):
+                def helper():                   # references, never runs
+                    return self._admission_detail(uids, [])
+                self._seqs[0] = helper          # still BEFORE the check
+                ok, _, why = self._admission_detail(uids, [])
+                if not ok:
+                    raise RuntimeError(why)
+    """)
+    assert [(v.rule, v.line) for v in rep.violations] == [("SXT006", 20)]
+
+
+def test_sxt007_fires_outside_lock(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+        @locked_by("_mu", "inflight", "ticket")
+        class Chan:
+            def __init__(self):
+                self.inflight = {}                 # __init__ is exempt
+
+            def send(self, p):
+                self.ticket += 1                   # outside the lock
+                self.inflight.pop(0)               # mutator call outside
+    """)
+    assert rule_ids(rep) == ["SXT007"]
+    assert len(rep.violations) == 2
+
+
+def test_sxt007_quiet_under_lock_and_requires_lock(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import locked_by, requires_lock
+
+        @locked_by("_mu", "inflight", "ticket")
+        class Chan:
+            def send(self, p):
+                with self._mu:
+                    self.ticket += 1
+                    self.inflight[self.ticket] = p
+
+            @requires_lock("_mu")
+            def _evict(self):
+                self.inflight.clear()
+
+            def unrelated(self):
+                self.other = 1                     # not registered
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt007_reentrant_with_keeps_outer_hold(tmp_path):
+    rep = check_source(tmp_path, """
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+        @locked_by("_mu", "inflight")
+        class Chan:
+            def reenter(self):
+                with self._mu:
+                    with self._mu:       # RLock re-entry
+                        self.inflight[0] = 1
+                    self.inflight[1] = 2  # outer hold still active
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt008_fires_in_jitted_bodies(tmp_path):
+    rep = check_source(tmp_path, """
+        import time
+        import jax
+        import numpy as np
+
+        def step(state, n):
+            t = time.perf_counter()
+            r = np.random.normal()
+            return state * t * r * int(n)
+
+        fn = jax.jit(step)
+
+        class Eng:
+            def _impl(self, params, x):
+                return params * float(x)
+
+            def build(self):
+                return jax.jit(self._impl, donate_argnums=(0,))
+    """, select={"SXT008"})
+    assert rule_ids(rep) == ["SXT008"]
+    assert len(rep.violations) == 4   # time, np.random, int(), float()
+
+
+def test_sxt008_quiet_outside_jit_and_on_static_shapes(tmp_path):
+    rep = check_source(tmp_path, """
+        import time
+        import jax
+        import numpy as np
+
+        def host_side(n):
+            return time.perf_counter() + np.random.normal() + int(n)
+
+        def jitted(x):
+            B = int(x.shape[0])      # shape access, not a bare param
+            return x * B
+
+        fn = jax.jit(jitted)
+    """)
+    assert rule_ids(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics (satellite)
+# ---------------------------------------------------------------------------
+
+def test_suppression_silences_with_id_and_reason(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            # sxt: ignore[SXT002] fixture: documented divergence
+            return jax.jit(f, donate_argnums=(0,))
+    """)
+    assert rule_ids(rep) == []
+    assert len(rep.suppressed) == 1
+    assert rep.suppressed[0].reason == "fixture: documented divergence"
+    assert not rep.stale
+
+
+def test_suppression_end_of_line_form(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            return jax.jit(f, donate_argnums=(0,))  # sxt: ignore[SXT002] fixture reason
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_suppression_without_rule_id_is_a_violation(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            # sxt: ignore
+            return jax.jit(f, donate_argnums=(0,))
+    """)
+    # the bare ignore is SXT000 AND it fails to suppress the SXT002
+    assert rule_ids(rep) == ["SXT000", "SXT002"]
+
+
+def test_suppression_without_reason_is_a_violation(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            # sxt: ignore[SXT002]
+            return jax.jit(f, donate_argnums=(0,))
+    """)
+    assert rule_ids(rep) == ["SXT000", "SXT002"]
+
+
+def test_sxt000_is_unsuppressable(tmp_path):
+    rep = check_source(tmp_path, """
+        x = 1  # sxt: ignore
+    """)
+    assert rule_ids(rep) == ["SXT000"]
+
+
+def test_wrong_rule_id_does_not_suppress(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            # sxt: ignore[SXT005] wrong rule for this line
+            return jax.jit(f, donate_argnums=(0,))
+    """)
+    assert "SXT002" in rule_ids(rep)
+
+
+def test_stale_suppression_is_a_warning_not_a_failure(tmp_path):
+    rep = check_source(tmp_path, """
+        import jax
+
+        def build(f):
+            # sxt: ignore[SXT002] nothing fires here anymore
+            return jax.jit(f)
+    """)
+    assert rep.exit_code == 0
+    assert len(rep.stale) == 1
+    assert rep.stale[0].rules == ("SXT002",)
+
+
+def test_select_does_not_mark_unran_suppressions_stale(tmp_path):
+    """--select runs a rule subset; suppressions for rules that never ran
+    cannot be judged stale (review-round fix: --select + --fail-on-stale
+    must not fail a tree the full gate passes)."""
+    p = tmp_path / "f.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        def build(f):
+            # sxt: ignore[SXT002] valid under the full gate
+            return jax.jit(f, donate_argnums=(0,))
+    """))
+    rep = run([str(p)], select={"SXT001", "SXT000"})
+    assert not rep.violations
+    assert not rep.stale            # SXT002 did not run -> not stale
+    full = run([str(p)])
+    assert not full.stale and len(full.suppressed) == 1
+
+
+def test_admission_check_names_shared_with_runtime_marker():
+    """The analyzer and the runtime marker must agree on the default
+    admission-check names (single source of truth in utils/invariants)."""
+    from shuffle_exchange_tpu.analysis import rules
+    from shuffle_exchange_tpu.utils import invariants
+
+    assert rules.DEFAULT_ADMISSION_CHECKS is invariants.DEFAULT_ADMISSION_CHECKS
+
+
+def test_parse_suppressions_ignores_strings():
+    sups, bad = parse_suppressions(
+        's = "# sxt: ignore[SXT001] not a comment"\n')
+    assert not sups and not bad
+
+
+# ---------------------------------------------------------------------------
+# 3. the regression drill: deleting the routing fails the gate
+# ---------------------------------------------------------------------------
+
+ENGINE_V2 = os.path.join(PKG_DIR, "inference", "engine_v2.py")
+
+
+def test_engine_v2_fixture_copy_is_clean(tmp_path):
+    src = open(ENGINE_V2).read()
+    p = tmp_path / "engine_v2_copy.py"
+    p.write_text(src)
+    rep = fold([analyze_file(str(p))])
+    assert rule_ids(rep) == []
+
+
+@pytest.mark.parametrize("site", range(3))
+def test_deleting_donate_routing_fails_the_gate(tmp_path, site):
+    """Acceptance criterion: replace the ``_donate_cache()`` routing at any
+    one engine_v2 jit site with a raw tuple (in a fixture copy, never the
+    tree) and the self-clean gate must fail with SXT002."""
+    src = open(ENGINE_V2).read()
+    needle = "donate_argnums=_donate_cache()"
+    n = src.count(needle)
+    assert n >= 3, f"expected >=3 routed jit sites in engine_v2.py, found {n}"
+    # replace exactly the `site`-th occurrence
+    parts = src.split(needle)
+    mutated = (needle.join(parts[:site + 1]) + "donate_argnums=(1,)"
+               + needle.join(parts[site + 1:]))
+    assert mutated.count(needle) == n - 1
+    p = tmp_path / "engine_v2_mutated.py"
+    p.write_text(mutated)
+    rep = fold([analyze_file(str(p))])
+    assert rule_ids(rep) == ["SXT002"]
+    assert rep.exit_code == 1
+
+
+def test_deleting_cache_safe_derivation_fails_the_gate(tmp_path):
+    """Same drill at the derivation itself: _donate_cache returning a raw
+    tuple makes it a non-deriving function, so every jit site using it
+    fires."""
+    src = open(ENGINE_V2).read()
+    needle = "return cache_safe_donate_argnums((1,))"
+    assert needle in src
+    mutated = src.replace(needle, "return (1,)")
+    p = tmp_path / "engine_v2_broken_derivation.py"
+    p.write_text(mutated)
+    rep = fold([analyze_file(str(p))])
+    assert rule_ids(rep) == ["SXT002"]
+    assert len(rep.violations) >= 3
+
+
+# ---------------------------------------------------------------------------
+# CLI + report contract
+# ---------------------------------------------------------------------------
+
+def test_cli_json_report_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nf = jax.jit(lambda s: s, donate_argnums=(0,))\n")
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "shuffle_exchange_tpu.analysis", str(bad),
+         "--json", str(out)],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == 1
+    assert "SXT002" in proc.stdout
+    data = json.loads(out.read_text())
+    assert data["tool"] == "sxt-check"
+    assert data["counts"] == {"SXT002": 1}
+    assert data["violations"][0]["rule"] == "SXT002"
+    assert data["violations"][0]["line"] == 2
+    assert "SXT002" in data["rules"]
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "shuffle_exchange_tpu.analysis", str(clean)],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == 0
+
+
+def test_cli_select_subset(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "f = jax.jit(lambda s: s, donate_argnums=(0,))\n"
+        "from jax.experimental.shard_map import shard_map\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "shuffle_exchange_tpu.analysis", str(bad),
+         "--select", "SXT001"],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == 1
+    assert "SXT001" in proc.stdout and "SXT002" not in proc.stdout
+
+
+def test_runtime_markers_are_noops():
+    """The decorators must never change runtime behavior — they attach
+    metadata and hand the object back."""
+    from shuffle_exchange_tpu.utils.invariants import (atomic_on_reject,
+                                                       locked_by,
+                                                       requires_lock)
+
+    @atomic_on_reject
+    def f():
+        return 42
+
+    @atomic_on_reject(check="begin_import")
+    def g():
+        return 43
+
+    assert f() == 42 and g() == 43
+    assert f.__sxt_atomic_on_reject__ == ("_admission_detail", "can_schedule")
+    assert g.__sxt_atomic_on_reject__ == "begin_import"
+
+    @locked_by("_mu", "a", "b")
+    class C:
+        @requires_lock("_mu")
+        def h(self):
+            return 44
+
+    assert C().h() == 44
+    assert C.__sxt_locked_by__ == {"_mu": ("a", "b")}
+    assert C.h.__sxt_requires_lock__ == ("_mu",)
+
+
+def test_annotations_present_on_real_seams():
+    """The real admission/lock seams carry the markers the analyzer
+    checks — deleting one would silently shrink coverage."""
+    from shuffle_exchange_tpu.inference.engine_v2 import InferenceEngineV2
+    from shuffle_exchange_tpu.inference.scheduler import \
+        ContinuousBatchingScheduler
+    from shuffle_exchange_tpu.monitor.monitor import FleetMonitor
+    from shuffle_exchange_tpu.serving.disagg import KVTransferChannel
+    from shuffle_exchange_tpu.serving.router import ReplicaRouter
+
+    for meth in (InferenceEngineV2.put, InferenceEngineV2.step,
+                 InferenceEngineV2.decode_loop, InferenceEngineV2.begin_import,
+                 ContinuousBatchingScheduler.submit,
+                 ContinuousBatchingScheduler.inject,
+                 KVTransferChannel.transfer):
+        assert hasattr(meth, "__sxt_atomic_on_reject__"), meth
+    assert "_lock" in ReplicaRouter.__sxt_locked_by__
+    assert "_mu" in KVTransferChannel.__sxt_locked_by__
+    assert "_mu" in FleetMonitor.__sxt_locked_by__
