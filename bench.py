@@ -660,6 +660,107 @@ def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
     }
 
 
+def rlhf_rollout_row(model_cfg, *, n_rollouts=8, shared_len=64,
+                     suffix_lo=8, suffix_hi=32, max_new=32, flips=3,
+                     kv_block=64, seed=0, toy=False):
+    """Config-5 RLHF-rollout row (ISSUE 11): the hybrid engine's two
+    headline numbers — rollout goodput through the serving fleet (shared-
+    prompt batches, so the prefix cache absorbs the common system-prompt
+    span) and the train->serve FLIP latency (jitted ZeRO gather + two-
+    phase fleet publish), measured across ``flips`` train->publish->
+    generate cycles on a warmed fleet with the zero-recompile and replay
+    contracts asserted. Reused at toy size by tests/test_bench_smoke.py
+    so the published row cannot rot on CPU; the on-chip figures are
+    pending the next TPU window (BASELINE.md)."""
+    import dataclasses as _dc
+
+    import jax as _jax
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer
+    from shuffle_exchange_tpu.rlhf import HybridEngineV2, RLHFLoop, pg_loss_fn
+
+    cfg = _dc.replace(model_cfg, remat=False)
+    model = Transformer(cfg)
+    vocab = cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    S = cfg.max_seq_len
+    bs = min(kv_block, S)
+    while bs > 1 and S % bs:
+        bs //= 2
+    n_dev = len(_jax.devices())
+    tbs = max(n_rollouts, n_dev)
+    engine, *_ = sxt.initialize(model=model, loss_fn=pg_loss_fn(model),
+                                config={
+        "train_batch_size": tbs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": not toy},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9,
+    })
+    hy = HybridEngineV2(engine, model, inference_config={
+        "dtype": "float32" if toy else "bfloat16",
+        "max_seq_len": S, "kv_block_size": bs,
+        "num_kv_blocks": 8 * max(1, S // bs) + 8,
+        "prefix_caching": True,
+        "serving": {"token_budget": max(64, 2 * shared_len),
+                    "max_running": 8,
+                    "chunk_min": min(16, bs)},
+    })
+    shared = rng.integers(1, vocab, size=shared_len).tolist()
+    prompts = [shared + rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(suffix_lo, suffix_hi + 1, size=tbs)]
+    loop = RLHFLoop(hy, reward_fn=lambda p, t: float(len(set(t))),
+                    seq_len=min(S, shared_len + suffix_hi + max_new))
+    # warm: build the fleet, compile the ladder + the train step
+    loop.pg_step(loop.rollout(prompts, max_new_tokens=max_new))
+    progs0 = [r.engine.program_shapes for r in hy.router.replicas]
+    flip_s, gen_s, gen_tokens = [], [], 0
+    for _ in range(flips):
+        t0 = time.perf_counter()
+        hy.eval()
+        hy.publish_weights()
+        flip_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        records = hy.rollout(prompts, max_new_tokens=max_new)
+        gen_s.append(time.perf_counter() - t0)
+        gen_tokens += sum(len(r.tokens) for r in records)
+        loop.pg_step(records)
+    # the zero-recompile flag covers exactly the flip loop — snapshot
+    # before the replay drill below adds its own (legitimate, cold)
+    # single-request shapes
+    no_recompiles = ([r.engine.program_shapes
+                      for r in hy.router.replicas] == progs0)
+    st = hy.fleet_stats()
+    sched_stats = hy.router.replicas[0].scheduler.stats()
+    verified, _ = hy.replay_log.verify(
+        hy, hy.replay_log.at_version(hy.weight_version)[:2])
+    return {
+        "n_rollouts": tbs,
+        "shared_prefix_tokens": shared_len,
+        "suffix_tokens": [suffix_lo, suffix_hi],
+        "max_new_tokens": max_new,
+        "flips": flips,
+        "flip_s_median": round(float(np.median(flip_s)), 4),
+        "gather_s_total": round(hy.gather_latency_s, 4),
+        "rollout_tokens_per_sec": round(
+            gen_tokens / max(1e-9, sum(gen_s)), 1),
+        "prefix_cache_hit_rate": (
+            round(sched_stats["prefix_cache"]["hit_rate"], 3)
+            if sched_stats["prefix_cache"]["hit_rate"] is not None else None),
+        "weight_version": hy.weight_version,
+        "train_steps": engine.global_steps,
+        "publishes": hy.publisher.publishes,
+        "replays_bit_exact": verified,
+        "zero_recompile_across_flips": no_recompiles,
+        "kv_pools_intact": all(
+            r.engine.free_blocks == r.engine.allocator.num_blocks - 1
+            for r in hy.router.replicas),
+        "weight_versions_converged": (
+            len(set(st["weight_versions"].values())) == 1),
+    }
+
+
 def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
     """Config #5: engine_v2 paged prefill + decode tokens/s.
 
@@ -902,6 +1003,18 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         spec_row = None
 
+    # ---- RLHF rollout: the hybrid engine's flip latency + rollout
+    # goodput (ISSUE 11) — train -> publish -> generate cycles on a warmed
+    # fleet, shared-prompt rollout batches (the prefix cache's regime),
+    # with the zero-recompile / replay / version-convergence contracts
+    # reported alongside the timings
+    try:
+        rlhf_row = rlhf_rollout_row(model_cfg)
+    except Exception as e:
+        print(f"SXT_WARN rlhf rollout bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        rlhf_row = None
+
     # decode FLOPs ≈ 2*N per token (fwd only) -> model-bandwidth utilization
     best_tps = max([decode_tps, fused_tps]
                    + [r["tokens_per_sec"] for r in engine_rows])
@@ -942,6 +1055,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_prefix_cache": prefix_row,
         "serving_fleet": fleet_row,
         "serving_speculative": spec_row,
+        "rlhf_rollout": rlhf_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
                                 if eng_best else None),
         "decode_hbm_util": (eng_best or {}).get("hbm_util"),

@@ -46,6 +46,11 @@ python -m pytest tests/test_serving_router.py tests/test_disagg.py -q "$@"
 # prefix-cache commit chain, and the prefix x speculative x kv-dtype
 # compose matrix.
 python -m pytest tests/test_speculative.py -q "$@"
+# RLHF / HybridEngine v2 gates (ISSUE 11): train->serve flip parity with
+# a fresh engine on the gathered weights, zero recompiles across flips on
+# a warmed fleet, bit-exact rollout replay at the recorded weight
+# version, crash-mid-publish fleet atomicity, and the v1 shim contract.
+python -m pytest tests/test_rlhf.py tests/test_hybrid_engine.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \
@@ -59,4 +64,6 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_serving_router.py \
     --ignore=tests/test_disagg.py \
-    --ignore=tests/test_speculative.py "$@"
+    --ignore=tests/test_speculative.py \
+    --ignore=tests/test_rlhf.py \
+    --ignore=tests/test_hybrid_engine.py "$@"

@@ -198,8 +198,9 @@ def initialize(
         engine.module = model
 
     # RLHF hybrid engine (reference runtime/hybrid_engine.py:30, selected by
-    # the hybrid_engine config section): wrap so generate() runs the fused
-    # inference loop on current consensus weights.
+    # the hybrid_engine config section): wrap so generate() runs rollouts
+    # through the paged serving fleet on the current consensus weights
+    # (the v1 class is a shim over rlhf.HybridEngineV2 since ISSUE 11).
     if dict(cfg.hybrid_engine or {}).get("enabled", False):
         from .runtime.hybrid_engine import HybridEngine
 

@@ -209,12 +209,13 @@ class InferenceEngine:
             warning_once("fused decode: partially fused layer body "
                          f"({'; '.join(reasons)})")
 
-    def update_params(self, params) -> None:
-        """Swap in new weights (same tree/shapes) without dropping compiled
-        programs — the hybrid-engine path (reference hybrid_engine.py swaps
-        inference containers in during ``generate()``; here the jitted
-        generate/prefill/decode programs are weight-agnostic, so refreshing
-        the pytree is the whole swap)."""
+    def _prepare_params(self, params):
+        """Cast to the serving dtype, quantize when configured, and place —
+        everything ``update_params`` does short of the commit. Split out so
+        the RLHF weight-publication path (``rlhf/publish.py``) can STAGE a
+        prepared tree per replica and flip every replica's pointer only
+        after all of them prepared successfully (two-phase publish: the
+        prepare is the phase that can fail, the commit is a pointer swap)."""
         import jax
         import jax.numpy as jnp
 
@@ -228,7 +229,15 @@ class InferenceEngine:
             params, is_leaf=lambda p: isinstance(p, QuantizedMatrix))
         if self.config.quantize_weights:
             params = self._quantize(params)
-        self.params = self._place(params)
+        return self._place(params)
+
+    def update_params(self, params) -> None:
+        """Swap in new weights (same tree/shapes) without dropping compiled
+        programs — the hybrid-engine path (reference hybrid_engine.py swaps
+        inference containers in during ``generate()``; here the jitted
+        generate/prefill/decode programs are weight-agnostic, so refreshing
+        the pytree is the whole swap)."""
+        self.params = self._prepare_params(params)
 
     # -- checkpoint-backed serving (resilience layer) -------------------
 
@@ -242,18 +251,28 @@ class InferenceEngine:
         inherits)."""
         return cls(model, load_serving_weights(ckpt_dir, model, tag=tag), config)
 
-    def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None) -> bool:
-        """Hot-swap serving weights from the newest complete checkpoint in
-        ``ckpt_dir`` (a serving fleet following a live trainer). Degrades
-        gracefully: when no tag is loadable — mid-save, torn ``latest``,
-        corrupted shards — the engine KEEPS SERVING its current weights and
-        returns False instead of raising."""
+    def _try_load_serving_weights(self, ckpt_dir: str,
+                                  tag: Optional[str] = None):
+        """``load_serving_weights`` with the reload-path degrade policy:
+        when no tag is loadable — mid-save, torn ``latest``, corrupted
+        shards — log and return None so the caller KEEPS SERVING its
+        current weights (shared by both reload_weights overloads; the
+        exception set and message live in exactly one place)."""
         try:
-            params = load_serving_weights(ckpt_dir, self.model, tag=tag)
+            return load_serving_weights(ckpt_dir, self.model, tag=tag)
         except (ValueError, OSError) as e:
             logger.warning(f"reload_weights: no loadable checkpoint in "
                            f"{ckpt_dir} ({type(e).__name__}: {e}); continuing "
                            "to serve the current weights")
+            return None
+
+    def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None) -> bool:
+        """Hot-swap serving weights from the newest complete checkpoint in
+        ``ckpt_dir`` (a serving fleet following a live trainer). Degrades
+        gracefully (see ``_try_load_serving_weights``): an unloadable
+        directory returns False and keeps serving."""
+        params = self._try_load_serving_weights(ckpt_dir, tag=tag)
+        if params is None:
             return False
         self.update_params(params)
         return True

@@ -160,6 +160,15 @@ class InferenceEngineV2(InferenceEngine):
         # table width of the most recent decode dispatch (bench.py uses it
         # to count the KV bytes the kernels actually stream)
         self._last_decode_table_width = self._max_blocks
+        # versioned serving weights (ISSUE 11): the RLHF train->serve flip
+        # stamps every publication so rollout replay logs can name the
+        # exact weights a token was sampled under. ``_staged_weights``
+        # holds a prepared-but-uncommitted tree (the two-phase fleet
+        # publish), ``_pending_weights`` a committed-but-deferred one
+        # (applied at the next tick boundary — see apply_pending_weights).
+        self.weight_version = 0
+        self._staged_weights: Optional[Tuple[object, Optional[int]]] = None
+        self._pending_weights: Optional[Tuple[object, Optional[int]]] = None
 
     # -- scheduling queries (engine_v2.py:158-232) ---------------------
 
@@ -1548,33 +1557,151 @@ class InferenceEngineV2(InferenceEngine):
         self._seqs[resv.uid] = desc
         self._commit(desc)
 
+    # -- versioned weight swap (ISSUE 11: the RLHF train->serve flip) ---
+    # The serving programs are weight-agnostic jitted functions, so a
+    # weight swap is a pytree pointer flip: paged KV pools, block
+    # allocator, and every compiled program survive it untouched (zero
+    # recompiles across flips — tests/test_rlhf.py pins it). What a swap
+    # MUST do is invalidate the prefix-cache content registry (keys hash
+    # token history, not weights) and bar live mixed-weight sequences
+    # from committing their blocks. Delivery is two-phase so a fleet
+    # publish can crash between replicas and leave every one of them
+    # serving the OLD weights (serving/router.py publish_weights).
+
+    @atomic_on_reject(check="validate")
+    def stage_weights(self, params, version: Optional[int] = None,
+                      prepared: bool = False) -> None:
+        """Phase 1 of the train->serve flip: cast/quantize/place the new
+        tree into the staging slot without touching serving state. The
+        prepare is the half that can fail (casts, device transfer,
+        quantization); after it returns, ``commit_staged_weights`` is a
+        host pointer swap. Validates the prepared tree's structure against
+        the live one BEFORE staging, so a later commit cannot discover a
+        mismatch mid-flip. ``prepared=True`` takes ``params`` as already
+        run through ``_prepare_params`` — the router prepares ONCE per
+        serving-transform key and hands the same placed tree to every
+        replica (sharing the device buffers; the serving programs never
+        donate the params operand)."""
+        import jax
+
+        placed = params if prepared else self._prepare_params(params)
+        new_td = jax.tree_util.tree_structure(placed)
+        old_td = jax.tree_util.tree_structure(self.params)
+        if new_td != old_td:
+            raise ValueError(
+                "stage_weights: published tree structure does not match the "
+                f"serving tree ({new_td} vs {old_td}) — publish the "
+                "model-structured weights (engine.module_weights())")
+        self._staged_weights = (placed,
+                                None if version is None else int(version))
+
+    def discard_staged_weights(self) -> None:
+        """Drop an uncommitted staging slot (fleet-publish rollback path).
+        Safe to call when nothing is staged."""
+        self._staged_weights = None
+
+    def commit_staged_weights(self, force: bool = False,
+                              defer: bool = False) -> bool:
+        """Phase 2 of the flip: move serving onto the staged tree.
+
+        Live sequences hold KV computed under the OLD weights, so a commit
+        under them would silently mix weights into their continuations.
+        The guard ladder:
+
+        - no live sequences: install immediately (the staged slot empties);
+        - live + ``defer=True``: the staged tree becomes PENDING and is
+          installed at the next tick boundary (``apply_pending_weights``,
+          which the scheduler calls at tick entry after the in-flight tick
+          has fully drained) — the router's delivery mode, safe to call
+          while another thread is mid-tick;
+        - live + ``force=True``: install NOW (the PR 2 hard-swap for
+          callers that accept mid-episode approximation);
+        - live + neither: refuse, keep the staged tree for a retry, and
+          return False."""
+        if self._staged_weights is None:
+            raise RuntimeError("commit_staged_weights: nothing staged "
+                               "(stage_weights first)")
+        if self._seqs and not (force or defer):
+            logger.warning(
+                f"commit_staged_weights: {len(self._seqs)} live sequences "
+                "hold KV from the current weights; refusing the swap (drain "
+                "or flush() them, or pass force=True / defer=True)")
+            return False
+        if self._seqs and defer and not force:
+            self._pending_weights = self._staged_weights
+            self._staged_weights = None
+            return True
+        staged, self._staged_weights = self._staged_weights, None
+        self._install_weights(*staged)
+        return True
+
+    def _install_weights(self, placed, version: Optional[int]) -> None:
+        """The actual swap: flip the params pointer, stamp the version,
+        and invalidate everything that silently assumed weight identity —
+        the content index points at KV computed under the OLD weights
+        (keys are pure functions of token history, so a post-swap
+        admission hashing the same system prompt would reuse stale KV),
+        and live sequences carry mixed-weight KV that must never enter
+        the registry."""
+        self.params = placed
+        self.weight_version = (self.weight_version + 1 if version is None
+                               else int(version))
+        self.allocator.invalidate_registry()
+        for d in self._seqs.values():
+            d.no_commit = True
+
+    @property
+    def has_pending_weights(self) -> bool:
+        return self._pending_weights is not None
+
+    def apply_pending_weights(self) -> bool:
+        """Install a deferred weight commit — the tick-boundary half of
+        ``commit_staged_weights(defer=True)``. The scheduler calls this at
+        tick entry (the previous tick's dispatch has fully drained, the
+        next has not started), which is the only point a swap can land
+        without interleaving a half-executed tick; direct ``step()``
+        drivers own their tick boundary and call it themselves. Returns
+        True when a swap was applied."""
+        if self._pending_weights is None:
+            return False
+        pending, self._pending_weights = self._pending_weights, None
+        self._install_weights(*pending)
+        return True
+
+    def publish_weights(self, params, version: Optional[int] = None,
+                        force: bool = False, defer: bool = False) -> bool:
+        """In-memory weight delivery (the RLHF train->serve flip): stage +
+        commit in one call. ``rlhf.WeightPublisher`` hands the gathered
+        training tree here; the fleet path goes through
+        ``serving/router.py publish_weights`` instead so the stage phase
+        completes on EVERY replica before any replica flips."""
+        self.stage_weights(params, version=version)
+        return self.commit_staged_weights(force=force, defer=defer)
+
     def reload_weights(self, ckpt_dir: str, tag: Optional[str] = None,
-                       force: bool = False) -> bool:
+                       force: bool = False, defer: bool = False) -> bool:
         """Hot-swap serving weights from a training checkpoint (see the base
         engine), with a continuous-batching guard: live sequences hold KV
         entries computed under the OLD weights, so swapping under them would
         silently corrupt their continuations. With live sequences the swap
-        is refused (returns False, keeps serving) unless ``force=True`` —
-        callers that accept the approximation (e.g. RLHF rollouts mid-
-        episode) can opt in; everyone else flushes or drains first."""
-        if self._seqs and not force:
+        is refused (returns False, keeps serving) unless the caller opts
+        in: ``defer=True`` applies the swap at the next tick boundary (the
+        scheduler drains the in-flight tick first — the footgun-free mode
+        the router uses), ``force=True`` hard-swaps immediately (RLHF
+        rollouts mid-episode that accept the approximation). Load failures
+        — mid-save, torn ``latest``, corrupted shards — keep serving the
+        current weights and return False either way."""
+        if self._seqs and not (force or defer):
             logger.warning(
                 f"reload_weights: {len(self._seqs)} live sequences hold KV "
                 "from the current weights; refusing the hot-swap (drain or "
-                "flush() them, or pass force=True)")
+                "flush() them, or pass force=True / defer=True)")
             return False
-        ok = super().reload_weights(ckpt_dir, tag=tag)
-        if ok:
-            # the content index points at KV computed under the OLD
-            # weights; keys are pure functions of token history, so a
-            # post-swap admission hashing the same system prompt would
-            # silently reuse stale KV — drop every registration and
-            # parked block, and bar force-swapped live sequences (mixed-
-            # weight KV) from ever committing their blocks
-            self.allocator.invalidate_registry()
-            for d in self._seqs.values():
-                d.no_commit = True
-        return ok
+        params = self._try_load_serving_weights(ckpt_dir, tag=tag)
+        if params is None:
+            return False
+        self.stage_weights(params)
+        return self.commit_staged_weights(force=force, defer=defer)
 
     def flush(self, uids: Sequence[int]) -> None:
         """Free all state for finished sequences (engine_v2.py:242)."""

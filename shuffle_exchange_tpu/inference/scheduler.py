@@ -271,6 +271,19 @@ class ContinuousBatchingScheduler:
         eng, cfg = self.engine, self.cfg
         bs = eng.cache.block_size
 
+        # 0) tick boundary (ISSUE 11): a deferred weight commit
+        # (reload_weights/publish_weights with defer=True) lands HERE —
+        # the previous tick's dispatch has fully drained and the next has
+        # not packed yet, so the swap can never interleave a half-executed
+        # tick. KV pools, allocator, and compiled programs all survive;
+        # live sequences continue (mixed-weight, no_commit) exactly as a
+        # force swap would leave them, but at a defined boundary.
+        if eng.has_pending_weights and eng.apply_pending_weights():
+            logger.info(
+                f"serving: replica {self.replica_id} applied deferred "
+                f"weight swap at tick boundary (now version "
+                f"{eng.weight_version})")
+
         # 1) decode set: every running sequence takes one budget slot — or
         # 1+k slots when its drafter proposes k tokens this tick (ISSUE 8:
         # the pending token plus the drafts are one verify row through the
@@ -459,6 +472,11 @@ class ContinuousBatchingScheduler:
             ("prefix_cache/cow_copies", eng.cow_copies, self.ticks),
             ("prefix_cache/shared_blocks", eng.allocator.shared_blocks,
              self.ticks),
+            # weight-version watermark (ISSUE 11): every tick records the
+            # serving weight version its tokens were sampled under, so a
+            # post-mortem can line the event stream up against the RLHF
+            # replay log's per-rollout versions
+            ("weights/version", eng.weight_version, self.ticks),
         ]
         if self.spec.enabled:
             # speculative group (cumulative; ISSUE 8): proposed/accepted/
@@ -648,6 +666,7 @@ class ContinuousBatchingScheduler:
             "ticks": self.ticks,
             "preemptions": self.preemptions,
             "compiled_programs": len(self.engine.program_shapes),
+            "weight_version": eng.weight_version,
             "prefix_cache": {
                 "hit_tokens": hit,
                 "miss_tokens": miss,

@@ -273,6 +273,19 @@ class FleetMonitor(Monitor):
                 vals = [v for lbl, v, _ in events if lbl == label]
                 if vals:
                     out[key][r] = vals[-1]
+        # serving weight versions (ISSUE 11): each scheduler stamps every
+        # tick with its engine's weight_version, so the fleet aggregate
+        # shows which weights each replica is ANSWERING from — after an
+        # RLHF publish the map converges to the published version as
+        # deferred commits land at tick boundaries
+        wv = {}
+        for r in sorted(self._replica_ids):
+            vals = [v for lbl, v, _ in events
+                    if lbl == f"replica{r}/weights/version"]
+            if vals:
+                wv[r] = vals[-1]
+        if wv:
+            out["weight_version"] = wv
         # speculative group (ISSUE 8): the scheduler counters are
         # CUMULATIVE per replica, so the fleet figure is the sum of each
         # replica's latest value, and acceptance is re-derived from the
@@ -304,6 +317,8 @@ class FleetMonitor(Monitor):
                   if isinstance(v, (int, float)) and v is not None]
         events += [(f"fleet/replica{r}/queue_depth", v, self._step)
                    for r, v in agg["queue_depth"].items()]
+        events += [(f"fleet/replica{r}/weight_version", v, self._step)
+                   for r, v in (agg.get("weight_version") or {}).items()]
         events += [(f"fleet/speculative/{k}", v, self._step)
                    for k, v in (agg.get("speculative") or {}).items()
                    if isinstance(v, (int, float))]

@@ -1,0 +1,41 @@
+"""RLHF subsystem (ISSUE 11): one process flipping between the ZeRO
+training engine and the paged serving fleet, sharing one weight-layout
+contract.
+
+Reference surface: ``DeepSpeedHybridEngine`` (SURVEY §2.3). The pieces:
+
+- ``publish.py`` — the train->serve weight flip: ``WeightPublisher``
+  (jitted ZeRO-3 gather + LoRA fuse + host-offload join, versioned,
+  metered) delivering through ``InferenceEngineV2.publish_weights`` or
+  the router's two-phase fleet publish, and ``WeightWire`` for
+  cross-process delivery over the disagg pinned-staging substrate.
+- ``hybrid.py`` — ``HybridEngineV2``: owns one training ``Engine`` and
+  one ``ReplicaRouter`` fleet; eval/train mode flips with LoRA
+  fuse/unfuse parity, scheduler-driven rollouts (prefix cache +
+  speculative drafters live), flip/* meters through the monitor.
+- ``loop.py`` — the generate->score->train driver: ``RolloutRecord`` /
+  ``ReplayLog`` (token-identical replay at the recorded weight version),
+  ``pg_loss_fn`` / ``dpo_loss_fn`` over the existing jitted train step,
+  and ``RLHFLoop`` tying them together.
+
+``runtime/hybrid_engine.py``'s v1 ``HybridEngine`` is a deprecation shim
+over ``HybridEngineV2``.
+"""
+
+from .hybrid import HybridEngineV2
+from .loop import (ReplayLog, RLHFLoop, RolloutRecord, dpo_loss_fn,
+                   pg_loss_fn, sequence_logprob)
+from .publish import WeightPublisher, WeightWire, publish_over_wire
+
+__all__ = [
+    "HybridEngineV2",
+    "ReplayLog",
+    "RLHFLoop",
+    "RolloutRecord",
+    "dpo_loss_fn",
+    "pg_loss_fn",
+    "sequence_logprob",
+    "WeightPublisher",
+    "WeightWire",
+    "publish_over_wire",
+]

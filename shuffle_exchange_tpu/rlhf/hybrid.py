@@ -1,0 +1,451 @@
+"""HybridEngine v2: one process flipping between training and serving.
+
+Reference: ``DeepSpeedHybridEngine`` (SURVEY §2.3, ``runtime/
+hybrid_engine.py:30``, 577 LoC) — train+generate in one engine, inference
+containers swapped in during ``generate()``, ZeRO-3 params gathered and
+LoRA fused/unfused around the rollout, per-phase latencies metered.
+
+v2 collapse: the training half is the full ZeRO :class:`runtime.engine.
+Engine` (host-offload tier included) and the serving half is the PAGED
+fleet — a :class:`serving.router.ReplicaRouter` of ``InferenceEngineV2`` +
+``ContinuousBatchingScheduler`` replicas — so every serving-perf lever the
+repo built (continuous batching, prefix-cached quantized paged KV,
+speculative drafters, placement/drain) is live for rollout generation.
+Shared-prompt rollout batches are the prefix cache's best case, and
+speculative drafters amortize the decode steps the reference pays one by
+one. The flip itself is ``WeightPublisher``: one jitted gather (ZeRO-3
+allgather + LoRA fuse + host-offload join) and a two-phase fleet publish
+that never tears down KV pools or compiled programs — a warmed fleet
+stays zero-recompile across any number of flips.
+
+Every rollout is recorded ``(prompt, sampled tokens, weight_version)`` in
+a :class:`rlhf.loop.ReplayLog`; greedy scheduling makes the replay
+bit-exact at the recorded version (the drain-replay discipline applied to
+RLHF debugging).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..monitor.monitor import InMemoryMonitor, Monitor
+from ..utils.logging import log_dist
+from .loop import ReplayLog, RolloutRecord
+from .publish import WeightPublisher
+
+
+def _serving_dtype(engine) -> str:
+    if engine.bfloat16_enabled:
+        return "bfloat16"
+    if engine.fp16_enabled:
+        return "float16"
+    return "float32"
+
+
+def _auto_block_size(max_seq_len: int) -> int:
+    """Largest power-of-two KV block <= 64 dividing max_seq_len (tiny test
+    models have short sequences; production configs override)."""
+    bs = 64
+    while bs > 1 and max_seq_len % bs:
+        bs //= 2
+    return bs
+
+
+class HybridEngineV2:
+    """Owns one training :class:`Engine` and one serving fleet; flips
+    between them sharing a single weight-layout contract.
+
+    ``engine``: the training engine (from ``sxt.initialize``). ``model``:
+    the model-zoo Transformer both halves run. ``inference_config``:
+    overrides for the fleet's :class:`InferenceConfig` (merged over the
+    ``hybrid_engine.inference_config`` config section). ``n_replicas``:
+    fleet width (default: ``hybrid_engine.num_replicas`` or 1).
+
+    The fleet is built lazily at the first generate (the reference swaps
+    containers in lazily too) from a fresh gather; later flips go through
+    ``publish_weights`` — stage on every replica, then commit, zero
+    recompiles, KV pools intact. ``release_inference_cache`` (reference
+    flag) drops the whole fleet on ``train()`` so HBM returns to training
+    between rollout phases."""
+
+    def __init__(self, engine, model, inference_config: Optional[dict] = None,
+                 n_replicas: Optional[int] = None,
+                 monitor: Optional[Monitor] = None,
+                 drafter_factory=None,
+                 replay_log: Optional[ReplayLog] = None,
+                 clock=time.perf_counter):
+        if not hasattr(model, "head"):
+            raise TypeError("HybridEngineV2 needs a model-zoo Transformer "
+                            "(rollouts drive its serving path)")
+        self.engine = engine
+        self.model = model
+        self.clock = clock
+        hcfg: Dict[str, Any] = dict(engine.config.hybrid_engine or {})
+        self._hcfg = hcfg
+        self._release_cache = bool(hcfg.get("release_inference_cache", False))
+        self._icfg_overrides = dict(hcfg.get("inference_config", {}) or {})
+        self._icfg_overrides.update(inference_config or {})
+        self.n_replicas = int(n_replicas if n_replicas is not None
+                              else hcfg.get("num_replicas", 1))
+        if self.n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {self.n_replicas}")
+        self.drafter_factory = drafter_factory
+        self.memory_monitor = InMemoryMonitor(maxlen=2048)
+        self._sinks: List[Monitor] = [monitor] if monitor is not None else []
+        self.publisher = WeightPublisher(engine, monitor=self._tap(),
+                                         clock=clock)
+        self.replay_log = replay_log if replay_log is not None else ReplayLog()
+        self._training = True
+        self._lora_fused = False
+        self._router = None
+        self._icfg_cache = None
+        self._published_at = None      # (global_steps, micro_steps) watermark
+        self._version: Optional[int] = None
+        # meters (reference _generate_latency/_training_latency parity,
+        # same keys as the v1 wrapper's latency_report)
+        self.generate_calls = 0
+        self.generate_tokens = 0
+        self.generate_latency_s = 0.0
+        self.training_latency_s = 0.0
+        self.training_iters = 0
+        self.flips_to_serve = 0
+        self.flips_to_train = 0
+        self.lora_fuses = 0
+        self.lora_unfuses = 0
+
+    # -- plumbing ------------------------------------------------------
+
+    def _tap(self) -> Monitor:
+        hybrid = self
+
+        class _Tap(InMemoryMonitor):
+            def write_events(self, event_list):
+                hybrid._emit(event_list)
+
+        return _Tap(maxlen=1)
+
+    def _emit(self, events) -> None:
+        self.memory_monitor.write_events(events)
+        for s in self._sinks:
+            s.write_events(events)
+
+    def __getattr__(self, name):
+        # full training-engine API delegation (train_batch/forward are
+        # wrapped below; everything else — checkpointing, lr, zero —
+        # passes through). The "engine" guard keeps a half-constructed
+        # instance from recursing.
+        if name == "engine":
+            raise AttributeError(name)
+        return getattr(self.engine, name)
+
+    @property
+    def gather_latency_s(self) -> float:
+        return self.publisher.gather_latency_s
+
+    @property
+    def weight_version(self) -> Optional[int]:
+        """The fleet's published weight version (None before the first
+        fleet build)."""
+        return self._version
+
+    # -- serving fleet -------------------------------------------------
+
+    def _inference_config(self):
+        if self._icfg_cache is not None:
+            return self._icfg_cache
+        from ..inference.config import InferenceConfig
+
+        mcfg = self.model.config
+        S = int(self._icfg_overrides.get("max_seq_len", mcfg.max_seq_len))
+        bs = int(self._icfg_overrides.get("kv_block_size",
+                                          _auto_block_size(S)))
+        max_running = int((self._icfg_overrides.get("serving") or {})
+                          .get("max_running", 8))
+        kw: Dict[str, Any] = {
+            "dtype": _serving_dtype(self.engine),
+            "max_seq_len": S,
+            "max_new_tokens": int(self._hcfg.get("max_out_tokens", 256)),
+            "tensor_parallel": int(self._hcfg.get("inference_tp_size", 1)),
+            "kv_block_size": bs,
+            # default pool: every running sequence at full length, plus
+            # scratch + headroom
+            "num_kv_blocks": max_running * max(1, S // bs) + 8,
+        }
+        kw.update(self._icfg_overrides)
+        self._icfg_cache = InferenceConfig.from_dict(kw)
+        return self._icfg_cache
+
+    @property
+    def router(self):
+        """The serving fleet, built lazily from a fresh gather. Replicas
+        share the published weights but own their KV pools, schedulers,
+        and drafters (the PR 7 fleet contract)."""
+        if self._router is None:
+            from ..inference.engine_v2 import InferenceEngineV2
+            from ..serving.router import ReplicaRouter
+
+            icfg = self._inference_config()
+            weights = self.publisher.gather()
+            version = int(self.engine.global_steps)
+            engines = []
+            for _ in range(self.n_replicas):
+                eng = InferenceEngineV2(self.model, weights, icfg)
+                eng.weight_version = version
+                engines.append(eng)
+            self._router = ReplicaRouter(engines,
+                                         drafter_factory=self.drafter_factory)
+            self._published_at = (self.engine.global_steps,
+                                  self.engine.micro_steps)
+            self._version = version
+            self.publisher.last_version = version
+            self._emit([("flip/fleet_builds", 1, self.flips_to_serve),
+                        ("flip/weight_version", version,
+                         self.flips_to_serve)])
+        return self._router
+
+    def publish_weights(self, force: bool = False) -> int:
+        """Flip train->serve: gather the CURRENT training weights (ZeRO-3
+        allgather, LoRA fuse, host-offload join — one jitted program) and
+        deliver them to every replica, two-phase, without tearing down
+        paged KV or compiled programs. No-op when no optimizer step ran
+        since the last publish (the v1 freshness contract). Returns the
+        fleet's weight version."""
+        fresh_at = (self.engine.global_steps, self.engine.micro_steps)
+        if self._router is None:
+            _ = self.router            # first build IS the publish
+            return self._version
+        if self._published_at == fresh_at and not force:
+            return self._version
+        t0 = self.clock()
+        version = self.publisher.publish(self._router)
+        self._published_at = fresh_at
+        self._version = version
+        self._emit([("flip/publish_s", self.clock() - t0,
+                     self.flips_to_serve),
+                    ("flip/weight_version", version, self.flips_to_serve)])
+        return version
+
+    # -- mode flips (reference module.eval()/train() container swap) ----
+
+    def eval(self):
+        """Enter generation mode. LoRA is fused for the serving side
+        (reference ``fuse_lora``-before-generate; see :meth:`fuse_lora`
+        for why the fuse costs nothing extra here). The weight publish
+        itself stays lazy — it happens at the next generate, so a
+        train->eval->train bounce without rollouts never pays a gather."""
+        if self._training:
+            self.fuse_lora()
+            self._training = False
+            self.flips_to_serve += 1
+            self._emit([("flip/to_serve", self.flips_to_serve,
+                         self.flips_to_serve)])
+        return self
+
+    def train(self, mode: bool = True):
+        """Back to training mode. With ``release_inference_cache`` the
+        whole fleet (compiled programs + KV pools) is dropped so HBM
+        returns to training between rollout phases (the reference flag's
+        semantics); without it the warmed fleet persists for the next
+        flip — the zero-recompile fast path."""
+        if mode and not self._training:
+            self.unfuse_lora()
+            self.flips_to_train += 1
+            self._emit([("flip/to_train", self.flips_to_train,
+                         self.flips_to_train)])
+            if self._release_cache:
+                self._router = None
+                self._published_at = None
+        self._training = bool(mode)
+        return self
+
+    @property
+    def in_training_mode(self) -> bool:
+        return self._training
+
+    def fuse_lora(self) -> None:
+        """Reference-parity seam (SURVEY §2.3 ``fuse_lora``): the
+        reference materializes base + B@A into the live weights before
+        generation and subtracts it back after. Here the fuse lives
+        INSIDE the jitted gather — ``module_weights`` materializes the
+        fused model-structured tree without ever mutating training state
+        — so the marker flips bookkeeping and meters the call, and the
+        training tree needs no unfuse-subtraction (bit-exact by
+        construction, not by inverse arithmetic)."""
+        if not self._lora_fused:
+            self._lora_fused = True
+            self.lora_fuses += 1
+            self._emit([("flip/lora_fuse", self.lora_fuses,
+                         self.lora_fuses)])
+
+    def unfuse_lora(self) -> None:
+        """Inverse marker (reference ``unfuse_lora``): a no-op on the
+        training tree — the gather never mutated it — kept for call-site
+        parity and metering."""
+        if self._lora_fused:
+            self._lora_fused = False
+            self.lora_unfuses += 1
+            self._emit([("flip/lora_unfuse", self.lora_unfuses,
+                         self.lora_unfuses)])
+
+    # -- training side -------------------------------------------------
+
+    def train_batch(self, *args, **kwargs):
+        t0 = self.clock()
+        out = self.engine.train_batch(*args, **kwargs)
+        self.training_latency_s += self.clock() - t0
+        self.training_iters += 1
+        return out
+
+    def forward(self, batch, **kwargs):
+        """Training mode: engine loss forward. Eval mode: full-sequence
+        logits from replica 0's serving engine (the reference's
+        swapped-container forward)."""
+        if self._training:
+            return self.engine.forward(batch, **kwargs)
+        self.publish_weights()
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return self.router.replicas[0].engine.forward(ids)
+
+    # -- rollouts (the serving fast path) ------------------------------
+
+    @staticmethod
+    def _normalize_prompts(prompts, prompt_lengths=None) -> List[List[int]]:
+        if isinstance(prompts, np.ndarray) or (
+                prompts and isinstance(prompts[0], np.ndarray)):
+            ids = np.asarray(prompts)
+            if ids.ndim != 2:
+                raise ValueError(f"prompt array must be [B, T], got "
+                                 f"{ids.shape}")
+            B, T = ids.shape
+            if prompt_lengths is None:
+                prompt_lengths = [T] * B
+            return [[int(t) for t in ids[i, :int(prompt_lengths[i])]]
+                    for i in range(B)]
+        if prompt_lengths is not None:
+            raise ValueError("prompt_lengths only applies to a padded "
+                             "[B, T] prompt array")
+        return [[int(t) for t in p] for p in prompts]
+
+    def rollout(self, prompts, max_new_tokens: Optional[int] = None,
+                prompt_lengths=None, session_ids=None,
+                record: bool = True) -> List[RolloutRecord]:
+        """Generate rollouts with the CURRENT training weights through the
+        scheduler-driven fleet (continuous batching; shared-prompt batches
+        hit the prefix cache, speculative drafters ride the serving
+        config). Publishes first if an optimizer step ran since the last
+        flip. Every rollout is recorded ``(prompt, tokens,
+        weight_version)`` in the replay log (``record=False`` skips the
+        log, not the metering). Returns the records in submission order."""
+        t0 = self.clock()
+        version = self.publish_weights()
+        plist = self._normalize_prompts(prompts, prompt_lengths)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self._inference_config().max_new_tokens)
+        out = self.router.serve(plist, max_new_tokens=max_new,
+                                session_ids=session_ids)
+        records = [RolloutRecord(prompt=p, tokens=list(toks),
+                                 weight_version=version, uid=uid)
+                   for (uid, toks), p in zip(out.items(), plist)]
+        if record:
+            self.replay_log.extend(records)
+        dt = self.clock() - t0
+        self.generate_latency_s += dt
+        self.generate_calls += 1
+        self.generate_tokens += sum(len(r.tokens) for r in records)
+        self._emit([("flip/generate_s", dt, self.generate_calls),
+                    ("flip/rollout_tokens", self.generate_tokens,
+                     self.generate_calls)])
+        return records
+
+    def generate(self, input_ids, prompt_lengths=None,
+                 max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 eos_token_id: Optional[int] = None, rng=None, **kwargs):
+        """v1-shaped rollout API: right-padded int32 [B, T] prompts in,
+        int32 [B, max_new_tokens] greedy tokens out — but served by the
+        fleet scheduler instead of the v1 whole-batch generate loop.
+
+        The v1 sampling kwargs are accepted at their GREEDY no-op values
+        only (the scheduler's token-parity and replay contracts are
+        greedy, and it never stops at EOS): anything else raises a
+        targeted error instead of silently changing semantics — callers
+        that need sampled or EOS-stopped rollouts should drive a v1
+        ``InferenceEngine`` on ``module_weights()`` directly."""
+        if kwargs:
+            raise TypeError(f"HybridEngineV2.generate: unsupported kwargs "
+                            f"{sorted(kwargs)}")
+        if temperature not in (None, 0, 0.0):
+            raise ValueError(
+                f"HybridEngineV2.generate decodes greedily (the fleet "
+                f"scheduler's parity/replay contract): temperature="
+                f"{temperature!r} is not supported — use a v1 "
+                "InferenceEngine on module_weights() for sampled rollouts")
+        if top_k not in (None, 0) or top_p not in (None, 1, 1.0):
+            raise ValueError(
+                f"HybridEngineV2.generate decodes greedily: top_k={top_k!r}"
+                f"/top_p={top_p!r} are not supported — use a v1 "
+                "InferenceEngine on module_weights() for sampled rollouts")
+        if eos_token_id not in (None, -1):
+            raise ValueError(
+                f"HybridEngineV2.generate emits exactly max_new_tokens "
+                f"(the scheduler has no EOS early-stop): eos_token_id="
+                f"{eos_token_id!r} is not supported — trim at EOS on the "
+                "host, or drive a v1 InferenceEngine directly")
+        # rng is accepted and unused: greedy decoding draws no randomness
+        records = self.rollout(input_ids, max_new_tokens=max_new_tokens,
+                               prompt_lengths=prompt_lengths)
+        return np.asarray([r.tokens for r in records], dtype=np.int32)
+
+    def replay(self, rec: RolloutRecord) -> List[int]:
+        """Bit-exact replay of a recorded rollout: re-serve its prompt
+        greedily at the SAME weight version and return the tokens (the
+        drain-replay discipline — greedy scheduling is deterministic, so
+        the replay reproduces the recording token for token). Refuses
+        when the fleet has moved past the record's version — replaying
+        old rollouts on new weights would silently "reproduce" different
+        tokens."""
+        version = self.publish_weights() if self._router is None \
+            else self._version
+        if rec.weight_version != version:
+            raise RuntimeError(
+                f"cannot replay rollout recorded at weight version "
+                f"{rec.weight_version}: the fleet serves version {version} "
+                "(replay before training past the recording, or keep a "
+                "checkpoint of that version)")
+        out = self.router.serve([rec.prompt],
+                                max_new_tokens=max(1, len(rec.tokens)))
+        return next(iter(out.values()))
+
+    # -- meters --------------------------------------------------------
+
+    def latency_report(self) -> Dict[str, float]:
+        """Aggregate meters (reference prints per-phase latencies); the
+        v1 wrapper's keys plus the flip counters."""
+        return {
+            "generate_calls": self.generate_calls,
+            "generate_tokens": self.generate_tokens,
+            "generate_latency_s": round(self.generate_latency_s, 4),
+            "gather_latency_s": round(self.gather_latency_s, 4),
+            "tokens_per_sec": round(
+                self.generate_tokens / self.generate_latency_s, 2)
+            if self.generate_latency_s else 0.0,
+            "training_iters": self.training_iters,
+            "training_latency_s": round(self.training_latency_s, 4),
+            "publishes": self.publisher.publishes,
+            "publish_latency_s": round(self.publisher.publish_latency_s, 4),
+            "weight_version": self._version,
+            "flips_to_serve": self.flips_to_serve,
+            "flips_to_train": self.flips_to_train,
+            "rollouts_logged": len(self.replay_log),
+        }
+
+    def log_latency(self) -> None:
+        log_dist(f"hybrid engine v2: {self.latency_report()}", ranks=[0])
+
+    def fleet_stats(self) -> Dict[str, object]:
+        """The router's fleet summary (None before the first rollout)."""
+        return self._router.stats() if self._router is not None else None

@@ -1,170 +1,100 @@
-"""Hybrid engine: one engine for RLHF-style train + generate loops.
+"""Hybrid engine v1 — DEPRECATED shim over ``shuffle_exchange_tpu.rlhf``.
 
-Reference: ``DeepSpeedHybridEngine`` (``runtime/hybrid_engine.py:30``, 577
-LoC) — subclasses the training engine so actor rollouts run on the
-inference kernel path with the CURRENT training weights: inference
-containers are swapped in during ``generate()`` (``:?generate``), ZeRO-3
-params are gathered (``fuse_lora``/``unfuse_lora`` around it), and
-latencies are metered (``_generate_latency``/``_training_latency``).
+History: the v1 wrapper here (PR 0 era) bridged the training engine to
+the v1 whole-batch inference engine directly — ``module_weights`` into a
+persistent ``InferenceEngine`` via ``update_params`` — which bypassed
+engine_v2, the continuous-batching scheduler, and the serving fleet
+entirely, so none of the serving-perf levers built since (paged KV,
+prefix caching, speculative decoding, the replica router) applied to
+rollout generation.
 
-TPU-native collapse: training weights are a device-resident sharded pytree,
-and the v1 inference engine's prefill/decode/generate programs are
-weight-agnostic jitted functions. So "swapping the inference containers in"
-is: materialize the consensus bit16 tree (``engine.module_weights`` — a
-jitted cast/mix, no host round-trip) and hand it to a persistent
-``InferenceEngine`` via ``update_params``. Compiled generate programs are
-reused across training steps; the weight refresh is the only per-call cost
-(metered as ``gather_latency_s``, the ZeRO-3-gather analog).
+The real implementation now lives in ``shuffle_exchange_tpu/rlhf/``
+(ISSUE 11): :class:`rlhf.HybridEngineV2` owns the training engine and a
+``ReplicaRouter`` fleet, flips weights through the versioned two-phase
+``WeightPublisher`` (ZeRO-3 gather + LoRA fuse, zero recompiles, KV
+pools intact), runs scheduler-driven rollouts, and records every rollout
+``(prompt, tokens, weight_version)`` for bit-exact replay. This module
+keeps the v1 class name and call surface — ``sxt.initialize`` with a
+``hybrid_engine`` config section still returns a :class:`HybridEngine` —
+as a thin delegation shim, with parity pinned by
+``tests/test_hybrid_engine.py``. New code should construct
+``rlhf.HybridEngineV2`` directly.
 
 Config: the ``hybrid_engine`` section of the DS JSON (reference
 ``runtime/config.py`` DeepSpeedHybridEngineConfig) — ``enabled``,
 ``max_out_tokens``, ``inference_tp_size``, ``release_inference_cache``,
-``pin_parameters`` (accepted; pinning is moot on TPU — no pageable host
-staging in this path).
+``pin_parameters`` (accepted; pinning is moot on TPU), plus the v2
+extras ``num_replicas`` and ``inference_config`` (overrides for the
+fleet's ``InferenceConfig``, serving/speculative/prefix knobs included).
 """
 
 from __future__ import annotations
 
-import time
-from typing import Any, Dict, Optional
+from typing import Optional
 
-from ..utils.logging import log_dist
+from ..utils.logging import warning_once
 
 
 class HybridEngine:
-    """Wraps a training :class:`Engine` with an inference fast path.
+    """Deprecation shim: the v1 hybrid-engine surface over
+    :class:`rlhf.HybridEngineV2`.
 
-    Delegates the full engine API (``train_batch``/``forward``/``backward``/
-    ``step``/checkpointing/...) and adds ``generate()``, ``eval()``/
-    ``train()`` mode flips, and latency meters.
-    """
+    Everything delegates — ``train_batch``/``eval``/``train``/
+    ``generate``/``forward``/``latency_report`` plus the full training-
+    engine API via v2's own delegation. ``generate`` keeps the v1 shape
+    contract (right-padded int32 [B, T] in, [B, max_new_tokens] out) but
+    is served by the fleet scheduler."""
 
     def __init__(self, engine, model, inference_config: Optional[dict] = None):
         if not hasattr(model, "head"):
             raise TypeError("HybridEngine needs a model-zoo Transformer "
                             "(generate() drives its prefill/decode path)")
+        warning_once(
+            "runtime.hybrid_engine.HybridEngine is a deprecation shim over "
+            "shuffle_exchange_tpu.rlhf.HybridEngineV2 — construct the v2 "
+            "class directly for the fleet/replay/publisher API")
+        from ..rlhf import HybridEngineV2
+
         self.engine = engine
         self.model = model
-        hcfg: Dict[str, Any] = dict(engine.config.hybrid_engine or {})
-        self._release_cache = bool(hcfg.get("release_inference_cache", False))
-        self._training = True
-        self._iengine = None
-        # overrides: hybrid_engine.inference_config section, then ctor arg
-        self._icfg_overrides = dict(hcfg.get("inference_config", {}) or {})
-        self._icfg_overrides.update(inference_config or {})
-        self._hcfg = hcfg
-        # meters (reference hybrid_engine.py _generate_latency/_training_latency)
-        self.generate_calls = 0
-        self.generate_tokens = 0
-        self.generate_latency_s = 0.0
-        self.gather_latency_s = 0.0
-        self.training_latency_s = 0.0
-        self.training_iters = 0
+        self._v2 = HybridEngineV2(engine, model,
+                                  inference_config=inference_config)
 
-    # -- engine delegation -------------------------------------------------
+    # -- delegation ----------------------------------------------------
 
     def __getattr__(self, name):
-        return getattr(self.engine, name)
+        if name in ("_v2", "engine", "model"):
+            raise AttributeError(name)
+        return getattr(self._v2, name)
 
     def train_batch(self, *args, **kwargs):
-        t0 = time.time()
-        out = self.engine.train_batch(*args, **kwargs)
-        self.training_latency_s += time.time() - t0
-        self.training_iters += 1
-        return out
-
-    # -- mode flips (reference module.eval()/train() container swap) -------
+        return self._v2.train_batch(*args, **kwargs)
 
     def eval(self):
-        """Enter generation mode (reference swaps inference containers in;
-        here the swap happens lazily at the next generate())."""
-        self._training = False
+        self._v2.eval()
         return self
 
     def train(self, mode: bool = True):
-        self._training = bool(mode)
-        if mode and self._release_cache:
-            # reference release_inference_cache frees the inference workspace
-            # between rollout phases; our analog drops compiled generate
-            # programs + KV buffers so HBM goes back to training
-            self._iengine = None
+        self._v2.train(mode)
         return self
 
-    @property
-    def in_training_mode(self) -> bool:
-        return self._training
-
-    # -- the inference fast path ------------------------------------------
-
-    def _inference_config(self):
-        from ..inference.config import InferenceConfig
-
-        mcfg = self.model.config
-        kw = {
-            "dtype": ("bfloat16" if self.engine.bfloat16_enabled
-                      else "float16" if self.engine.fp16_enabled else "float32"),
-            "max_seq_len": mcfg.max_seq_len,
-            "max_new_tokens": int(self._hcfg.get("max_out_tokens", 256)),
-            "tensor_parallel": int(self._hcfg.get("inference_tp_size", 1)),
-        }
-        kw.update(self._icfg_overrides)
-        return InferenceConfig.from_dict(kw)
-
-    def refresh_inference_params(self) -> None:
-        """Push the current consensus bit16 weights into the inference
-        engine (reference: container re-population at generate entry).
-        No-op when no optimizer step has run since the last refresh."""
-        from ..inference.engine import InferenceEngine
-
-        fresh_at = (self.engine.global_steps, self.engine.micro_steps)
-        if self._iengine is not None and getattr(self, "_params_fresh_at", None) == fresh_at:
-            return
-        t0 = time.time()
-        weights = self.engine.module_weights(consensus=True)
-        if self._iengine is None:
-            self._iengine = InferenceEngine(self.model, weights, self._inference_config())
-        else:
-            self._iengine.update_params(weights)
-        self._params_fresh_at = fresh_at
-        self.gather_latency_s += time.time() - t0
+    def forward(self, batch, **kwargs):
+        return self._v2.forward(batch, **kwargs)
 
     def generate(self, input_ids, prompt_lengths=None, **kwargs):
-        """Rollout with the CURRENT training weights on the fused v1
-        generate loop. Returns int32 [B, max_new_tokens]."""
-        import numpy as np
+        """Rollout with the CURRENT training weights through the serving
+        fleet. Returns int32 [B, max_new_tokens] (v1 contract)."""
+        return self._v2.generate(input_ids, prompt_lengths=prompt_lengths,
+                                 **kwargs)
 
-        t0 = time.time()
-        self.refresh_inference_params()
-        out = self._iengine.generate(input_ids, prompt_lengths=prompt_lengths, **kwargs)
-        self.generate_latency_s += time.time() - t0
-        self.generate_calls += 1
-        self.generate_tokens += int(np.asarray(out).size)
-        return out
+    def refresh_inference_params(self) -> None:
+        """v1 name for the train->serve weight flip; now the versioned
+        fleet publish (no-op when no optimizer step ran since the last
+        refresh — the same freshness contract v1 kept)."""
+        self._v2.publish_weights()
 
-    def forward(self, batch, **kwargs):
-        """Training mode: engine loss forward. Eval mode: inference logits
-        (the reference's swapped-container forward)."""
-        if self._training:
-            return self.engine.forward(batch, **kwargs)
-        self.refresh_inference_params()
-        ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        return self._iengine.forward(ids)
-
-    # -- meters ------------------------------------------------------------
-
-    def latency_report(self) -> Dict[str, float]:
-        """Aggregate meters (reference prints per-phase latencies)."""
-        return {
-            "generate_calls": self.generate_calls,
-            "generate_tokens": self.generate_tokens,
-            "generate_latency_s": round(self.generate_latency_s, 4),
-            "gather_latency_s": round(self.gather_latency_s, 4),
-            "tokens_per_sec": round(
-                self.generate_tokens / self.generate_latency_s, 2)
-            if self.generate_latency_s else 0.0,
-            "training_iters": self.training_iters,
-            "training_latency_s": round(self.training_latency_s, 4),
-        }
+    def latency_report(self):
+        return self._v2.latency_report()
 
     def log_latency(self) -> None:
-        log_dist(f"hybrid engine: {self.latency_report()}", ranks=[0])
+        self._v2.log_latency()

@@ -44,7 +44,7 @@ from ..inference.config import RouterConfig
 from ..inference.engine_v2 import InferenceEngineV2
 from ..inference.scheduler import ContinuousBatchingScheduler, ServingRequest
 from ..monitor.monitor import FleetMonitor, Monitor
-from ..utils.invariants import locked_by, requires_lock
+from ..utils.invariants import atomic_on_reject, locked_by, requires_lock
 from ..utils.logging import logger
 
 ACTIVE, DRAINING, STOPPED = "active", "draining", "stopped"
@@ -71,7 +71,8 @@ class Replica:
 
 
 @locked_by("_lock", "requests", "owner", "sessions", "_session_of",
-           "_next_uid", "drains", "requeued")
+           "_next_uid", "drains", "requeued", "weight_publishes",
+           "published_version", "_published_weights")
 class ReplicaRouter:
     """Place requests across replicas; tick them; aggregate their stats.
 
@@ -118,6 +119,15 @@ class ReplicaRouter:
         self._pending_drains: set = set()
         self.drains = 0
         self.requeued = 0
+        # fleet-wide weight publication (ISSUE 11): count + last version,
+        # plus a reference to the last-published tree so elastic scale-up
+        # can catch a factory-built replica up to the fleet's version
+        # (without it, a replica added after a publish would serve the
+        # factory's construction-time weights — a silently half-published
+        # fleet). Replaced on every publish; costs one retained tree.
+        self.weight_publishes = 0
+        self.published_version: Optional[int] = None
+        self._published_weights = None
         for eng in engines:
             self._add_replica(eng)
 
@@ -131,6 +141,12 @@ class ReplicaRouter:
             engine, on_token=self._emit_token, clock=self.clock,
             monitor=self.fleet.sink(rid), replica_id=rid, drafter=drafter)
         rep = Replica(rid, engine, sched)
+        # elastic scale-up after a publish: catch the newcomer up to the
+        # fleet's published weights before it takes traffic (a fresh
+        # engine has no live KV, so the commit applies immediately)
+        if self._published_weights is not None:
+            engine.publish_weights(self._published_weights,
+                                   version=self.published_version)
         self.replicas.append(rep)
         return rep
 
@@ -466,6 +482,82 @@ class ReplicaRouter:
                 self.scale_to(want)
             return len(self.active_replicas)
 
+    # -- fleet-wide weight publication (ISSUE 11) ----------------------
+
+    @atomic_on_reject(check="validate")
+    def publish_weights(self, params, version: Optional[int] = None) -> int:
+        """Deliver new serving weights to EVERY live replica — the fleet
+        half of the RLHF train->serve flip — without tearing down any
+        replica's paged KV pool or compiled programs.
+
+        Two-phase for per-replica atomicity: every replica STAGES the
+        prepared tree first (the phase that can fail — casts, device
+        placement, quantization; the ``weight_publish`` fault site lands
+        here), and only after ALL replicas staged successfully does each
+        one commit. A crash mid-stage rolls every staged replica back, so
+        the fleet keeps serving the OLD weight version as one unit — a
+        half-published fleet (replicas answering from different weights)
+        can never exist. Commits use ``defer=True``: a replica with live
+        sequences applies the swap at its next tick boundary (its
+        scheduler drains the in-flight tick first), an idle replica flips
+        immediately.
+
+        ``version`` stamps every replica's ``weight_version`` (default:
+        one past the fleet's current max). Returns the published version.
+        """
+        from ..testing import faults
+
+        with self._lock:
+            reps = [r for r in self.replicas if r.state != STOPPED]
+            if not reps:
+                raise RuntimeError(
+                    "publish_weights: no live replicas (all stopped)")
+            if version is None:
+                version = max(r.engine.weight_version for r in reps) + 1
+            version = int(version)
+            # prepare ONCE per serving-transform key (dtype/quantization)
+            # and hand every matching replica the same placed tree: the
+            # per-replica work under the lock is then a structure check +
+            # a staging-slot write, not N cast+place passes of the whole
+            # model (replicas share the device buffers; the serving
+            # programs never donate the params operand)
+            prep_cache: Dict[tuple, object] = {}
+
+            def _prep(eng):
+                cfg = eng.config
+                key = (cfg.dtype, cfg.quantize_weights, str(cfg.quant_bits),
+                       cfg.quant_group_size)
+                if key not in prep_cache:
+                    prep_cache[key] = eng._prepare_params(params)
+                return prep_cache[key]
+
+            staged: List[Replica] = []
+            try:
+                for i, rep in enumerate(reps):
+                    faults.maybe_crash("weight_publish", i)
+                    rep.engine.stage_weights(_prep(rep.engine),
+                                             version=version, prepared=True)
+                    staged.append(rep)
+            except BaseException:
+                # roll back: no replica has committed yet, so dropping the
+                # staged trees leaves the WHOLE fleet on the old version
+                for rep in staged:
+                    rep.engine.discard_staged_weights()
+                raise
+            for rep in reps:
+                with rep.lock:
+                    rep.engine.commit_staged_weights(defer=True)
+            self.weight_publishes += 1
+            self.published_version = version
+            self._published_weights = params
+            self.fleet.write_events([
+                ("fleet/weight_version", version, self.weight_publishes),
+                ("fleet/weight_publishes", self.weight_publishes,
+                 self.weight_publishes)])
+            logger.info(f"router: published weight version {version} to "
+                        f"{len(reps)} replicas")
+            return version
+
     # -- observability --------------------------------------------------
 
     def stats(self) -> Dict[str, object]:
@@ -495,6 +587,13 @@ class ReplicaRouter:
             "tpot_p99_s": pct(tpot, 99),
             "drains": self.drains,
             "requeued": self.requeued,
+            # RLHF weight publication (ISSUE 11): the last fleet-published
+            # version plus every replica's installed version — a healthy
+            # fleet shows them all equal once deferred commits landed
+            "weight_publishes": self.weight_publishes,
+            "published_version": self.published_version,
+            "weight_versions": {r.replica_id: r.engine.weight_version
+                                for r in self.replicas},
             # fleet-aggregated speculative group (ISSUE 8): sums over
             # replicas; acceptance_rate re-derived from the sums so it is
             # token-weighted, not an average of per-replica averages

@@ -30,6 +30,11 @@ Sites (``Fault.site``):
   transfer (serving/disagg.py) after the decode side's blocks are reserved
   but before the payload commits; the transfer's cleanup must abort the
   reservation, so the decode engine is left clean (tests/test_disagg.py).
+- ``weight_publish``      — kill a fleet-wide RLHF weight publication
+  (serving/router.py ``publish_weights``) while STAGING replica ``index``'s
+  new weights; the two-phase flip must roll every staged replica back and
+  leave the whole fleet serving the OLD weight version atomically
+  (tests/test_rlhf.py).
 - ``corrupt_manifest`` / ``drop_manifest`` / ``corrupt_shard`` — post-commit
   damage to an already-committed tag (drives checksum verification and the
   newest-complete-tag fallback on load). ``index`` selects the manifest
@@ -62,7 +67,7 @@ SITES = (
     "ckpt_pre_commit", "ckpt_pre_latest",
     "nan_loss", "sigterm_mid_step", "offload_bucket_update",
     "corrupt_manifest", "drop_manifest", "corrupt_shard",
-    "kv_transfer",
+    "kv_transfer", "weight_publish",
 )
 
 

@@ -655,15 +655,23 @@ def test_annotations_present_on_real_seams():
     from shuffle_exchange_tpu.inference.scheduler import \
         ContinuousBatchingScheduler
     from shuffle_exchange_tpu.monitor.monitor import FleetMonitor
+    from shuffle_exchange_tpu.rlhf.publish import WeightWire
     from shuffle_exchange_tpu.serving.disagg import KVTransferChannel
     from shuffle_exchange_tpu.serving.router import ReplicaRouter
 
     for meth in (InferenceEngineV2.put, InferenceEngineV2.step,
                  InferenceEngineV2.decode_loop, InferenceEngineV2.begin_import,
+                 InferenceEngineV2.stage_weights,
                  ContinuousBatchingScheduler.submit,
                  ContinuousBatchingScheduler.inject,
-                 KVTransferChannel.transfer):
+                 KVTransferChannel.transfer,
+                 ReplicaRouter.publish_weights):
         assert hasattr(meth, "__sxt_atomic_on_reject__"), meth
     assert "_lock" in ReplicaRouter.__sxt_locked_by__
+    # the ISSUE 11 publish seam rides the same registries: the fleet
+    # publish counters under the router lock, the weight wire's staging
+    # slots under its channel lock
+    assert "weight_publishes" in ReplicaRouter.__sxt_locked_by__["_lock"]
     assert "_mu" in KVTransferChannel.__sxt_locked_by__
+    assert "_mu" in WeightWire.__sxt_locked_by__
     assert "_mu" in FleetMonitor.__sxt_locked_by__

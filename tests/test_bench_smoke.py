@@ -233,3 +233,34 @@ def test_serving_speculative_row_runs_at_toy_size():
     # greedy acceptance: every variant emits the k=0 tokens exactly
     assert row["token_mismatches_ngram_vs_k0"] == 0
     assert row["token_mismatches_draft_vs_k0"] == 0
+
+
+def test_rlhf_rollout_row_runs_at_toy_size():
+    """The config-5 RLHF row (bench.rlhf_rollout_row) at toy size: three
+    train -> publish -> generate flips on a warmed 2-replica fleet with
+    shared-prompt rollouts — flip latency, rollout goodput, prefix-cache
+    hit rate, and the zero-recompile / replay / version-convergence
+    contract flags — runs on CPU, so the published row cannot rot on the
+    driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    from bench import rlhf_rollout_row
+    from shuffle_exchange_tpu.models import tiny
+
+    mcfg = tiny(vocab=64, d=32, layers=2, heads=2, seq=64)
+    row = rlhf_rollout_row(mcfg, n_rollouts=8, shared_len=16, suffix_lo=4,
+                           suffix_hi=8, max_new=6, flips=2, kv_block=8,
+                           toy=True)
+    assert row["flips"] == 2
+    assert row["flip_s_median"] > 0 and row["gather_s_total"] > 0
+    assert row["rollout_tokens_per_sec"] > 0
+    # shared system prompt -> the second+ rollouts hit committed blocks
+    assert row["prefix_cache_hit_rate"] is not None
+    assert row["prefix_cache_hit_rate"] > 0
+    # the contract flags the TPU row will publish alongside the timings
+    assert row["zero_recompile_across_flips"] is True
+    assert row["kv_pools_intact"] is True
+    assert row["weight_versions_converged"] is True
+    assert row["replays_bit_exact"] == 2
+    assert row["weight_version"] == row["train_steps"] - 1

@@ -1,17 +1,19 @@
-"""Hybrid engine (RLHF train+generate) — reference runtime/hybrid_engine.py:30.
+"""Hybrid engine v1 shim — parity over ``rlhf.HybridEngineV2``.
 
-The RLHF shape: train a few steps -> generate rollouts with the CURRENT
-weights -> train more -> generate again. Generations must match a fresh
-inference engine built from module_weights() (i.e. the swap really uses the
-live training weights, not stale ones), and the whole loop must not
-recompile the generate program after the first call.
+``sxt.initialize`` with a ``hybrid_engine`` config section still returns
+the v1 :class:`runtime.hybrid_engine.HybridEngine` surface; since ISSUE 11
+that class is a thin deprecation shim over the rlhf subsystem, so these
+tests pin the shim's contract: generations run through the serving FLEET
+with the CURRENT training weights (parity with a fresh paged engine built
+from ``module_weights()``), mode flips and the latency report keep the v1
+keys, and the warmed fleet never recompiles across weight refreshes.
 """
 
 import numpy as np
 import pytest
 
 
-def _build(tmp_path=None, **cfg_extra):
+def _build(**cfg_extra):
     import shuffle_exchange_tpu as sxt
     from shuffle_exchange_tpu.models import Transformer, tiny
 
@@ -34,19 +36,38 @@ def _batch(vocab=64, b=8, t=32, seed=0):
     return {"input_ids": rng.integers(0, vocab, size=(b, t)).astype(np.int32)}
 
 
+def _reference(model, engine, prompts, n_new):
+    """Greedy tokens from a FRESH paged engine on the current consensus
+    weights — what the shim's fleet generations must match exactly."""
+    from shuffle_exchange_tpu.inference import InferenceEngineV2
+
+    eng = InferenceEngineV2(model, engine.module_weights(consensus=True),
+                            engine._v2._inference_config())
+    out = np.zeros((len(prompts), n_new), np.int32)
+    for i, p in enumerate(prompts):
+        lg = eng.put([i], [list(map(int, p))])
+        first = int(np.argmax(lg[0]))
+        toks = [first]
+        if n_new > 1:
+            toks += [int(t) for t in eng.decode_loop([i], [first],
+                                                     n_new - 1)[0]]
+        out[i] = toks
+    return out
+
+
 def test_initialize_returns_hybrid_engine():
+    from shuffle_exchange_tpu.rlhf import HybridEngineV2
     from shuffle_exchange_tpu.runtime.hybrid_engine import HybridEngine
 
     _, engine = _build()
     assert isinstance(engine, HybridEngine)
+    assert isinstance(engine._v2, HybridEngineV2), "shim must wrap v2"
     # full engine API delegation
     assert engine.global_steps == 0
     assert engine.zero_stage == 1
 
 
 def test_rlhf_loop_generations_track_training_weights():
-    from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngine
-
     model, engine = _build()
     prompts = _batch(t=8, seed=1)["input_ids"]
 
@@ -55,40 +76,41 @@ def test_rlhf_loop_generations_track_training_weights():
     out1 = engine.generate(prompts, max_new_tokens=6)
     assert out1.shape == (8, 6)
 
-    # a fresh engine on the CURRENT consensus weights must agree exactly
-    ref = InferenceEngine(model, engine.module_weights(consensus=True),
-                          InferenceConfig(dtype="float32", max_seq_len=32))
-    np.testing.assert_array_equal(out1, ref.generate(prompts, max_new_tokens=6))
+    # a fresh paged engine on the CURRENT consensus weights must agree
+    np.testing.assert_array_equal(out1, _reference(model, engine, prompts, 6))
 
     # train more -> weights moved -> generations refresh (and typically change)
     for _ in range(3):
         engine.train_batch(_batch(seed=3))
     out2 = engine.generate(prompts, max_new_tokens=6)
-    ref2 = InferenceEngine(model, engine.module_weights(consensus=True),
-                           InferenceConfig(dtype="float32", max_seq_len=32))
-    np.testing.assert_array_equal(out2, ref2.generate(prompts, max_new_tokens=6))
+    np.testing.assert_array_equal(out2, _reference(model, engine, prompts, 6))
 
     rep = engine.latency_report()
     assert rep["generate_calls"] == 2
     assert rep["training_iters"] == 8
     assert rep["generate_latency_s"] > 0
     assert rep["gather_latency_s"] > 0
+    # v2 extras ride along: versions track the optimizer step
+    assert rep["weight_version"] == engine.global_steps
+    assert rep["publishes"] >= 1
 
 
 def test_generate_reuses_compiled_program():
-    """The persistent inference engine must keep its jit cache across weight
+    """The persistent fleet must keep its compiled programs across weight
     refreshes (the whole point of the TPU design: params swap, program
-    stays)."""
+    stays — now fleet-wide)."""
     _, engine = _build()
     prompts = _batch(t=8, seed=1)["input_ids"]
     engine.train_batch(_batch(seed=2))
     engine.generate(prompts, max_new_tokens=4)
-    iengine = engine._iengine
-    cache_after_first = dict(iengine._gen_cache)
+    router = engine._v2._router
+    assert router is not None
+    progs = [rep.engine.program_shapes for rep in router.replicas]
     engine.train_batch(_batch(seed=3))
     engine.generate(prompts, max_new_tokens=4)
-    assert engine._iengine is iengine, "inference engine must persist"
-    assert dict(iengine._gen_cache) == cache_after_first, "no new compiles"
+    assert engine._v2._router is router, "fleet must persist across flips"
+    assert [rep.engine.program_shapes for rep in router.replicas] == progs, \
+        "no new compiled shapes across a weight refresh"
 
 
 def test_eval_train_flips_and_eval_forward():
@@ -104,6 +126,9 @@ def test_eval_train_flips_and_eval_forward():
     # training-mode forward returns the loss path
     loss = engine.forward(_batch(seed=5))
     assert np.asarray(loss).shape == ()
+    # the flips were metered through the v2 monitor
+    assert engine._v2.flips_to_serve == 1
+    assert engine._v2.flips_to_train == 1
 
 
 def test_release_inference_cache():
@@ -112,9 +137,25 @@ def test_release_inference_cache():
                                       "inference_config": {"dtype": "float32"}})
     prompts = _batch(t=8, seed=1)["input_ids"]
     engine.generate(prompts, max_new_tokens=4)
-    assert engine._iengine is not None
+    assert engine._v2._router is not None
+    engine.eval()
     engine.train()
-    assert engine._iengine is None, "release_inference_cache drops the workspace"
+    assert engine._v2._router is None, \
+        "release_inference_cache drops the fleet workspace"
+
+
+def test_refresh_inference_params_is_the_publish():
+    """v1's refresh name still works and is a no-op between optimizer
+    steps (the freshness contract)."""
+    _, engine = _build()
+    prompts = _batch(t=8, seed=1)["input_ids"]
+    engine.generate(prompts, max_new_tokens=4)
+    n = engine._v2.publisher.publishes
+    engine.refresh_inference_params()      # no step since -> no publish
+    assert engine._v2.publisher.publishes == n
+    engine.train_batch(_batch(seed=2))
+    engine.refresh_inference_params()
+    assert engine._v2.weight_version == engine.global_steps
 
 
 def test_hybrid_requires_zoo_model():

@@ -429,7 +429,7 @@ class TestScheduler:
         same prompt would otherwise silently reuse KV computed under the
         OLD weights. Parked blocks return to the free list; a force-swap
         under live sequences bars them from ever committing."""
-        from shuffle_exchange_tpu.inference.engine import InferenceEngine
+        from shuffle_exchange_tpu.inference import engine as _eng
 
         model, params = model_and_params
         rng = np.random.default_rng(11)
@@ -439,9 +439,13 @@ class TestScheduler:
         eng.flush([0])
         assert eng.prefix_peek(prompt)[0] == 16   # parked and addressable
 
-        monkeypatch.setattr(InferenceEngine, "reload_weights",
-                            lambda self, d, tag=None: True)
+        # reload now loads through the shared _try_load_serving_weights
+        # seam and installs via the staged-swap path (ISSUE 11); fake the
+        # load, keep the swap
+        monkeypatch.setattr(_eng, "load_serving_weights",
+                            lambda d, m, tag=None: params)
         assert eng.reload_weights("/does/not/matter")
+        assert eng.weight_version == 1            # versioned install
         assert eng.prefix_peek(prompt) == (0, 0, 0)
         assert eng.allocator.cached_blocks == 0
         assert eng.free_blocks == eng.allocator.num_blocks - 1

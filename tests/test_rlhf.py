@@ -1,0 +1,419 @@
+"""HybridEngine v2 (ISSUE 11): the train<->serve weight flip.
+
+The contract under test: published weights reach every replica WITHOUT
+tearing down paged KV pools or compiled programs (zero recompiles across
+flips on a warmed fleet), rollouts through the scheduler fleet are
+token-identical to a fresh engine built from the same gathered weights,
+every rollout replays bit-exactly at its recorded weight version, and a
+crash mid-publish leaves the whole fleet atomically on the OLD version.
+"""
+
+import json
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+import shuffle_exchange_tpu as sxt
+from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                            InferenceConfig,
+                                            InferenceEngineV2)
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.rlhf import (HybridEngineV2, ReplayLog, RLHFLoop,
+                                       RolloutRecord, WeightPublisher,
+                                       WeightWire, dpo_loss_fn, pg_loss_fn,
+                                       publish_over_wire)
+from shuffle_exchange_tpu.testing import faults
+from shuffle_exchange_tpu.testing.faults import InjectedFault
+
+VOCAB = 64
+
+ICFG = {
+    "dtype": "float32", "max_seq_len": 32, "kv_block_size": 8,
+    "num_kv_blocks": 40,
+    "serving": {"token_budget": 16, "max_running": 4, "chunk_min": 4},
+}
+
+
+def _prompts(rng, n=8):
+    # fixed lengths so every flip re-serves the same shape-bin ladder
+    lens = (9, 12, 7, 10, 9, 12, 7, 10)[:n]
+    return [rng.integers(1, VOCAB - 2, size=ln).tolist() for ln in lens]
+
+
+def _reference_tokens(model, weights, prompts, n_new):
+    """Greedy tokens from a FRESH paged engine on the same weights — the
+    parity oracle for fleet rollouts."""
+    eng = InferenceEngineV2(model, weights, InferenceConfig.from_dict(
+        dict(ICFG)))
+    out = []
+    for i, p in enumerate(prompts):
+        lg = eng.put([i], [p])
+        first = int(np.argmax(lg[0]))
+        toks = [first]
+        if n_new > 1:
+            toks += [int(t) for t in eng.decode_loop([i], [first],
+                                                     n_new - 1)[0]]
+        out.append(toks)
+    return out
+
+
+def _jit_cache_size(eng) -> int:
+    """Total compiled-executable count across the engine's program caches
+    — the real zero-recompile meter (program_shapes only counts shape
+    keys, not recompiles of the same key)."""
+    total = 0
+    for cache in (eng._prefill_cache, eng._decode_cache, eng._extend_cache,
+                  eng._mixed_cache, getattr(eng, "_loop_cache", {})):
+        for fn in cache.values():
+            if hasattr(fn, "_cache_size"):
+                total += fn._cache_size()
+            else:        # pragma: no cover - newer jax
+                total += 1
+    return total
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One training engine (PG loss, ZeRO-3 over fsdp) + a 2-replica
+    hybrid fleet, warmed by one rollout. Shared across the module —
+    tests advance its training state but keep prompt shapes fixed so the
+    warmed ladder never grows."""
+    model = Transformer(tiny(vocab=VOCAB, d=32, layers=2, heads=2, seq=32))
+    engine, *_ = sxt.initialize(model=model, loss_fn=pg_loss_fn(model),
+                                config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3},
+        "mesh": {"fsdp": 2, "data": -1},
+        "steps_per_print": 10**9,
+    })
+    hy = HybridEngineV2(engine, model, inference_config=dict(ICFG),
+                        n_replicas=2)
+    rng = np.random.default_rng(0)
+    prompts = _prompts(rng)
+    hy.rollout(prompts, max_new_tokens=6)          # builds + warms the fleet
+    return SimpleNamespace(model=model, engine=engine, hy=hy,
+                           prompts=prompts, rng=rng)
+
+
+def _train_batch(stack, seed):
+    rng = np.random.default_rng(seed)
+    records = [RolloutRecord(prompt=p, tokens=[1] * 4, weight_version=0,
+                             reward=float(rng.uniform()))
+               for p in _prompts(rng)]
+    loop = RLHFLoop(stack.hy)
+    return loop.pg_batch(records)
+
+
+class TestPublish:
+    def test_publish_reaches_every_replica_without_kv_teardown(self, stack):
+        hy, engine = stack.hy, stack.engine
+        router = hy.router
+        allocators = [id(rep.engine.allocator) for rep in router.replicas]
+        engines = [id(rep.engine) for rep in router.replicas]
+        hy.train_batch(_train_batch(stack, 1))
+        version = hy.publish_weights()
+        assert version == engine.global_steps
+        st = router.stats()
+        assert st["published_version"] == version
+        assert set(st["weight_versions"].values()) == {version}
+        # no teardown: same engines, same allocators, pools fully free
+        assert [id(rep.engine) for rep in router.replicas] == engines
+        assert [id(rep.engine.allocator) for rep in router.replicas] \
+            == allocators
+        for rep in router.replicas:
+            eng = rep.engine
+            assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_rollout_token_parity_with_fresh_engine(self, stack):
+        hy = stack.hy
+        hy.train_batch(_train_batch(stack, 2))
+        records = hy.rollout(stack.prompts, max_new_tokens=6)
+        want = _reference_tokens(
+            stack.model, stack.engine.module_weights(consensus=True),
+            stack.prompts, 6)
+        assert [r.tokens for r in records] == want
+        assert {r.weight_version for r in records} == \
+            {stack.engine.global_steps}
+
+    def test_zero_recompile_across_three_flips(self, stack):
+        hy = stack.hy
+        router = hy.router
+        # warmed: the fixture + tests above served these exact shapes
+        before_progs = [rep.engine.program_shapes for rep in router.replicas]
+        before_jits = [_jit_cache_size(rep.engine) for rep in router.replicas]
+        for i in range(3):
+            hy.train_batch(_train_batch(stack, 10 + i))
+            hy.rollout(stack.prompts, max_new_tokens=6)
+        assert [rep.engine.program_shapes for rep in router.replicas] \
+            == before_progs
+        assert [_jit_cache_size(rep.engine) for rep in router.replicas] \
+            == before_jits, "a weight flip recompiled a warmed program"
+        # the flips really happened: every replica is on the latest step
+        st = router.stats()
+        assert set(st["weight_versions"].values()) == \
+            {stack.engine.global_steps}
+
+    def test_crash_mid_publish_leaves_fleet_on_old_weights(self, stack):
+        hy, router = stack.hy, stack.hy.router
+        hy.publish_weights()                      # fleet at current step
+        v_old = hy.weight_version
+        old_tokens = [list(t) for t in router.serve(
+            stack.prompts[:2], max_new_tokens=6).values()]
+        hy.train_batch(_train_batch(stack, 3))
+        faults.arm("weight_publish", index=1)     # crash staging replica 1
+        try:
+            with pytest.raises(InjectedFault):
+                hy.publisher.publish(router)
+        finally:
+            faults.clear()
+        # atomic: both replicas still on the OLD version, nothing staged,
+        # and generation still answers from the old weights
+        st = router.stats()
+        assert set(st["weight_versions"].values()) == {v_old}
+        for rep in router.replicas:
+            assert rep.engine._staged_weights is None
+            assert not rep.engine.has_pending_weights
+        again = [list(t) for t in router.serve(
+            stack.prompts[:2], max_new_tokens=6).values()]
+        assert again == old_tokens
+        # and a clean retry flips the whole fleet
+        version = hy.publish_weights()
+        assert version == stack.engine.global_steps
+        assert set(router.stats()["weight_versions"].values()) == {version}
+
+    def test_fleet_monitor_sees_converged_weight_version(self, stack):
+        # serve once so every replica's scheduler stamps ticks at the
+        # current version, then the fleet aggregate must show both
+        # replicas answering from the same weights
+        hy = stack.hy
+        hy.rollout(stack.prompts, max_new_tokens=6)
+        agg = hy.router.fleet.aggregate()
+        assert set(agg["weight_version"].values()) == {hy.weight_version}
+
+
+class TestReplay:
+    def test_replay_log_bit_exact_and_jsonl_roundtrip(self, stack, tmp_path):
+        hy = stack.hy
+        records = hy.rollout(stack.prompts, max_new_tokens=6)
+        for rec in records[:3]:
+            assert hy.replay(rec) == rec.tokens
+        path = tmp_path / "rollouts.jsonl"
+        hy.replay_log.save(str(path))
+        loaded = ReplayLog.load(str(path))
+        assert len(loaded) == len(hy.replay_log)
+        assert [r.to_json() for r in loaded] == \
+            [r.to_json() for r in hy.replay_log]
+        verified, skipped = loaded.verify(
+            hy, loaded.at_version(hy.weight_version)[:3])
+        assert verified == 3 and skipped == 0
+
+    def test_replay_refuses_stale_weight_version(self, stack):
+        hy = stack.hy
+        rec = hy.rollout(stack.prompts[:1], max_new_tokens=4)[0]
+        hy.train_batch(_train_batch(stack, 4))
+        hy.publish_weights()
+        with pytest.raises(RuntimeError, match="weight version"):
+            hy.replay(rec)
+        # verify() skips rather than falsely "reproducing" on new weights
+        log = ReplayLog([rec])
+        verified, skipped = log.verify(hy)
+        assert (verified, skipped) == (0, 1)
+
+
+class TestDeferredSwap:
+    @pytest.fixture(scope="class")
+    def serve_stack(self):
+        model = Transformer(tiny(vocab=VOCAB, d=32, layers=2, heads=2,
+                                 seq=32))
+        p0 = model.init(jax.random.PRNGKey(0))
+        p1 = model.init(jax.random.PRNGKey(7))
+        eng = InferenceEngineV2(model, p0,
+                                InferenceConfig.from_dict(dict(ICFG)))
+        return SimpleNamespace(model=model, p0=p0, p1=p1, eng=eng)
+
+    def test_defer_applies_at_tick_boundary(self, serve_stack):
+        eng = serve_stack.eng
+        sched = ContinuousBatchingScheduler(eng)
+        sched.submit(list(range(1, 13)), max_new_tokens=8)
+        sched.tick()                                   # live sequence now
+        assert eng._seqs
+        ok = eng.publish_weights(serve_stack.p1, version=5, defer=True)
+        assert ok and eng.has_pending_weights
+        assert eng.weight_version == 0, "defer must not swap mid-tick"
+        # plain commit without force/defer refuses under live KV
+        assert eng.publish_weights(serve_stack.p1, version=9) is False
+        assert eng.weight_version == 0
+        sched.tick()                                   # tick boundary
+        assert eng.weight_version == 5
+        assert not eng.has_pending_weights
+        # mixed-weight continuations are barred from the content registry
+        assert all(d.no_commit for d in eng._seqs.values())
+        assert sched.memory_monitor.latest("weights/version") == 5
+        sched.drain()
+
+    def test_stage_validates_tree_structure(self, serve_stack):
+        eng = serve_stack.eng
+        with pytest.raises(ValueError, match="structure"):
+            eng.stage_weights({"not": np.zeros((2, 2), np.float32)})
+
+
+class TestScaleUp:
+    def test_scaled_up_replica_catches_up_to_published_weights(self):
+        """A replica added AFTER a publish must serve the published
+        weights, not the factory's construction-time ones — otherwise
+        elastic scale-up silently creates the half-published fleet the
+        two-phase publish exists to prevent."""
+        from shuffle_exchange_tpu.serving import ReplicaRouter
+
+        model = Transformer(tiny(vocab=VOCAB, d=32, layers=2, heads=2,
+                                 seq=32))
+        p0 = model.init(jax.random.PRNGKey(0))
+        p1 = model.init(jax.random.PRNGKey(5))
+        icfg = InferenceConfig.from_dict(dict(ICFG))
+
+        def mk():
+            return InferenceEngineV2(model, p0, icfg)
+
+        router = ReplicaRouter([mk()], engine_factory=mk)
+        router.publish_weights(p1, version=7)
+        router.scale_to(2)
+        st = router.stats()
+        assert set(st["weight_versions"].values()) == {7}, st
+        a = jax.tree_util.tree_leaves(router.replicas[0].engine.params)[0]
+        b = jax.tree_util.tree_leaves(router.replicas[1].engine.params)[0]
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestWire:
+    def test_failed_send_releases_its_staging_slot(self, stack,
+                                                   monkeypatch):
+        weights = stack.engine.module_weights(consensus=True)
+        wire = WeightWire()
+
+        def boom(*a, **k):
+            raise RuntimeError("staging boom")
+
+        monkeypatch.setattr(wire, "pool",
+                            SimpleNamespace(staging=boom, native=False))
+        with pytest.raises(RuntimeError, match="staging boom"):
+            wire.send(weights)
+        assert wire._slots_in_use == set(), \
+            "a failed send stranded its staging slot"
+        assert wire.stats()["in_flight"] == 0
+
+    def test_weight_wire_roundtrip_is_byte_exact(self, stack):
+        weights = stack.engine.module_weights(consensus=True)
+        wire = WeightWire()
+        got = wire.recv(wire.send(weights))
+        leaves, td = jax.tree_util.tree_flatten(weights)
+        got_leaves, got_td = jax.tree_util.tree_flatten(got)
+        assert td == got_td
+        for a, b in zip(leaves, got_leaves):
+            assert np.asarray(a).tobytes() == b.tobytes()
+        assert wire.stats()["in_flight"] == 0
+
+    def test_publish_over_wire_reaches_fleet(self, stack):
+        hy = stack.hy
+        hy.train_batch(_train_batch(stack, 5))
+        pub = WeightPublisher(stack.engine)
+        version = publish_over_wire(pub, WeightWire(), hy.router)
+        assert version == stack.engine.global_steps
+        assert set(hy.router.stats()["weight_versions"].values()) == \
+            {version}
+        hy._version = version                 # realign the hybrid watermark
+        hy._published_at = (stack.engine.global_steps,
+                            stack.engine.micro_steps)
+
+
+class TestLoop:
+    def test_generate_score_train_end_to_end(self, stack):
+        """The acceptance drill: generate -> score -> train for two
+        rounds through the fleet, losses finite, versions advancing,
+        and the last round's rollouts replay bit-exactly."""
+        hy = stack.hy
+        loop = RLHFLoop(hy, reward_fn=lambda p, t: float(len(set(t))))
+        out = loop.run([stack.prompts, stack.prompts], max_new_tokens=6)
+        assert out["steps"] == 2
+        assert all(np.isfinite(loss) for loss in out["losses"])
+        # each round trains once, so round 2's rollouts sample one
+        # version later than round 1's
+        assert out["weight_versions"][1] == out["weight_versions"][0] + 1
+        # the final train step moved the policy; republish and replay the
+        # freshest records
+        hy.eval()
+        records = hy.rollout(stack.prompts[:2], max_new_tokens=6)
+        verified, skipped = hy.replay_log.verify(hy, records)
+        assert (verified, skipped) == (2, 0)
+        rep = hy.latency_report()
+        assert rep["publishes"] >= 2 and rep["generate_calls"] >= 3
+        assert rep["gather_latency_s"] > 0
+
+    def test_dpo_step_runs_on_existing_train_machinery(self):
+        """DPO: a separate engine with the DPO loss, no fleet needed —
+        the ref policy is a frozen snapshot and the step is the engine's
+        existing jitted train step."""
+        model = Transformer(tiny(vocab=VOCAB, d=32, layers=2, heads=2,
+                                 seq=32))
+        engine, *_ = sxt.initialize(model=model,
+                                    loss_fn=dpo_loss_fn(model, beta=0.2),
+                                    config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10**9,
+        })
+        hy = HybridEngineV2(engine, model, inference_config=dict(ICFG))
+        loop = RLHFLoop(hy)
+        rng = np.random.default_rng(3)
+        pairs = [(rng.integers(1, 60, size=6).tolist(),
+                  rng.integers(1, 60, size=5).tolist(),
+                  rng.integers(1, 60, size=5).tolist()) for _ in range(8)]
+        batch = loop.dpo_batch(pairs)
+        # ref log-probs are data: finite, one per row
+        assert batch["ref_chosen_lp"].shape == (8,)
+        assert np.isfinite(batch["ref_chosen_lp"]).all()
+        loss0 = loop.dpo_step(pairs)
+        assert np.isfinite(loss0)
+        # at init policy == ref, so the DPO loss is exactly -log sigmoid(0)
+        assert loss0 == pytest.approx(float(-np.log(0.5)), rel=1e-3)
+        loss1 = loop.dpo_step(pairs)
+        assert np.isfinite(loss1) and loss1 < loss0
+
+
+class TestShimAndConfig:
+    def test_record_json_shape(self):
+        rec = RolloutRecord(prompt=[1, 2], tokens=[3], weight_version=7,
+                            reward=0.5, uid=11)
+        d = json.loads(json.dumps(rec.to_json()))
+        assert RolloutRecord.from_json(d) == rec
+
+    def test_n_replicas_validation(self, stack):
+        with pytest.raises(ValueError, match="n_replicas"):
+            HybridEngineV2(stack.engine, stack.model, n_replicas=0)
+
+    def test_requires_zoo_model(self, stack):
+        with pytest.raises(TypeError, match="Transformer"):
+            HybridEngineV2(stack.engine, object())
+
+    def test_generate_v1_kwargs_greedy_noops_accepted_rest_refused(
+            self, stack):
+        """The v1 sampling kwargs are accepted at their greedy no-op
+        values and refused (named error, no silent semantics change)
+        otherwise — the scheduler's parity/replay contract is greedy and
+        has no EOS early-stop."""
+        hy = stack.hy
+        prompts = np.asarray([stack.prompts[0][:7],
+                              stack.prompts[2][:7]], np.int32)
+        out = hy.generate(prompts, max_new_tokens=2, temperature=0.0,
+                          top_k=0, top_p=1.0, eos_token_id=-1, rng=None)
+        assert out.shape == (2, 2)
+        with pytest.raises(ValueError, match="greedily"):
+            hy.generate(prompts, max_new_tokens=2, temperature=0.7)
+        with pytest.raises(ValueError, match="greedily"):
+            hy.generate(prompts, max_new_tokens=2, top_k=5)
+        with pytest.raises(ValueError, match="EOS"):
+            hy.generate(prompts, max_new_tokens=2, eos_token_id=2)
