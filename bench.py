@@ -660,6 +660,86 @@ def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
     }
 
 
+def serving_failover_row(model, params, icfg, vocab, *, n_requests=16,
+                         prompt_lo=48, prompt_hi=192, max_new=24,
+                         kill_after_ticks=4, load=2.0, seed=0):
+    """Config-5 serving-failover row (ISSUE 12): the SAME Poisson trace
+    served by a 2-replica fleet clean, then with replica 0 CRASHED
+    uncleanly mid-trace (``replica_crash`` fault at its
+    ``kill_after_ticks``-th tick, no drain, engine lost). Failover
+    re-places the dead replica's queue and in-flight requests on the
+    survivor with token-identical drain-replay, so the row's headline
+    figures are the COST of an unclean death under load: goodput
+    retention (chaos/clean sustained tokens/s), recovered-request count,
+    and the TTFT p95 delta (queueing on the halved fleet plus the retry
+    backoff). Token parity is asserted per request. Reused at toy size by
+    tests/test_bench_smoke.py so the published row cannot rot on CPU."""
+    from shuffle_exchange_tpu.inference import InferenceEngineV2
+    from shuffle_exchange_tpu.serving import ReplicaRouter
+    from shuffle_exchange_tpu.testing import faults
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+
+    def fleet():
+        return ReplicaRouter([InferenceEngineV2(model, params, icfg)
+                              for _ in range(2)])
+
+    # throwaway pass warms the shape-bin ladder; capacity calibrates the
+    # arrivals both measured runs then replay at identical offsets
+    fleet().serve(prompts, max_new_tokens=max_new)
+    cap_router = fleet()
+    cap_router.serve(prompts, max_new_tokens=max_new)
+    cap = cap_router.stats()["sustained_tokens_per_sec"]
+    span = n_requests * max_new / cap / load
+    arrivals = np.cumsum(rng.exponential(span / n_requests,
+                                         size=n_requests)).tolist()
+
+    clean_router = fleet()
+    out_clean = clean_router.serve(prompts, max_new_tokens=max_new,
+                                   arrivals=list(arrivals))
+    st_clean = clean_router.stats()
+
+    chaos_router = fleet()
+    faults.clear()
+    faults.arm("replica_crash", index=0, fire_nth=kill_after_ticks)
+    try:
+        out_chaos = chaos_router.serve(prompts, max_new_tokens=max_new,
+                                       arrivals=list(arrivals))
+    finally:
+        faults.clear()
+    st_chaos = chaos_router.stats()
+    fo = st_chaos["failover"]
+    mismatches = sum(out_chaos[u] != out_clean[u] for u in out_chaos)
+    return {
+        "n_requests": n_requests,
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "kill_after_ticks": kill_after_ticks,
+        "deaths": fo["deaths"],
+        "recovered_requests": fo["recovered_requests"],
+        "reprefill_tokens": fo["reprefill_tokens"],
+        "quarantined": len(fo["quarantined"]),
+        "token_mismatches_vs_clean": mismatches,
+        "sustained_tokens_per_sec_clean": round(
+            st_clean["sustained_tokens_per_sec"], 1),
+        "sustained_tokens_per_sec_failover": round(
+            st_chaos["sustained_tokens_per_sec"], 1),
+        "goodput_retention": round(
+            st_chaos["sustained_tokens_per_sec"]
+            / st_clean["sustained_tokens_per_sec"], 3),
+        "ttft_p50_s_clean": round(st_clean["ttft_p50_s"], 4),
+        "ttft_p95_s_clean": round(st_clean["ttft_p95_s"], 4),
+        "ttft_p50_s_failover": round(st_chaos["ttft_p50_s"], 4),
+        "ttft_p95_s_failover": round(st_chaos["ttft_p95_s"], 4),
+        "ttft_p95_delta_s": round(st_chaos["ttft_p95_s"]
+                                  - st_clean["ttft_p95_s"], 4),
+    }
+
+
 def rlhf_rollout_row(model_cfg, *, n_rollouts=8, shared_len=64,
                      suffix_lo=8, suffix_hi=32, max_new=32, flips=3,
                      kv_block=64, seed=0, toy=False):
@@ -1003,6 +1083,18 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         spec_row = None
 
+    # ---- serving failover: the same Poisson trace clean vs with one
+    # mid-trace unclean replica kill (ISSUE 12) — goodput retention,
+    # recovered-request count, and the TTFT p95 delta an unclean death
+    # costs under load, with per-request token parity asserted
+    try:
+        failover_row = serving_failover_row(model, params, icfg,
+                                            cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving failover bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        failover_row = None
+
     # ---- RLHF rollout: the hybrid engine's flip latency + rollout
     # goodput (ISSUE 11) — train -> publish -> generate cycles on a warmed
     # fleet, shared-prompt rollout batches (the prefix cache's regime),
@@ -1055,6 +1147,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_prefix_cache": prefix_row,
         "serving_fleet": fleet_row,
         "serving_speculative": spec_row,
+        "serving_failover": failover_row,
         "rlhf_rollout": rlhf_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
                                 if eng_best else None),

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Chaos drill CLI (ISSUE 12): kill/hang/revive serving replicas under a
+live Poisson trace and assert the fault-tolerance bars — zero lost
+requests, token parity with the clean run, ACTIVE-only recovery, bounded
+TTFT degradation, and (with a hang kill) KV migration with zero re-prefill
+tokens.
+
+Runs on the CPU driver box (virtual mesh not required — replicas are
+in-process engine+scheduler pairs). Wired into scripts/ci_full.sh; the
+same harness rides dryrun config 14 (__graft_entry__.dryrun_multichip)
+and, at toy size, tests/test_failover.py.
+
+Usage:
+    python scripts/chaos_drill.py                  # default crash+hang drill
+    python scripts/chaos_drill.py --kills 3:crash:0 6:hang:1 --requests 12
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the image's sitecustomize may pin a tunneled TPU platform; this drill is
+# a CPU correctness gate (same recipe as tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_backend_optimization_level" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_backend_optimization_level=0"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kills", nargs="*", default=None,
+                    help="after_request:kind:replica triples, e.g. "
+                         "4:crash:0 8:hang:1 (kind in crash|hang|"
+                         "tick_exception)")
+    ap.add_argument("--cooperative", action="store_true",
+                    help="drive ticks inline instead of threaded replicas "
+                         "(crash/tick_exception kills only)")
+    ap.add_argument("--no-revive", action="store_true")
+    ap.add_argument("--ttft-bound-x", type=float, default=None,
+                    help="assert chaos TTFT p95 <= bound * clean p95")
+    ap.add_argument("--json", action="store_true", help="machine-readable "
+                    "report on stdout")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     os.path.join(repo, ".cache", "jax")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from shuffle_exchange_tpu.inference import (InferenceConfig,
+                                                InferenceEngineV2)
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.serving import run_chaos_drill
+
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    def mk():
+        return InferenceEngineV2(model, params, InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40,
+            serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+            # detection thresholds sized for a 1-core CPU box where a
+            # NORMAL warm tick takes a few hundred ms but a COLD one can
+            # sit in a multi-second compile: the injected hang parks
+            # forever, so the generous threshold only delays detection
+            router={"heartbeat_interval_s": 0.25, "suspect_after_misses": 8,
+                    "dead_after_misses": 40, "tick_timeout_s": 10.0,
+                    "health_check_interval_s": 0.05,
+                    "poison_death_threshold": 3}))
+
+    if args.kills:
+        kills = []
+        for spec in args.kills:
+            after, kind, rid = spec.split(":")
+            kills.append((int(after), kind, int(rid)))
+    else:
+        kills = [(args.requests // 3, "crash", 0)]
+        if not args.cooperative and args.replicas > 1:
+            kills.append((2 * args.requests // 3, "hang", 1))
+
+    report = run_chaos_drill(
+        mk, n_replicas=args.replicas, n_requests=args.requests,
+        max_new=args.max_new, vocab=90, seed=args.seed, kills=kills,
+        threaded=not args.cooperative, revive=not args.no_revive,
+        ttft_p95_bound_x=args.ttft_bound_x,
+        require_migration=any(k[1] == "hang" for k in kills),
+        timeout_s=600.0, arm_wait_s=60.0)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        fo = report["failover"]
+        print(f"chaos drill: {report['finished']}/{report['n_requests']} "
+              f"finished, {report['lost']} lost, "
+              f"{report['token_mismatches']} token mismatches, "
+              f"{fo['deaths']} deaths -> {fo['recovered_requests']} "
+              f"recovered ({fo['migrated_sequences']} KV-migrated, "
+              f"{fo['reprefill_tokens']} re-prefill tokens), "
+              f"shed {report['shed']}, active_only={report['active_only']}, "
+              f"ttft_p95 {report['ttft_p95_s_clean']} -> "
+              f"{report['ttft_p95_s_chaos']}")
+    print("chaos drill: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

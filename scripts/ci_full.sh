@@ -38,8 +38,20 @@ python -m pytest tests/test_prefix_cache.py tests/test_kv_quant.py -q "$@"
 # Multi-host serving front gates (ISSUE 7): router placement/sticky/parity
 # + SIGTERM drain with zero lost requests, and the disaggregated
 # prefill->decode transfer (wire-format roundtrip incl. quantized scale
-# planes, handshake atomicity on reject, crash-mid-transfer cleanliness).
+# planes, handshake atomicity on reject, crash-mid-transfer cleanliness,
+# drain-vs-inflight-transfer quiesce compose).
 python -m pytest tests/test_serving_router.py tests/test_disagg.py -q "$@"
+# Fleet fault tolerance gates (ISSUE 12): heartbeat health states with
+# hysteresis, unclean-crash failover with token-identical drain-replay,
+# hung-replica KV migration with zero re-prefill tokens, deadlines/retry
+# backoff/poison quarantine/load shedding with typed errors, and the
+# clock-driven multi-kill chaos matrix (@slow cases included here).
+python -m pytest tests/test_failover.py -q "$@"
+# The chaos drill end to end as a script (the operator entry point):
+# 3 replicas under a Poisson trace, one crashed + one hung mid-trace,
+# revived through the factory — zero lost requests, token parity with
+# the clean run, KV migration, ACTIVE-only recovery.
+python scripts/chaos_drill.py
 # Speculative-decoding gates (ISSUE 8): exact-token parity vs decode_loop
 # across k, one-dispatch verify ticks + warmed-server zero-recompile,
 # the steps-per-token bar, rejected-draft KV rewind atomicity vs the
@@ -64,6 +76,7 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_serving_router.py \
     --ignore=tests/test_disagg.py \
+    --ignore=tests/test_failover.py \
     --ignore=tests/test_speculative.py \
     --ignore=tests/test_rlhf.py \
     --ignore=tests/test_hybrid_engine.py "$@"

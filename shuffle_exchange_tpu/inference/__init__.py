@@ -16,7 +16,8 @@ from .engine import InferenceEngine, init_inference, load_serving_weights
 from .paged import BlockedAllocator, PagedKVCache
 from .engine_v2 import (ImportReservation, InferenceEngineV2, KVBlockPayload,
                         SequenceDescriptor)
-from .scheduler import ContinuousBatchingScheduler, ServingRequest
+from .scheduler import (ContinuousBatchingScheduler, DeadlineExceededError,
+                        ServingRequest)
 from .speculative import DraftModelDrafter, NGramDrafter, make_drafter
 
 __all__ = [
@@ -37,5 +38,6 @@ __all__ = [
     "KVBlockPayload",
     "SequenceDescriptor",
     "ContinuousBatchingScheduler",
+    "DeadlineExceededError",
     "ServingRequest",
 ]

@@ -245,6 +245,41 @@ class RouterConfig:
     # least-recently-used (0 = unbounded)
     retain_finished: int = 4096
     max_sessions: int = 65536
+    # -- fleet fault tolerance (ISSUE 12, serving/health.py + failover) --
+    # Health: every replica heartbeats at tick entry; the monitor marks a
+    # replica SUSPECT after `suspect_after_misses` expected beat periods
+    # without one and DEAD after `dead_after_misses` (hysteresis: a
+    # SUSPECT replica that beats returns to ACTIVE; DEAD is terminal and
+    # triggers failover). A tick still IN FLIGHT counts as missing beats,
+    # so a hung dispatch and a dead process converge on the same
+    # thresholds; `tick_timeout_s` > 0 additionally arms a per-tick
+    # watchdog (runtime/resilience.py idiom) that logs + counts the hang
+    # the moment it exceeds the timeout, without waiting for the miss
+    # budget. `tick_exception_strikes` consecutive RAISED ticks escalate
+    # a SUSPECT replica to DEAD (one success resets the streak).
+    heartbeat_interval_s: float = 0.25
+    suspect_after_misses: int = 2
+    dead_after_misses: int = 8
+    health_check_interval_s: float = 0.05
+    tick_timeout_s: float = 0.0
+    tick_exception_strikes: int = 3
+    # Failover: a request whose replica died mid-execution is re-placed on
+    # a survivor at most `max_retries` times, backed off exponentially
+    # (`retry_backoff_s * 2**(retries-1)` before it may pack again); after
+    # `poison_death_threshold` replica deaths mid-execution it is
+    # QUARANTINED (failed with a typed error) so one pathological input
+    # cannot serially take the fleet down. `kv_migration` moves a HUNG
+    # (reachable) replica's committed KV blocks to the survivor over the
+    # disagg transfer channel instead of re-prefilling.
+    max_retries: int = 3
+    retry_backoff_s: float = 0.05
+    poison_death_threshold: int = 2
+    kv_migration: bool = True
+    # Load shedding: 0 = off; otherwise new admissions are rejected with
+    # a typed LoadShedError once the fleet's total queued requests cross
+    # the bound (the SLO guard: a queue past this depth means deadlines
+    # are already lost — refusing loudly beats timing out silently).
+    shed_queue_depth: int = 0
 
     def __post_init__(self):
         if self.num_replicas < 1:
@@ -271,6 +306,35 @@ class RouterConfig:
                 raise ConfigError(
                     f"router.{name} must be an int >= 0 (0 = unbounded), "
                     f"got {v!r}")
+        for name in ("heartbeat_interval_s", "health_check_interval_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or v <= 0:
+                raise ConfigError(f"router.{name} must be > 0, got {v!r}")
+        for name in ("suspect_after_misses", "dead_after_misses",
+                     "tick_exception_strikes", "max_retries",
+                     "poison_death_threshold"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ConfigError(
+                    f"router.{name} must be an int >= 1, got {v!r}")
+        if self.suspect_after_misses > self.dead_after_misses:
+            raise ConfigError(
+                f"router.suspect_after_misses ({self.suspect_after_misses}) "
+                f"must not exceed dead_after_misses "
+                f"({self.dead_after_misses}) — a replica must pass through "
+                f"SUSPECT before DEAD (the hysteresis window)")
+        for name in ("tick_timeout_s", "retry_backoff_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ConfigError(f"router.{name} must be >= 0, got {v!r}")
+        if not isinstance(self.kv_migration, bool):
+            raise ConfigError(
+                f"router.kv_migration must be a bool, got "
+                f"{self.kv_migration!r}")
+        if not isinstance(self.shed_queue_depth, int) or self.shed_queue_depth < 0:
+            raise ConfigError(
+                f"router.shed_queue_depth must be an int >= 0 (0 = off), "
+                f"got {self.shed_queue_depth!r}")
 
 
 @dataclasses.dataclass

@@ -85,6 +85,13 @@ class KVBlockPayload:
     v_scale: Optional[np.ndarray]
     kv_cache_dtype: str
     block_size: int
+    # the serving weight version the exported KV was computed under
+    # (ISSUE 12): KV bytes are only valid against the weights that wrote
+    # them, so a failover migration from a replica that missed a fleet
+    # publish must be refused (commit_import validates) and fall back to
+    # re-prefill under the survivor's weights. None (a pre-ISSUE-12
+    # payload) skips the check.
+    weight_version: Optional[int] = None
 
     def arrays(self) -> List[np.ndarray]:
         """The device payload planes in wire order (data, then scales)."""
@@ -1442,6 +1449,7 @@ class InferenceEngineV2(InferenceEngine):
             v_scale=None if vsc is None else np.asarray(vsc[:, idx]),
             kv_cache_dtype=self.config.kv_cache_dtype,
             block_size=bs,
+            weight_version=self.weight_version,
         )
 
     @atomic_on_reject
@@ -1525,6 +1533,13 @@ class InferenceEngineV2(InferenceEngine):
                 f"wire-format mismatch: payload kv_cache_dtype "
                 f"{payload.kv_cache_dtype!r}, this pool stores "
                 f"{self.config.kv_cache_dtype!r}")
+        if (payload.weight_version is not None
+                and payload.weight_version != self.weight_version):
+            raise ValueError(
+                f"weight-version mismatch: payload KV was computed under "
+                f"version {payload.weight_version} but this engine serves "
+                f"version {self.weight_version} — KV bytes are only valid "
+                f"against the weights that wrote them (re-prefill instead)")
         if payload.seen_tokens != resv.n_tokens:
             raise ValueError(
                 f"payload carries {payload.seen_tokens} tokens but the "

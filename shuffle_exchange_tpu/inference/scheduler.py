@@ -57,6 +57,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..monitor import InMemoryMonitor, Monitor
+from ..testing import faults
 from ..utils.invariants import atomic_on_reject
 from ..utils.logging import logger
 from .config import ServingConfig
@@ -64,6 +65,24 @@ from .engine_v2 import InferenceEngineV2
 from .paged import blocks_needed
 
 QUEUED, PREFILL, RUNNING, FINISHED = "queued", "prefill", "running", "finished"
+FAILED = "failed"
+
+
+class DeadlineExceededError(RuntimeError):
+    """A request outlived its ``deadline_s`` before finishing (ISSUE 12).
+    Deterministic and named: the message carries the uid, the deadline vs
+    elapsed time, and the replica's state at expiry; the error object is
+    retained on ``ServingRequest.error`` for the caller."""
+
+    def __init__(self, uid: int, deadline_s: float, elapsed_s: float,
+                 replica_id: int, generated: int, fleet_state: str):
+        self.uid = uid
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+        super().__init__(
+            f"request {uid} exceeded its {deadline_s:.3f}s deadline "
+            f"({elapsed_s:.3f}s elapsed, {generated} tokens generated) on "
+            f"replica {replica_id} [{fleet_state}]")
 
 
 @dataclasses.dataclass
@@ -87,6 +106,19 @@ class ServingRequest:
     # speculation on, decode_ticks / len(generated) is the per-sequence
     # steps-per-emitted-token — the lever speculative decoding pulls
     decode_ticks: int = 0
+    # request-level robustness (ISSUE 12): ``deadline_s`` caps wall time
+    # from submission (expired requests FAIL with a typed error at the
+    # next tick boundary); ``not_before`` is the failover backoff gate —
+    # a re-placed request yields its packing slot until the clock passes
+    # it; ``retries`` counts failover re-placements and
+    # ``replica_deaths`` the replica deaths it was mid-execution for
+    # (the poison-quarantine signal). ``error`` retains the typed error
+    # a FAILED request died with.
+    deadline_s: Optional[float] = None
+    not_before: float = 0.0
+    retries: int = 0
+    replica_deaths: int = 0
+    error: Optional[BaseException] = None
 
     @property
     def prefill_target(self) -> List[int]:
@@ -125,6 +157,13 @@ class ContinuousBatchingScheduler:
         # a draining replica (SIGTERM'd, or scaled away) admits nothing
         # new; its unfinished requests are exported for requeue elsewhere
         self.draining = False
+        # a FENCED replica was declared dead by the health layer while a
+        # tick might still be in flight (hang): the zombie tick must emit
+        # nothing when it finally returns — its requests were already
+        # snapshotted and re-placed on survivors, so a late emission would
+        # duplicate tokens. A bare bool write (no lock): the failover path
+        # cannot take this replica's lock, the hung tick holds it.
+        self.fenced = False
         self.cfg: ServingConfig = engine.config.serving
         self.queue: Deque[ServingRequest] = deque()  # FIFO; preempted at front
         self.active: List[ServingRequest] = []       # admission order
@@ -138,6 +177,7 @@ class ContinuousBatchingScheduler:
         self._sinks: List[Monitor] = [monitor] if monitor is not None else []
         self.ticks = 0
         self.preemptions = 0
+        self.deadline_expired = 0
         self._next_uid = 0
         # speculative decoding (ISSUE 8): k drafts per running sequence
         # per tick, verified in the same one-dispatch mixed step. The
@@ -160,10 +200,16 @@ class ContinuousBatchingScheduler:
 
     @atomic_on_reject(check="validate")
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               uid: Optional[int] = None) -> int:
+               uid: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its uid. Validates against the
         engine's hard caps up front so impossible requests fail at submit
-        time with named numbers, not mid-serve."""
+        time with named numbers, not mid-serve. ``deadline_s`` caps the
+        request's wall time from submission (ISSUE 12): a request still
+        unfinished past it FAILS with a typed ``DeadlineExceededError``
+        at the next tick boundary instead of holding budget forever."""
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         if self.draining:
             raise RuntimeError(
                 f"replica {self.replica_id} is draining and admits no new "
@@ -201,7 +247,8 @@ class ContinuousBatchingScheduler:
             raise ValueError(f"uid {uid} is already live")
         r = ServingRequest(uid=uid, prompt=prompt,
                            max_new_tokens=int(max_new_tokens),
-                           submitted_at=self.clock())
+                           submitted_at=self.clock(),
+                           deadline_s=deadline_s)
         self.requests[uid] = r
         self.queue.append(r)
         return uid
@@ -244,6 +291,46 @@ class ContinuousBatchingScheduler:
         if r in self.active:
             self.active.remove(r)
 
+    def fail(self, r: ServingRequest, err: BaseException, now: float) -> None:
+        """Terminally fail a request (deadline expiry, poison quarantine,
+        retries exhausted): frees its KV, records the typed error on the
+        request, and removes it from the queue/running set. Partial
+        ``generated`` tokens stay readable on the request."""
+        r.state = FAILED
+        r.error = err
+        r.finished_at = now
+        if r.uid in self.engine._seqs:
+            self.engine.flush([r.uid])
+        if self.drafter is not None:
+            self.drafter.forget(r.uid)
+        if r in self.active:
+            self.active.remove(r)
+        if r in self.queue:
+            self.queue.remove(r)
+        logger.warning(f"serving: replica {self.replica_id} failed uid "
+                       f"{r.uid}: {err}")
+
+    def _expire_deadlines(self, now: float, events: list) -> None:
+        """Fail every live request past its deadline (ISSUE 12). Runs at
+        tick entry — the dispatch boundary — so an expiry never interleaves
+        a half-executed tick, and the freed budget/KV goes to requests that
+        can still meet theirs."""
+        for r in [a for a in self.active] + list(self.queue):
+            if r.deadline_s is None:
+                continue
+            elapsed = now - r.submitted_at
+            if elapsed <= r.deadline_s:
+                continue
+            state = (f"state={r.state} queue_depth={len(self.queue)} "
+                     f"running={len(self.active)} draining={self.draining}")
+            err = DeadlineExceededError(r.uid, r.deadline_s, elapsed,
+                                        self.replica_id, len(r.generated),
+                                        state)
+            self.fail(r, err, now)
+            self.deadline_expired += 1
+            events.append(("serving/deadline_expired",
+                           self.deadline_expired, self.ticks))
+
     def _emit(self, r: ServingRequest, tok: int, now: float, events: list) -> None:
         r.generated.append(tok)
         if r.first_token_at is None:
@@ -271,6 +358,21 @@ class ContinuousBatchingScheduler:
         eng, cfg = self.engine, self.cfg
         bs = eng.cache.block_size
 
+        # -1) fault sites (ISSUE 12, armed per replica id): all three land
+        # HERE, at tick entry — the dispatch boundary, where a real
+        # preemption becomes observable — so a tripped fault never leaves
+        # a half-executed tick. A hang parks until the failover path
+        # fences this scheduler (or the drill releases it); the fence
+        # check right after makes the woken zombie emit nothing.
+        if faults.ACTIVE:
+            faults.maybe_hang("replica_hang", self.replica_id,
+                              wake=lambda: self.fenced)
+            faults.maybe_crash("replica_crash", self.replica_id,
+                               exc=faults.ReplicaCrashed)
+            faults.maybe_crash("tick_exception", self.replica_id)
+        if self.fenced:
+            return False
+
         # 0) tick boundary (ISSUE 11): a deferred weight commit
         # (reload_weights/publish_weights with defer=True) lands HERE —
         # the previous tick's dispatch has fully drained and the next has
@@ -283,6 +385,14 @@ class ContinuousBatchingScheduler:
                 f"serving: replica {self.replica_id} applied deferred "
                 f"weight swap at tick boundary (now version "
                 f"{eng.weight_version})")
+
+        # 0.5) request deadlines (ISSUE 12): expire before packing, so an
+        # expired request's budget and KV blocks fund live ones this tick
+        now0 = self.clock()
+        pre_events: list = []
+        self._expire_deadlines(now0, pre_events)
+        if pre_events:
+            self._write_events(pre_events)
 
         # 1) decode set: every running sequence takes one budget slot — or
         # 1+k slots when its drafter proposes k tokens this tick (ISSUE 8:
@@ -357,6 +467,13 @@ class ContinuousBatchingScheduler:
             if budget_left <= 0:
                 break
             from_queue = r.state == QUEUED
+            if from_queue and r.not_before > now0:
+                # failover backoff (ISSUE 12): a re-placed request yields
+                # its packing slot until its backoff window passes — the
+                # one sanctioned exception to strict FIFO, since holding
+                # the head would stall every request behind it for the
+                # whole backoff
+                continue
             if from_queue and len(self.active) + len(admitted) >= cfg.max_running:
                 break
             target = r.prefill_target
@@ -402,6 +519,10 @@ class ContinuousBatchingScheduler:
         if not decodes and not prefills:
             if not (self.active or self.queue):
                 return False
+            if any(r.not_before > now0 for r in self.queue):
+                # everything eligible is in its failover backoff window —
+                # work remains, it just may not pack yet
+                return True
             head = next((r for r in self.active if r.state == PREFILL),
                         self.queue[0] if self.queue else None)
             if head is None:     # running set exists; it will free budget
@@ -433,6 +554,11 @@ class ContinuousBatchingScheduler:
                 [(r.uid, c) for r, c in prefills])
             sres = []
         tick_s = self.clock() - t0
+        if self.fenced:
+            # the health layer declared this replica dead while the
+            # dispatch was in flight: its requests were snapshotted and
+            # re-placed on survivors — emitting now would duplicate tokens
+            return False
 
         # 5) results: decode tokens stream immediately; a verify row
         # streams its accepted drafts plus the verifier's correction/bonus
@@ -572,6 +698,54 @@ class ContinuousBatchingScheduler:
         else:
             self.queue.append(r)
 
+    @atomic_on_reject(check="validate")
+    def adopt_running(self, r: ServingRequest) -> None:
+        """Adopt a request whose KV was MIGRATED into this replica's
+        engine (hung-replica failover, ISSUE 12): the sequence is already
+        live engine-side (``commit_import``), so it enters the running
+        set directly and its next tick is a plain decode token — zero
+        re-prefill tokens. Everything is validated before any mutation; a
+        refusal leaves both scheduler and engine untouched, and the
+        caller falls back to ``inject()`` (drain-replay re-prefill)."""
+        if self.draining:
+            raise RuntimeError(
+                f"replica {self.replica_id} is draining and admits no new "
+                f"requests (route to a surviving replica)")
+        if r.uid in self.requests:
+            raise ValueError(f"uid {r.uid} is already live on replica "
+                             f"{self.replica_id}")
+        if not r.generated:
+            raise ValueError(
+                f"uid {r.uid} has no generated tokens — a migrated "
+                f"sequence must be mid-decode; inject() fresh requests")
+        desc = self.engine._seqs.get(r.uid)
+        if desc is None:
+            raise ValueError(
+                f"uid {r.uid} has no imported KV on replica "
+                f"{self.replica_id} — commit_import first, or inject() "
+                f"for re-prefill")
+        want = len(r.prompt) + len(r.generated) - 1
+        if desc.seen_tokens != want:
+            raise ValueError(
+                f"uid {r.uid}: imported KV covers {desc.seen_tokens} "
+                f"tokens but the request's history needs {want} (prompt "
+                f"{len(r.prompt)} + generated {len(r.generated)} - 1 "
+                f"pending); the migrated pool state is torn")
+        total = len(r.prompt) + r.max_new_tokens
+        if total > self.engine.config.max_seq_len:
+            raise ValueError(
+                f"replica {self.replica_id}: request {r.uid} needs {total} "
+                f"tokens but max_seq_len is "
+                f"{self.engine.config.max_seq_len}")
+        if len(self.active) >= self.cfg.max_running:
+            raise RuntimeError(
+                f"replica {self.replica_id}: running set is at max_running"
+                f"={self.cfg.max_running}; requeue uid {r.uid} instead")
+        r.state = RUNNING
+        r.prefill_done = len(r.prompt) + len(r.generated)
+        self.requests[r.uid] = r
+        self.active.append(r)
+
     def load(self) -> Dict[str, object]:
         """Cheap placement snapshot for the router: queue depth, running
         set, and KV-pool pressure, every tick-independent number the
@@ -596,12 +770,15 @@ class ContinuousBatchingScheduler:
 
     def serve(self, requests: Sequence[Union[Sequence[int], Tuple[Sequence[int], int]]],
               max_new_tokens: int = 32,
-              arrivals: Optional[Sequence[float]] = None) -> Dict[int, List[int]]:
+              arrivals: Optional[Sequence[float]] = None,
+              deadline_s: Optional[float] = None) -> Dict[int, List[int]]:
         """Serve a batch of requests to completion, continuous-batching
         style. ``requests``: prompts, or ``(prompt, max_new)`` pairs.
         ``arrivals``: optional arrival offsets in seconds (e.g. a Poisson
         trace) — request i is submitted once ``clock() - t0 >=
-        arrivals[i]``; None submits everything up front. Returns
+        arrivals[i]``; None submits everything up front. ``deadline_s``
+        applies one per-request deadline to every submission (an expired
+        request FAILS with its partial tokens retained). Returns
         ``{uid: generated tokens}`` in submission order."""
         items = []
         for req in requests:
@@ -619,7 +796,8 @@ class ContinuousBatchingScheduler:
             while pending and (arrivals is None
                                or self.clock() - t0 >= arrivals[pending[0][0]]):
                 _, (prompt, mn) = pending.popleft()
-                uids.append(self.submit(prompt, max_new_tokens=mn))
+                uids.append(self.submit(prompt, max_new_tokens=mn,
+                                        deadline_s=deadline_s))
             if not self.tick() and pending and arrivals is not None:
                 # idle: sleep until the next arrival is due (clock() may be
                 # a test fake, so never pass a negative to sleep)
@@ -665,6 +843,12 @@ class ContinuousBatchingScheduler:
             "tpot_p99_s": pct(tpot, 99),
             "ticks": self.ticks,
             "preemptions": self.preemptions,
+            # request-level robustness (ISSUE 12): terminally-failed
+            # requests by cause — deadline expiries counted here, poison
+            # quarantines / exhausted retries land via router fail()s
+            "failed": sum(1 for r in self.requests.values()
+                          if r.state == FAILED),
+            "deadline_expired": self.deadline_expired,
             "compiled_programs": len(self.engine.program_shapes),
             "weight_version": eng.weight_version,
             "prefix_cache": {

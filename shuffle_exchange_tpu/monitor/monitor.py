@@ -306,6 +306,20 @@ class FleetMonitor(Monitor):
                 spec["accepted"] / spec["proposed"]
                 if spec.get("proposed") else None)
             out["speculative"] = spec
+        # fleet fault tolerance (ISSUE 12): the router writes the
+        # fleet/health/*, failover/* and shed/* counter groups straight
+        # into the ring (they are fleet-level, not per-replica); the
+        # aggregate surfaces each label's LATEST value so SLO dashboards
+        # see health/failover/shed state next to the latency tails
+        for group, prefix in (("health", "fleet/health/"),
+                              ("failover", "failover/"),
+                              ("shed", "shed/")):
+            vals = {}
+            for lbl, v, _ in events:
+                if lbl.startswith(prefix):
+                    vals[lbl[len(prefix):]] = v
+            if vals:
+                out[group] = vals
         return out
 
     def publish(self, step: "int | None" = None) -> dict:
@@ -322,6 +336,13 @@ class FleetMonitor(Monitor):
         events += [(f"fleet/speculative/{k}", v, self._step)
                    for k, v in (agg.get("speculative") or {}).items()
                    if isinstance(v, (int, float))]
+        # fault-tolerance groups (ISSUE 12) ride downstream under fleet/*
+        # namespacing (health labels are already fleet/health/<k> in the
+        # ring; failover/shed gain the fleet/ prefix here)
+        for group in ("health", "failover", "shed"):
+            events += [(f"fleet/{group}/{k}", v, self._step)
+                       for k, v in (agg.get(group) or {}).items()
+                       if isinstance(v, (int, float))]
         if self.downstream is not None and events:
             self.downstream.write_events(events)
         self.write_events(events)

@@ -91,11 +91,15 @@ class StepWatchdog:
     """Flags steps that exceed ``timeout_s``. The timer fires on a daemon
     thread; it never kills the step (a TPU program cannot be safely
     interrupted mid-flight) — it makes the hang VISIBLE: a log line + a
-    monitor counter an operator can alert on."""
+    monitor counter an operator can alert on. ``name`` labels the watched
+    unit (the training engine's global step, or a serving replica's tick —
+    serving/health.py arms one per replica)."""
 
-    def __init__(self, timeout_s: float, on_hang: Callable[[int, float], None]):
+    def __init__(self, timeout_s: float, on_hang: Callable[[int, float], None],
+                 name: str = "step"):
         self.timeout_s = timeout_s
         self.on_hang = on_hang
+        self.name = name
         self._timer: Optional[threading.Timer] = None
         self.hung_steps = 0
 
@@ -105,6 +109,7 @@ class StepWatchdog:
         self.stop()
         self._timer = threading.Timer(self.timeout_s, self._fire, args=(step,))
         self._timer.daemon = True
+        self._timer.name = f"watchdog-{self.name}"
         self._timer.start()
 
     def _fire(self, step: int) -> None:

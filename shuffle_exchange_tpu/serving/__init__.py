@@ -9,20 +9,38 @@ workers (the ``PagedKVCache`` block is the wire format, staged through the
 AIO pinned-buffer pool under an atomic admission handshake), and
 ``lifecycle.py`` wires SIGTERM to drain-and-requeue and the queue-depth
 autoscaler to the fleet (``launcher.elastic_agent.AutoscalePolicy``).
+
+ISSUE 12 adds the UNCLEAN-failure layer: ``health.py`` (heartbeat
+ACTIVE/SUSPECT/DEAD state machine with hysteresis), the router's
+``fail_over`` (fence + token-identical re-placement, KV migration from
+hung replicas), per-request deadlines/retries/poison-quarantine/load
+shedding with typed errors, and ``chaos.py`` (the kill/hang/revive drill
+harness behind ``scripts/chaos_drill.py`` and dryrun config 14).
 """
 
-from .disagg import DisaggregatedServer, KVTransferChannel
+from .chaos import run_chaos_drill
+from .disagg import DisaggregatedServer, KVTransferChannel, TransferAborted
+from .health import HealthMonitor
 from .lifecycle import (ElasticServingSupervisor, install_sigterm_drain,
                         uninstall_sigterm_drain)
-from .router import Replica, ReplicaRouter, fleet_commands
+from .router import (LoadShedError, NoActiveReplicaError,
+                     PoisonQuarantinedError, Replica, ReplicaRouter,
+                     RetriesExhaustedError, fleet_commands)
 
 __all__ = [
     "DisaggregatedServer",
     "KVTransferChannel",
+    "TransferAborted",
+    "HealthMonitor",
     "ElasticServingSupervisor",
     "install_sigterm_drain",
     "uninstall_sigterm_drain",
+    "LoadShedError",
+    "NoActiveReplicaError",
+    "PoisonQuarantinedError",
+    "RetriesExhaustedError",
     "Replica",
     "ReplicaRouter",
     "fleet_commands",
+    "run_chaos_drill",
 ]

@@ -1,0 +1,301 @@
+"""Chaos drill: kill/hang/revive replicas under a live Poisson trace.
+
+The fault-tolerance layer (ISSUE 12) is only trustworthy if it is
+continuously exercised, the same argument that put the checkpoint
+fault-injection seam into CI (PR 2). This module drives a ReplicaRouter
+fleet through a Poisson arrival trace while deterministically arming
+``replica_crash`` / ``replica_hang`` / ``tick_exception`` fault sites
+(``testing/faults.py``) against live replicas, optionally reviving the
+fleet through the engine factory, and then asserts the ISSUE 12
+acceptance bars:
+
+- **zero lost requests**: every non-shed request reaches a terminal
+  state, and (with quarantine/deadlines off) every one FINISHES;
+- **token parity**: every finished request's tokens are identical to a
+  sequential single-engine reference — greedy decoding makes the
+  crash-replay deterministic, so unclean failure costs latency, never
+  output fidelity;
+- **ACTIVE-only recovery**: once the trace drains, every non-stopped
+  replica is healthy (no SUSPECT residue, every DEAD replica fenced and
+  failed over);
+- **bounded TTFT degradation**: chaos-run TTFT p95 within
+  ``ttft_p95_bound_x`` of the clean run's (both runs serve the identical
+  trace at identical arrival offsets).
+
+Used by ``scripts/chaos_drill.py`` (CLI + CI), dryrun config 14
+(``__graft_entry__.dryrun_multichip``), and — at toy size, with the
+heavy multi-kill matrix marked ``@slow`` — ``tests/test_failover.py``.
+
+Kill schedule: ``(after_request, kind, replica_id)`` triples. When the
+submission index reaches ``after_request``, the drill waits (bounded)
+for the target replica to hold admitted work, then arms the fault with
+``fire_nth=1`` — it trips at the replica's very next tick entry. Arming
+against observed fleet state (rather than a wall-clock offset) is what
+makes the drill reproducible on machines of any speed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..testing import faults
+from ..utils.logging import logger
+from .router import LoadShedError, ReplicaRouter
+
+KINDS = ("crash", "hang", "tick_exception")
+_SITE = {"crash": "replica_crash", "hang": "replica_hang",
+         "tick_exception": "tick_exception"}
+
+
+def _reference_tokens(engine_factory, prompts: Sequence[List[int]],
+                      max_new: int) -> List[List[int]]:
+    """Sequential single-engine greedy reference — the parity oracle."""
+    eng = engine_factory()
+    out = []
+    for i, p in enumerate(prompts):
+        lg = eng.put([i], [p])
+        first = int(np.argmax(lg[0]))
+        toks = [first]
+        if max_new > 1:
+            toks += [int(t) for t in eng.decode_loop([i], [first],
+                                                     max_new - 1)[0]]
+        eng.flush([i])
+        out.append(toks)
+    return out
+
+
+def _poisson_arrivals(n: int, span_s: float, rng) -> List[float]:
+    return np.cumsum(rng.exponential(span_s / max(1, n), size=n)).tolist()
+
+
+def _serve_clean(engine_factory, n_replicas: int,
+                 prompts, arrivals, max_new: int) -> Dict[str, object]:
+    router = ReplicaRouter([engine_factory() for _ in range(n_replicas)])
+    out = router.serve(prompts, max_new_tokens=max_new,
+                       arrivals=list(arrivals))
+    st = router.stats()
+    return {"tokens": [out[u] for u in out], "stats": st}
+
+
+def _replica_has_work(router: ReplicaRouter, rid: int, kind: str) -> bool:
+    if rid >= len(router.replicas):
+        return False
+    rep = router.replicas[rid]
+    if rep.state != "active":
+        return False
+    if kind == "hang":
+        # a hang drill exists to exercise KV migration: wait until the
+        # target holds a RUNNING (mid-decode) sequence, the migratable kind
+        return any(r.state == "running" for r in rep.scheduler.active)
+    return bool(rep.scheduler.active) or bool(rep.scheduler.queue)
+
+
+def run_chaos_drill(engine_factory: Callable[[], object], *,
+                    n_replicas: int = 3,
+                    n_requests: int = 12,
+                    prompt_lo: int = 6, prompt_hi: int = 24,
+                    max_new: int = 8,
+                    vocab: int = 90,
+                    seed: int = 0,
+                    kills: Optional[Sequence[Tuple[int, str, int]]] = None,
+                    threaded: bool = True,
+                    revive: bool = True,
+                    deadline_s: Optional[float] = None,
+                    ttft_p95_bound_x: Optional[float] = None,
+                    require_migration: bool = False,
+                    timeout_s: float = 180.0,
+                    arm_wait_s: float = 15.0,
+                    check: bool = True) -> Dict[str, object]:
+    """Run the drill; returns a machine-readable report (and raises
+    ``AssertionError`` on a violated bar unless ``check=False``).
+
+    ``kills``: ``(after_request, kind, replica_id)`` with kind in
+    ``{"crash", "hang", "tick_exception"}``; default is one mid-trace
+    crash of replica 0 and, in threaded mode, one hang of replica 1.
+    ``threaded`` runs one tick thread per replica plus the health-monitor
+    thread (hang detection needs it: a cooperative caller would hang with
+    the replica); cooperative mode drives ``router.tick()`` inline and
+    supports crash/tick_exception kills only. ``require_migration``
+    additionally asserts at least one sequence resumed via KV migration
+    with zero re-prefill tokens (arm a hang against a replica holding
+    RUNNING work). ``arm_wait_s`` bounds the wait for the kill target to
+    hold (RUNNING) work before arming — raise it on cold caches, where a
+    tick can sit in a multi-second compile.
+
+    Sizing ``router.tick_timeout_s`` for the drill host matters: the
+    injected hang parks FOREVER, so a generous threshold only delays
+    detection — but a threshold under the host's real worst-case tick
+    (cold compiles on a 1-core CPU box easily exceed seconds) falsely
+    kills healthy replicas and the failover churn convoys behind their
+    own slow ticks."""
+    if kills is None:
+        kills = [(n_requests // 3, "crash", 0)]
+        if threaded and n_replicas > 1:
+            kills = kills + [(2 * n_requests // 3, "hang", 1)]
+    for _, kind, _rid in kills:
+        if kind not in KINDS:
+            raise ValueError(f"unknown kill kind {kind!r}; known: {KINDS}")
+        if kind == "hang" and not threaded:
+            raise ValueError("hang kills need threaded=True (a cooperative "
+                             "caller would hang with the replica)")
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+    reference = _reference_tokens(engine_factory, prompts, max_new)
+
+    # clean run calibrates the arrival span AND the TTFT baseline: total
+    # service time / 2 offers ~2x capacity, the heavy-traffic regime
+    probe = ReplicaRouter([engine_factory() for _ in range(n_replicas)])
+    probe.serve(prompts, max_new_tokens=max_new)
+    cap = probe.stats()["sustained_tokens_per_sec"] or 1.0
+    span = n_requests * max_new / cap / 2.0
+    arrivals = _poisson_arrivals(n_requests, span, rng)
+    clean = _serve_clean(engine_factory, n_replicas, prompts, arrivals,
+                         max_new)
+    assert clean["tokens"] == reference, (
+        "clean fleet run diverges from the sequential reference — fix "
+        "serving before drilling faults")
+
+    # ---- chaos run ----------------------------------------------------
+    router = ReplicaRouter([engine_factory() for _ in range(n_replicas)],
+                           engine_factory=engine_factory if revive else None)
+    if (any(k == "hang" for _, k, _ in kills)
+            and router.rcfg.tick_timeout_s <= 0):
+        raise ValueError(
+            "hang kills need router.tick_timeout_s > 0 — hang-to-DEAD "
+            "detection is opt-in (a cold server's compiles look like "
+            "hangs otherwise)")
+    pending_kills = sorted(kills)   # by after_request
+    armed: List[Tuple[str, int]] = []
+    uids: List[Optional[int]] = []
+    shed = 0
+    faults.clear()
+    if threaded:
+        router.start()
+    try:
+        t0 = router.clock()
+        i = 0
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"chaos drill did not drain in {timeout_s:.0f}s "
+                    f"(uids={len(uids)}/{n_requests}, "
+                    f"stats={router.stats()['failover']})")
+            # arm kills whose submission index has arrived
+            while pending_kills and len(uids) >= pending_kills[0][0]:
+                _, kind, rid = pending_kills.pop(0)
+                wait_until = time.monotonic() + arm_wait_s
+                while (not _replica_has_work(router, rid, kind)
+                       and time.monotonic() < wait_until):
+                    if not threaded:
+                        router.tick()
+                    else:
+                        time.sleep(0.002)
+                faults.arm(_SITE[kind], index=rid, fire_nth=1)
+                armed.append((kind, rid))
+                logger.warning(f"chaos: armed {kind} on replica {rid} "
+                               f"after {len(uids)} submissions")
+            if i < n_requests and router.clock() - t0 >= arrivals[i]:
+                try:
+                    uids.append(router.submit(prompts[i],
+                                              max_new_tokens=max_new,
+                                              deadline_s=deadline_s))
+                except LoadShedError:
+                    uids.append(None)
+                    shed += 1
+                i += 1
+                continue
+            if not threaded:
+                router.tick()
+            else:
+                time.sleep(0.002)
+            router.check_health()
+            # revive (the drill's "scale back up"): failover may have
+            # shrunk the active fleet; grow it back through the factory —
+            # _add_replica catches the newcomer up to any published
+            # weight version, the tentpole's replacement contract
+            if revive and len(router.active_replicas) < n_replicas:
+                router.scale_to(n_replicas)
+            if i >= n_requests:
+                live = [u for u in uids if u is not None]
+                if all(router.requests[u].state in ("finished", "failed")
+                       for u in live):
+                    break
+    finally:
+        if threaded:
+            router.stop()
+        faults.release_hangs()
+        faults.clear()
+
+    # ---- report + bars -------------------------------------------------
+    st = router.stats()
+    live_uids = [u for u in uids if u is not None]
+    finished = [u for u in live_uids
+                if router.requests[u].state == "finished"]
+    failed = [u for u in live_uids if router.requests[u].state == "failed"]
+    lost = [u for u in live_uids
+            if router.requests[u].state not in ("finished", "failed")]
+    mismatches = [u for j, u in enumerate(uids)
+                  if u is not None and router.requests[u].state == "finished"
+                  and router.requests[u].generated != reference[j]]
+    active_reps = [r for r in router.replicas if r.state == "active"]
+    states = router.health.states()
+    active_only = all(states.get(r.replica_id) == "active"
+                      for r in active_reps) and bool(active_reps)
+    clean_p95 = clean["stats"]["ttft_p95_s"]
+    chaos_p95 = st["ttft_p95_s"]
+    report = {
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "kills": [{"kind": k, "replica": r} for k, r in armed],
+        "shed": shed,
+        "finished": len(finished),
+        "failed": len(failed),
+        "lost": len(lost),
+        "token_mismatches": len(mismatches),
+        "failover": st["failover"],
+        "health": {rid: s for rid, s in states.items()},
+        "active_replicas": len(active_reps),
+        "active_only": active_only,
+        "ttft_p95_s_clean": clean_p95,
+        "ttft_p95_s_chaos": chaos_p95,
+        "ttft_p95_x": (chaos_p95 / clean_p95
+                       if clean_p95 and chaos_p95 else None),
+        "goodput_clean": clean["stats"]["sustained_tokens_per_sec"],
+        "goodput_chaos": st["sustained_tokens_per_sec"],
+    }
+    if check:
+        assert not lost, f"lost requests (no terminal state): {lost}"
+        if deadline_s is None:
+            quarantined = set(st["failover"]["quarantined"])
+            hard_failed = [u for u in failed if u not in quarantined]
+            assert not hard_failed, (
+                f"non-shed requests failed: "
+                f"{[(u, str(router.requests[u].error)) for u in hard_failed]}")
+        assert not mismatches, (
+            f"recovered requests diverged from the clean run: {mismatches}")
+        assert active_only, (
+            f"fleet did not return to ACTIVE-only health: {report['health']}")
+        hard_kills = sum(1 for k, _ in armed if k in ("crash", "hang"))
+        if hard_kills:
+            assert st["failover"]["deaths"] >= hard_kills, (
+                f"{hard_kills} hard kill(s) armed but only "
+                f"{st['failover']['deaths']} failover death(s) observed")
+        if require_migration:
+            assert st["failover"]["migrated_sequences"] >= 1, (
+                f"expected >= 1 KV-migrated sequence, failover stats: "
+                f"{st['failover']}")
+        if ttft_p95_bound_x and report["ttft_p95_x"] is not None:
+            assert report["ttft_p95_x"] <= ttft_p95_bound_x, (
+                f"TTFT p95 degraded {report['ttft_p95_x']:.1f}x > bound "
+                f"{ttft_p95_bound_x}x")
+    return report
+
+
+__all__ = ["run_chaos_drill", "KINDS"]

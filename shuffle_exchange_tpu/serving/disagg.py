@@ -40,9 +40,18 @@ from ..inference.engine_v2 import InferenceEngineV2, KVBlockPayload
 from ..monitor.monitor import InMemoryMonitor, Monitor
 from ..testing import faults
 from ..utils.invariants import atomic_on_reject, locked_by, requires_lock
+from ..utils.logging import logger
+
+
+class TransferAborted(RuntimeError):
+    """A KV transfer was vetoed mid-flight (``quiesce(abort=True)`` — a
+    drain racing the transfer chose abort over wait). The transfer's
+    cleanup path aborts the decode-side reservation and releases the
+    staging slot, so both engines are left exactly as before the call."""
 
 
 @locked_by("_mu", "_inflight", "_ticket", "_slots_in_use")
+@locked_by("_cv", "_busy", "_aborting")
 class KVTransferChannel:
     """Moves ``KVBlockPayload``s between engines through pinned staging.
 
@@ -84,6 +93,17 @@ class KVTransferChannel:
         # steady-state one-at-a-time case keeps reusing slot 0's
         # long-lived allocations
         self._slots_in_use: set = set()
+        # drain/transfer atomicity (ISSUE 12): per-engine in-flight
+        # transfer counts + abort votes, waited on through the condition
+        # (same underlying lock as _mu). A SIGTERM drain that would flush
+        # an engine mid-transfer calls quiesce() first — wait for the
+        # transfer to land, or abort=True to veto it at its next
+        # checkpoint — instead of racing export/commit (the payload could
+        # otherwise gather blocks a concurrent flush already freed and
+        # reallocated to another sequence).
+        self._cv = threading.Condition(self._mu)
+        self._busy: Dict[int, int] = {}        # id(engine) -> in-flight
+        self._aborting: set = set()            # id(engine) under abort veto
 
     @requires_lock("_mu")
     def _alloc_slot(self) -> int:
@@ -92,6 +112,76 @@ class KVTransferChannel:
             slot += 1
         self._slots_in_use.add(slot)
         return slot
+
+    # -- drain/transfer atomicity (ISSUE 12) ---------------------------
+
+    def _begin_use(self, *engines) -> None:
+        with self._cv:
+            for eng in engines:
+                if id(eng) in self._aborting:
+                    raise TransferAborted(
+                        "engine is quiescing (drain in progress) — no new "
+                        "transfers may start against it")
+            for eng in engines:
+                self._busy[id(eng)] = self._busy.get(id(eng), 0) + 1
+
+    def _end_use(self, *engines) -> None:
+        with self._cv:
+            for eng in engines:
+                left = self._busy.get(id(eng), 0) - 1
+                if left > 0:
+                    self._busy[id(eng)] = left
+                else:
+                    self._busy.pop(id(eng), None)
+            self._cv.notify_all()
+
+    def _abort_wanted(self, *engines) -> bool:
+        with self._cv:
+            return any(id(eng) in self._aborting for eng in engines)
+
+    def _check_abort(self, *engines) -> None:
+        if self._abort_wanted(*engines):
+            raise TransferAborted(
+                "transfer vetoed mid-flight by quiesce(abort=True)")
+
+    def in_flight(self, engine: Optional[InferenceEngineV2] = None) -> int:
+        """Transfers currently using ``engine`` (or any engine)."""
+        with self._cv:
+            if engine is not None:
+                return self._busy.get(id(engine), 0)
+            return sum(self._busy.values())
+
+    def quiesce(self, engine: InferenceEngineV2, abort: bool = False,
+                timeout_s: float = 30.0) -> None:
+        """Block until no transfer is using ``engine`` — the drain
+        barrier (ISSUE 12): a SIGTERM drain (or failover) that is about
+        to flush an engine's sequences calls this FIRST, so it either
+        waits for an in-flight transfer to land atomically or, with
+        ``abort=True``, vetoes it at its next checkpoint (the transfer's
+        cleanup aborts the decode reservation and releases staging —
+        both engines end byte-identically clean). While an abort veto is
+        pending, new transfers against the engine are refused. Raises
+        TimeoutError when the transfer neither lands nor aborts in
+        ``timeout_s`` (a wedged transfer thread — failing loudly beats a
+        silent torn flush)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            if abort:
+                self._aborting.add(id(engine))
+            try:
+                while self._busy.get(id(engine), 0) > 0:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cv.wait(timeout=left):
+                        raise TimeoutError(
+                            f"quiesce: {self._busy.get(id(engine), 0)} "
+                            f"transfer(s) still in flight against the "
+                            f"engine after {timeout_s:.1f}s "
+                            f"(abort={abort})")
+            finally:
+                if abort:
+                    self._aborting.discard(id(engine))
+        if abort:
+            logger.info("kv_transfer: engine quiesced (abort veto lifted)")
 
     def _emit(self, events) -> None:
         self.memory_monitor.write_events(events)
@@ -200,45 +290,58 @@ class KVTransferChannel:
 
         Any failure after the reservation aborts it — the decode engine
         holds no descriptor and no blocks (the ``kv_transfer`` fault site
-        drills exactly this). Returns the decode-side uid."""
+        drills exactly this). While the transfer is in flight both
+        engines are registered busy: a concurrent drain goes through
+        ``quiesce`` (wait, or ``abort=True`` to veto at the next
+        checkpoint — the ``kv_transfer_stall`` site composes them in
+        tests/test_disagg.py). Returns the decode-side uid."""
         dst_uid = uid if dst_uid is None else dst_uid
-        desc = src._seqs.get(uid)
-        if desc is None:
-            raise ValueError(f"unknown uid {uid} on the prefill engine")
-        t0 = self.clock()
+        self._begin_use(src, dst)
         try:
-            resv = dst.begin_import(dst_uid, desc.seen_tokens)
-        except RuntimeError:
-            self.rejects += 1
-            self._emit([("kv_transfer/rejects", self.rejects,
-                         self.transfers)])
-            raise
-        ticket = None
-        try:
-            faults.maybe_crash("kv_transfer", 0)
-            payload = src.export_kv_blocks(uid)
-            ticket = self.send(payload)
-            faults.maybe_crash("kv_transfer", 1)
-            wire = self.recv(ticket)
-            wire = dataclasses.replace(wire, uid=dst_uid)
-            dst.commit_import(resv, wire)
-        except BaseException:
-            dst.abort_import(resv)
-            if ticket is not None:
-                self.cancel(ticket)   # undelivered: free slot + spill file
-            raise
-        if flush_src:
-            src.flush([uid])
-        self.transfers += 1
-        self.bytes_moved += payload.nbytes
-        self.blocks_moved += len(resv.blocks)
-        self._emit([
-            ("kv_transfer/transfers", self.transfers, self.transfers),
-            ("kv_transfer/blocks", self.blocks_moved, self.transfers),
-            ("kv_transfer/bytes", self.bytes_moved, self.transfers),
-            ("kv_transfer/transfer_s", self.clock() - t0, self.transfers),
-        ])
-        return dst_uid
+            desc = src._seqs.get(uid)
+            if desc is None:
+                raise ValueError(f"unknown uid {uid} on the prefill engine")
+            t0 = self.clock()
+            try:
+                resv = dst.begin_import(dst_uid, desc.seen_tokens)
+            except RuntimeError:
+                self.rejects += 1
+                self._emit([("kv_transfer/rejects", self.rejects,
+                             self.transfers)])
+                raise
+            ticket = None
+            try:
+                faults.maybe_crash("kv_transfer", 0)
+                self._check_abort(src, dst)
+                payload = src.export_kv_blocks(uid)
+                ticket = self.send(payload)
+                faults.maybe_crash("kv_transfer", 1)
+                faults.maybe_hang("kv_transfer_stall", 0,
+                                  wake=lambda: self._abort_wanted(src, dst))
+                self._check_abort(src, dst)
+                wire = self.recv(ticket)
+                wire = dataclasses.replace(wire, uid=dst_uid)
+                dst.commit_import(resv, wire)
+            except BaseException:
+                dst.abort_import(resv)
+                if ticket is not None:
+                    self.cancel(ticket)   # undelivered: free slot + spill file
+                raise
+            if flush_src:
+                src.flush([uid])
+            self.transfers += 1
+            self.bytes_moved += payload.nbytes
+            self.blocks_moved += len(resv.blocks)
+            self._emit([
+                ("kv_transfer/transfers", self.transfers, self.transfers),
+                ("kv_transfer/blocks", self.blocks_moved, self.transfers),
+                ("kv_transfer/bytes", self.bytes_moved, self.transfers),
+                ("kv_transfer/transfer_s", self.clock() - t0,
+                 self.transfers),
+            ])
+            return dst_uid
+        finally:
+            self._end_use(src, dst)
 
     def stats(self) -> Dict[str, object]:
         return {
@@ -311,6 +414,22 @@ class DisaggregatedServer:
             uid = self._next_uid
             out[uid] = self.serve_one(p, max_new_tokens=max_new_tokens)
         return out
+
+    def drain(self, abort_transfers: bool = False) -> None:
+        """SIGTERM drain for a disaggregated pair (ISSUE 12): quiesce the
+        channel against BOTH engines first — wait for an in-flight
+        transfer to land, or veto it with ``abort_transfers=True`` — and
+        only then flush live sequences. Flushing mid-transfer would free
+        blocks the export was still gathering (a concurrent admission
+        could reuse and overwrite them, shipping another sequence's KV),
+        which is exactly the race tests/test_disagg.py composes via the
+        ``kv_transfer_stall`` fault site."""
+        for eng in (self.prefill, self.decode):
+            self.channel.quiesce(eng, abort=abort_transfers)
+        for eng in (self.prefill, self.decode):
+            live = list(eng._seqs)
+            if live:
+                eng.flush(live)
 
     def stats(self) -> Dict[str, object]:
         return {"channel": self.channel.stats()}

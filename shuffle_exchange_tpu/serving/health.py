@@ -1,0 +1,301 @@
+"""Fleet health: the heartbeat state machine behind unclean failover.
+
+PR 7's fleet handles *graceful* failure — SIGTERM drains replay
+token-identically — but a replica that dies or hangs uncleanly answers no
+drain. This module is the detection half of ISSUE 12: every replica
+stamps a heartbeat at tick entry and exit (``beat_start``/``beat_end``),
+and the monitor folds beats, thread liveness, raised ticks, and
+in-flight-tick age into a per-replica state machine
+
+    ACTIVE --(missed beats / hung tick / raised tick)--> SUSPECT
+    SUSPECT --(a completed tick)--> ACTIVE            (hysteresis)
+    SUSPECT --(miss budget / strike budget / dead thread)--> DEAD
+
+with DEAD terminal: the router fences the replica and fails its requests
+over to survivors (``serving/router.py``). Thresholds come from the
+``router`` config section (``heartbeat_interval_s``,
+``suspect_after_misses``, ``dead_after_misses``, ``tick_timeout_s``,
+``tick_exception_strikes``).
+
+Two failure shapes matter because recovery differs (ISSUE 12 tentpole):
+
+- **crash** — the replica's thread/process is gone (``is_alive`` False,
+  or a ``ReplicaCrashed`` tick). Its engine and KV pool are LOST;
+  failover re-prefills on survivors.
+- **hang** — the thread is alive but a tick never returns (wedged
+  collective, dead host callback). The engine's pool is still reachable
+  host-side, so failover migrates committed KV blocks over the disagg
+  channel instead of re-prefilling. Hang-to-DEAD is opt-in via
+  ``tick_timeout_s`` > 0: a cold server's first ticks legitimately sit in
+  multi-second compiles, and only the operator knows where "slow compile"
+  ends and "wedged" begins.
+
+The per-tick watchdog reuses ``runtime/resilience.StepWatchdog`` (the
+training engine's hung-step idiom): it makes a hang VISIBLE — log line +
+``fleet/health/hung_ticks`` counter — the moment ``tick_timeout_s``
+elapses, while the DEAD *decision* stays in ``check()``, which is
+clock-driven and therefore deterministic under a test's fake clock.
+
+Miss-based transitions only apply to replicas that report thread
+liveness (``is_alive(rid)`` not None, i.e. threaded fleets): in
+cooperative ticking the caller IS the heartbeat source, so a slow
+neighbor tick would read as a false death; cooperative failures surface
+synchronously as exceptions and route through ``strike``/``mark_dead``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..runtime.resilience import StepWatchdog
+from ..utils.invariants import locked_by, requires_lock
+from ..utils.logging import logger
+
+H_ACTIVE, H_SUSPECT, H_DEAD = "active", "suspect", "dead"
+
+
+class ReplicaHealth:
+    """One replica's health record (all fields guarded by the monitor's
+    lock; the record object never leaves the monitor)."""
+
+    def __init__(self, replica_id: int, now: float):
+        self.replica_id = replica_id
+        self.state = H_ACTIVE
+        self.last_beat = now
+        self.tick_started_at: Optional[float] = None
+        self.ticks = 0
+        self.strikes = 0           # consecutive raised ticks
+        self.hang_flagged = False  # watchdog fired on the current tick
+        self.reason = ""
+        # False once the replica is declared dead by CRASH: its engine
+        # (and KV pool) must be treated as unreachable by failover
+        self.engine_reachable = True
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"state": self.state, "strikes": self.strikes,
+                "ticks": self.ticks, "reason": self.reason,
+                "engine_reachable": self.engine_reachable}
+
+
+@locked_by("_mu", "records", "hung_ticks", "transitions")
+class HealthMonitor:
+    """Heartbeat bookkeeping + the ACTIVE/SUSPECT/DEAD state machine.
+
+    The router owns one of these; replicas stamp beats around their
+    ticks, the router (or its monitor thread) calls ``check()`` on the
+    ``health_check_interval_s`` cadence, and newly-DEAD replicas come
+    back as ``(replica_id, reason, engine_reachable)`` triples for the
+    failover path to consume. ``clock`` is injectable so the state
+    machine is unit-testable without sleeping."""
+
+    def __init__(self, rcfg, clock: Callable[[], float] = time.perf_counter):
+        self.rcfg = rcfg
+        self.clock = clock
+        self._mu = threading.Lock()
+        self.records: Dict[int, ReplicaHealth] = {}
+        self._watchdogs: Dict[int, StepWatchdog] = {}
+        self.hung_ticks = 0
+        self.transitions = 0
+
+    # -- membership ----------------------------------------------------
+
+    def register(self, replica_id: int) -> None:
+        rid = int(replica_id)
+        with self._mu:
+            self.records[rid] = ReplicaHealth(rid, self.clock())
+            if self.rcfg.tick_timeout_s > 0:
+                self._watchdogs[rid] = StepWatchdog(
+                    self.rcfg.tick_timeout_s,
+                    lambda tick, timeout, _rid=rid: self._on_hang(
+                        _rid, tick, timeout),
+                    name=f"replica{rid}-tick")
+
+    def retire(self, replica_id: int) -> None:
+        """Forget a replica that left the fleet CLEANLY (drain/stop): its
+        silence is no longer a symptom."""
+        with self._mu:
+            self.records.pop(replica_id, None)
+            wd = self._watchdogs.pop(replica_id, None)
+        if wd is not None:
+            wd.stop()
+
+    # -- heartbeats (called from the replica's tick path) --------------
+
+    def beat_start(self, replica_id: int) -> None:
+        rec = self.records.get(replica_id)
+        if rec is None:
+            return
+        with self._mu:
+            now = self.clock()
+            rec.last_beat = now
+            rec.tick_started_at = now
+            rec.ticks += 1
+        wd = self._watchdogs.get(replica_id)
+        if wd is not None:
+            wd.start(rec.ticks)
+
+    def beat_end(self, replica_id: int) -> None:
+        wd = self._watchdogs.get(replica_id)
+        if wd is not None:
+            wd.stop()
+        rec = self.records.get(replica_id)
+        if rec is None:
+            return
+        with self._mu:
+            rec.last_beat = self.clock()
+            rec.tick_started_at = None
+            rec.strikes = 0
+            rec.hang_flagged = False
+            if rec.state == H_SUSPECT:
+                # hysteresis: a COMPLETED tick is the recovery signal
+                rec.state = H_ACTIVE
+                rec.reason = ""
+                self.transitions += 1
+                logger.info(f"health: replica {replica_id} recovered "
+                            f"(SUSPECT -> ACTIVE)")
+
+    # -- synchronous failure reports -----------------------------------
+
+    def strike(self, replica_id: int, reason: str) -> str:
+        """A replica's tick RAISED: one strike. Returns the new state —
+        SUSPECT until ``tick_exception_strikes`` consecutive strikes,
+        then DEAD (engine still reachable: the tick admission discipline
+        is atomic-on-reject, so a raised tick left the engine clean)."""
+        rec = self.records.get(replica_id)
+        if rec is None or rec.state == H_DEAD:
+            return H_DEAD
+        with self._mu:
+            rec.strikes += 1
+            rec.reason = reason
+            if rec.strikes >= self.rcfg.tick_exception_strikes:
+                self._to_dead(rec, f"{rec.strikes} consecutive tick "
+                                   f"exceptions (last: {reason})",
+                              engine_reachable=True)
+                self._silence(replica_id)
+            elif rec.state == H_ACTIVE:
+                rec.state = H_SUSPECT
+                self.transitions += 1
+                logger.warning(f"health: replica {replica_id} SUSPECT — "
+                               f"tick raised ({reason}), strike "
+                               f"{rec.strikes}/"
+                               f"{self.rcfg.tick_exception_strikes}")
+            return rec.state
+
+    def mark_dead(self, replica_id: int, reason: str,
+                  engine_reachable: bool) -> None:
+        """Directly declare a replica dead (a ``ReplicaCrashed`` tick, or
+        an operator verdict)."""
+        rec = self.records.get(replica_id)
+        if rec is None:
+            return
+        with self._mu:
+            self._to_dead(rec, reason, engine_reachable)
+        self._silence(replica_id)
+
+    def _silence(self, replica_id: int) -> None:
+        """Cancel a dead replica's pending tick watchdog — its last tick
+        will never beat_end, and a post-mortem timer firing minutes later
+        would read as a fresh hang."""
+        wd = self._watchdogs.get(replica_id)
+        if wd is not None:
+            wd.stop()
+
+    @requires_lock("_mu")
+    def _to_dead(self, rec: ReplicaHealth, reason: str,
+                 engine_reachable: bool) -> None:
+        if rec.state == H_DEAD:
+            return
+        rec.state = H_DEAD
+        rec.reason = reason
+        rec.engine_reachable = engine_reachable
+        self.transitions += 1
+        logger.error(f"health: replica {rec.replica_id} DEAD — {reason} "
+                     f"(engine {'reachable' if engine_reachable else 'lost'})")
+
+    def _on_hang(self, replica_id: int, tick: int, timeout_s: float) -> None:
+        """StepWatchdog callback (timer thread): the hang is VISIBLE now;
+        the DEAD decision waits for check()'s clock-driven thresholds."""
+        rec = self.records.get(replica_id)
+        if rec is None:
+            return
+        with self._mu:
+            self.hung_ticks += 1
+            rec.hang_flagged = True
+            if rec.state == H_ACTIVE:
+                rec.state = H_SUSPECT
+                rec.reason = (f"tick {tick} exceeded the {timeout_s:.2f}s "
+                              f"watchdog")
+                self.transitions += 1
+        logger.error(f"health: replica {replica_id} tick {tick} exceeded "
+                     f"the {timeout_s:.2f}s watchdog (hung dispatch?)")
+
+    # -- the clock-driven state machine --------------------------------
+
+    def check(self, is_alive: Optional[Callable[[int], Optional[bool]]] = None
+              ) -> List[Tuple[int, str, bool]]:
+        """Fold elapsed time into state transitions; returns the replicas
+        that became DEAD this call as ``(replica_id, reason,
+        engine_reachable)``. ``is_alive(rid)`` reports the replica
+        thread's liveness: False = crashed (immediate DEAD, engine lost),
+        None = no thread (cooperative mode — miss-based transitions are
+        skipped; see module docstring)."""
+        cfg = self.rcfg
+        now = self.clock()
+        newly_dead: List[Tuple[int, str, bool]] = []
+        with self._mu:
+            for rid, rec in self.records.items():
+                if rec.state == H_DEAD:
+                    continue
+                alive = is_alive(rid) if is_alive is not None else None
+                if alive is False:
+                    self._to_dead(rec, "replica thread died uncleanly",
+                                  engine_reachable=False)
+                    newly_dead.append((rid, rec.reason, False))
+                    continue
+                if alive is None:
+                    continue
+                elapsed = now - rec.last_beat
+                misses = elapsed / cfg.heartbeat_interval_s
+                in_flight = rec.tick_started_at is not None
+                if in_flight and cfg.tick_timeout_s > 0 and elapsed >= max(
+                        cfg.tick_timeout_s,
+                        cfg.dead_after_misses * cfg.heartbeat_interval_s):
+                    self._to_dead(
+                        rec, f"tick in flight for {elapsed:.2f}s (hang)",
+                        engine_reachable=True)
+                    newly_dead.append((rid, rec.reason, True))
+                elif (not in_flight
+                        and misses >= cfg.dead_after_misses):
+                    self._to_dead(
+                        rec, f"no heartbeat for {elapsed:.2f}s "
+                             f"({misses:.0f} missed beats)",
+                        engine_reachable=True)
+                    newly_dead.append((rid, rec.reason, True))
+                elif misses >= cfg.suspect_after_misses and rec.state == H_ACTIVE:
+                    rec.state = H_SUSPECT
+                    rec.reason = (f"{misses:.0f} missed heartbeats"
+                                  + (" (tick in flight)" if in_flight else ""))
+                    self.transitions += 1
+                    logger.warning(f"health: replica {rid} SUSPECT — "
+                                   f"{rec.reason}")
+        for rid, _, _ in newly_dead:
+            self._silence(rid)
+        return newly_dead
+
+    # -- observability --------------------------------------------------
+
+    def states(self) -> Dict[int, str]:
+        with self._mu:
+            return {rid: rec.state for rid, rec in self.records.items()}
+
+    def state_counts(self) -> Dict[str, int]:
+        counts = {H_ACTIVE: 0, H_SUSPECT: 0, H_DEAD: 0}
+        for s in self.states().values():
+            counts[s] += 1
+        return counts
+
+    def snapshot(self) -> Dict[int, Dict[str, object]]:
+        with self._mu:
+            return {rid: rec.snapshot() for rid, rec in self.records.items()}

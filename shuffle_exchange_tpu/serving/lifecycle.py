@@ -98,17 +98,44 @@ class ElasticServingSupervisor:
     thresholds ``scale_up/down_queue_depth``)."""
 
     def __init__(self, router: ReplicaRouter,
-                 policy: Optional[AutoscalePolicy] = None):
+                 policy: Optional[AutoscalePolicy] = None,
+                 replace_dead: bool = True):
         self.router = router
         self.policy = policy or AutoscalePolicy.from_router_config(
             router.rcfg)
+        # revive (ISSUE 12): after an unclean death shrank the fleet, grow
+        # it back toward the pre-death size at the next observation when
+        # the factory allows — failover parked the dead replica's work on
+        # survivors, but the fleet should not stay permanently smaller
+        self.replace_dead = replace_dead
+        self._target_floor = len(router.active_replicas)
+        self._seen_failovers = router.failovers
         self.scale_events = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def step(self) -> int:
+        # health first (ISSUE 12): a dead replica must fail over before
+        # the autoscale observation, or its queue depth reads as load on
+        # a replica that will never serve it
+        self.router.check_health()
         before = len(self.router.active_replicas)
+        # revive only on NEW failovers since the last observation: the
+        # cumulative count would otherwise keep "fixing" every deliberate
+        # out-of-band drain forever after the first unclean death
+        if (self.replace_dead and self.router.engine_factory is not None
+                and before < self._target_floor
+                and self.router.failovers > self._seen_failovers):
+            before = self.router.scale_to(
+                min(self._target_floor, self.policy.max_replicas))
+            logger.warning(
+                f"supervisor: revived fleet to {before} replicas after "
+                f"unclean death(s)")
+        self._seen_failovers = self.router.failovers
         after = self.router.autoscale_step(self.policy)
+        # the floor tracks the autoscaler's DELIBERATE verdict: an unclean
+        # death drops actives below it (revive), a policy shrink moves it
+        self._target_floor = after
         if after != before:
             self.scale_events += 1
             self.router.fleet.write_events([

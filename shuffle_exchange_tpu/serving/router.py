@@ -42,12 +42,64 @@ import numpy as np
 
 from ..inference.config import RouterConfig
 from ..inference.engine_v2 import InferenceEngineV2
-from ..inference.scheduler import ContinuousBatchingScheduler, ServingRequest
+from ..inference.scheduler import (FAILED, FINISHED, PREFILL, RUNNING,
+                                   ContinuousBatchingScheduler,
+                                   ServingRequest)
 from ..monitor.monitor import FleetMonitor, Monitor
+from ..testing import faults
 from ..utils.invariants import atomic_on_reject, locked_by, requires_lock
 from ..utils.logging import logger
+from .health import H_DEAD, HealthMonitor
 
 ACTIVE, DRAINING, STOPPED = "active", "draining", "stopped"
+
+
+class NoActiveReplicaError(RuntimeError):
+    """Every replica is drained, stopped or dead — the fleet cannot take
+    (or re-place) a request."""
+
+
+class LoadShedError(RuntimeError):
+    """Admission refused by the load shedder (ISSUE 12): fleet queue depth
+    crossed ``router.shed_queue_depth``. Carries the uid the request would
+    have gotten plus the fleet state, so callers can log/retry with
+    context instead of guessing."""
+
+    def __init__(self, uid: int, queue_depth: int, bound: int,
+                 active_replicas: int):
+        self.uid = uid
+        self.queue_depth = queue_depth
+        self.bound = bound
+        super().__init__(
+            f"admission shed for request {uid}: fleet queue depth "
+            f"{queue_depth} >= shed_queue_depth {bound} across "
+            f"{active_replicas} active replica(s) — back off and retry")
+
+
+class PoisonQuarantinedError(RuntimeError):
+    """A request's replica died mid-execution ``poison_death_threshold``
+    times (ISSUE 12): it is quarantined — never re-placed — so one
+    pathological input cannot serially take the whole fleet down."""
+
+    def __init__(self, uid: int, deaths: int):
+        self.uid = uid
+        self.deaths = deaths
+        super().__init__(
+            f"request {uid} quarantined as poison: its replica died "
+            f"mid-execution {deaths} times — not re-placing it on a "
+            f"third replica")
+
+
+class RetriesExhaustedError(RuntimeError):
+    """A request was failover-re-placed more than ``router.max_retries``
+    times without finishing (ISSUE 12)."""
+
+    def __init__(self, uid: int, retries: int, max_retries: int):
+        self.uid = uid
+        self.retries = retries
+        super().__init__(
+            f"request {uid} failed after {retries} failover re-placements "
+            f"(max_retries={max_retries})")
 
 
 class Replica:
@@ -72,7 +124,10 @@ class Replica:
 
 @locked_by("_lock", "requests", "owner", "sessions", "_session_of",
            "_next_uid", "drains", "requeued", "weight_publishes",
-           "published_version", "_published_weights")
+           "published_version", "_published_weights",
+           "failovers", "recovered", "migrated_sequences",
+           "migrated_blocks", "reprefill_tokens", "quarantined",
+           "retries_exhausted", "shed", "_channel")
 class ReplicaRouter:
     """Place requests across replicas; tick them; aggregate their stats.
 
@@ -119,6 +174,23 @@ class ReplicaRouter:
         self._pending_drains: set = set()
         self.drains = 0
         self.requeued = 0
+        # fleet fault tolerance (ISSUE 12): the heartbeat state machine,
+        # failover bookkeeping, and the lazy KV-migration channel. The
+        # health monitor is consulted inline (tick()) and, for threaded
+        # fleets, from the dedicated monitor thread start() spawns — a
+        # hung replica cannot check its own pulse.
+        self.health = HealthMonitor(self.rcfg, clock=self.clock)
+        self.failovers = 0
+        self.recovered = 0            # requests re-placed by failover
+        self.migrated_sequences = 0   # re-placed WITHOUT re-prefill
+        self.migrated_blocks = 0
+        self.reprefill_tokens = 0     # prefill tokens replayed by failover
+        self.quarantined: Dict[int, int] = {}   # uid -> replica deaths
+        self.retries_exhausted = 0
+        self.shed = 0
+        self._channel = None          # lazy KVTransferChannel
+        self._health_thread: Optional[threading.Thread] = None
+        self._last_health_check = 0.0
         # fleet-wide weight publication (ISSUE 11): count + last version,
         # plus a reference to the last-published tree so elastic scale-up
         # can catch a factory-built replica up to the fleet's version
@@ -148,6 +220,7 @@ class ReplicaRouter:
             engine.publish_weights(self._published_weights,
                                    version=self.published_version)
         self.replicas.append(rep)
+        self.health.register(rid)
         return rep
 
     def _emit_token(self, uid: int, tok: int) -> None:
@@ -178,26 +251,54 @@ class ReplicaRouter:
 
     def place(self, prompt: Sequence[int],
               session_id: Optional[object] = None) -> Replica:
-        """Pick the replica a request should land on (no mutation)."""
+        """Pick the replica a request should land on (no mutation).
+        Health-aware (ISSUE 12): SUSPECT replicas — missed heartbeats or
+        a flagged hang — take no NEW placements while any healthy
+        candidate exists (they may be about to die; their existing work
+        either recovers with them or fails over)."""
         cfg = self.rcfg
         candidates = self.active_replicas
         if not candidates:
-            raise RuntimeError("no ACTIVE replicas (all drained/stopped)")
+            raise NoActiveReplicaError(
+                "no ACTIVE replicas (all drained/stopped/dead)")
+        states = self.health.states()
+        healthy = [r for r in candidates
+                   if states.get(r.replica_id) == "active"]
+        if healthy:
+            candidates = healthy
         if cfg.sticky_sessions and session_id is not None:
             rid = self.sessions.get(session_id)
-            if rid is not None and self.replicas[rid].active:
+            if (rid is not None and self.replicas[rid].active
+                    and self.replicas[rid] in candidates):
                 return self.replicas[rid]
         # stable max: ties go to the lowest replica id
         return max(candidates, key=lambda r: (self._score(r, prompt),
                                               -r.replica_id))
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               session_id: Optional[object] = None) -> int:
+               session_id: Optional[object] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Route one request; returns its fleet-global uid. When NO active
         replica can ever take the request, the error aggregates every
         replica's own needed-vs-free numbers (the ``_admission_detail``
-        discipline carried across the fleet boundary)."""
+        discipline carried across the fleet boundary). With
+        ``router.shed_queue_depth`` set, admission is refused with a
+        typed ``LoadShedError`` once the fleet's total queued requests
+        cross the bound (ISSUE 12) — a loud early refusal instead of a
+        silent deadline miss later. ``deadline_s`` rides to the
+        scheduler's per-request deadline."""
         with self._lock:
+            bound = self.rcfg.shed_queue_depth
+            if bound:
+                depth = sum(len(r.scheduler.queue)
+                            for r in self.active_replicas)
+                if depth >= bound:
+                    self.shed += 1
+                    self.fleet.write_events([
+                        ("shed/rejected", self.shed, self.shed),
+                        ("shed/queue_depth", depth, self.shed)])
+                    raise LoadShedError(self._next_uid, depth, bound,
+                                        len(self.active_replicas))
             rep = self.place(prompt, session_id=session_id)
             uid = self._next_uid
             self._next_uid += 1
@@ -205,8 +306,12 @@ class ReplicaRouter:
                 with rep.lock:
                     rep.scheduler.submit(prompt,
                                          max_new_tokens=max_new_tokens,
-                                         uid=uid)
-            except ValueError as first_err:
+                                         uid=uid,
+                                         deadline_s=deadline_s)
+            # RuntimeError included (ISSUE 12): the placed replica may
+            # have been fenced/drained between place() and the lock — a
+            # draining refusal is retryable on the survivors
+            except (ValueError, RuntimeError) as first_err:
                 # the chosen replica can never take it — try the rest and
                 # aggregate every refusal with its numbers (satellite:
                 # admission errors name the replica considered)
@@ -218,10 +323,10 @@ class ReplicaRouter:
                         with other.lock:
                             other.scheduler.submit(
                                 prompt, max_new_tokens=max_new_tokens,
-                                uid=uid)
+                                uid=uid, deadline_s=deadline_s)
                         rep = other
                         break
-                    except ValueError as e:
+                    except (ValueError, RuntimeError) as e:
                         reasons.append(str(e))
                 else:
                     raise ValueError(
@@ -249,7 +354,7 @@ class ReplicaRouter:
         if cap and len(self.requests) > cap:
             excess = len(self.requests) - cap
             done = [u for u, r in self.requests.items()
-                    if r.state == "finished"][:excess]
+                    if r.state in (FINISHED, FAILED)][:excess]
             for u in done:
                 del self.requests[u]
                 self.owner.pop(u, None)
@@ -264,15 +369,33 @@ class ReplicaRouter:
         """Tick every non-stopped replica once (round-robin); True while
         any replica holds work. Signal-requested drains (SIGTERM hook)
         are applied here, at a point where no router mutation is half
-        done."""
+        done. A tick that RAISES is a health event (ISSUE 12): a
+        ``ReplicaCrashed`` is an unclean death — immediate failover with
+        the engine treated as lost — while any other exception is a
+        strike (SUSPECT, escalating to DEAD after
+        ``tick_exception_strikes`` consecutive ones). The failure is
+        handled OUTSIDE the replica lock: failover takes the router lock
+        and the survivors' locks, and the lock order is router before
+        replica, always."""
         self._process_pending_drains()
+        self.check_health()
         busy = False
         for rep in list(self.replicas):
             if rep.state == STOPPED:
                 continue
+            err: Optional[BaseException] = None
+            self.health.beat_start(rep.replica_id)
             with rep.lock:
                 if rep.state != STOPPED:
-                    busy = rep.scheduler.tick() or busy
+                    try:
+                        busy = rep.scheduler.tick() or busy
+                    except BaseException as e:
+                        err = e
+            if err is None:
+                self.health.beat_end(rep.replica_id)
+            else:
+                self._on_tick_failure(rep, err)
+                busy = True   # failed-over work now lives on survivors
         return busy
 
     def request_drain(self, replica_id: int) -> None:
@@ -296,16 +419,313 @@ class ReplicaRouter:
             except Exception:
                 logger.exception(f"requested drain of replica {rid} failed")
 
+    # -- fleet health & unclean failover (ISSUE 12) ---------------------
+
+    def check_health(self, force: bool = False) -> int:
+        """One health observation: fold heartbeats/thread-liveness into
+        the state machine and fail over every newly-DEAD replica.
+        Rate-limited to ``health_check_interval_s`` unless ``force``
+        (the dedicated monitor thread forces; inline callers — tick(),
+        the supervisor — ride the limiter). Returns the number of
+        replicas failed over."""
+        now = self.clock()
+        if not force and now - self._last_health_check < \
+                self.rcfg.health_check_interval_s:
+            return 0
+        self._last_health_check = now
+
+        def is_alive(rid: int) -> Optional[bool]:
+            rep = self.replicas[rid]
+            if rep.state == STOPPED:
+                return None
+            if rep.thread is None:
+                return None   # cooperative mode: failures are synchronous
+            return rep.thread.is_alive()
+
+        newly_dead = self.health.check(is_alive)
+        for rid, reason, reachable in newly_dead:
+            try:
+                self.fail_over(rid, reason=reason,
+                               engine_reachable=reachable)
+            except Exception:
+                logger.exception(f"failover of replica {rid} failed")
+        counts = self.health.state_counts()
+        self.fleet.write_events([
+            ("fleet/health/active", counts["active"], self.failovers),
+            ("fleet/health/suspect", counts["suspect"], self.failovers),
+            ("fleet/health/dead", counts["dead"], self.failovers),
+            ("fleet/health/hung_ticks", self.health.hung_ticks,
+             self.failovers)])
+        return len(newly_dead)
+
+    def _on_tick_failure(self, rep: Replica, exc: BaseException) -> None:
+        """A replica's tick raised. ``ReplicaCrashed`` (and non-Exception
+        BaseExceptions) = unclean death: immediate failover, engine lost.
+        Anything else = a strike; ``tick_exception_strikes`` consecutive
+        ones escalate to DEAD with the engine still reachable (the tick
+        admission discipline is atomic-on-reject, so a raised tick left
+        engine state clean). Never called with the replica's lock held."""
+        rid = rep.replica_id
+        if rep.state == STOPPED:
+            return
+        if (isinstance(exc, faults.ReplicaCrashed)
+                or not isinstance(exc, Exception)):
+            logger.error(f"router: replica {rid} tick crashed uncleanly: "
+                         f"{type(exc).__name__}: {exc}")
+            self.health.mark_dead(rid, f"tick crashed: {exc}",
+                                  engine_reachable=False)
+            self.fail_over(rid, reason=f"tick crashed: {exc}",
+                           engine_reachable=False)
+            return
+        logger.warning(f"router: replica {rid} tick raised "
+                       f"{type(exc).__name__}: {exc}")
+        state = self.health.strike(rid, f"{type(exc).__name__}: {exc}")
+        if state == H_DEAD:
+            self.fail_over(
+                rid, reason=f"tick-exception strike budget exhausted "
+                            f"(last: {exc})",
+                engine_reachable=True)
+
+    def fail_over(self, replica_id: int, reason: str = "operator verdict",
+                  engine_reachable: bool = False) -> int:
+        """Reclaim a DEAD replica's queue and in-flight requests and
+        re-place them on survivors (ISSUE 12 tentpole).
+
+        Unlike ``drain()``, the dead replica is never asked anything: the
+        router's own bookkeeping — the shared ``ServingRequest`` objects
+        in ``self.requests`` (prompt + emitted tokens per uid, the
+        ``export_requests``-shaped state kept router-side) — is the
+        source of truth. The scheduler is FENCED first, so a hung tick
+        that eventually returns emits nothing (its requests have new
+        homes); every re-placed request carries its generated
+        continuation, so the replay elsewhere is token-identical under
+        greedy decoding (the drain-replay discipline applied to crashes).
+
+        Recovery per request, oldest first:
+
+        - mid-execution deaths count toward poison quarantine
+          (``poison_death_threshold``) and bounded retries
+          (``max_retries`` with exponential backoff via ``not_before``);
+        - a RUNNING sequence on a REACHABLE engine (hang, not crash)
+          migrates its committed KV blocks to a survivor over the
+          ``KVTransferChannel`` and resumes decoding with ZERO re-prefill
+          tokens; everything else front-requeues for drain-replay;
+        - sticky sessions re-pin to wherever their requests landed.
+
+        With no surviving replica, a replacement is spawned from
+        ``engine_factory`` (caught up to the published weight version by
+        ``_add_replica``); without a factory the orphans FAIL with typed
+        errors rather than hanging forever. Returns the number of
+        recovered (re-placed) requests."""
+        rep = self.replicas[replica_id]
+        if rep.state == STOPPED:
+            return 0
+        # fence BEFORE taking the router lock: bare bool writes the
+        # zombie tick reads after its dispatch. Never take rep.lock here
+        # (a hung tick holds it) — and never require the router lock for
+        # the fence itself: a submit() may be holding the router lock
+        # while blocked on THIS replica's lock, and the fence is what
+        # releases that hung tick (the submit then gets a retryable
+        # draining refusal and re-places on a survivor).
+        rep.scheduler.fenced = True
+        rep.scheduler.draining = True
+        with self._lock:
+            if rep.state == STOPPED:
+                return 0
+            rep.state = STOPPED
+            self.health.mark_dead(replica_id, reason, engine_reachable)
+            self.failovers += 1
+            victims = sorted(
+                uid for uid, rid in self.owner.items()
+                if rid == replica_id
+                and self.requests[uid].state not in (FINISHED, FAILED))
+            survivors = [r for r in self.active_replicas if r is not rep]
+            if victims and not survivors and self.engine_factory is not None:
+                logger.warning(
+                    f"router: no survivor for replica {replica_id}'s "
+                    f"{len(victims)} requests — spawning a replacement "
+                    f"from the engine factory")
+                survivors = [self._add_replica(self.engine_factory())]
+                if any(r.thread is not None and r.thread.is_alive()
+                       for r in self.replicas):
+                    self.start()
+            now = self.clock()
+            recovered = migrated = 0
+            # inject newest-first so the OLDEST victim ends up at the very
+            # front of its new queue (fleet FIFO, the drain discipline)
+            for uid in reversed(victims):
+                old = self.requests[uid]
+                mid_exec = old.state in (PREFILL, RUNNING)
+                # snapshot a FRESH request object: the dead replica's
+                # zombie tick may still hold the old one
+                snap = ServingRequest(
+                    uid=uid, prompt=list(old.prompt),
+                    max_new_tokens=old.max_new_tokens,
+                    generated=list(old.generated),
+                    submitted_at=old.submitted_at,
+                    first_token_at=old.first_token_at,
+                    last_token_at=old.last_token_at,
+                    tpot_s=list(old.tpot_s),
+                    preemptions=old.preemptions + (1 if mid_exec else 0),
+                    decode_ticks=old.decode_ticks,
+                    deadline_s=old.deadline_s,
+                    retries=old.retries,
+                    replica_deaths=old.replica_deaths)
+                self.requests[uid] = snap
+                if mid_exec:
+                    snap.replica_deaths += 1
+                    if snap.replica_deaths >= self.rcfg.poison_death_threshold:
+                        snap.state = FAILED
+                        snap.finished_at = now
+                        snap.error = PoisonQuarantinedError(
+                            uid, snap.replica_deaths)
+                        self.quarantined[uid] = snap.replica_deaths
+                        logger.error(str(snap.error))
+                        continue
+                    snap.retries += 1
+                    if snap.retries > self.rcfg.max_retries:
+                        snap.state = FAILED
+                        snap.finished_at = now
+                        snap.error = RetriesExhaustedError(
+                            uid, snap.retries, self.rcfg.max_retries)
+                        self.retries_exhausted += 1
+                        logger.error(str(snap.error))
+                        continue
+                    snap.not_before = now + (self.rcfg.retry_backoff_s
+                                             * 2 ** (snap.retries - 1))
+                target = None
+                if (engine_reachable and self.rcfg.kv_migration
+                        and old.state == RUNNING and old.generated
+                        and uid in rep.engine._seqs):
+                    target = self._migrate(rep, snap, survivors)
+                    if target is not None:
+                        migrated += 1
+                if target is None:
+                    target = self._replace(snap, survivors, replica_id, now)
+                    if target is None:
+                        continue   # FAILED inside _replace
+                recovered += 1
+                self.owner[uid] = target.replica_id
+                sid = self._session_of.get(uid)
+                if sid is not None:
+                    self.sessions[sid] = target.replica_id
+            for sid, rid in list(self.sessions.items()):
+                if rid == replica_id:
+                    del self.sessions[sid]
+            self.recovered += recovered
+            self.migrated_sequences += migrated
+            self.fleet.write_events([
+                ("failover/deaths", self.failovers, self.failovers),
+                ("failover/recovered", self.recovered, self.failovers),
+                ("failover/migrated_sequences", self.migrated_sequences,
+                 self.failovers),
+                ("failover/migrated_blocks", self.migrated_blocks,
+                 self.failovers),
+                ("failover/reprefill_tokens", self.reprefill_tokens,
+                 self.failovers),
+                ("failover/quarantined", len(self.quarantined),
+                 self.failovers)])
+            logger.warning(
+                f"router: replica {replica_id} failed over ({reason}): "
+                f"{recovered}/{len(victims)} requests re-placed on "
+                f"{len(survivors)} survivors ({migrated} via KV "
+                f"migration), {len(self.quarantined)} quarantined total")
+            return recovered
+
+    @requires_lock("_lock")
+    def _migrate(self, rep: Replica, snap: ServingRequest,
+                 survivors: List[Replica]) -> Optional[Replica]:
+        """Move a RUNNING sequence's committed KV from a hung (reachable)
+        replica to a survivor and adopt it mid-decode — zero re-prefill
+        tokens. Any refusal (KV pressure, weight-version mismatch, full
+        running set) falls back to drain-replay; a committed import whose
+        adoption is then refused is flushed so nothing leaks."""
+        from .disagg import KVTransferChannel, TransferAborted
+
+        if self._channel is None:
+            self._channel = KVTransferChannel(monitor=self.fleet)
+
+        def load_of(s):
+            ld = s.scheduler.load()
+            return (ld["queue_depth"] + ld["running"], s.replica_id)
+
+        for target in sorted(survivors, key=load_of):
+            with target.lock:
+                if (target.scheduler.draining
+                        or len(target.scheduler.active)
+                        >= target.scheduler.cfg.max_running):
+                    continue
+                try:
+                    self._channel.transfer(rep.engine, target.engine,
+                                           snap.uid, flush_src=False)
+                except (ValueError, RuntimeError, TransferAborted) as e:
+                    logger.info(
+                        f"failover: KV migration of uid {snap.uid} to "
+                        f"replica {target.replica_id} refused ({e}); "
+                        f"trying the next survivor")
+                    continue
+                try:
+                    target.scheduler.adopt_running(snap)
+                except (ValueError, RuntimeError) as e:
+                    target.engine.flush([snap.uid])
+                    logger.info(
+                        f"failover: replica {target.replica_id} refused "
+                        f"adoption of migrated uid {snap.uid} ({e})")
+                    continue
+                # read under the target's lock: its tick thread may
+                # finish+flush the adopted sequence the moment we let go
+                nblocks = len(target.engine._seqs[snap.uid].blocks)
+            self.migrated_blocks += nblocks
+            logger.info(
+                f"failover: uid {snap.uid} migrated to replica "
+                f"{target.replica_id} ({nblocks} KV blocks, zero "
+                f"re-prefill tokens)")
+            return target
+        return None
+
+    @requires_lock("_lock")
+    def _replace(self, snap: ServingRequest, survivors: List[Replica],
+                 dead_rid: int, now: float) -> Optional[Replica]:
+        """Front-requeue a victim on a survivor (drain-replay: the
+        generated continuation folds into the prefill target). Marks the
+        request FAILED with a typed error when nobody can take it."""
+        refusals = []
+
+        def load_of(s):
+            ld = s.scheduler.load()
+            return (ld["queue_depth"] + ld["running"], s.replica_id)
+
+        for target in sorted(survivors, key=load_of):
+            try:
+                with target.lock:
+                    target.scheduler.inject(snap, front=True)
+            except (ValueError, RuntimeError) as e:
+                refusals.append(str(e))
+                continue
+            self.reprefill_tokens += len(snap.prompt) + len(snap.generated)
+            return target
+        snap.state = FAILED
+        snap.finished_at = now
+        snap.error = NoActiveReplicaError(
+            f"request {snap.uid}: no surviving replica could adopt it "
+            f"from dead replica {dead_rid}"
+            + (f" — {'; '.join(refusals)}" if refusals else ""))
+        logger.error(str(snap.error))
+        return None
+
     def serve(self, requests: Sequence[Union[Sequence[int],
                                              Tuple[Sequence[int], int]]],
               max_new_tokens: int = 32,
               arrivals: Optional[Sequence[float]] = None,
-              session_ids: Optional[Sequence[object]] = None
+              session_ids: Optional[Sequence[object]] = None,
+              deadline_s: Optional[float] = None
               ) -> Dict[int, List[int]]:
         """Serve a batch to completion across the fleet — the scheduler's
         Poisson-trace ``serve`` contract, routed. Returns ``{uid: tokens}``
-        in submission order. Results survive mid-serve drains: the router
-        tracks the live ``ServingRequest`` objects, wherever they run."""
+        in submission order (a FAILED request contributes its partial
+        tokens; check ``requests[uid].state``/``.error`` for the verdict).
+        Results survive mid-serve drains AND failovers: the router tracks
+        the live ``ServingRequest`` objects, wherever they run."""
         items = []
         for req in requests:
             if (isinstance(req, tuple) and len(req) == 2
@@ -327,7 +747,8 @@ class ReplicaRouter:
                 i, (prompt, mn) = pending.popleft()
                 sid = session_ids[i] if session_ids is not None else None
                 uids.append(self.submit(prompt, max_new_tokens=mn,
-                                        session_id=sid))
+                                        session_id=sid,
+                                        deadline_s=deadline_s))
             if not self.tick() and pending and arrivals is not None:
                 wait = arrivals[pending[0][0]] - (self.clock() - t0)
                 if wait > 0:
@@ -340,7 +761,10 @@ class ReplicaRouter:
         """One worker thread per replica, each ticking its own scheduler
         until ``stop()`` — the in-process analog of one serving process
         per host. Placement/submit stay on the caller's thread (the
-        scheduler queue is the handoff point)."""
+        scheduler queue is the handoff point). A dedicated health-monitor
+        thread runs the heartbeat checks (ISSUE 12): a hung replica
+        cannot check its own pulse, and the submit thread may be asleep
+        between arrivals."""
         self._stop.clear()
         for rep in self.replicas:
             if rep.thread is None or not rep.thread.is_alive():
@@ -348,14 +772,40 @@ class ReplicaRouter:
                     target=self._replica_loop, args=(rep,), daemon=True,
                     name=f"serving-replica-{rep.replica_id}")
                 rep.thread.start()
+        if self._health_thread is None or not self._health_thread.is_alive():
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True,
+                name="serving-health-monitor")
+            self._health_thread.start()
 
     def _replica_loop(self, rep: Replica) -> None:
         while not self._stop.is_set() and rep.state != STOPPED:
             self._process_pending_drains()
+            err: Optional[BaseException] = None
+            busy = False
+            self.health.beat_start(rep.replica_id)
             with rep.lock:
-                busy = rep.scheduler.tick() if rep.state != STOPPED else False
+                if rep.state != STOPPED:
+                    try:
+                        busy = rep.scheduler.tick()
+                    except BaseException as e:
+                        err = e
+            if err is not None:
+                self._on_tick_failure(rep, err)
+                if rep.state == STOPPED:
+                    return   # this replica is dead; the loop ends with it
+            else:
+                self.health.beat_end(rep.replica_id)
             if not busy:
                 time.sleep(0.001)
+
+    def _health_loop(self) -> None:
+        interval = self.rcfg.health_check_interval_s
+        while not self._stop.wait(interval):
+            try:
+                self.check_health(force=True)
+            except Exception:
+                logger.exception("health check failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -363,6 +813,9 @@ class ReplicaRouter:
             if rep.thread is not None:
                 rep.thread.join(timeout=5.0)
                 rep.thread = None
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
 
     # -- elastic lifecycle ---------------------------------------------
 
@@ -435,6 +888,7 @@ class ReplicaRouter:
                 if rid == replica_id:
                     del self.sessions[sid]
             rep.state = STOPPED
+            self.health.retire(replica_id)   # clean exit, not a symptom
             self.drains += 1
             self.requeued += len(exported)
             self.fleet.write_events([
@@ -447,9 +901,13 @@ class ReplicaRouter:
 
     def scale_to(self, n: int) -> int:
         """Grow or shrink the ACTIVE fleet to ``n`` replicas. Growth needs
-        ``engine_factory``; shrink drains the newest active replicas
-        (their requests requeue on the survivors). Returns the active
-        count after scaling."""
+        ``engine_factory``; shrink drains the LEAST-LOADED active replica
+        (queue depth + running set, ties to the newest id) — draining the
+        newest regardless of load evicted whichever replica happened to
+        join last, including one that had accumulated the hottest prefix
+        cache, and moved the most in-flight work when an idle replica was
+        standing right there. The verdict is logged per drain. Returns
+        the active count after scaling."""
         if n < 1:
             raise ValueError(f"cannot scale to {n} replicas")
         with self._lock:
@@ -465,7 +923,18 @@ class ReplicaRouter:
                 logger.info(f"router: scaled up — replica "
                             f"{rep.replica_id} joined")
             while len(self.active_replicas) > n:
-                victim = self.active_replicas[-1]
+                loads = {}
+                for r in self.active_replicas:
+                    ld = r.scheduler.load()
+                    loads[r.replica_id] = ld["queue_depth"] + ld["running"]
+                victim = min(self.active_replicas,
+                             key=lambda r: (loads[r.replica_id],
+                                            -r.replica_id))
+                logger.info(
+                    f"router: shrink verdict — draining replica "
+                    f"{victim.replica_id} (least loaded: "
+                    f"{loads[victim.replica_id]} queued+running, fleet "
+                    f"loads {loads})")
                 self.drain(victim.replica_id)
             return len(self.active_replicas)
 
@@ -569,6 +1038,7 @@ class ReplicaRouter:
             return float(np.percentile(xs, q)) if len(xs) else None
 
         done = [r for r in self.requests.values() if r.state == "finished"]
+        failed = [r for r in self.requests.values() if r.state == FAILED]
         ttft = [r.first_token_at - r.submitted_at for r in done
                 if r.first_token_at is not None]
         tpot = [t for r in done for t in r.tpot_s]
@@ -580,6 +1050,26 @@ class ReplicaRouter:
             "active_replicas": len(self.active_replicas),
             "requests": len(done),
             "generated_tokens": total,
+            # fleet fault tolerance (ISSUE 12): per-replica health states,
+            # failover recovery bookkeeping (incl. the poison-quarantine
+            # roster — uid -> replica deaths), and shed/deadline tallies
+            "health": self.health.snapshot(),
+            "failover": {
+                "deaths": self.failovers,
+                "recovered_requests": self.recovered,
+                "migrated_sequences": self.migrated_sequences,
+                "migrated_blocks": self.migrated_blocks,
+                "reprefill_tokens": self.reprefill_tokens,
+                "quarantined": dict(self.quarantined),
+                "retries_exhausted": self.retries_exhausted,
+            },
+            "shed": {
+                "rejected": self.shed,
+                "queue_depth_bound": self.rcfg.shed_queue_depth,
+            },
+            "failed_requests": len(failed),
+            "deadline_expired": sum(r.scheduler.deadline_expired
+                                    for r in self.replicas),
             "sustained_tokens_per_sec": (total / span) if span > 0 else None,
             "ttft_p50_s": pct(ttft, 50), "ttft_p95_s": pct(ttft, 95),
             "ttft_p99_s": pct(ttft, 99),

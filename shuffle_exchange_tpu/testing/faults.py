@@ -30,6 +30,11 @@ Sites (``Fault.site``):
   transfer (serving/disagg.py) after the decode side's blocks are reserved
   but before the payload commits; the transfer's cleanup must abort the
   reservation, so the decode engine is left clean (tests/test_disagg.py).
+- ``kv_transfer_stall``   — BLOCK a disaggregated transfer mid-flight
+  (after the payload is staged, before the decode-side commit) until the
+  channel aborts it or :func:`release_hangs` fires — the window a SIGTERM
+  drain can race (``KVTransferChannel.quiesce`` must wait for or abort the
+  stalled transfer atomically; tests/test_disagg.py composes them).
 - ``weight_publish``      — kill a fleet-wide RLHF weight publication
   (serving/router.py ``publish_weights``) while STAGING replica ``index``'s
   new weights; the two-phase flip must roll every staged replica back and
@@ -40,12 +45,39 @@ Sites (``Fault.site``):
   newest-complete-tag fallback on load). ``index`` selects the manifest
   process id / shard file ordinal; ``byte_offset`` the byte to flip.
 
+Serving-fleet fault sites (ISSUE 12, armed per REPLICA id via ``index``;
+all three land at the scheduler's tick entry — the dispatch boundary, which
+is exactly where a real preemption becomes observable — so a tripped fault
+never leaves a half-executed tick behind):
+
+- ``replica_crash``   — raise :class:`ReplicaCrashed` from replica
+  ``index``'s tick: simulates UNCLEAN process death. The router's health
+  layer must declare the replica dead and fail its requests over with the
+  engine treated as LOST (re-prefill on survivors, no KV migration).
+- ``replica_hang``    — BLOCK replica ``index``'s tick (a wedged
+  collective / dead host callback) until the scheduler is fenced or
+  :func:`release_hangs` fires. The health layer must detect the missing
+  heartbeats, declare the replica dead, and — because the process is alive
+  and its KV pool quiescent — migrate committed KV blocks to survivors
+  instead of re-prefilling.
+- ``tick_exception``  — raise a plain :class:`InjectedFault` from replica
+  ``index``'s tick: a transient tick failure. The health layer counts it
+  as a strike (SUSPECT), not an immediate death; consecutive strikes
+  escalate to DEAD.
+
 Arm programmatically (``faults.arm(...)``) or via the environment::
 
     SXT_FAULTS="ckpt_shard_write:index=1:byte_offset=16,sigterm_mid_step:index=3"
 
 Faults are one-shot by default (``once=True``): after tripping they disarm,
 so the restarted run proceeds clean — exactly a transient preemption.
+
+Deterministic schedules (ISSUE 12): ``fire_nth=N`` arms a fault that stays
+silent for the first N-1 matching checks and trips on the Nth — "crash
+replica 1 on its 4th tick" is ``arm("replica_crash", index=1, fire_nth=4)``
+and reproduces exactly, run after run, because the count is per-armed-fault
+and advanced only by its own (site, index) checks. The default (1) trips on
+the first check, the historical behavior.
 """
 
 from __future__ import annotations
@@ -53,7 +85,9 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
-from typing import List, Optional
+import threading
+import time
+from typing import Callable, List, Optional
 
 from ..utils.logging import logger
 
@@ -62,30 +96,51 @@ class InjectedFault(Exception):
     """Raised at an armed fault site (simulates a crash/preemption)."""
 
 
+class ReplicaCrashed(InjectedFault):
+    """Raised at the ``replica_crash`` site: simulates UNCLEAN process
+    death of a serving replica — the health layer must treat the replica's
+    engine (and its KV pool) as unreachable."""
+
+
 SITES = (
     "ckpt_shard_write", "ckpt_manifest_write", "ckpt_item_save",
     "ckpt_pre_commit", "ckpt_pre_latest",
     "nan_loss", "sigterm_mid_step", "offload_bucket_update",
     "corrupt_manifest", "drop_manifest", "corrupt_shard",
-    "kv_transfer", "weight_publish",
+    "kv_transfer", "kv_transfer_stall", "weight_publish",
+    "replica_crash", "replica_hang", "tick_exception",
 )
 
 
 @dataclasses.dataclass
 class Fault:
     site: str
-    index: int = 0                      # shard ordinal / step / process id
+    index: int = 0                      # shard ordinal / step / replica id
     byte_offset: Optional[int] = None   # torn-prefix length or flip position
     once: bool = True
+    fire_nth: int = 1                   # trip on the Nth matching check
     hits: int = 0
+    checks: int = 0                     # matching checks seen so far
+    # blocking sites (replica_hang, kv_transfer_stall) park on this event;
+    # release_hangs() sets it so tests can un-wedge deterministically
+    released: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False)
 
     def __post_init__(self):
         if self.site not in SITES:
             raise ValueError(f"unknown fault site {self.site!r}; known: {SITES}")
+        if self.fire_nth < 1:
+            raise ValueError(f"fire_nth must be >= 1, got {self.fire_nth}")
 
 
 _PLAN: List[Fault] = []
-ACTIVE = False   # fast-path gate: every seam checks this first
+_HUNG: List[Fault] = []   # tripped blocking faults still (possibly) parked
+#: guards _PLAN/_HUNG and per-fault check counters — serving-fleet sites
+#: are checked from every replica thread at every tick entry, and an
+#: unsynchronized one-shot removal could shift-skip another thread's
+#: matching fault mid-iteration, breaking fire_nth determinism
+_MU = threading.Lock()
+ACTIVE = False   # fast-path gate: every seam checks this first, lock-free
 
 
 def _update_active() -> None:
@@ -94,43 +149,88 @@ def _update_active() -> None:
 
 
 def arm(site: str, index: int = 0, byte_offset: Optional[int] = None,
-        once: bool = True) -> Fault:
-    """Arm one fault; returns it (``.hits`` counts trips)."""
-    f = Fault(site, index=index, byte_offset=byte_offset, once=once)
-    _PLAN.append(f)
-    _update_active()
+        once: bool = True, fire_nth: int = 1) -> Fault:
+    """Arm one fault; returns it (``.hits`` counts trips). ``fire_nth=N``
+    stays silent for the first N-1 matching checks and trips on the Nth —
+    the deterministic-schedule knob chaos drills reproduce runs with."""
+    f = Fault(site, index=index, byte_offset=byte_offset, once=once,
+              fire_nth=fire_nth)
+    with _MU:
+        _PLAN.append(f)
+        _update_active()
     return f
 
 
 def clear() -> None:
-    _PLAN.clear()
-    _update_active()
+    release_hangs()
+    with _MU:
+        _PLAN.clear()
+        _update_active()
 
 
 def armed() -> List[Fault]:
-    return list(_PLAN)
+    with _MU:
+        return list(_PLAN)
+
+
+def release_hangs() -> None:
+    """Un-wedge every tripped blocking fault (test/drill hygiene: a hung
+    replica thread parked at ``replica_hang`` exits its site and observes
+    its fence)."""
+    with _MU:
+        hung, _HUNG[:] = list(_HUNG), []
+    for f in hung:
+        f.released.set()
 
 
 def trip(site: str, index: Optional[int] = 0) -> Optional[Fault]:
     """The armed fault matching (site, index), disarmed if one-shot.
     ``index=None`` matches any armed fault at the site — used by sites
-    where ``index`` is a payload selector, not a match key."""
+    where ``index`` is a payload selector, not a match key. A fault armed
+    with ``fire_nth=N`` absorbs its first N-1 matching checks silently."""
     if not ACTIVE:
         return None
-    for f in _PLAN:
-        if f.site == site and (index is None or f.index == index):
-            f.hits += 1
-            if f.once:
-                _PLAN.remove(f)
-                _update_active()
-            return f
+    with _MU:
+        for f in _PLAN:
+            if f.site == site and (index is None or f.index == index):
+                f.checks += 1
+                if f.checks < f.fire_nth:
+                    return None
+                f.hits += 1
+                if f.once:
+                    _PLAN.remove(f)
+                    _update_active()
+                return f
     return None
 
 
-def maybe_crash(site: str, index: int = 0) -> None:
-    """Raise InjectedFault when (site, index) is armed."""
+def maybe_crash(site: str, index: int = 0, exc=InjectedFault) -> None:
+    """Raise ``exc`` when (site, index) is armed."""
     if ACTIVE and trip(site, index) is not None:
-        raise InjectedFault(f"injected crash at {site}[{index}]")
+        raise exc(f"injected crash at {site}[{index}]")
+
+
+def maybe_hang(site: str, index: int = 0,
+               wake: Optional[Callable[[], bool]] = None,
+               poll_s: float = 0.002) -> bool:
+    """Block at (site, index) when armed — the wedged-collective /
+    dead-host-callback simulation. The block ends when ``wake()`` goes
+    true (e.g. the scheduler was fenced by a failover) or the fault is
+    released (:func:`release_hangs` / ``fault.released.set()``). Returns
+    True iff the site actually hung, so callers can re-check their fence
+    before touching any state."""
+    if not ACTIVE:
+        return False
+    f = trip(site, index)
+    if f is None:
+        return False
+    with _MU:
+        _HUNG.append(f)
+    logger.warning(f"faults: hanging at {site}[{index}] "
+                   f"(until fenced/released)")
+    while not f.released.is_set() and not (wake is not None and wake()):
+        time.sleep(poll_s)
+    return True
 
 
 def on_write(site: str, index: int, path: str, data) -> None:

@@ -657,6 +657,7 @@ def test_annotations_present_on_real_seams():
     from shuffle_exchange_tpu.monitor.monitor import FleetMonitor
     from shuffle_exchange_tpu.rlhf.publish import WeightWire
     from shuffle_exchange_tpu.serving.disagg import KVTransferChannel
+    from shuffle_exchange_tpu.serving.health import HealthMonitor
     from shuffle_exchange_tpu.serving.router import ReplicaRouter
 
     for meth in (InferenceEngineV2.put, InferenceEngineV2.step,
@@ -664,6 +665,7 @@ def test_annotations_present_on_real_seams():
                  InferenceEngineV2.stage_weights,
                  ContinuousBatchingScheduler.submit,
                  ContinuousBatchingScheduler.inject,
+                 ContinuousBatchingScheduler.adopt_running,
                  KVTransferChannel.transfer,
                  ReplicaRouter.publish_weights):
         assert hasattr(meth, "__sxt_atomic_on_reject__"), meth
@@ -675,3 +677,13 @@ def test_annotations_present_on_real_seams():
     assert "_mu" in KVTransferChannel.__sxt_locked_by__
     assert "_mu" in WeightWire.__sxt_locked_by__
     assert "_mu" in FleetMonitor.__sxt_locked_by__
+    # the ISSUE 12 failover seam: the router's failover/shed bookkeeping
+    # under its lock, the health monitor's records under its own, and the
+    # transfer channel's drain barrier (in-flight counts + abort votes)
+    # under the condition wrapping the channel lock
+    for attr in ("failovers", "recovered", "migrated_sequences",
+                 "quarantined", "shed"):
+        assert attr in ReplicaRouter.__sxt_locked_by__["_lock"], attr
+    assert "records" in HealthMonitor.__sxt_locked_by__["_mu"]
+    assert "_busy" in KVTransferChannel.__sxt_locked_by__["_cv"]
+    assert "_aborting" in KVTransferChannel.__sxt_locked_by__["_cv"]

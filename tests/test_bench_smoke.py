@@ -155,6 +155,44 @@ def test_serving_fleet_row_runs_at_toy_size():
     assert row["token_mismatches_vs_1r"] == 0
 
 
+def test_serving_failover_row_runs_at_toy_size():
+    """The config-5 serving-failover row (bench.serving_failover_row) at
+    toy size: the same Poisson trace served clean and with one mid-trace
+    unclean replica kill — goodput retention, recovered-request count,
+    TTFT p95 delta, token parity — runs on CPU, so the published row
+    cannot rot on the driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_failover_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+        router={"retry_backoff_s": 0.001})
+    row = serving_failover_row(model, params, icfg, mcfg.vocab_size,
+                               n_requests=4, prompt_lo=4, prompt_hi=16,
+                               max_new=4, kill_after_ticks=2, load=2.0)
+    assert row["deaths"] == 1
+    assert row["recovered_requests"] >= 1
+    assert row["quarantined"] == 0
+    # greedy drain-replay: an unclean death never costs output fidelity
+    assert row["token_mismatches_vs_clean"] == 0
+    assert row["sustained_tokens_per_sec_clean"] > 0
+    assert row["sustained_tokens_per_sec_failover"] > 0
+    assert row["goodput_retention"] > 0
+    assert row["ttft_p95_s_failover"] >= row["ttft_p50_s_failover"] > 0
+
+
 def test_prefix_cache_row_runs_at_toy_size():
     """The config-5 prefix-cache row (bench.prefix_cache_row) at toy size:
     the shared-system-prompt trace served with and without prefix_caching

@@ -294,3 +294,166 @@ class TestDisaggServing:
         src.put([2], [rng.integers(1, 90, size=20).tolist()])
         ch.transfer(src, dst, 2)
         assert len(pool._staging) == n_bufs, "same shape must reuse staging"
+
+
+class TestDrainTransferCompose:
+    """ISSUE 12 satellite: a SIGTERM drain arriving while a kv_transfer is
+    in flight must WAIT for it (or abort it) atomically — flushing the
+    source mid-transfer would free blocks the export was still gathering,
+    and a concurrent admission could reuse and overwrite them (another
+    sequence's KV shipped silently). The ``kv_transfer_stall`` fault site
+    parks a transfer mid-flight to open exactly that window."""
+
+    def _staged(self, model, params, n=14, seed=21):
+        src = InferenceEngineV2(model, params, _icfg())
+        dst = InferenceEngineV2(model, params, _icfg())
+        rng = np.random.default_rng(seed)
+        prompt = rng.integers(1, 90, size=n).tolist()
+        src.put([0], [prompt])
+        return src, dst
+
+    def test_drain_waits_for_inflight_transfer(self, model_and_params):
+        """quiesce(engine) blocks the drain until the stalled transfer
+        lands; the decode side then holds the byte-identical payload and
+        only AFTER that does the drain flush the source."""
+        import threading
+        import time as _time
+
+        from shuffle_exchange_tpu.serving import KVTransferChannel
+
+        model, params = model_and_params
+        src, dst = self._staged(model, params)
+        want = _pool_blocks(src, 0)
+        ch = KVTransferChannel()
+        f = faults.arm("kv_transfer_stall")
+        errs = []
+
+        def xfer():
+            try:
+                ch.transfer(src, dst, 0, flush_src=False)
+            except BaseException as e:   # pragma: no cover - surfaced below
+                errs.append(e)
+
+        t = threading.Thread(target=xfer, daemon=True)
+        t.start()
+        deadline = _time.time() + 10
+        while f.hits == 0 and _time.time() < deadline:
+            _time.sleep(0.002)
+        assert f.hits == 1, "transfer never reached the stall site"
+        drained = []
+
+        def drain_src():
+            ch.quiesce(src)               # the drain barrier
+            src.flush(list(src._seqs))
+            drained.append(True)
+
+        d = threading.Thread(target=drain_src, daemon=True)
+        d.start()
+        _time.sleep(0.1)
+        # the drain is WAITING, not flushing: the source sequence is
+        # intact while the transfer is in flight
+        assert d.is_alive() and not drained
+        assert 0 in src._seqs
+        assert ch.in_flight(src) == 1
+        faults.release_hangs()
+        t.join(timeout=10)
+        d.join(timeout=10)
+        assert not errs, errs
+        assert drained and 0 not in src._seqs
+        assert ch.transfers == 1 and ch.in_flight() == 0
+        got = _pool_blocks(dst, 0)
+        for a, b in zip(want, got):
+            assert np.array_equal(a, b), "drained transfer is not byte-exact"
+
+    def test_drain_abort_vetoes_inflight_transfer(self, model_and_params):
+        """quiesce(abort=True) vetoes the stalled transfer at its next
+        checkpoint: the decode reservation aborts, staging releases, and
+        BOTH engines end byte-identically clean."""
+        import threading
+        import time as _time
+
+        from shuffle_exchange_tpu.serving import (KVTransferChannel,
+                                                  TransferAborted)
+
+        model, params = model_and_params
+        src, dst = self._staged(model, params, seed=22)
+        ch = KVTransferChannel()
+        f = faults.arm("kv_transfer_stall")
+        errs = []
+
+        def xfer():
+            try:
+                ch.transfer(src, dst, 0, flush_src=False)
+            except BaseException as e:
+                errs.append(e)
+
+        t = threading.Thread(target=xfer, daemon=True)
+        t.start()
+        deadline = _time.time() + 10
+        while f.hits == 0 and _time.time() < deadline:
+            _time.sleep(0.002)
+        assert f.hits == 1
+        ch.quiesce(dst, abort=True, timeout_s=10)
+        t.join(timeout=10)
+        assert len(errs) == 1 and isinstance(errs[0], TransferAborted)
+        assert 0 not in dst._seqs
+        assert dst.free_blocks == dst.allocator.num_blocks - 1
+        assert 0 in src._seqs                      # source untouched
+        assert ch._inflight == {} and ch._slots_in_use == set()
+        assert ch.in_flight() == 0
+        # the veto lifted with the quiesce: the channel works again
+        ch.transfer(src, dst, 0, flush_src=False)
+        assert ch.transfers == 1
+
+    def test_new_transfers_refused_while_quiescing(self, model_and_params):
+        from shuffle_exchange_tpu.serving import (KVTransferChannel,
+                                                  TransferAborted)
+
+        model, params = model_and_params
+        src, dst = self._staged(model, params, seed=23)
+        ch = KVTransferChannel()
+        with ch._cv:
+            ch._aborting.add(id(src))
+        with pytest.raises(TransferAborted, match="quiescing"):
+            ch.transfer(src, dst, 0)
+        with ch._cv:
+            ch._aborting.discard(id(src))
+        assert 0 in src._seqs and 0 not in dst._seqs
+        ch.transfer(src, dst, 0, flush_src=False)   # veto lifted
+
+    def test_quiesce_times_out_loudly(self, model_and_params):
+        import threading
+        import time as _time
+
+        from shuffle_exchange_tpu.serving import KVTransferChannel
+
+        model, params = model_and_params
+        src, dst = self._staged(model, params, seed=24)
+        ch = KVTransferChannel()
+        f = faults.arm("kv_transfer_stall")
+        t = threading.Thread(
+            target=lambda: ch.transfer(src, dst, 0, flush_src=False),
+            daemon=True)
+        t.start()
+        deadline = _time.time() + 10
+        while f.hits == 0 and _time.time() < deadline:
+            _time.sleep(0.002)
+        with pytest.raises(TimeoutError, match="in flight"):
+            ch.quiesce(src, timeout_s=0.2)
+        faults.release_hangs()
+        t.join(timeout=10)
+
+    def test_server_drain_quiesces_both_engines(self, model_and_params):
+        """DisaggregatedServer.drain: the SIGTERM-drain entry point —
+        quiesce both engines, then flush every live sequence."""
+        model, params = model_and_params
+        pre = InferenceEngineV2(model, params, _icfg())
+        dec = InferenceEngineV2(model, params, _icfg())
+        srv = DisaggregatedServer(pre, dec)
+        rng = np.random.default_rng(25)
+        srv.prefill_chunked(0, rng.integers(1, 90, size=18).tolist())
+        srv.channel.transfer(pre, dec, 0, flush_src=False)
+        srv.drain()
+        assert pre._seqs == {} and dec._seqs == {}
+        assert pre.free_blocks == pre.allocator.num_blocks - 1
+        assert dec.free_blocks == dec.allocator.num_blocks - 1

@@ -1,0 +1,548 @@
+"""Fleet fault tolerance (ISSUE 12): unclean replica death must lose zero
+requests and zero output fidelity — heartbeat health states with
+hysteresis, crash failover with token-identical drain-replay, hung-replica
+KV migration with zero re-prefill tokens, per-request deadlines/retry
+backoff, poison quarantine, and load shedding, all with typed errors.
+
+Tier-1 discipline: every engine here reuses the EXACT tiny-model +
+inference-config shapes of tests/test_serving_router.py, so the
+persistent compile cache already holds every program these tests
+dispatch; the clock-driven multi-kill chaos matrix is @slow (ci_full).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.config import ConfigError
+from shuffle_exchange_tpu.inference import (DeadlineExceededError,
+                                            InferenceConfig,
+                                            InferenceEngineV2, ServingRequest)
+from shuffle_exchange_tpu.inference.scheduler import \
+    ContinuousBatchingScheduler
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.serving import (HealthMonitor, LoadShedError,
+                                          PoisonQuarantinedError,
+                                          ReplicaRouter, run_chaos_drill)
+from shuffle_exchange_tpu.serving.health import H_ACTIVE, H_DEAD, H_SUSPECT
+from shuffle_exchange_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.release_hangs()
+    faults.clear()
+
+
+def _icfg(**router):
+    return InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8,
+        num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+        router=router or None)
+
+
+def _mk(model, params, **router):
+    return InferenceEngineV2(model, params, _icfg(**router))
+
+
+def _reference(model, params, prompts, n_new):
+    eng = _mk(model, params)
+    out = []
+    for i, p in enumerate(prompts):
+        lg = eng.put([i], [p])
+        first = int(np.argmax(lg[0]))
+        toks = [first]
+        if n_new > 1:
+            toks += [int(t) for t in eng.decode_loop([i], [first],
+                                                     n_new - 1)[0]]
+        eng.flush([i])
+        out.append(toks)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# health state machine (fake clock — no engine, no sleeps)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _rcfg(**kw):
+    base = dict(heartbeat_interval_s=1.0, suspect_after_misses=2,
+                dead_after_misses=4, tick_timeout_s=10.0,
+                health_check_interval_s=0.01)
+    base.update(kw)
+    return InferenceConfig(router=base).router
+
+
+class TestHealthStateMachine:
+    def test_miss_suspect_recover_hysteresis(self):
+        clock = FakeClock()
+        hm = HealthMonitor(_rcfg(), clock=clock)
+        hm.register(0)
+        hm.beat_start(0)
+        hm.beat_end(0)
+        alive = lambda rid: True  # noqa: E731  (threaded-mode liveness)
+        assert hm.check(alive) == []
+        assert hm.states() == {0: H_ACTIVE}
+        clock.t += 2.5   # 2 missed beats -> SUSPECT, not dead
+        assert hm.check(alive) == []
+        assert hm.states() == {0: H_SUSPECT}
+        # hysteresis: a COMPLETED tick recovers the replica
+        hm.beat_start(0)
+        hm.beat_end(0)
+        assert hm.states() == {0: H_ACTIVE}
+        # the miss budget kills only once exhausted
+        clock.t += 4.5
+        dead = hm.check(alive)
+        assert [(d[0], d[2]) for d in dead] == [(0, True)]
+        assert hm.states() == {0: H_DEAD}
+        # DEAD is terminal — later beats do not resurrect
+        hm.beat_start(0)
+        hm.beat_end(0)
+        assert hm.states() == {0: H_DEAD}
+
+    def test_dead_thread_is_immediate_death_engine_lost(self):
+        clock = FakeClock()
+        hm = HealthMonitor(_rcfg(), clock=clock)
+        hm.register(0)
+        dead = hm.check(lambda rid: False)
+        assert [(d[0], d[2]) for d in dead] == [(0, False)]  # engine LOST
+
+    def test_inflight_hang_needs_opt_in_timeout(self):
+        clock = FakeClock()
+        # tick_timeout_s=0: a tick in flight NEVER dies on the miss budget
+        # (cold-server compiles would read as hangs)
+        hm = HealthMonitor(_rcfg(tick_timeout_s=0.0), clock=clock)
+        hm.register(0)
+        hm.beat_start(0)   # tick starts, never ends
+        clock.t += 100.0
+        assert hm.check(lambda rid: True) == []
+        assert hm.states() == {0: H_SUSPECT}
+        # with the watchdog armed, the same shape is a death, engine
+        # REACHABLE (hang, not crash) -> the KV-migration recovery path
+        hm2 = HealthMonitor(_rcfg(tick_timeout_s=5.0), clock=clock)
+        hm2.register(1)
+        hm2.beat_start(1)
+        clock.t += 6.0
+        dead = hm2.check(lambda rid: True)
+        assert [(d[0], d[2]) for d in dead] == [(1, True)]
+
+    def test_cooperative_mode_never_miss_killed(self):
+        # is_alive -> None (no thread): a slow cooperative caller is the
+        # heartbeat source, so misses are the CALLER's fault
+        clock = FakeClock()
+        hm = HealthMonitor(_rcfg(), clock=clock)
+        hm.register(0)
+        clock.t += 1000.0
+        assert hm.check(lambda rid: None) == []
+        assert hm.states() == {0: H_ACTIVE}
+
+    def test_strikes_escalate_to_dead(self):
+        hm = HealthMonitor(_rcfg(tick_exception_strikes=3),
+                           clock=FakeClock())
+        hm.register(0)
+        assert hm.strike(0, "boom") == H_SUSPECT
+        hm.beat_start(0)
+        hm.beat_end(0)   # a good tick resets the streak
+        assert hm.records[0].strikes == 0
+        assert hm.strike(0, "boom") == H_SUSPECT
+        assert hm.strike(0, "boom") == H_SUSPECT
+        assert hm.strike(0, "boom") == H_DEAD
+
+    def test_config_validation(self):
+        with pytest.raises(ConfigError, match="suspect_after_misses"):
+            InferenceConfig(router={"suspect_after_misses": 9,
+                                    "dead_after_misses": 3})
+        with pytest.raises(ConfigError, match="heartbeat_interval_s"):
+            InferenceConfig(router={"heartbeat_interval_s": 0})
+        with pytest.raises(ConfigError, match="shed_queue_depth"):
+            InferenceConfig(router={"shed_queue_depth": -1})
+        with pytest.raises(ConfigError, match="kv_migration"):
+            InferenceConfig(router={"kv_migration": "yes"})
+        with pytest.raises(ConfigError, match="max_retries"):
+            InferenceConfig(router={"max_retries": 0})
+
+
+class TestFaultSchedules:
+    def test_fire_nth_is_deterministic(self):
+        f = faults.arm("tick_exception", index=0, fire_nth=3)
+        assert faults.trip("tick_exception", 0) is None
+        assert faults.trip("tick_exception", 0) is None
+        assert faults.trip("tick_exception", 0) is f
+        assert f.hits == 1 and f.checks == 3
+        assert faults.trip("tick_exception", 0) is None  # one-shot: disarmed
+
+    def test_fire_nth_validates(self):
+        with pytest.raises(ValueError, match="fire_nth"):
+            faults.arm("tick_exception", fire_nth=0)
+
+    def test_release_hangs_unparks(self):
+        f = faults.arm("replica_hang", index=0)
+        done = []
+
+        def run():
+            faults.maybe_hang("replica_hang", 0)
+            done.append(True)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while f.hits == 0 and time.time() < deadline:
+            time.sleep(0.002)
+        assert f.hits == 1 and not done
+        faults.release_hangs()
+        t.join(timeout=5)
+        assert done
+
+
+# ---------------------------------------------------------------------------
+# failover (engine-backed; shapes shared with test_serving_router)
+# ---------------------------------------------------------------------------
+
+
+class TestCrashFailover:
+    def test_crash_mid_serve_token_identical(self, model_and_params):
+        """An unclean crash (no drain) re-places the dead replica's queue
+        AND in-flight requests from router-side bookkeeping; greedy
+        drain-replay keeps every token identical to the reference, and
+        the fleet ends ACTIVE-only."""
+        model, params = model_and_params
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (12, 5, 22, 9, 15)]
+        want = _reference(model, params, prompts, 8)
+        router = ReplicaRouter([_mk(model, params, retry_backoff_s=0.001)
+                                for _ in range(2)])
+        uids = [router.submit(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            router.tick()
+        faults.arm("replica_crash", index=0, fire_nth=1)
+        while router.tick():
+            pass
+        assert [router.requests[u].generated for u in uids] == want
+        st = router.stats()
+        assert st["failover"]["deaths"] == 1
+        assert st["failover"]["recovered_requests"] >= 1
+        assert st["failover"]["migrated_sequences"] == 0  # engine LOST
+        assert st["health"][0]["state"] == H_DEAD
+        assert not st["health"][0]["engine_reachable"]
+        assert st["health"][1]["state"] == H_ACTIVE
+        assert st["active_replicas"] == 1
+        # retried requests carry the failover bookkeeping
+        retried = [u for u in uids if router.requests[u].retries]
+        assert retried
+        assert all(router.requests[u].replica_deaths == 1 for u in retried)
+
+    def test_tick_exception_strikes_then_dead(self, model_and_params):
+        """A transiently-raising tick is a STRIKE (SUSPECT), not a death;
+        the strike budget escalates to DEAD with the engine reachable."""
+        model, params = model_and_params
+        router = ReplicaRouter(
+            [_mk(model, params, tick_exception_strikes=3,
+                 retry_backoff_s=0.001) for _ in range(2)])
+        uid = router.submit([3, 1, 4, 1, 5], max_new_tokens=3)
+        assert router.owner[uid] == 0
+        faults.arm("tick_exception", index=0, once=False)
+        router.tick()
+        assert router.stats()["health"][0]["state"] == H_SUSPECT
+        assert router.replicas[0].state == "active"
+        while router.tick():
+            pass
+        faults.clear()
+        st = router.stats()
+        assert st["health"][0]["state"] == H_DEAD
+        assert st["failover"]["deaths"] == 1
+        assert router.requests[uid].state == "finished"
+        assert len(router.requests[uid].generated) == 3
+
+    def test_retry_backoff_gates_replay(self, model_and_params):
+        """A failover-re-placed request waits out its exponential backoff
+        (not_before) before packing again — and the queue does NOT stall
+        behind it."""
+        model, params = model_and_params
+        router = ReplicaRouter([_mk(model, params, retry_backoff_s=60.0)
+                                for _ in range(2)])
+        uid = router.submit([5, 4, 3, 2, 1], max_new_tokens=3)
+        while router.requests[uid].state != "running":
+            router.tick()
+        before = len(router.requests[uid].generated)
+        faults.arm("replica_crash", index=0, fire_nth=1)
+        for _ in range(6):
+            router.tick()
+        r = router.requests[uid]
+        assert r.retries == 1 and r.state == "queued"
+        assert r.not_before > router.clock() + 30
+        # backed off: no progress — but a fresh request on the survivor
+        # overtakes it instead of stalling behind the backoff window
+        assert len(r.generated) == before
+        other = router.submit([9, 8, 7], max_new_tokens=2)
+        while router.requests[other].state != "finished":
+            router.tick()
+        assert len(r.generated) == before
+        # lift the backoff: the replay finishes token-identically
+        r.not_before = 0.0
+        while router.tick():
+            pass
+        want = _reference(model, params, [[5, 4, 3, 2, 1]], 3)[0]
+        assert r.generated == want
+
+    def test_poison_quarantine_after_two_deaths(self, model_and_params):
+        """A request whose replica dies mid-execution twice is QUARANTINED
+        with a typed error instead of taking a third replica down."""
+        model, params = model_and_params
+        router = ReplicaRouter(
+            [_mk(model, params, poison_death_threshold=2,
+                 retry_backoff_s=0.0) for _ in range(3)])
+        uid = router.submit([7, 7, 7, 7, 7, 7], max_new_tokens=8)
+        first_owner = router.owner[uid]
+        while router.requests[uid].state != "running":
+            router.tick()
+        faults.arm("replica_crash", index=first_owner, fire_nth=1)
+        for _ in range(4):
+            router.tick()
+        assert router.requests[uid].replica_deaths == 1
+        second_owner = router.owner[uid]
+        assert second_owner != first_owner
+        while router.requests[uid].state != "running":
+            router.tick()
+        faults.arm("replica_crash", index=second_owner, fire_nth=1)
+        while router.tick():
+            pass
+        r = router.requests[uid]
+        assert r.state == "failed"
+        assert isinstance(r.error, PoisonQuarantinedError)
+        assert r.error.uid == uid and r.error.deaths == 2
+        st = router.stats()
+        assert st["failover"]["quarantined"] == {uid: 2}
+        # the third replica never died for it
+        assert st["active_replicas"] == 1
+        assert st["failover"]["deaths"] == 2
+
+    def test_no_survivor_spawns_replacement_from_factory(
+            self, model_and_params):
+        """Failover with zero survivors spawns a replacement replica from
+        the engine factory instead of stranding the requests."""
+        model, params = model_and_params
+
+        def factory():
+            return _mk(model, params, retry_backoff_s=0.001)
+
+        router = ReplicaRouter([factory()], engine_factory=factory)
+        uid = router.submit([1, 2, 3, 4], max_new_tokens=4)
+        router.tick()
+        faults.arm("replica_crash", index=0, fire_nth=1)
+        while router.tick():
+            pass
+        assert router.requests[uid].state == "finished"
+        want = _reference(model, params, [[1, 2, 3, 4]], 4)[0]
+        assert router.requests[uid].generated == want
+        assert router.replicas[1].state == "active"
+        assert router.stats()["failover"]["deaths"] == 1
+
+
+class TestHangFailoverMigration:
+    def test_hung_replica_migrates_kv_zero_reprefill(self, model_and_params):
+        """A HUNG (not crashed) replica's RUNNING sequence resumes on the
+        survivor via KV-block migration over the transfer channel: zero
+        re-prefill tokens, token-identical output, and the zombie tick is
+        fenced (no duplicate emission when the hang releases)."""
+        model, params = model_and_params
+        prompt = list(np.random.default_rng(3).integers(1, 90, size=14))
+        want = _reference(model, params, [prompt], 10)[0]
+        router = ReplicaRouter([_mk(model, params) for _ in range(2)])
+        uid = router.submit(prompt, max_new_tokens=10)
+        assert router.owner[uid] == 0
+        router.start()
+        try:
+            deadline = time.time() + 60
+            while (router.requests[uid].state != "running"
+                   and time.time() < deadline):
+                time.sleep(0.002)
+            assert router.requests[uid].state == "running"
+            f = faults.arm("replica_hang", index=0, fire_nth=1)
+            while f.hits == 0 and time.time() < deadline:
+                time.sleep(0.002)
+            assert f.hits == 1, "replica 0 never parked at the hang site"
+            # the health monitor's clock-driven detection is unit-tested
+            # above; here the operator verdict declares the hang directly
+            # so tier-1 pays no detection-threshold sleeps
+            moved = router.fail_over(0, reason="drill: wedged tick",
+                                     engine_reachable=True)
+            assert moved == 1
+            while (router.requests[uid].state != "finished"
+                   and time.time() < deadline):
+                time.sleep(0.002)
+        finally:
+            router.stop()
+            faults.release_hangs()
+        r = router.requests[uid]
+        assert r.state == "finished"
+        assert r.generated == want, "migrated continuation diverged"
+        st = router.stats()
+        assert st["failover"]["migrated_sequences"] == 1
+        assert st["failover"]["migrated_blocks"] >= 1
+        assert st["failover"]["reprefill_tokens"] == 0, (
+            "KV migration must not replay prefill")
+        assert st["failover"]["deaths"] == 1
+        # the zombie emitted nothing after the fence
+        assert len(r.generated) == 10
+
+    def test_adopt_running_validates_atomically(self, model_and_params):
+        """adopt_running refuses without imported KV / without history,
+        mutating nothing (the inject fallback then re-prefills)."""
+        model, params = model_and_params
+        sched = ContinuousBatchingScheduler(_mk(model, params))
+        r = ServingRequest(uid=9, prompt=[1, 2, 3], max_new_tokens=4)
+        with pytest.raises(ValueError, match="no generated tokens"):
+            sched.adopt_running(r)
+        r.generated = [5]
+        with pytest.raises(ValueError, match="no imported KV"):
+            sched.adopt_running(r)
+        assert not sched.requests and not sched.active
+        assert sched.engine.free_blocks == sched.engine.allocator.num_blocks - 1
+
+    def test_weight_version_mismatch_refuses_stale_kv(self, model_and_params):
+        """KV bytes are only valid against the weights that wrote them: a
+        payload exported under an older weight version is refused by
+        commit_import (the failover path then falls back to re-prefill)."""
+        from shuffle_exchange_tpu.serving import KVTransferChannel
+
+        model, params = model_and_params
+        src = _mk(model, params)
+        dst = _mk(model, params)
+        src.put([0], [[1, 2, 3, 4, 5, 6, 7, 8, 9]])
+        dst.publish_weights(params)   # dst now serves version 1, src 0
+        with pytest.raises(ValueError, match="weight-version mismatch"):
+            KVTransferChannel().transfer(src, dst, 0)
+        assert 0 not in dst._seqs
+        assert dst.free_blocks == dst.allocator.num_blocks - 1
+
+
+class TestDeadlinesAndShedding:
+    def test_deadline_expires_with_typed_error(self, model_and_params):
+        model, params = model_and_params
+        sched = ContinuousBatchingScheduler(_mk(model, params))
+        with pytest.raises(ValueError, match="deadline_s"):
+            sched.submit([1, 2, 3], max_new_tokens=2, deadline_s=0)
+        uid = sched.submit([1, 2, 3], max_new_tokens=2, deadline_s=1e-6)
+        sched.tick()
+        r = sched.requests[uid]
+        assert r.state == "failed"
+        assert isinstance(r.error, DeadlineExceededError)
+        assert r.error.uid == uid
+        assert str(uid) in str(r.error) and "deadline" in str(r.error)
+        assert sched.stats()["deadline_expired"] == 1
+        assert sched.engine.free_blocks == sched.engine.allocator.num_blocks - 1
+        # an un-deadlined request on the same scheduler still serves
+        ok = sched.submit([4, 5, 6], max_new_tokens=2)
+        while sched.tick():
+            pass
+        assert sched.requests[ok].state == "finished"
+
+    def test_shed_rejects_with_fleet_state(self, model_and_params):
+        model, params = model_and_params
+        router = ReplicaRouter([_mk(model, params, shed_queue_depth=2)])
+        u0 = router.submit([1, 2, 3], max_new_tokens=2)
+        u1 = router.submit([4, 5, 6], max_new_tokens=2)
+        with pytest.raises(LoadShedError) as ei:
+            router.submit([7, 8, 9], max_new_tokens=2)
+        assert ei.value.queue_depth == 2 and ei.value.bound == 2
+        assert "shed" in str(ei.value)
+        st = router.stats()
+        assert st["shed"] == {"rejected": 1, "queue_depth_bound": 2}
+        assert router.fleet.memory_monitor.latest("shed/rejected") == 1
+        # the queue drains; admission reopens below the bound
+        while router.tick():
+            pass
+        assert router.requests[u0].state == "finished"
+        assert router.requests[u1].state == "finished"
+        u2 = router.submit([7, 8, 9], max_new_tokens=2)
+        while router.tick():
+            pass
+        assert router.requests[u2].state == "finished"
+
+
+class TestElasticShrinkVerdict:
+    def test_shrink_drains_least_loaded_not_newest(self, model_and_params):
+        """Satellite: scale-down picks the least-loaded drainable replica
+        (ties to the newest id) instead of always drain-newest."""
+        model, params = model_and_params
+        router = ReplicaRouter([_mk(model, params) for _ in range(2)])
+        # pile work onto replica 1 via sticky sessions; replica 0 stays
+        # lightest — drain-newest would wrongly evict busy replica 1
+        router.submit([1, 2, 3], max_new_tokens=2, session_id="a")  # -> 0
+        for _ in range(3):
+            router.submit([4, 5, 6, 7], max_new_tokens=2, session_id="b")
+        assert router.owner[0] == 0
+        assert [router.owner[u] for u in (1, 2, 3)] == [1, 1, 1]
+        assert router.scale_to(1) == 1
+        assert router.replicas[0].state == "stopped"   # least loaded
+        assert router.replicas[1].state == "active"
+        while router.tick():
+            pass
+        assert all(router.requests[u].state == "finished" for u in range(4))
+
+    def test_idle_tie_still_drains_newest(self, model_and_params):
+        model, params = model_and_params
+        router = ReplicaRouter([_mk(model, params) for _ in range(2)])
+        assert router.scale_to(1) == 1
+        assert router.replicas[1].state == "stopped"
+        assert router.replicas[0].state == "active"
+
+
+# ---------------------------------------------------------------------------
+# the clock-driven chaos matrix (ci_full)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kills,threaded", [
+    ([(3, "crash", 0), (6, "hang", 1)], True),       # crash + hang + revive
+    ([(2, "crash", 0), (5, "crash", 1)], True),      # double crash
+])
+def test_chaos_matrix(model_and_params, kills, threaded):
+    """Multi-kill chaos drills with REAL clock-driven detection: zero
+    lost requests, token parity, ACTIVE-only recovery, revival through
+    the factory. (Single-kill cooperative crash and tick-exception
+    strikes are covered by the unmarked tests above and the ci_full
+    chaos-drill script — this matrix keeps the clock-driven multi-kill
+    shapes only, for the tier-1 wall-clock budget.)"""
+    model, params = model_and_params
+
+    def mk():
+        return _mk(model, params, heartbeat_interval_s=0.25,
+                   suspect_after_misses=4, dead_after_misses=12,
+                   tick_timeout_s=3.0, health_check_interval_s=0.05,
+                   retry_backoff_s=0.001)
+
+    report = run_chaos_drill(
+        mk, n_replicas=3, n_requests=9, prompt_lo=5, prompt_hi=20,
+        max_new=8, vocab=90, seed=2, kills=kills, threaded=threaded,
+        revive=True,
+        require_migration=any(k[1] == "hang" for k in kills))
+    assert report["lost"] == 0
+    assert report["token_mismatches"] == 0
+    assert report["active_only"]
