@@ -35,23 +35,28 @@ python -m pytest tests/test_serving_scheduler.py -q "$@"
 # zero-new-allocation assert, COW divergence, preempt/requeue with shared
 # blocks, and int8/fp8 KV decode parity vs the bf16 gather oracle.
 python -m pytest tests/test_prefix_cache.py tests/test_kv_quant.py -q "$@"
-# Multi-host serving front gates (ISSUE 7): router placement/sticky/parity
+# Multi-host serving front gates (ISSUE 7), sanitized (ISSUE 13):
+# router placement/sticky/parity
 # + SIGTERM drain with zero lost requests, and the disaggregated
 # prefill->decode transfer (wire-format roundtrip incl. quantized scale
 # planes, handshake atomicity on reject, crash-mid-transfer cleanliness,
 # drain-vs-inflight-transfer quiesce compose).
-python -m pytest tests/test_serving_router.py tests/test_disagg.py -q "$@"
-# Fleet fault tolerance gates (ISSUE 12): heartbeat health states with
+env SXT_SANITIZE=1 python -m pytest tests/test_serving_router.py tests/test_disagg.py -q "$@"
+# Fleet fault tolerance gates (ISSUE 12) — run under the runtime
+# concurrency sanitizer (ISSUE 13, SXT_SANITIZE=1): instrumented fleet
+# locks fail any test that exhibits a lock-order inversion, a blocking
+# dispatch under a foreign lock, or a leaked fleet thread, with both
+# stacks in the report (testing/sanitizer.py). Heartbeat health states with
 # hysteresis, unclean-crash failover with token-identical drain-replay,
 # hung-replica KV migration with zero re-prefill tokens, deadlines/retry
 # backoff/poison quarantine/load shedding with typed errors, and the
 # clock-driven multi-kill chaos matrix (@slow cases included here).
-python -m pytest tests/test_failover.py -q "$@"
+env SXT_SANITIZE=1 python -m pytest tests/test_failover.py -q "$@"
 # The chaos drill end to end as a script (the operator entry point):
 # 3 replicas under a Poisson trace, one crashed + one hung mid-trace,
 # revived through the factory — zero lost requests, token parity with
 # the clean run, KV migration, ACTIVE-only recovery.
-python scripts/chaos_drill.py
+env SXT_SANITIZE=1 python scripts/chaos_drill.py
 # Speculative-decoding gates (ISSUE 8): exact-token parity vs decode_loop
 # across k, one-dispatch verify ticks + warmed-server zero-recompile,
 # the steps-per-token bar, rejected-draft KV rewind atomicity vs the
@@ -62,7 +67,7 @@ python -m pytest tests/test_speculative.py -q "$@"
 # a fresh engine on the gathered weights, zero recompiles across flips on
 # a warmed fleet, bit-exact rollout replay at the recorded weight
 # version, crash-mid-publish fleet atomicity, and the v1 shim contract.
-python -m pytest tests/test_rlhf.py tests/test_hybrid_engine.py -q "$@"
+env SXT_SANITIZE=1 python -m pytest tests/test_rlhf.py tests/test_hybrid_engine.py -q "$@"
 exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_mosaic_lowering.py \
     --ignore=tests/test_resilience.py \

@@ -16,6 +16,7 @@ The tier-1 self-clean gate (``tests/test_analysis.py``) asserts the
 package itself has zero unsuppressed violations.
 """
 
+from .lockgraph import LockGraph, analyze_lock_graph, build_lock_graph
 from .report import Report, fold, render_text, write_json
 from .rules import RULES, FileChecker, Rule, Violation
 from .suppress import parse_suppressions
@@ -24,12 +25,14 @@ from .walker import analyze, analyze_file, iter_python_files
 
 def run(paths, select=None) -> Report:
     """Analyze ``paths`` (files or directories) and fold the results —
-    the one-call API the tests and the CLI share."""
+    the one-call API the tests and the CLI share. Includes the
+    whole-tree lock-graph pass (SXT009/SXT010)."""
     return fold(analyze(paths, select=select), select=select)
 
 
 __all__ = [
-    "RULES", "Rule", "Violation", "FileChecker", "Report",
-    "analyze", "analyze_file", "iter_python_files", "fold",
+    "RULES", "Rule", "Violation", "FileChecker", "Report", "LockGraph",
+    "analyze", "analyze_file", "analyze_lock_graph", "build_lock_graph",
+    "iter_python_files", "fold",
     "render_text", "write_json", "parse_suppressions", "run",
 ]

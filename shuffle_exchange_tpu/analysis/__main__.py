@@ -35,6 +35,11 @@ def main(argv=None) -> int:
                     help="write the machine-readable report to this file")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="dump the harvested lock-acquisition graph "
+                         "(nodes+declared ranks+witness edges) as JSON — "
+                         "the SXT009/SXT010 debugging view; also embedded "
+                         "in the --json report")
     ap.add_argument("--verbose", "-v", action="store_true",
                     help="print incident + fix advice under each finding")
     ap.add_argument("--fail-on-stale", action="store_true",
@@ -64,7 +69,14 @@ def main(argv=None) -> int:
             print(f"no such path: {p}", file=sys.stderr)
             return 2
 
-    report = fold(analyze(paths, select=select), select=select)
+    results, graph = analyze(paths, select=select, want_graph=True)
+    report = fold(results, select=select)
+    if graph is not None and (args.lock_graph or args.json_path):
+        report.lock_graph = graph.to_json()
+    if args.lock_graph:
+        import json as _json
+
+        print(_json.dumps(report.lock_graph or {}, indent=2))
     out = render_text(report, verbose=args.verbose)
     if out:
         print(out)

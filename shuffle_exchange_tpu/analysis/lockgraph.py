@@ -1,0 +1,639 @@
+"""Interprocedural lock-acquisition-graph pass: SXT009 / SXT010.
+
+The threaded serving fleet (PRs 7/11/12) carries an explicit lock
+discipline — ``@locked_by`` registrations, ``@requires_lock`` helpers,
+and the declared global rank table ``utils.invariants.LOCK_ORDER``
+(router -> replica-scheduler -> channel -> monitor). This pass consumes
+that metadata and PROVES the ordering statically:
+
+- **SXT009 — lock-order cycle.** Every ``with self.<lock>`` (and
+  resolvable foreign ``with <obj>.<lock>``) acquisition is harvested
+  with the set of locks already held at that point, both syntactically
+  and through resolvable call edges (same-module calls, plus
+  ``self.<attr>`` receivers whose class is recorded by a
+  ``self.<attr> = ClassName(...)`` constructor assignment — the same
+  conservative dataflow SXT002's derivation machinery uses). Two locks
+  acquired in inconsistent order across ANY two paths form a cycle in
+  the resulting graph; each participating acquisition site is flagged.
+  Incident: the PR 11 router/replica deadlock (``submit`` held the
+  router lock blocked on a hung replica's lock; the failover that would
+  have released the replica needed the router lock to fence it).
+
+- **SXT010 — blocking call under a ``@locked_by`` lock.** While a lock
+  registered by ``@locked_by`` is held: (a) acquiring — directly or
+  through a resolvable call — a lock whose ``LOCK_ORDER`` rank is not
+  strictly greater than the held lock's (or a lock with no declared
+  rank at all) and (b) direct ``join``/``wait``/``quiesce``/``tick``/
+  ``sleep``/``acquire``-shaped calls (``X.wait()`` on the lock
+  currently held is the sanctioned condition-variable pattern and is
+  exempt) are flagged. A third shape guards the PR 7 reentrant-SIGTERM
+  fix: a function installed via ``signal.signal`` in the same module
+  must not acquire ANY known lock (handlers run mid-bytecode on the
+  main thread — the reason ``request_drain`` only records).
+
+Everything here is best-effort syntactic resolution, same philosophy as
+the rest of sxt-check: unresolvable receivers are SKIPPED (conservative
+misses, never false claims about code it cannot see), and nested
+function/lambda bodies are excluded (they run later, under their own
+discipline). The runtime sanitizer (``testing/sanitizer.py``) covers
+the dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..utils.invariants import LOCK_ORDER
+from .rules import Violation, _last_attr
+from .scopes import ImportTable, build_import_table
+
+#: direct call names treated as blocking under a @locked_by lock
+BLOCKING_CALLS = frozenset({
+    "join", "wait", "wait_for", "quiesce", "tick", "sleep", "acquire",
+})
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
+               "threading.Semaphore", "threading.BoundedSemaphore")
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+# ---------------------------------------------------------------------------
+# harvested per-file facts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MethodFacts:
+    """One function/method's lock-relevant behavior."""
+    key: Tuple[str, str]                 # (class name or "", func name)
+    path: str
+    requires: List[str]                  # lock ids held at entry
+    #: (lock_id, line, held-at-that-point) — syntactic `with` acquisitions
+    acquires: List[Tuple[str, int, Tuple[str, ...]]]
+    #: (callee key, line, held-at-that-point) — resolvable call edges
+    calls: List[Tuple[Tuple[str, str], int, Tuple[str, ...]]]
+    #: (display name, line, held, wait_target lock id or None)
+    blocking: List[Tuple[str, int, Tuple[str, ...], Optional[str]]]
+
+
+@dataclasses.dataclass
+class ClassFacts:
+    name: str
+    path: str
+    lock_attrs: Set[str]                 # attr names that hold locks
+    locked_by: Set[str]                  # the @locked_by-registered subset
+    attr_types: Dict[str, str]           # self.<attr> -> class simple name
+
+
+@dataclasses.dataclass
+class ModuleFacts:
+    path: str
+    module_path: str
+    classes: Dict[str, ClassFacts]
+    methods: Dict[Tuple[str, str], MethodFacts]
+    module_locks: Set[str]               # module-level lock names
+    #: same-module functions installed as signal handlers, with the
+    #: signal.signal call line
+    signal_handlers: List[Tuple[str, int]]
+    #: module-level ``SXT_LOCK_ORDER = {"Class.attr": rank}`` declaration
+    #: — the extension point for lock hierarchies OUTSIDE the serving
+    #: fleet's (utils.invariants.LOCK_ORDER, which wins on conflict)
+    declared_ranks: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+def _is_lock_ctor(node: ast.AST, imports: ImportTable) -> bool:
+    """True when ``node`` contains a threading lock constructor call
+    (possibly wrapped, e.g. ``sanitizer.wrap(threading.RLock(), ...)``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = imports.canonical(sub.func)
+            if name in _LOCK_CTORS:
+                return True
+            # testing.sanitizer construction helpers build (wrapped) locks
+            if _last_attr(sub.func) in ("wrap", "make_condition"):
+                return True
+    return False
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _Harvester:
+    """One pass over one module collecting ClassFacts/MethodFacts."""
+
+    def __init__(self, path: str, tree: ast.Module, module_path: str):
+        self.path = path
+        self.tree = tree
+        self.module_path = module_path
+        self.imports = build_import_table(tree, module_path)
+        self.out = ModuleFacts(path, module_path, {}, {}, set(), [])
+
+    # -- prepass: classes, their lock attrs, attr types ----------------
+
+    def run(self) -> ModuleFacts:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value,
+                                                              self.imports):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.out.module_locks.add(t.id)
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "SXT_LOCK_ORDER"
+                    and isinstance(node.value, ast.Dict)):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, int)):
+                        self.out.declared_ranks[k.value] = v.value
+            if isinstance(node, ast.ClassDef):
+                self._harvest_class_decl(node)
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cf = self.out.classes[node.name]
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._harvest_function(item, cf)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._harvest_function(node, None)
+        self._harvest_signal_handlers()
+        return self.out
+
+    def _harvest_class_decl(self, node: ast.ClassDef) -> None:
+        locked: Set[str] = set()
+        for dec in node.decorator_list:
+            if (isinstance(dec, ast.Call) and _last_attr(dec.func) == "locked_by"
+                    and dec.args and isinstance(dec.args[0], ast.Constant)
+                    and isinstance(dec.args[0].value, str)):
+                locked.add(dec.args[0].value)
+        cf = ClassFacts(node.name, self.path, set(locked), locked, {})
+        # lock attrs + attr types from every `self.X = ...` in the class
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                continue
+            attr = _self_attr_of(sub.targets[0])
+            if attr is None:
+                continue
+            if _is_lock_ctor(sub.value, self.imports):
+                cf.lock_attrs.add(attr)
+            if isinstance(sub.value, ast.Call):
+                cname = self.imports.canonical(sub.value.func)
+                simple = (cname.rsplit(".", 1)[-1] if cname
+                          else _last_attr(sub.value.func))
+                if simple and simple[:1].isupper():
+                    cf.attr_types[attr] = simple
+        self.out.classes[node.name] = cf
+
+    # -- per-function event walk ---------------------------------------
+
+    def _harvest_function(self, fn: ast.FunctionDef,
+                          cf: Optional[ClassFacts]) -> None:
+        cls = cf.name if cf is not None else ""
+        requires: List[str] = []
+        for dec in fn.decorator_list:
+            if (isinstance(dec, ast.Call)
+                    and _last_attr(dec.func) == "requires_lock"):
+                for a in dec.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        lid = self._resolve_self_lock(a.value, cf)
+                        if lid:
+                            requires.append(lid)
+        mf = MethodFacts((cls, fn.name), self.path, requires, [], [], [])
+        local_types: Dict[str, str] = {}
+        self._walk(fn.body, list(requires), cf, local_types, mf)
+        self.out.methods[(cls, fn.name)] = mf
+
+    def _resolve_self_lock(self, attr: str,
+                           cf: Optional[ClassFacts]) -> Optional[str]:
+        if cf is not None and (attr in cf.lock_attrs
+                               or f"{cf.name}.{attr}" in LOCK_ORDER
+                               or f"{cf.name}.{attr}" in self.out.declared_ranks):
+            return f"{cf.name}.{attr}"
+        return None
+
+    def _resolve_lock_expr(self, node: ast.AST, cf: Optional[ClassFacts],
+                           local_types: Dict[str, str]) -> Optional[str]:
+        """Lock id of a `with` context expression, best-effort."""
+        attr = _self_attr_of(node)
+        if attr is not None:
+            return self._resolve_self_lock(attr, cf)
+        if isinstance(node, ast.Name):
+            if node.id in self.out.module_locks:
+                return f"{self.module_path}:{node.id}"
+            return None
+        if isinstance(node, ast.Attribute):
+            # typed receiver first: rep.lock where rep's class is known
+            base = node.value
+            bcls = None
+            if isinstance(base, ast.Name):
+                bcls = local_types.get(base.id)
+            else:
+                battr = _self_attr_of(base)
+                if battr is not None and cf is not None:
+                    bcls = cf.attr_types.get(battr)
+            if bcls is not None:
+                lid = f"{bcls}.{node.attr}"
+                own = self.out.classes.get(bcls)
+                if own is not None:
+                    # same-module class: only attrs known to BE locks
+                    return lid if (node.attr in own.lock_attrs
+                                   or lid in LOCK_ORDER
+                                   or lid in self.out.declared_ranks) else None
+                # cross-module class: trust only ranked names (a typed
+                # receiver's arbitrary context manager is not a lock)
+                if lid in LOCK_ORDER or lid in self.out.declared_ranks:
+                    return lid
+                return None
+            # fall back to a unique attr-name match across the rank table
+            # (resolution, not policy: LOCK_ORDER doubles as the registry
+            # of cross-class lock attr names)
+            table = dict(self.out.declared_ranks)
+            table.update(LOCK_ORDER)
+            hits = [k for k in table if k.endswith(f".{node.attr}")]
+            if len(hits) == 1:
+                return hits[0]
+        return None
+
+    def _resolve_call(self, call: ast.Call, cf: Optional[ClassFacts],
+                      local_types: Dict[str, str]
+                      ) -> Optional[Tuple[str, str]]:
+        """(class, func) key of a resolvable callee, else None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return ("", f.id)
+        if isinstance(f, ast.Attribute):
+            attr = _self_attr_of(f)
+            if attr is not None and cf is not None:
+                return (cf.name, f.attr) if attr not in cf.attr_types else None
+            base = f.value
+            battr = _self_attr_of(base)
+            if battr is not None and cf is not None:
+                bcls = cf.attr_types.get(battr)
+                if bcls is not None:
+                    return (bcls, f.attr)
+            if isinstance(base, ast.Name):
+                bcls = local_types.get(base.id)
+                if bcls is not None:
+                    return (bcls, f.attr)
+        return None
+
+    def _walk(self, stmts: Sequence[ast.stmt], held: List[str],
+              cf: Optional[ClassFacts], local_types: Dict[str, str],
+              mf: MethodFacts) -> None:
+        for st in stmts:
+            self._walk_node(st, held, cf, local_types, mf)
+
+    def _walk_node(self, node: ast.AST, held: List[str],
+                   cf: Optional[ClassFacts], local_types: Dict[str, str],
+                   mf: MethodFacts) -> None:
+        if isinstance(node, _NESTED):
+            return   # closures run later, under their own discipline
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            cname = self.imports.canonical(node.value.func)
+            simple = (cname.rsplit(".", 1)[-1] if cname
+                      else _last_attr(node.value.func))
+            if simple and simple[:1].isupper():
+                local_types[node.targets[0].id] = simple
+        if isinstance(node, ast.With):
+            pushed: List[str] = []
+            for item in node.items:
+                # events inside the context expr see the pre-push stack
+                self._walk_node(item.context_expr, held, cf, local_types, mf)
+                lid = self._resolve_lock_expr(item.context_expr, cf,
+                                              local_types)
+                if lid is not None:
+                    mf.acquires.append((lid, item.context_expr.lineno,
+                                        tuple(held)))
+                    held.append(lid)
+                    pushed.append(lid)
+            self._walk(node.body, held, cf, local_types, mf)
+            for lid in pushed:
+                held.remove(lid)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held, cf, local_types, mf)
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(child, held, cf, local_types, mf)
+
+    def _record_call(self, call: ast.Call, held: List[str],
+                     cf: Optional[ClassFacts], local_types: Dict[str, str],
+                     mf: MethodFacts) -> None:
+        last = _last_attr(call.func)
+        if (last == "join" and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, (ast.Constant, ast.JoinedStr))):
+            last = None   # "sep".join(...) is a string op, not a thread join
+        if last in BLOCKING_CALLS and held:
+            target = None
+            if isinstance(call.func, ast.Attribute):
+                target = self._resolve_lock_expr(call.func.value, cf,
+                                                 local_types)
+            name = self.imports.canonical(call.func) or last
+            mf.blocking.append((name, call.lineno, tuple(held), target))
+        key = self._resolve_call(call, cf, local_types)
+        if key is not None:
+            mf.calls.append((key, call.lineno, tuple(held)))
+
+    # -- signal handlers ------------------------------------------------
+
+    def _harvest_signal_handlers(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.imports.canonical(node.func)
+            if name != "signal.signal" or len(node.args) < 2:
+                continue
+            h = node.args[1]
+            if isinstance(h, ast.Name):
+                self.out.signal_handlers.append((h.id, node.lineno))
+
+
+# ---------------------------------------------------------------------------
+# the global pass
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LockGraph:
+    modules: List[ModuleFacts]
+    #: (held, acquired) -> first witness (path, line)
+    edges: Dict[Tuple[str, str], Tuple[str, int]]
+    #: lock id -> declared rank (None entries omitted)
+    ranks: Dict[str, int]
+    #: (module, class, fn) -> transitive acquisition set (computed once)
+    summary: Dict[Tuple[str, str, str], Set[str]] = dataclasses.field(
+        default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "ranks": dict(sorted(self.ranks.items(),
+                                 key=lambda kv: (kv[1], kv[0]))),
+            "edges": [{"held": a, "acquired": b, "path": p, "line": ln}
+                      for (a, b), (p, ln) in sorted(self.edges.items())],
+        }
+
+
+def _summaries(modules: Sequence[ModuleFacts]
+               ) -> Dict[Tuple[str, str, str], Set[str]]:
+    """Fixed-point transitive acquisition summary per (module, class, fn).
+
+    Call edges resolve within the harvested set: same-module bare
+    functions, same-class methods, and cross-module methods of classes
+    recorded by constructor-typed receivers (class simple names are
+    unique across this package)."""
+    # index: class name -> module_path (for cross-module method lookup)
+    cls_home: Dict[str, str] = {}
+    for m in modules:
+        for cname in m.classes:
+            cls_home.setdefault(cname, m.module_path)
+    by_mod = {m.module_path: m for m in modules}
+
+    def method_of(mod: ModuleFacts, key: Tuple[str, str]
+                  ) -> Optional[Tuple[str, Tuple[str, str]]]:
+        cls, fn = key
+        if (cls, fn) in mod.methods and cls == "":
+            return (mod.module_path, key)
+        if cls:
+            home = cls_home.get(cls)
+            if home is not None and (cls, fn) in by_mod[home].methods:
+                return (home, (cls, fn))
+        return None
+
+    summary: Dict[Tuple[str, str, str], Set[str]] = {}
+    for m in modules:
+        for key, mf in m.methods.items():
+            summary[(m.module_path,) + key] = {lid for lid, _, _
+                                               in mf.acquires}
+    changed = True
+    while changed:
+        changed = False
+        for m in modules:
+            for key, mf in m.methods.items():
+                mine = summary[(m.module_path,) + key]
+                for ckey, _, _ in mf.calls:
+                    resolved = method_of(m, ckey)
+                    if resolved is None:
+                        continue
+                    theirs = summary.get((resolved[0],) + resolved[1], set())
+                    add = theirs - mine
+                    if add:
+                        mine |= add
+                        changed = True
+    return summary
+
+
+def build_lock_graph(entries: Sequence[Tuple[str, ast.Module, str]]
+                     ) -> LockGraph:
+    """Harvest ``(path, tree, module_path)`` entries into the graph."""
+    modules = [_Harvester(p, t, mp).run() for p, t, mp in entries]
+    summary = _summaries(modules)
+    cls_home: Dict[str, str] = {}
+    for m in modules:
+        for cname in m.classes:
+            cls_home.setdefault(cname, m.module_path)
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(a: str, b: str, path: str, line: int) -> None:
+        if a == b:
+            return   # re-entrancy / same-id instances: the runtime
+        edges.setdefault((a, b), (path, line))   # sanitizer owns those
+
+    for m in modules:
+        for key, mf in m.methods.items():
+            for lid, line, held in mf.acquires:
+                for h in held:
+                    add_edge(h, lid, m.path, line)
+            for ckey, line, held in mf.calls:
+                if not held:
+                    continue
+                cls, fn = ckey
+                home = m.module_path if not cls else cls_home.get(cls)
+                if home is None:
+                    continue
+                theirs = summary.get((home, cls, fn))
+                if not theirs:
+                    continue
+                for h in held:
+                    for lid in theirs:
+                        if lid != h and lid not in held:
+                            add_edge(h, lid, m.path, line)
+    ranks: Dict[str, int] = {}
+    for m in modules:
+        ranks.update(m.declared_ranks)
+    ranks.update(LOCK_ORDER)   # the serving hierarchy wins on conflict
+    return LockGraph(list(modules), edges, ranks, summary)
+
+
+def _sccs(nodes: Set[str], adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan SCCs (iterative), deterministic over sorted nodes."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, iter(sorted(adj.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]; counter[0] += 1
+        stack.append(v0); on.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]; counter[0] += 1
+                    stack.append(w); on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = set()
+                while True:
+                    w = stack.pop(); on.discard(w); comp.add(w)
+                    if w == v:
+                        break
+                out.append(comp)
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def check_lock_graph(graph: LockGraph) -> Dict[str, List[Violation]]:
+    """SXT009 + SXT010 violations, keyed by file path."""
+    out: Dict[str, List[Violation]] = {}
+
+    def add(path: str, rule: str, line: int, msg: str) -> None:
+        out.setdefault(path, []).append(Violation(rule, path, line, 0, msg))
+
+    # -- SXT009: cycles -------------------------------------------------
+    nodes: Set[str] = set()
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in graph.edges:
+        nodes.add(a); nodes.add(b)
+        adj.setdefault(a, set()).add(b)
+    for comp in _sccs(nodes, adj):
+        if len(comp) < 2:
+            continue
+        cyc_edges = sorted((a, b) for (a, b) in graph.edges
+                           if a in comp and b in comp)
+        witness = "; ".join(
+            f"{a} -> {b} at {graph.edges[(a, b)][0]}:"
+            f"{graph.edges[(a, b)][1]}" for a, b in cyc_edges)
+        for a, b in cyc_edges:
+            path, line = graph.edges[(a, b)]
+            add(path, "SXT009", line,
+                f"lock-order cycle: `{b}` is acquired while `{a}` is held "
+                f"here, but the locks {sorted(comp)} are also acquired in "
+                f"the opposite order on another path ({witness}) — two "
+                f"threads interleaving these paths deadlock (the PR 11 "
+                f"router/replica incident shape). Pick one order and "
+                f"declare it in utils.invariants.LOCK_ORDER")
+
+    # -- SXT010: blocking / rank-inverted acquisition under @locked_by --
+    registered: Set[str] = set()
+    for m in graph.modules:
+        for cf in m.classes.values():
+            for a in cf.locked_by:
+                registered.add(f"{cf.name}.{a}")
+
+    def rank_of(lid: str) -> Optional[int]:
+        return graph.ranks.get(lid)
+
+    def check_acq(path: str, line: int, held: Tuple[str, ...], lid: str,
+                  via: str) -> None:
+        for h in held:
+            if h not in registered or lid == h or lid in held:
+                continue
+            rh, rl = rank_of(h), rank_of(lid)
+            if rl is None:
+                add(path, "SXT010", line,
+                    f"`{lid}` acquired{via} while holding `{h}` "
+                    f"(@locked_by), but `{lid}` has no declared rank in "
+                    f"utils.invariants.LOCK_ORDER — an ordering nobody "
+                    f"declared is an ordering nobody checks")
+            elif rh is None or rl <= rh:
+                add(path, "SXT010", line,
+                    f"`{lid}` (rank {rl}) acquired{via} while holding "
+                    f"`{h}` (rank {rh}): the declared order "
+                    f"(utils.invariants.LOCK_ORDER) only permits "
+                    f"strictly-increasing ranks — this is the hold-and-"
+                    f"wait half of a deadlock")
+
+    summary = graph.summary
+    cls_home: Dict[str, str] = {}
+    for mm in graph.modules:
+        for c in mm.classes:
+            cls_home.setdefault(c, mm.module_path)
+    for m in graph.modules:
+        for key, mf in m.methods.items():
+            for lid, line, held in mf.acquires:
+                check_acq(m.path, line, held, lid, "")
+            for ckey, line, held in mf.calls:
+                if not held or not any(h in registered for h in held):
+                    continue
+                cls, fn = ckey
+                home = m.module_path if not cls else cls_home.get(cls)
+                if home is None:
+                    continue
+                theirs = summary.get((home, cls, fn))
+                if not theirs:
+                    continue
+                for lid in sorted(theirs):
+                    check_acq(m.path, line, held, lid,
+                              f" via {cls + '.' if cls else ''}{fn}()")
+            for name, line, held, target in mf.blocking:
+                if target is not None and target in held:
+                    continue   # cv.wait() on the held lock: sanctioned
+                regs = [h for h in held if h in registered]
+                if not regs:
+                    continue
+                add(m.path, "SXT010", line,
+                    f"blocking-shaped call `{name}(...)` while holding "
+                    f"{regs} (@locked_by): a call that can park forever "
+                    f"under a lock is the PR 11 deadlock shape — release "
+                    f"the lock first, or fence with bare writes the way "
+                    f"fail_over() does")
+
+    # -- signal handlers ------------------------------------------------
+    for m in graph.modules:
+        for hname, line in m.signal_handlers:
+            mf = m.methods.get(("", hname))
+            if mf is None:
+                continue
+            acquired = set(summary.get((m.module_path, "", hname), set()))
+            direct = {lid for lid, _, _ in mf.acquires}
+            acquired |= direct
+            if acquired:
+                add(m.path, "SXT010", line,
+                    f"signal handler `{hname}` acquires {sorted(acquired)}:"
+                    f" a handler runs mid-bytecode on the main thread, "
+                    f"where a (reentrant) lock lets it interleave with a "
+                    f"half-finished frame underneath — record the request "
+                    f"and apply it at a safe point instead (the PR 7 "
+                    f"reentrant-SIGTERM fix, serving/lifecycle.py)")
+    return out
+
+
+def analyze_lock_graph(entries: Sequence[Tuple[str, ast.Module, str]]
+                       ) -> Tuple[LockGraph, Dict[str, List[Violation]]]:
+    graph = build_lock_graph(entries)
+    return graph, check_lock_graph(graph)

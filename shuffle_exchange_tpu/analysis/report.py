@@ -32,6 +32,9 @@ class Report:
     suppressed: List[SuppressedViolation]
     stale: List[StaleSuppression]            # warnings (do not fail)
     files_scanned: int
+    #: lock-graph dump (nodes+ranks+edges) when the CLI ran with
+    #: ``--lock-graph``; rides into to_json() for debugging SXT009/SXT010
+    lock_graph: "dict | None" = None
 
     @property
     def exit_code(self) -> int:
@@ -59,6 +62,8 @@ class Report:
             "rules": {rid: {"title": r.title, "incident": r.incident,
                             "advice": r.advice}
                       for rid, r in sorted(RULES.items())},
+            **({"lock_graph": self.lock_graph}
+               if self.lock_graph is not None else {}),
         }
 
 

@@ -85,6 +85,25 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "and int()/float() on a tracer is a concretization error at best",
          "hoist host work out of the jitted function; use jax.random / "
          "shape-derived ints inside"),
+    Rule("SXT009", "lock-order cycle across acquisition paths",
+         "PR 11 chaos drill: submit held the router lock while blocked on "
+         "a hung replica's lock; failover needed the router lock to fence "
+         "that replica — a three-way deadlock whose reduction is two "
+         "paths acquiring the same two locks in opposite orders. Fixed by "
+         "hand (the lock-free fence), codified here",
+         "acquire locks in strictly-increasing utils.invariants.LOCK_ORDER "
+         "rank on every path; fence with bare writes below rank 0 when the "
+         "order cannot hold (serving/router.py::fail_over)"),
+    Rule("SXT010", "blocking call or rank-inverted acquisition under a "
+                   "@locked_by lock",
+         "PR 11 (hold-and-wait under the router lock is the deadlock's "
+         "other half) and PR 7 (a SIGTERM handler draining through the "
+         "reentrant router lock interleaved with a half-finished submit "
+         "frame — the handler now only RECORDS the drain)",
+         "while holding a @locked_by lock, only acquire strictly-higher-"
+         "LOCK_ORDER-rank locks and never call join/wait/quiesce/tick/"
+         "sleep-shaped methods; signal handlers must not lock at all "
+         "(record-and-apply-at-tick, serving/lifecycle.py)"),
 ]}
 
 #: mutating method names counted as writes for SXT006/SXT007

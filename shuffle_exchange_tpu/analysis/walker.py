@@ -19,6 +19,10 @@ class FileResult:
     violations: List[Violation]          # raw; suppressions applied later
     suppressions: List[Suppression]
     malformed: List[MalformedSuppression]
+    #: parse artifacts kept for the whole-tree lock-graph pass (SXT009/
+    #: SXT010, analysis/lockgraph.py); None when the file did not parse
+    tree: "ast.Module | None" = None
+    module_path: str = ""
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
@@ -62,10 +66,31 @@ def analyze_file(path: str, select: Optional[Set[str]] = None) -> FileResult:
         return FileResult(path, [Violation(
             "SXT000", path, e.lineno or 1, e.offset or 0,
             f"file does not parse: {e.msg}")], sups, malformed)
-    checker = FileChecker(path, tree, module_path_of(path), select=select)
-    return FileResult(path, checker.run(), sups, malformed)
+    mp = module_path_of(path)
+    checker = FileChecker(path, tree, mp, select=select)
+    return FileResult(path, checker.run(), sups, malformed,
+                      tree=tree, module_path=mp)
 
 
-def analyze(paths: Sequence[str],
-            select: Optional[Set[str]] = None) -> List[FileResult]:
-    return [analyze_file(p, select=select) for p in iter_python_files(paths)]
+def analyze(paths: Sequence[str], select: Optional[Set[str]] = None,
+            want_graph: bool = False):
+    """Per-file rules plus the whole-tree lock-graph pass (SXT009/SXT010
+    need every scanned file's acquisitions to judge an ORDER, so they run
+    over the folded set, and their violations land on the owning file so
+    the per-line suppression machinery applies unchanged). With
+    ``want_graph`` returns ``(results, LockGraph-or-None)`` for the CLI's
+    ``--lock-graph`` dump."""
+    results = [analyze_file(p, select=select) for p in iter_python_files(paths)]
+    graph = None
+    if select is None or select & {"SXT009", "SXT010"}:
+        from .lockgraph import analyze_lock_graph
+
+        entries = [(fr.path, fr.tree, fr.module_path)
+                   for fr in results if fr.tree is not None]
+        graph, extra = analyze_lock_graph(entries)
+        by_path = {fr.path: fr for fr in results}
+        for path, vios in extra.items():
+            for v in vios:
+                if select is None or v.rule in select:
+                    by_path[path].violations.append(v)
+    return (results, graph) if want_graph else results

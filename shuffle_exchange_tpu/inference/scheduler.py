@@ -57,7 +57,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..monitor import InMemoryMonitor, Monitor
-from ..testing import faults
+from ..testing import faults, sanitizer
 from ..utils.invariants import atomic_on_reject
 from ..utils.logging import logger
 from .config import ServingConfig
@@ -357,6 +357,13 @@ class ContinuousBatchingScheduler:
         Returns True while admitted or queued work remains."""
         eng, cfg = self.engine, self.cfg
         bs = eng.cache.block_size
+
+        # -1.5) concurrency sanitizer (ISSUE 13): a tick can park
+        # indefinitely (cold compile, wedged dispatch, the replica_hang
+        # drill) — dispatching one while the calling thread holds any
+        # instrumented lock beyond this replica's own guard is the PR 11
+        # deadlock shape, reported with both stacks. Disarmed: one bool.
+        sanitizer.check_blocking("scheduler.tick", allow=("Replica.lock",))
 
         # -1) fault sites (ISSUE 12, armed per replica id): all three land
         # HERE, at tick entry — the dispatch boundary, where a real

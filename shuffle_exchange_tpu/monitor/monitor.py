@@ -227,14 +227,17 @@ class FleetMonitor(Monitor):
         super().__init__(enabled=True)
         import threading
 
+        from ..testing import sanitizer
+
         self.memory_monitor = InMemoryMonitor(maxlen=maxlen)
         self.downstream = downstream
         self._replica_ids: set = set()
         self._step = 0
         # threaded fleets write from one tick thread per replica while
         # aggregate()/publish() read — iterating the deque during an
-        # append raises RuntimeError, so both sides take this lock
-        self._mu = threading.Lock()
+        # append raises RuntimeError, so both sides take this lock.
+        # Rank 30 (utils.invariants.LOCK_ORDER): a leaf lock.
+        self._mu = sanitizer.wrap(threading.Lock(), "FleetMonitor._mu")
 
     def sink(self, replica_id: int) -> Monitor:
         self._replica_ids.add(int(replica_id))

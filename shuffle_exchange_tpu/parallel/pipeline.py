@@ -173,9 +173,16 @@ def spmd_pipeline(stage_fn: Callable, x_micro, *, n_stages: int, axis_name: str 
 
     state0 = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
     outputs0 = jnp.zeros_like(x_micro)
-    carry0 = (state0, outputs0, jnp.zeros((), jnp.float32))
+    # The aux carry is [1], not a 0-d scalar: when the aux genuinely
+    # participates in the gradient (a mixed-MoE stack's load-balance loss),
+    # grad-of-shard_map on jax 0.4.x saves the scan carry as region
+    # residuals and assigns each a stacked-over-devices spec on dim 0 — a
+    # rank-0 residual has no dim 0 and the transpose dies in _check_names
+    # (_SpecError). Dense stacks never hit this (their constant-zero aux is
+    # pruned as a symbolic-zero cotangent before residuals are chosen).
+    carry0 = (state0, outputs0, jnp.zeros((1,), jnp.float32))
     (state, outputs, aux), _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
-    return outputs, aux
+    return outputs, aux[0]
 
 
 class PipelinedModel:

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..monitor.monitor import InMemoryMonitor, Monitor
+from ..testing import sanitizer
 from ..utils.invariants import locked_by, requires_lock
 
 
@@ -136,7 +137,9 @@ class WeightWire:
 
         self.pool = get_buffer_pool()
         self._chan = next(WeightWire._next_channel_id)
-        self._mu = threading.Lock()
+        # rank 20 (utils.invariants.LOCK_ORDER), like the KV channel it
+        # mirrors; instrumented under SXT_SANITIZE
+        self._mu = sanitizer.wrap(threading.Lock(), "WeightWire._mu")
         self.spill_dir = spill_dir
         self.sends = 0
         self.bytes_moved = 0

@@ -41,7 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..testing import faults
+from ..testing import faults, sanitizer
 from ..utils.logging import logger
 from .router import LoadShedError, ReplicaRouter
 
@@ -175,6 +175,11 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
     uids: List[Optional[int]] = []
     shed = 0
     faults.clear()
+    # runtime concurrency sanitizer (ISSUE 13): under SXT_SANITIZE=1 the
+    # fleet's locks are instrumented — the drill asserts the chaos run
+    # produced ZERO inversion / hold-while-blocking reports (held-too-long
+    # is expected: the injected hang parks a replica lock by design)
+    san_before = len(sanitizer.reports())
     if threaded:
         router.start()
     try:
@@ -270,6 +275,13 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
         "goodput_clean": clean["stats"]["sustained_tokens_per_sec"],
         "goodput_chaos": st["sustained_tokens_per_sec"],
     }
+    san_new = sanitizer.reports()[san_before:]
+    report["sanitizer"] = {
+        "armed": sanitizer.armed(),
+        "reports": {k: sum(1 for r in san_new if r.kind == k)
+                    for k in ("inversion", "hold_while_blocking",
+                              "held_too_long", "thread_leak")},
+    }
     if check:
         assert not lost, f"lost requests (no terminal state): {lost}"
         if deadline_s is None:
@@ -295,6 +307,13 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
             assert report["ttft_p95_x"] <= ttft_p95_bound_x, (
                 f"TTFT p95 degraded {report['ttft_p95_x']:.1f}x > bound "
                 f"{ttft_p95_bound_x}x")
+        if sanitizer.armed():
+            bad = [r for r in san_new
+                   if r.kind in ("inversion", "hold_while_blocking")]
+            assert not bad, (
+                "chaos drill under the concurrency sanitizer produced "
+                f"{len(bad)} inversion/hold-while-blocking report(s):\n"
+                + "\n\n".join(repr(r) for r in bad))
     return report
 
 

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..inference.engine_v2 import InferenceEngineV2, KVBlockPayload
 from ..monitor.monitor import InMemoryMonitor, Monitor
-from ..testing import faults
+from ..testing import faults, sanitizer
 from ..utils.invariants import atomic_on_reject, locked_by, requires_lock
 from ..utils.logging import logger
 
@@ -75,7 +75,10 @@ class KVTransferChannel:
         # DisaggregatedServers) staging the same wire shape must never
         # share a buffer
         self._chan = next(KVTransferChannel._next_channel_id)
-        self._mu = threading.Lock()
+        # rank 20 (utils.invariants.LOCK_ORDER); _cv below wraps the SAME
+        # mutex, so they share the rank — acquiring one while holding the
+        # other is a self-deadlock SXT010/the sanitizer both refuse
+        self._mu = sanitizer.wrap(threading.Lock(), "KVTransferChannel._mu")
         self.spill_dir = spill_dir
         self.clock = clock
         self.memory_monitor = InMemoryMonitor(maxlen=1024)
@@ -101,7 +104,7 @@ class KVTransferChannel:
         # checkpoint — instead of racing export/commit (the payload could
         # otherwise gather blocks a concurrent flush already freed and
         # reallocated to another sequence).
-        self._cv = threading.Condition(self._mu)
+        self._cv = sanitizer.make_condition(self._mu, "KVTransferChannel._cv")
         self._busy: Dict[int, int] = {}        # id(engine) -> in-flight
         self._aborting: set = set()            # id(engine) under abort veto
 

@@ -50,6 +50,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..runtime.resilience import StepWatchdog
+from ..testing import sanitizer
 from ..utils.invariants import locked_by, requires_lock
 from ..utils.logging import logger
 
@@ -93,7 +94,9 @@ class HealthMonitor:
     def __init__(self, rcfg, clock: Callable[[], float] = time.perf_counter):
         self.rcfg = rcfg
         self.clock = clock
-        self._mu = threading.Lock()
+        # rank 30 (utils.invariants.LOCK_ORDER): a leaf lock — nothing
+        # else is ever acquired while it is held
+        self._mu = sanitizer.wrap(threading.Lock(), "HealthMonitor._mu")
         self.records: Dict[int, ReplicaHealth] = {}
         self._watchdogs: Dict[int, StepWatchdog] = {}
         self.hung_ticks = 0

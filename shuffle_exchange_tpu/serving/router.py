@@ -46,7 +46,7 @@ from ..inference.scheduler import (FAILED, FINISHED, PREFILL, RUNNING,
                                    ContinuousBatchingScheduler,
                                    ServingRequest)
 from ..monitor.monitor import FleetMonitor, Monitor
-from ..testing import faults
+from ..testing import faults, sanitizer
 from ..utils.invariants import atomic_on_reject, locked_by, requires_lock
 from ..utils.logging import logger
 from .health import H_DEAD, HealthMonitor
@@ -114,8 +114,10 @@ class Replica:
         self.thread: Optional[threading.Thread] = None
         # guards this replica's scheduler (tick vs submit/inject/export):
         # per-replica so N threaded replicas tick CONCURRENTLY — the
-        # router-wide lock covers only membership/placement bookkeeping
-        self.lock = threading.RLock()
+        # router-wide lock covers only membership/placement bookkeeping.
+        # Rank 10 in utils.invariants.LOCK_ORDER; instrumented under
+        # SXT_SANITIZE (testing/sanitizer.py).
+        self.lock = sanitizer.wrap(threading.RLock(), "Replica.lock")
 
     @property
     def active(self) -> bool:
@@ -165,7 +167,11 @@ class ReplicaRouter:
         self._session_of: Dict[int, object] = {}        # uid -> session
         self._next_uid = 0
         self._stop = threading.Event()
-        self._lock = threading.RLock()
+        # rank 0 — the BOTTOM of the declared lock hierarchy
+        # (utils.invariants.LOCK_ORDER): nothing below it may be held
+        # when it is taken, and fail_over()'s fence deliberately uses
+        # bare bool writes so a hung replica can be released without it
+        self._lock = sanitizer.wrap(threading.RLock(), "ReplicaRouter._lock")
         # replica ids whose drain was REQUESTED from a signal handler
         # (serving/lifecycle.py): the handler only records the id — a
         # handler that mutated router state directly could interleave

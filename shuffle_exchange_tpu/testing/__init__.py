@@ -1,4 +1,5 @@
-"""Test seams: fault injection for the resilience layer (testing/faults.py)."""
+"""Test seams: fault injection (testing/faults.py) and the runtime
+concurrency sanitizer (testing/sanitizer.py, ``SXT_SANITIZE=1``)."""
 
-from . import faults  # noqa: F401
+from . import faults, sanitizer  # noqa: F401
 from .faults import Fault, InjectedFault  # noqa: F401

@@ -1,0 +1,398 @@
+"""Runtime concurrency sanitizer: instrumented locks for the serving fleet.
+
+The static half (``analysis/lockgraph.py``, rules SXT009/SXT010) proves
+what it can resolve syntactically; this module catches the remainder at
+TEST time — actual interleavings, instance-level inversions between two
+replicas' same-named locks, hangs that only a real thread exhibits.
+
+Opt-in, zero production overhead: every annotated lock-construction site
+calls :func:`wrap` (or :func:`make_condition`), which returns the RAW
+lock unchanged unless the sanitizer is armed — ``SXT_SANITIZE=1`` in the
+environment, or :func:`arm` before the locks are constructed. Armed,
+each lock is wrapped in a recording proxy and the sanitizer maintains:
+
+- **per-thread acquisition stacks** — who holds what, acquired where
+  (a trimmed ``traceback`` per hold);
+- **an instance-level acquisition-order graph** — acquiring B while
+  holding A records the edge A->B with its stack; a later B->A is an
+  **inversion** report naming BOTH stacks (the PR 11 router/replica
+  deadlock, caught on the first interleaving that exhibits either order,
+  no need for the actual deadlock to strike);
+- **held-too-long** — a lock held longer than ``SXT_SANITIZE_HOLD_S``
+  (default 20s) is reported with its acquisition stack (a hung tick
+  parked under a replica lock shows up here during chaos drills — an
+  expected *warning*, which is why :func:`assert_clean` fails on
+  inversions only by default);
+- **hold-while-blocking** — :func:`blocking_region` marks designated
+  blocking sections (the scheduler's tick dispatch); entering one while
+  holding any instrumented lock outside the region's allow-list is a
+  report (the exact incident shape: a tick dispatched while the caller
+  held the router lock);
+- **thread leaks** — :func:`thread_baseline` / :func:`check_thread_leaks`
+  snapshot serving threads around a test; fleet threads that survive
+  teardown are reported (tests/conftest.py wires this per-test when the
+  sanitizer is armed).
+
+Reports accumulate process-wide in :func:`reports`; ``assert_clean()``
+raises with every offending stack. ``scripts/ci_full.sh`` runs the
+threaded serving suites (test_failover / test_serving_router /
+test_disagg / test_rlhf) and ``scripts/chaos_drill.py`` under
+``SXT_SANITIZE=1``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+
+#: hold-duration warning threshold (seconds)
+HOLD_S = float(os.environ.get("SXT_SANITIZE_HOLD_S", "20"))
+
+#: thread-name prefixes the leak detector owns (fleet worker threads)
+LEAK_PREFIXES = ("serving-", "watchdog-replica", "sxt-")
+
+_ARMED = [bool(os.environ.get("SXT_SANITIZE"))]
+_MU = threading.Lock()                       # guards the report/edge state
+#: (a_id, b_id) -> (a_name, b_name, stack, a_wrapper, b_wrapper). The
+#: wrappers are held STRONGLY so the underlying mutexes can never be
+#: garbage-collected while an edge references their id() — without the
+#: pin, CPython reusing a dead lock's address for a new one would alias
+#: stale edges onto it and fabricate inversions. Bounded by the number
+#: of distinct (lock, lock) nesting pairs a test run exhibits; reset()
+#: clears it.
+_EDGES: Dict[Tuple[int, int], Tuple[str, str, str, object, object]] = {}
+_REPORTS: List["Report"] = []
+_TLS = threading.local()
+
+
+class Report:
+    """One sanitizer finding."""
+
+    def __init__(self, kind: str, message: str,
+                 stacks: Tuple[str, ...] = ()):
+        self.kind = kind         # inversion | held_too_long |
+        #                          hold_while_blocking | thread_leak
+        self.message = message
+        self.stacks = stacks
+        self.thread = threading.current_thread().name
+
+    def __repr__(self):
+        body = "\n".join(f"--- stack {i} ---\n{s}"
+                         for i, s in enumerate(self.stacks))
+        return (f"[{self.kind}] ({self.thread}) {self.message}"
+                + (f"\n{body}" if body else ""))
+
+
+def armed() -> bool:
+    return _ARMED[0]
+
+
+def arm() -> None:
+    """Turn the sanitizer on for locks constructed FROM NOW ON (wrap()
+    decides at construction). Tests arm before building the fleet."""
+    _ARMED[0] = True
+
+
+def disarm() -> None:
+    _ARMED[0] = False
+
+
+def reset() -> None:
+    """Drop accumulated reports and edges (test isolation)."""
+    with _MU:
+        _REPORTS.clear()
+        _EDGES.clear()
+
+
+def reports() -> List[Report]:
+    with _MU:
+        return list(_REPORTS)
+
+
+def take_reports() -> List[Report]:
+    with _MU:
+        out = list(_REPORTS)
+        _REPORTS.clear()
+        return out
+
+
+def inversions() -> List[Report]:
+    return [r for r in reports() if r.kind == "inversion"]
+
+
+def assert_clean(kinds: Tuple[str, ...] = ("inversion",
+                                           "hold_while_blocking")) -> None:
+    """Raise if any report of the given kinds accumulated. Held-too-long
+    is excluded by default: a chaos drill's injected hang legitimately
+    parks a replica lock past any threshold — that report is the
+    sanitizer doing its job, not a bug in the tree."""
+    bad = [r for r in reports() if r.kind in kinds]
+    if bad:
+        raise AssertionError(
+            f"concurrency sanitizer: {len(bad)} report(s):\n"
+            + "\n\n".join(repr(r) for r in bad))
+
+
+def _emit(kind: str, message: str, stacks: Tuple[str, ...] = ()) -> None:
+    rep = Report(kind, message, stacks)
+    with _MU:
+        _REPORTS.append(rep)
+    logger.error(f"sanitizer: {rep!r}")
+
+
+def _stack(skip: int = 2) -> str:
+    return "".join(traceback.format_stack()[:-skip][-8:])
+
+
+def _holds() -> List[Tuple["_SanLockBase", float, str]]:
+    h = getattr(_TLS, "holds", None)
+    if h is None:
+        h = _TLS.holds = []
+    return h
+
+
+# ---------------------------------------------------------------------------
+# lock proxies
+# ---------------------------------------------------------------------------
+
+class _SanLockBase:
+    """Order/hold recording shared by the lock and condition proxies."""
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def _pre_acquire(self) -> None:
+        holds = _holds()
+        if any(h[0] is self for h in holds):
+            return   # re-entry on the same instance (RLock) — no edge
+        me = id(self._underlying())
+        stk = _stack()
+        for held, _, held_stk in holds:
+            a = id(held._underlying())
+            if a == me:
+                _emit("inversion",
+                      f"`{self.name}` and `{held.name}` share one "
+                      f"underlying mutex and the thread already holds it "
+                      f"— self-deadlock on a non-reentrant lock",
+                      (held_stk, stk))
+                continue
+            # decide under _MU, emit outside it (_emit retakes _MU)
+            with _MU:
+                rev = _EDGES.get((me, a))
+                if rev is None:
+                    _EDGES.setdefault((a, me),
+                                      (held.name, self.name, stk,
+                                       held, self))
+            if rev is not None:
+                _emit("inversion",
+                      f"lock-order inversion: acquiring `{self.name}` "
+                      f"while holding `{held.name}`, but the opposite "
+                      f"order `{held.name}` -> `{self.name}` was "
+                      f"recorded earlier (first stack: that recording; "
+                      f"second: this acquisition)",
+                      (rev[2], stk))
+
+    def _post_acquire(self) -> None:
+        _holds().append((self, time.monotonic(), _stack()))
+
+    def _pre_release(self) -> None:
+        holds = _holds()
+        for i in range(len(holds) - 1, -1, -1):
+            if holds[i][0] is self:
+                _, t0, stk = holds.pop(i)
+                dt = time.monotonic() - t0
+                if dt > HOLD_S:
+                    _emit("held_too_long",
+                          f"`{self.name}` held for {dt:.1f}s "
+                          f"(> {HOLD_S:.0f}s threshold)", (stk,))
+                return
+
+    def _underlying(self):
+        return self._inner
+
+    def __repr__(self):
+        return f"<sanitized {self.name} wrapping {self._inner!r}>"
+
+
+class _SanLock(_SanLockBase):
+    """Proxy for Lock/RLock."""
+
+    def acquire(self, *a, **kw):
+        self._pre_acquire()
+        ok = self._inner.acquire(*a, **kw)
+        if ok:
+            self._post_acquire()
+        return ok
+
+    def release(self):
+        self._pre_release()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition(built on a _SanLock) probes these when present
+    def _is_owned(self):
+        return self._inner._is_owned() if hasattr(self._inner, "_is_owned") \
+            else None
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") else None
+
+
+class _SanCondition(_SanLockBase):
+    """Proxy for Condition: wait() releases the hold for its duration."""
+
+    def acquire(self, *a, **kw):
+        self._pre_acquire()
+        ok = self._inner.acquire(*a, **kw)
+        if ok:
+            self._post_acquire()
+        return ok
+
+    def release(self):
+        self._pre_release()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        self._pre_release()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._post_acquire()
+
+    def wait_for(self, predicate, timeout=None):
+        self._pre_release()
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._post_acquire()
+
+    def notify(self, n=1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+    def _underlying(self):
+        # the Condition's mutex, so cv-vs-plain-lock aliasing is visible
+        return getattr(self._inner, "_lock", self._inner)
+
+
+def wrap(lock, name: str):
+    """Instrument ``lock`` under ``name`` ("Class.attr", matching the
+    utils.invariants.LOCK_ORDER key) when armed; return it unchanged
+    otherwise. The annotated construction sites call this
+    unconditionally — disarmed cost is one truthiness check."""
+    if not armed():
+        return lock
+    return _SanLock(lock, name)
+
+
+def make_condition(lock, name: str) -> "threading.Condition | _SanCondition":
+    """Build a Condition over ``lock`` (which may itself be a wrapped
+    lock — the Condition is built on the RAW mutex so the two wrappers
+    share an underlying id and cross-acquisition is detectable)."""
+    raw = lock._inner if isinstance(lock, _SanLockBase) else lock
+    cv = threading.Condition(raw)
+    if not armed():
+        return cv
+    return _SanCondition(cv, name)
+
+
+# ---------------------------------------------------------------------------
+# blocking regions (hold-while-blocking)
+# ---------------------------------------------------------------------------
+
+class blocking_region:
+    """Context manager marking a section that may block indefinitely
+    (a tick's compiled dispatch, a wire transfer). Entering it while
+    holding any instrumented lock whose name is not in ``allow`` is a
+    ``hold_while_blocking`` report — the exact PR 11 incident shape
+    (a tick dispatched under the router lock). Disarmed: zero work."""
+
+    def __init__(self, what: str, allow: Tuple[str, ...] = ()):
+        self.what = what
+        self.allow = allow
+
+    def __enter__(self):
+        if not armed():
+            return self
+        offenders = [(h, stk) for h, _, stk in _holds()
+                     if not any(h.name.startswith(p) for p in self.allow)]
+        if offenders:
+            names = [h.name for h, _ in offenders]
+            _emit("hold_while_blocking",
+                  f"entering blocking region `{self.what}` while holding "
+                  f"{names} — a hang inside would park those locks forever "
+                  f"(the PR 11 deadlock shape)",
+                  tuple(stk for _, stk in offenders) + (_stack(),))
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def check_blocking(what: str, allow: Tuple[str, ...] = ()) -> None:
+    """One-shot form of :class:`blocking_region` for call sites where a
+    context manager would force reindenting a long body (the scheduler's
+    tick entry). Disarmed: one boolean check."""
+    if armed():
+        blocking_region(what, allow).__enter__()
+
+
+# ---------------------------------------------------------------------------
+# thread-leak detection
+# ---------------------------------------------------------------------------
+
+def _fleet_threads() -> Dict[int, str]:
+    return {t.ident: t.name for t in threading.enumerate()
+            if t.ident is not None
+            and any(t.name.startswith(p) for p in LEAK_PREFIXES)}
+
+
+def thread_baseline() -> Dict[int, str]:
+    """Snapshot the live fleet threads (by ident) before a test."""
+    return _fleet_threads()
+
+
+def check_thread_leaks(baseline: Dict[int, str],
+                       grace_s: float = 2.0) -> List[str]:
+    """Fleet threads alive now that were NOT in ``baseline`` and do not
+    exit within ``grace_s`` are leaks (a router whose stop() was never
+    called, a watchdog timer nobody cancelled). Returns the leaked
+    names; also emits a ``thread_leak`` report for each."""
+    deadline = time.monotonic() + grace_s
+    leaked: Dict[int, str] = {}
+    while True:
+        leaked = {i: n for i, n in _fleet_threads().items()
+                  if i not in baseline}
+        if not leaked or time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    for name in leaked.values():
+        _emit("thread_leak",
+              f"fleet thread `{name}` survived test teardown — a "
+              f"router/supervisor/watchdog was started and never stopped")
+    return sorted(leaked.values())

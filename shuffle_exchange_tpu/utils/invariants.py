@@ -30,6 +30,50 @@ DEFAULT_ADMISSION_CHECKS = ("_admission_detail", "can_schedule")
 #: still ahead (validate-everything-then-mutate).
 VALIDATE = "validate"
 
+#: The declared global lock-acquisition order for the threaded serving
+#: fleet (ISSUE 13). A thread holding a lock of rank r may acquire only
+#: locks of STRICTLY greater rank — the router's membership lock comes
+#: first, then a replica's scheduler guard, then the transfer substrate,
+#: then the leaf observability locks that everything reports into. The
+#: PR 11 chaos drill found the one real deadlock this table codifies:
+#: ``submit`` held the router lock while blocked on a hung replica's
+#: lock, and the failover that would have released that replica needed
+#: the router lock to fence it — which is why ``fail_over``'s fence is
+#: bare bool writes taken with NO lock at all, strictly below rank 0.
+#:
+#: sxt-check rules SXT009/SXT010 (``analysis/lockgraph.py``) consume
+#: this table: acquiring (directly or through a resolvable call) a lock
+#: whose rank is not strictly greater than one already held — or a lock
+#: absent from this table — while holding a ``@locked_by`` lock is a
+#: violation. Keys are ``"ClassName.lock_attr"``. Locks of the SAME
+#: underlying mutex (``KVTransferChannel._cv`` wraps ``._mu``) share a
+#: rank: acquiring one while holding the other is a self-deadlock and
+#: the equal rank refuses it.
+LOCK_ORDER = {
+    # rank 0 — fleet membership/placement/failover bookkeeping. Held
+    # across placement decisions and failover re-homing; must NEVER wait
+    # on anything below (the PR 11 incident shape).
+    "ReplicaRouter._lock": 0,
+    # rank 10 — one replica's scheduler guard (tick vs submit/inject/
+    # export). The tick dispatch runs under it, so nothing that can be
+    # held while a tick is in flight may rank above it.
+    "Replica.lock": 10,
+    # rank 20 — the transfer substrate (KV migration / weight wire
+    # staging slots + the drain barrier condition).
+    "KVTransferChannel._mu": 20,
+    "KVTransferChannel._cv": 20,
+    "WeightWire._mu": 20,
+    # rank 30 — leaf locks: health records and monitor rings. Everything
+    # reports into these; they call out to nothing.
+    "HealthMonitor._mu": 30,
+    "FleetMonitor._mu": 30,
+}
+
+
+def lock_rank(lock_id: str) -> "int | None":
+    """Declared rank of ``"ClassName.attr"``; None when undeclared."""
+    return LOCK_ORDER.get(lock_id)
+
 
 def atomic_on_reject(fn=None, *, check: "str | None" = None):
     """Declare a method atomic-on-reject: a refused call mutates nothing.

@@ -54,6 +54,30 @@ def _isolate_topology():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _sanitizer_guard():
+    """Runtime concurrency sanitizer gate (ISSUE 13): when the suite runs
+    under ``SXT_SANITIZE=1`` (scripts/ci_full.sh runs the threaded serving
+    suites that way), every test fails on any NEW lock-order inversion /
+    hold-while-blocking report, and fleet threads that survive teardown
+    (``serving-*`` / replica watchdogs) are leak reports. Disarmed — the
+    tier-1 default — this is two attribute reads."""
+    from shuffle_exchange_tpu.testing import sanitizer
+
+    if not sanitizer.armed():
+        yield
+        return
+    baseline = sanitizer.thread_baseline()
+    before = len(sanitizer.reports())
+    yield
+    sanitizer.check_thread_leaks(baseline)
+    bad = [r for r in sanitizer.reports()[before:]
+           if r.kind in ("inversion", "hold_while_blocking", "thread_leak")]
+    assert not bad, (
+        f"concurrency sanitizer: {len(bad)} report(s) during this test:\n"
+        + "\n\n".join(repr(r) for r in bad))
+
+
 @pytest.fixture(scope="session")
 def devices8():
     import jax

@@ -406,6 +406,357 @@ def test_sxt008_quiet_outside_jit_and_on_static_shapes(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# SXT009 / SXT010: the lock-graph pass (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def check_locks(tmp_path, source, name="lockfix.py", select=None):
+    """Like check_source but through run(): the lock-graph pass only has
+    an ORDER to judge over the folded set, so it rides analyze(), not
+    the per-file checker."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run([str(p)], select=select)
+
+
+PR11_DEADLOCK = """
+    import threading
+    from shuffle_exchange_tpu.utils.invariants import locked_by
+
+    SXT_LOCK_ORDER = {"Router._lock": 0, "Router.replica_lock": 10}
+
+
+    @locked_by("_lock", "requests", "owner")
+    class Router:
+        '''PR 11 incident reconstruction, PRE-fix: submit holds the
+        router lock then the replica's; the old failover needed the
+        router lock while the replica lock was effectively held (the
+        hung tick) — reduced to its two-path lock-order inversion.'''
+
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.replica_lock = threading.RLock()
+
+        def submit(self, prompt):
+            with self._lock:
+                with self.replica_lock:
+                    self.requests = prompt
+
+        def fail_over_old(self, rid):
+            with self.replica_lock:
+                with self._lock:        # INVERSION: the fence needed _lock
+                    self.owner = rid
+"""
+
+PR11_FIXED = """
+    import threading
+    from shuffle_exchange_tpu.utils.invariants import locked_by
+
+    SXT_LOCK_ORDER = {"Router._lock": 0, "Router.replica_lock": 10}
+
+
+    @locked_by("_lock", "requests", "owner")
+    class Router:
+        '''The shipped fix: the fence is bare bool writes BELOW every
+        lock; failover takes the router lock alone.'''
+
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.replica_lock = threading.RLock()
+
+        def submit(self, prompt):
+            with self._lock:
+                with self.replica_lock:
+                    self.requests = prompt
+
+        def fail_over(self, rid):
+            self.fenced = True
+            with self._lock:
+                self.owner = rid
+"""
+
+
+def test_sxt009_fires_on_pr11_deadlock_reconstruction(tmp_path):
+    rep = check_locks(tmp_path, PR11_DEADLOCK)
+    ids = rule_ids(rep)
+    assert "SXT009" in ids
+    # both participating acquisition sites are flagged, each naming the
+    # opposite-order witness
+    nine = [v for v in rep.violations if v.rule == "SXT009"]
+    assert len(nine) == 2
+    assert all("opposite order" in v.message for v in nine)
+    assert rep.exit_code == 1
+
+
+def test_sxt009_silent_on_fixed_failover(tmp_path):
+    rep = check_locks(tmp_path, PR11_FIXED)
+    assert rule_ids(rep) == []
+    assert rep.exit_code == 0
+
+
+def test_sxt009_cycle_through_call_edge(tmp_path):
+    """The inversion hides behind a same-class call: harvesting must
+    resolve the helper's acquisition interprocedurally."""
+    rep = check_locks(tmp_path, """
+        import threading
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+        SXT_LOCK_ORDER = {"C.a": 0, "C.b": 1}
+
+
+        @locked_by("a", "x")
+        class C:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def fwd(self):
+                with self.a:
+                    with self.b:
+                        self.x = 1
+
+            def _fence(self):
+                with self.a:
+                    self.x = 2
+
+            def rev(self):
+                with self.b:
+                    self._fence()       # acquires a UNDER b via the call
+    """)
+    assert "SXT009" in rule_ids(rep)
+
+
+def test_sxt010_blocking_call_under_locked_by(tmp_path):
+    rep = check_locks(tmp_path, """
+        import threading
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+
+        @locked_by("_lock", "jobs")
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_stop(self, worker):
+                with self._lock:
+                    worker.join(timeout=5)      # blocks under the lock
+
+            def bad_tick(self, replica):
+                with self._lock:
+                    replica.scheduler.tick()    # a tick can hang
+
+            def good_stop(self, worker):
+                with self._lock:
+                    self.jobs = ()
+                worker.join(timeout=5)          # lock released first
+
+            def strings_are_fine(self, reasons):
+                with self._lock:
+                    self.jobs = "; ".join(reasons)   # str.join, not thread
+    """)
+    ten = [v for v in rep.violations if v.rule == "SXT010"]
+    assert len(ten) == 2
+    assert {"join" in v.message or "tick" in v.message for v in ten} == {True}
+
+
+def test_sxt010_rank_inversion_and_unranked(tmp_path):
+    rep = check_locks(tmp_path, """
+        import threading
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+        SXT_LOCK_ORDER = {"Low._mu": 0, "High._mu": 10}
+
+
+        class Low:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+
+        class Extra:
+            def __init__(self):
+                self.guard = threading.Lock()
+
+
+        @locked_by("_mu", "state")
+        class High:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def inverted(self):
+                low = Low()
+                with self._mu:          # rank 10
+                    with low._mu:       # rank 0 under rank 10
+                        self.state = 1
+
+            def unranked(self):
+                e = Extra()
+                with self._mu:
+                    with e.guard:       # no declared rank at all
+                        self.state = 2
+    """)
+    ten = [v for v in rep.violations if v.rule == "SXT010"]
+    assert len(ten) == 2
+    assert any("strictly-increasing" in v.message for v in ten)
+    assert any("no declared rank" in v.message for v in ten)
+
+
+def test_sxt010_rank_respecting_acquisition_is_silent(tmp_path):
+    rep = check_locks(tmp_path, """
+        import threading
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+        SXT_LOCK_ORDER = {"Low._mu": 0, "High._mu": 10}
+
+
+        class High:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+
+        @locked_by("_mu", "state")
+        class Low:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def ordered(self):
+                h = High()
+                with self._mu:          # rank 0
+                    with h._mu:         # rank 10: strictly increasing
+                        self.state = 1
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_sxt010_cv_wait_on_held_lock_is_exempt(tmp_path):
+    rep = check_locks(tmp_path, """
+        import threading
+        from shuffle_exchange_tpu.utils.invariants import locked_by
+
+
+        @locked_by("_cv", "busy")
+        class Chan:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def quiesce_ok(self):
+                with self._cv:
+                    while self.busy:
+                        self._cv.wait(timeout=1.0)   # sanctioned pattern
+
+            def bad_wait(self, other_event):
+                with self._cv:
+                    other_event.wait()               # waits on a STRANGER
+    """)
+    ten = [v for v in rep.violations if v.rule == "SXT010"]
+    assert len(ten) == 1
+    assert "wait" in ten[0].message
+
+
+def test_sxt010_signal_handler_lock_acquisition(tmp_path):
+    rep = check_locks(tmp_path, """
+        import signal
+        import threading
+
+        _MU = threading.Lock()
+        _HOOKS = {}
+
+
+        def bad_handler(signum, frame):
+            with _MU:                    # PR 7 shape: lock in a handler
+                _HOOKS.clear()
+
+
+        def good_handler(signum, frame):
+            _HOOKS.clear()               # record-only, no lock
+
+
+        signal.signal(signal.SIGTERM, bad_handler)
+        signal.signal(signal.SIGUSR1, good_handler)
+    """)
+    ten = [v for v in rep.violations if v.rule == "SXT010"]
+    assert len(ten) == 1
+    assert "signal handler" in ten[0].message
+    assert "bad_handler" in ten[0].message
+
+
+def test_sxt009_010_suppression_select_and_stale(tmp_path):
+    """The new rules ride the existing suppression/stale/--select
+    machinery (satellite): a reasoned suppression silences, --select
+    scopes, and an unmatched suppression is stale under the full gate
+    but never under a select that skipped the rule."""
+    src = PR11_DEADLOCK.replace(
+        "            with self.replica_lock:\n"
+        "                with self._lock:        # INVERSION: the fence needed _lock\n",
+        "            with self.replica_lock:\n"
+        "                # sxt: ignore[SXT009] fixture: documented legacy order\n"
+        "                with self._lock:\n")
+    p = tmp_path / "sup.py"
+    p.write_text(textwrap.dedent(src))
+    rep = run([str(p)])
+    # the submit-side edge of the cycle is still unsuppressed
+    assert [v.rule for v in rep.violations] == ["SXT009"]
+    assert len(rep.suppressed) == 1
+
+    rep = run([str(p)], select={"SXT000", "SXT010"})
+    assert not rep.violations
+    assert not rep.stale      # SXT009 did not run -> not judged stale
+
+    fixed = tmp_path / "stale.py"
+    dedented = textwrap.dedent(PR11_FIXED)
+    assert "        self.fenced = True\n" in dedented
+    fixed.write_text(dedented.replace(
+        "        self.fenced = True\n",
+        "        self.fenced = True\n"
+        "        # sxt: ignore[SXT009] nothing fires here anymore\n"))
+    rep = run([str(fixed)])
+    assert not rep.violations
+    assert len(rep.stale) == 1 and rep.stale[0].rules == ("SXT009",)
+
+
+def test_lock_graph_harvests_the_real_router():
+    """The shipped tree's graph contains the sanctioned router->replica
+    edge, every @locked_by fleet lock is ranked, and the declared order
+    is router < replica < channel < monitor."""
+    from shuffle_exchange_tpu.analysis import build_lock_graph
+    from shuffle_exchange_tpu.analysis.walker import analyze
+    from shuffle_exchange_tpu.utils.invariants import LOCK_ORDER
+
+    results = analyze([os.path.join(PKG_DIR, "serving"),
+                       os.path.join(PKG_DIR, "monitor"),
+                       os.path.join(PKG_DIR, "rlhf")])
+    graph = build_lock_graph([(r.path, r.tree, r.module_path)
+                              for r in results if r.tree is not None])
+    assert ("ReplicaRouter._lock", "Replica.lock") in graph.edges
+    # no edge may point DOWN the hierarchy
+    for (a, b) in graph.edges:
+        ra, rb = LOCK_ORDER.get(a), LOCK_ORDER.get(b)
+        if ra is not None and rb is not None:
+            assert ra < rb, (a, b)
+    assert (LOCK_ORDER["ReplicaRouter._lock"]
+            < LOCK_ORDER["Replica.lock"]
+            < LOCK_ORDER["KVTransferChannel._mu"]
+            < LOCK_ORDER["HealthMonitor._mu"])
+    assert LOCK_ORDER["KVTransferChannel._cv"] == \
+        LOCK_ORDER["KVTransferChannel._mu"]
+
+
+def test_cli_lock_graph_dump(tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "shuffle_exchange_tpu.analysis",
+         os.path.join(PKG_DIR, "serving", "router.py"),
+         "--lock-graph", "--json", str(out)],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert '"ranks"' in proc.stdout and '"edges"' in proc.stdout
+    data = json.loads(out.read_text())
+    assert "lock_graph" in data
+    assert data["lock_graph"]["ranks"]["ReplicaRouter._lock"] == 0
+    edges = {(e["held"], e["acquired"]) for e in data["lock_graph"]["edges"]}
+    assert ("ReplicaRouter._lock", "Replica.lock") in edges
+
+
+# ---------------------------------------------------------------------------
 # suppression mechanics (satellite)
 # ---------------------------------------------------------------------------
 

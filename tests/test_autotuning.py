@@ -38,6 +38,7 @@ def test_memory_estimate_monotone():
     assert unsharded > small                 # ZeRO sharding shrinks state
 
 
+@pytest.mark.slow   # 22s: measured-e2e tune; nightly via ci_full (ISSUE 13 tier-1 budget)
 def test_tune_picks_measured_best_of_six(devices8, tmp_path):
     """>= 6 candidates, measured short runs, best-by-metric wins (VERDICT
     round-1 item #5 'done' criterion)."""

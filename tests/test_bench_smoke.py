@@ -231,6 +231,7 @@ def test_prefix_cache_row_runs_at_toy_size():
     assert row["token_mismatches_vs_no_cache"] == 0
 
 
+@pytest.mark.slow   # 15s: bench-row pin; nightly via ci_full (ISSUE 13 tier-1 budget)
 def test_serving_speculative_row_runs_at_toy_size():
     """The config-5 speculative row (bench.serving_speculative_row) at toy
     size: the same repetitive-suffix Poisson trace at k=0 vs k=4 with the

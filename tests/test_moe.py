@@ -199,6 +199,7 @@ def test_grouped_matmul_matches_pergroup_einsum():
     assert np.isfinite(np.asarray(g)).all()
 
 
+@pytest.mark.slow   # 10s: impl parity; nightly via ci_full (ISSUE 13 tier-1 budget)
 def test_index_dispatch_matches_einsum_dispatch():
     """The round-5 index-form capacity path (scalar slot scatter + row
     gathers) must be BIT-equivalent in routing to the GShard dense-einsum

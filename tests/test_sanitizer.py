@@ -1,0 +1,220 @@
+"""Runtime concurrency sanitizer units (ISSUE 13): fake locks, no jax.
+
+The sanitizer (testing/sanitizer.py) is the dynamic half of the
+concurrency-correctness layer — the static half's fixtures live in
+tests/test_analysis.py. Everything here uses plain threading primitives
+and millisecond sleeps; the whole file stays well under the tier-1
+budget bar for new ISSUE 13 tests (<10s).
+"""
+
+import threading
+import time
+
+import pytest
+
+from shuffle_exchange_tpu.testing import sanitizer
+
+
+@pytest.fixture()
+def armed():
+    was = sanitizer.armed()
+    sanitizer.arm()
+    sanitizer.reset()
+    yield
+    sanitizer.reset()
+    if not was:
+        sanitizer.disarm()
+
+
+def test_wrap_is_identity_when_disarmed():
+    was = sanitizer.armed()
+    sanitizer.disarm()
+    try:
+        raw = threading.Lock()
+        assert sanitizer.wrap(raw, "X") is raw
+        cv = sanitizer.make_condition(raw, "X._cv")
+        assert isinstance(cv, threading.Condition)
+    finally:
+        if was:
+            sanitizer.arm()
+
+
+def test_inversion_detected_with_both_stacks(armed):
+    a = sanitizer.wrap(threading.Lock(), "A")
+    b = sanitizer.wrap(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:     # opposite order: the recorded A->B edge inverts
+            pass
+    inv = sanitizer.inversions()
+    assert len(inv) == 1
+    assert "`A` while holding `B`" in inv[0].message
+    assert len(inv[0].stacks) == 2 and all(inv[0].stacks)
+
+
+def test_clean_consistent_order_is_silent(armed):
+    a = sanitizer.wrap(threading.Lock(), "A")
+    b = sanitizer.wrap(threading.Lock(), "B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert sanitizer.reports() == []
+
+
+def test_cross_thread_abba_detected(armed):
+    """The PR 11 shape as two real threads: submit-path order vs the old
+    failover order. Each thread runs its nesting alone (no actual
+    deadlock); the edge graph still catches the inconsistency."""
+    a = sanitizer.wrap(threading.Lock(), "router._lock")
+    b = sanitizer.wrap(threading.Lock(), "replica.lock")
+
+    def submit_path():
+        with a:
+            with b:
+                pass
+
+    def old_failover_path():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=submit_path)
+    t1.start(); t1.join()
+    t2 = threading.Thread(target=old_failover_path)
+    t2.start(); t2.join()
+    assert len(sanitizer.inversions()) == 1
+
+
+def test_rlock_reentry_is_not_an_inversion(armed):
+    a = sanitizer.wrap(threading.RLock(), "A")
+    with a:
+        with a:
+            pass
+    assert sanitizer.reports() == []
+
+
+def test_condition_wait_releases_the_hold(armed):
+    mu = sanitizer.wrap(threading.Lock(), "C._mu")
+    cv = sanitizer.make_condition(mu, "C._cv")
+    with cv:
+        cv.wait(timeout=0.01)
+    assert sanitizer.reports() == []
+    # the hold bookkeeping drained (a later single acquisition records
+    # no edges and no reports)
+    with cv:
+        pass
+    assert sanitizer.reports() == []
+
+
+def test_same_underlying_mutex_via_two_wrappers_reports(armed):
+    """KVTransferChannel pattern: _cv wraps _mu's mutex. Acquiring the cv
+    while holding the plain lock would self-deadlock; the sanitizer
+    reports BEFORE blocking (we only exercise the report path — the
+    report fires in _pre_acquire, so we never actually acquire)."""
+    mu = sanitizer.wrap(threading.Lock(), "C._mu")
+    cv = sanitizer.make_condition(mu, "C._cv")
+    with mu:
+        cv._pre_acquire()       # the report half of acquire()
+    inv = sanitizer.inversions()
+    assert len(inv) == 1 and "share one underlying mutex" in inv[0].message
+
+
+def test_blocking_region_allows_designated_locks(armed):
+    rep = sanitizer.wrap(threading.Lock(), "Replica.lock")
+    with rep:
+        with sanitizer.blocking_region("scheduler.tick",
+                                       allow=("Replica.lock",)):
+            pass
+    assert sanitizer.reports() == []
+
+
+def test_blocking_region_reports_foreign_holds(armed):
+    router = sanitizer.wrap(threading.Lock(), "ReplicaRouter._lock")
+    with router:
+        with sanitizer.blocking_region("scheduler.tick",
+                                       allow=("Replica.lock",)):
+            pass
+    reps = [r for r in sanitizer.reports()
+            if r.kind == "hold_while_blocking"]
+    assert len(reps) == 1
+    assert "ReplicaRouter._lock" in reps[0].message
+    assert reps[0].stacks          # offender stack named
+
+
+def test_held_too_long_warns_but_does_not_fail_assert_clean(armed,
+                                                            monkeypatch):
+    monkeypatch.setattr(sanitizer, "HOLD_S", 0.01)
+    a = sanitizer.wrap(threading.Lock(), "A")
+    with a:
+        time.sleep(0.05)
+    kinds = [r.kind for r in sanitizer.reports()]
+    assert kinds == ["held_too_long"]
+    sanitizer.assert_clean()       # inversions/blocking only by default
+    with pytest.raises(AssertionError):
+        sanitizer.assert_clean(kinds=("held_too_long",))
+
+
+def test_thread_leak_report_and_grace(armed):
+    baseline = sanitizer.thread_baseline()
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="serving-test-leak",
+                         daemon=True)
+    t.start()
+    try:
+        leaked = sanitizer.check_thread_leaks(baseline, grace_s=0.1)
+        assert leaked == ["serving-test-leak"]
+        assert [r.kind for r in sanitizer.reports()] == ["thread_leak"]
+    finally:
+        release.set()
+        t.join(timeout=2.0)
+    # a thread that exits within the grace window is not a leak
+    sanitizer.reset()
+    ok = threading.Thread(target=lambda: time.sleep(0.02),
+                          name="serving-short-lived", daemon=True)
+    ok.start()
+    assert sanitizer.check_thread_leaks(baseline, grace_s=1.0) == []
+    assert sanitizer.reports() == []
+
+
+def test_assert_clean_raises_with_stacks(armed):
+    a = sanitizer.wrap(threading.Lock(), "A")
+    b = sanitizer.wrap(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    with pytest.raises(AssertionError, match="inversion"):
+        sanitizer.assert_clean()
+
+
+def test_take_reports_drains(armed):
+    a = sanitizer.wrap(threading.Lock(), "A")
+    b = sanitizer.wrap(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert len(sanitizer.take_reports()) == 1
+    assert sanitizer.reports() == []
+
+
+def test_fleet_locks_are_wrapped_when_armed(armed):
+    """The annotated construction sites route through wrap(): a
+    HealthMonitor built while armed carries an instrumented _mu."""
+    from shuffle_exchange_tpu.inference.config import RouterConfig
+    from shuffle_exchange_tpu.serving.health import HealthMonitor
+
+    hm = HealthMonitor(RouterConfig())
+    assert isinstance(hm._mu, sanitizer._SanLock)
+    assert hm._mu.name == "HealthMonitor._mu"
+    hm.register(0)
+    hm.beat_start(0)
+    hm.beat_end(0)
+    assert sanitizer.reports() == []

@@ -215,6 +215,7 @@ def test_engine_seq_times_pipe_matches_dp(devices8):
     np.testing.assert_allclose(sp_pp, dp, rtol=5e-3)
 
 
+@pytest.mark.slow   # 18s+12s: alibi x SP compose; nightly via ci_full (ISSUE 13 tier-1 budget)
 @pytest.mark.parametrize("flavor", ["ulysses", "ring"])
 def test_alibi_rides_sequence_parallel(devices8, flavor):
     """Round 5: ALiBi composes with SP — Ulysses slices the slope vector

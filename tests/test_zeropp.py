@@ -297,6 +297,7 @@ def test_lora_qwz_real_wire(devices8):
     assert np.isfinite(l1) and l1 < l0
 
 
+@pytest.mark.slow   # 14s: compression x qz3 compose; nightly via ci_full (ISSUE 13 tier-1 budget)
 def test_compression_qz3_real_wire(devices8):
     """VERDICT r4 #3: compression_training composes with the stage-3 wire —
     the transform applies to the gathered tree inside the region instead of
