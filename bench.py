@@ -336,6 +336,25 @@ def bench_train(label, model, ds_config, batch_size, seq_len, steps, warmup,
     return row
 
 
+def _trace_record(seed, prompts, max_new, load, arrivals, capacity=None):
+    """The reproducibility record every Poisson serving row returns
+    (ISSUE 14): the seed regenerates the workload, the prompt lengths and
+    arrival offsets audit what was actually offered, and an autotuner
+    trial citing the same record is PAIRED with the row — same prompts,
+    same arrivals, variance-controlled comparison. One shape everywhere:
+    this wraps ``PoissonTrace.describe()``, the same record the
+    serving_autotune row and the CLI trial logs emit."""
+    from shuffle_exchange_tpu.autotuning import PoissonTrace
+
+    return PoissonTrace(
+        seed=int(seed), prompts=tuple(tuple(int(t) for t in p)
+                                      for p in prompts),
+        max_new=int(max_new), arrivals=tuple(float(a) for a in arrivals),
+        load=load,
+        capacity_tokens_per_sec=(float(capacity) if capacity else None),
+    ).describe()
+
+
 def serving_goodput_row(model, params, icfg, vocab, *, n_requests=24,
                         prompt_lo=64, prompt_hi=512, max_new=32,
                         load=2.0, seed=0):
@@ -349,8 +368,12 @@ def serving_goodput_row(model, params, icfg, vocab, *, n_requests=24,
     "heavy traffic" regime: arrivals outpace service, the queue stays
     nonempty, and sustained tokens/s measures what mixed prefill+decode
     ticks actually deliver under pressure, with TTFT/TPOT p50 showing the
-    queueing cost). Reused at toy size by tests/test_bench_smoke.py so the
-    published bench config cannot rot on the CPU driver box."""
+    queueing cost). The row is seed-reproducible and returns its ``trace``
+    (seed + prompt lengths + arrival offsets) so autotuner trials and
+    later reruns can pair against the exact workload (ISSUE 14). Reused
+    at toy size by tests/test_bench_smoke.py so the published bench
+    config cannot rot on the CPU driver box."""
+    from shuffle_exchange_tpu.autotuning import poisson_arrivals
     from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
                                                 InferenceEngineV2)
 
@@ -368,14 +391,15 @@ def serving_goodput_row(model, params, icfg, vocab, *, n_requests=24,
     cap = warm.stats()["sustained_tokens_per_sec"]
 
     span = n_requests * max_new / cap / load
-    arrivals = np.cumsum(rng.exponential(span / n_requests,
-                                         size=n_requests)).tolist()
+    arrivals = poisson_arrivals(rng, n_requests, span)
     sched = ContinuousBatchingScheduler(eng)
     sched.serve(prompts, max_new_tokens=max_new, arrivals=arrivals)
     st = sched.stats()
     fills = sched.memory_monitor.values("serving/budget_fill")
     sv = icfg.serving
     return {
+        "trace": _trace_record(seed, prompts, max_new, load, arrivals,
+                               capacity=cap),
         "n_requests": n_requests,
         "prompt_tokens": [prompt_lo, prompt_hi],
         "max_new_tokens": max_new,
@@ -410,10 +434,12 @@ def prefix_cache_row(model, params, icfg, vocab, *, n_requests=16,
     admission past the first reuses the committed system-prompt blocks
     (zero new allocations for the shared span) and prefills only its
     suffix, so TTFT falls and per-tick prefill spend shrinks. The row
-    reports the hit-rate and the TTFT delta vs the no-cache path. Reused
+    reports the hit-rate and the TTFT delta vs the no-cache path, and is
+    seed-reproducible with its ``trace`` returned (ISSUE 14). Reused
     at toy size by tests/test_bench_smoke.py."""
     import dataclasses as _dc
 
+    from shuffle_exchange_tpu.autotuning import poisson_arrivals
     from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
                                                 InferenceEngineV2)
 
@@ -438,8 +464,7 @@ def prefix_cache_row(model, params, icfg, vocab, *, n_requests=16,
     # offered load calibrated on the NO-cache capacity, reused for both
     # traces so the comparison is at identical arrivals
     span = n_requests * max_new / cold["sustained_tokens_per_sec"] / load
-    arrivals = np.cumsum(rng.exponential(span / n_requests,
-                                         size=n_requests)).tolist()
+    arrivals = poisson_arrivals(rng, n_requests, span)
 
     def trace(eng):
         sched = ContinuousBatchingScheduler(eng)
@@ -458,6 +483,8 @@ def prefix_cache_row(model, params, icfg, vocab, *, n_requests=16,
     mismatches = sum(out_on[u] != out_off[u] for u in out_on)
     hit = st_on["prefix_cache"]
     return {
+        "trace": _trace_record(seed, prompts, max_new, load, arrivals,
+                               capacity=cold["sustained_tokens_per_sec"]),
         "n_requests": n_requests,
         "sys_prompt_tokens": sys_prompt_len,
         "suffix_tokens": [suffix_lo, suffix_hi],
@@ -566,10 +593,12 @@ def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
     batching cannot touch), steps-per-emitted-token (decode ticks per
     token per sequence — the ISSUE bar is < 0.67 at k=4), acceptance
     rate, and TTFT/TPOT p50/p95. Greedy acceptance keeps every variant
-    token-identical to k=0 (asserted). Reused at toy size by
+    token-identical to k=0 (asserted); the row is seed-reproducible with
+    its ``trace`` returned (ISSUE 14). Reused at toy size by
     tests/test_bench_smoke.py so the published row cannot rot on CPU."""
     import dataclasses as _dc
 
+    from shuffle_exchange_tpu.autotuning import poisson_arrivals
     from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
                                                 DraftModelDrafter,
                                                 InferenceEngineV2)
@@ -601,8 +630,7 @@ def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
     run(False)
     _, cold = run(False)
     span = n_requests * max_new / cold["sustained_tokens_per_sec"] / load
-    arrivals = np.cumsum(rng.exponential(span / n_requests,
-                                         size=n_requests)).tolist()
+    arrivals = poisson_arrivals(rng, n_requests, span)
 
     def variant(enabled, drafter=None):
         out, st = run(enabled, drafter=drafter, arrivals=list(arrivals))
@@ -638,6 +666,8 @@ def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
                                                    spec_cfg(True)))
     tok0 = [out0[u] for u in out0]
     return {
+        "trace": _trace_record(seed, prompts, max_new, load, arrivals,
+                               capacity=cold["sustained_tokens_per_sec"]),
         "n_requests": n_requests,
         "prompt_tokens": [prompt_lo, prompt_hi],
         "prompt_period": period,
@@ -738,6 +768,55 @@ def serving_failover_row(model, params, icfg, vocab, *, n_requests=16,
         "ttft_p95_delta_s": round(st_chaos["ttft_p95_s"]
                                   - st_clean["ttft_p95_s"], 4),
     }
+
+
+def serving_autotune_row(model, params, icfg, vocab, *, n_requests=16,
+                         prompt_lo=48, prompt_hi=192, max_new=16,
+                         load=2.0, seed=0, rounds=2, max_programs=512,
+                         axes=None, journal_dir=None):
+    """Config-5 serving-autotune row (ISSUE 14): a bounded successive-
+    halving search of the serving knob families (scheduler packing shape,
+    chunk/k ladders, KV/kernel modes) against the SAME seeded Poisson
+    goodput trace, headline = the tuned-vs-default goodput delta.
+
+    Search discipline (autotuning/search.py): capacity is calibrated once
+    on the default config and every candidate then faces identical
+    arrival offsets (paired trace, variance-controlled ranking);
+    candidates whose declared ladders blow the warmed-server compile
+    budget are pruned STATICALLY and never measured
+    (``pruned_never_measured`` asserts it); every measured trial warms
+    its shape-bin ladder and then must compile nothing during the
+    measured pass (``zero_recompile_all_trials``). The winner is emitted
+    as a loadable ServingConfig overlay — the same artifact
+    ``scripts/autotune_serving.py`` writes to disk. Reused at toy size by
+    tests/test_bench_smoke.py so the published row cannot rot on CPU."""
+    from shuffle_exchange_tpu.autotuning import PoissonTrace
+    from shuffle_exchange_tpu.autotuning.search import run_serving_search
+
+    trace = PoissonTrace.generate(seed, vocab=vocab, n_requests=n_requests,
+                                  prompt_lo=prompt_lo, prompt_hi=prompt_hi,
+                                  max_new=max_new)
+    out = run_serving_search(model, params, icfg, trace=trace, axes=axes,
+                             rounds=rounds, load=load,
+                             max_programs=max_programs,
+                             journal_dir=journal_dir)
+    row = out.summary()
+    row.update({
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "rounds": rounds,
+        "engines_built": out.objective.engines_built,
+        # finals only: screening metrics come off a trace PREFIX and are
+        # not comparable with full-trace goodput in one ranking
+        "ranked_final": [
+            {"candidate": t.candidate_name, "round": t.round,
+             "goodput_tokens_per_sec": (round(t.metric, 2)
+                                        if t.metric is not None else None),
+             "feasible": bool(t.detail.get("feasible", True))}
+            for t in out.result.ranked(final_only=True)[:8]],
+    })
+    return row
 
 
 def rlhf_rollout_row(model_cfg, *, n_rollouts=8, shared_len=64,
@@ -1095,6 +1174,18 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         failover_row = None
 
+    # ---- serving autotune: bounded successive-halving search of the
+    # serving knobs against the paired Poisson goodput trace (ISSUE 14) —
+    # tuned-vs-default delta, static-prune and zero-recompile contracts,
+    # and the winner overlay a deployment can load directly
+    try:
+        autotune_row = serving_autotune_row(model, params, icfg,
+                                            cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving autotune bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        autotune_row = None
+
     # ---- RLHF rollout: the hybrid engine's flip latency + rollout
     # goodput (ISSUE 11) — train -> publish -> generate cycles on a warmed
     # fleet, shared-prompt rollout batches (the prefix cache's regime),
@@ -1148,6 +1239,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_fleet": fleet_row,
         "serving_speculative": spec_row,
         "serving_failover": failover_row,
+        "serving_autotune": autotune_row,
         "rlhf_rollout": rlhf_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
                                 if eng_best else None),
@@ -1313,10 +1405,11 @@ def _config5(peak, hbm, n_chips, on_tpu, hbm_bw=None):
 _CONFIGS = {"1": _config1, "2": _config2, "3": _config3, "5": _config5}
 # per-config wall budgets (compile through the remote tunnel is the risk):
 # a stuck compile must cost one config, not the whole bench
-_BUDGET_S = {"1": 480, "2": 1800, "3": 900, "5": 1500}   # 2: + the host-
+_BUDGET_S = {"1": 480, "2": 1800, "3": 900, "5": 1800}   # 2: + the host-
 # offload ladder row's extra compile; 5: four quant
-# tiers x3 medians + big prefill + decode sweep (compile cache makes the
-# steady-state ~5 min; the budget covers a cold cache)
+# tiers x3 medians + big prefill + decode sweep + the bounded autotune
+# search (compile cache makes the steady-state ~5 min; the budget covers
+# a cold cache)
 
 
 def _hw():

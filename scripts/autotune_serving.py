@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Serving autotune CLI (ISSUE 14): search the serving knob families
+against the paired Poisson goodput trace and emit the winner as a
+loadable ``ServingConfig`` overlay plus a ranked machine-readable trial
+log.
+
+    # bounded CPU search on the tiny model (the ci_full smoke adds a
+    # kill->resume drill on top):
+    python scripts/autotune_serving.py --toy --out /tmp/at
+
+    # a model-zoo preset on the current backend (the TPU-window entry
+    # point: the same harness retunes training via
+    # ``python -m shuffle_exchange_tpu.autotuning``):
+    python scripts/autotune_serving.py --model gpt2_small --n-requests 24
+
+Artifacts under ``--out``:
+  - ``serving_overlay.json``  — the winner's knobs, loadable with
+    ``InferenceConfig.with_overlay`` (or merged into a config dict
+    before ``from_dict``)
+  - ``trials.json``           — the ranked trial log + search summary
+  - ``trials/``               — the crash-safe per-trial journal
+    (tmp+rename; a killed run rerun with the same arguments resumes
+    without re-measuring completed trials)
+
+Contracts asserted on every run: statically-pruned candidates are never
+measured, and the winner's (and baseline's) measured pass compiled
+nothing (the warmed-server zero-recompile discipline). ``--smoke`` adds
+the ci_full drill: a fault-injected kill mid-search, then a resume that
+must re-run nothing committed, and the winner must beat the worst
+screened candidate AND the default config's paired-trace goodput.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _build(args):
+    import jax
+
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    if args.toy:
+        mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                    activation="swiglu", norm="rmsnorm", position="rope",
+                    n_kv_heads=2, tie_embeddings=False)
+        model = Transformer(mcfg)
+        # a deliberately mid-range base point (small packing shape): the
+        # default the search must beat, with headroom in the space above
+        # it — mirrors a config nobody has tuned yet
+        icfg = InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=96,
+            serving={"token_budget": 64, "max_running": 2, "chunk_min": 4})
+    else:
+        from shuffle_exchange_tpu import models as zoo
+
+        mcfg = getattr(zoo, args.model)()
+        model = Transformer(mcfg)
+        seq = min(mcfg.max_seq_len, 2048)
+        icfg = InferenceConfig(
+            dtype="bfloat16", max_seq_len=seq, kv_block_size=64,
+            num_kv_blocks=4 * (seq // 64) + 8)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params, icfg, mcfg.vocab_size
+
+
+def _search(args, model, params, icfg, vocab, journal_dir):
+    from shuffle_exchange_tpu.autotuning import PoissonTrace
+    from shuffle_exchange_tpu.autotuning.search import run_serving_search
+
+    trace = PoissonTrace.generate(
+        args.seed, vocab=vocab, n_requests=args.n_requests,
+        prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+        max_new=args.max_new)
+    return run_serving_search(
+        model, params, icfg, trace=trace,
+        axes=json.loads(args.axes) if args.axes else None,
+        rounds=args.rounds, eta=args.eta, load=args.load,
+        max_programs=args.max_programs, journal_dir=journal_dir,
+        ttft_p95_limit_s=args.ttft_p95_limit_s,
+        tpot_p95_limit_s=args.tpot_p95_limit_s)
+
+
+def _assert_contracts(summary):
+    assert summary["pruned_never_measured"], (
+        "a statically-pruned candidate was measured: "
+        f"{summary['pruned_static']} pruned vs executed keys")
+    assert summary["winner_zero_recompile"], (
+        "the winner's measured pass compiled a program — the warmed-"
+        "server zero-recompile contract failed on the winner")
+    assert summary["default_zero_recompile"], (
+        "the default baseline's measured pass compiled a program — the "
+        "tuned-vs-default delta would be dishonest")
+
+
+def _smoke(args):
+    """The ci_full drill: kill the search at its 3rd trial commit, then
+    resume and finish — proving the journal's crash-safety — and hold the
+    winner to the beats-worst-screened and beats-default bars."""
+    from shuffle_exchange_tpu.autotuning import TrialJournal
+    from shuffle_exchange_tpu.testing import faults
+
+    model, params, icfg, vocab = _build(args)
+    journal_dir = os.path.join(args.out, "smoke")
+    # the drill needs an EMPTY journal: on a pre-populated one nothing
+    # commits, the armed fault never fires, and the failure reads like a
+    # fault-injection bug instead of "journal already populated"
+    shutil.rmtree(journal_dir, ignore_errors=True)
+
+    faults.clear()
+    # commit #1 is the journaled trace calibration; the kill lands at the
+    # 3rd TRIAL commit (4th journal commit overall)
+    faults.arm("autotune_trial", index=0, fire_nth=4)
+    killed = False
+    try:
+        _search(args, model, params, icfg, vocab, journal_dir)
+    except faults.InjectedFault:
+        killed = True
+    finally:
+        faults.clear()
+    assert killed, "the armed autotune_trial fault never fired"
+    committed = {k for k in TrialJournal(journal_dir).keys()
+                 if "calibration@" not in k}
+    assert len(committed) == 2, (
+        f"kill at the 3rd trial commit must leave exactly 2 committed "
+        f"trials, found {sorted(committed)}")
+
+    t0 = time.time()
+    outcome = _search(args, model, params, icfg, vocab, journal_dir)
+    wall = time.time() - t0
+    summary = outcome.summary()
+    _assert_contracts(summary)
+    # resume contract: nothing already committed was re-measured
+    rerun = committed & set(outcome.result.executed)
+    assert not rerun, f"resume re-measured committed trials: {sorted(rerun)}"
+    assert summary["resumed_from_journal"] >= len(committed)
+    # the halving smoke is bounded: tiny model, 2 rounds, <= 8 search
+    # trials (+1 baseline measurement at most)
+    assert len(outcome.result.executed) + len(committed) <= 9, (
+        outcome.result.executed)
+    # winner quality: beats the worst screened candidate AND the default
+    screened = [t.metric for t in outcome.result.trials
+                if t.status == "ok" and t.round == 0 and t.metric]
+    assert outcome.goodput_tuned > min(screened), (
+        outcome.goodput_tuned, screened)
+    assert outcome.goodput_tuned > outcome.goodput_default, (
+        "the search failed to beat the default config: "
+        f"tuned {outcome.goodput_tuned:.1f} vs default "
+        f"{outcome.goodput_default:.1f}")
+    return outcome, summary, wall
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="autotune_serving",
+        description="Search serving knobs against the Poisson goodput row")
+    ap.add_argument("--toy", action="store_true",
+                    help="tiny model + CPU-sized engine (CI smoke shape)")
+    ap.add_argument("--model", default="gpt2_small",
+                    help="model-zoo preset when not --toy")
+    ap.add_argument("--out", default=os.path.join("autotuning_results",
+                                                  "serving"),
+                    help="results dir (overlay, trial log, journal)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n-requests", type=int, default=12)
+    ap.add_argument("--prompt-lo", type=int, default=4)
+    ap.add_argument("--prompt-hi", type=int, default=20)
+    ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--load", type=float, default=2.0)
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--eta", type=int, default=2)
+    ap.add_argument("--max-programs", type=int, default=512,
+                    help="warmed-server compile budget (static prune bound)")
+    ap.add_argument("--axes", default=None,
+                    help='JSON axes dict, e.g. \'{"max_running": [2,4,8]}\'')
+    ap.add_argument("--ttft-p95-limit-s", type=float, default=None)
+    ap.add_argument("--tpot-p95-limit-s", type=float, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="ci_full drill: kill mid-search, resume, assert "
+                         "winner > worst screened and > default")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.toy = True
+
+    os.makedirs(args.out, exist_ok=True)
+    if args.smoke:
+        outcome, summary, wall = _smoke(args)
+    else:
+        model, params, icfg, vocab = _build(args)
+        t0 = time.time()
+        outcome = _search(args, model, params, icfg, vocab, args.out)
+        wall = time.time() - t0
+        summary = outcome.summary()
+        _assert_contracts(summary)
+
+    from shuffle_exchange_tpu.autotuning import atomic_write_json
+
+    overlay_path = atomic_write_json(
+        os.path.join(args.out, "serving_overlay.json"),
+        outcome.result.best.overlay())
+    log_path = atomic_write_json(
+        os.path.join(args.out, "trials.json"),
+        {"summary": summary, "search": outcome.result.log()})
+    print(json.dumps({
+        "winner": summary["winner"],
+        "goodput_default_tokens_per_sec":
+            summary["goodput_default_tokens_per_sec"],
+        "goodput_tuned_tokens_per_sec":
+            summary["goodput_tuned_tokens_per_sec"],
+        "goodput_delta_pct": summary["goodput_delta_pct"],
+        "trials_measured": summary["trials_measured"],
+        "pruned_static": summary["pruned_static"],
+        "pruned_never_measured": summary["pruned_never_measured"],
+        "winner_zero_recompile": summary["winner_zero_recompile"],
+        "resumed_from_journal": summary["resumed_from_journal"],
+        "knob_effects": summary["knob_effects"],
+        "wall_s": round(wall, 1),
+        "overlay": overlay_path,
+        "trial_log": log_path,
+        "smoke": bool(args.smoke),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,14 @@ env SXT_SANITIZE=1 python -m pytest tests/test_failover.py -q "$@"
 # revived through the factory — zero lost requests, token parity with
 # the clean run, KV migration, ACTIVE-only recovery.
 env SXT_SANITIZE=1 python scripts/chaos_drill.py
+# Serving-autotuner smoke (ISSUE 14): bounded successive-halving search
+# (tiny model, 2-round halving, <= 8 search trials) with the crash drill —
+# the search is killed at its 3rd trial-journal commit, resumed, and must
+# re-run nothing already committed; statically-pruned candidates are never
+# measured, the winner's warmed measured pass compiles nothing, and the
+# winner beats both the worst screened candidate and the default
+# ServingConfig on the paired Poisson trace.
+python scripts/autotune_serving.py --smoke --out "$(mktemp -d)"
 # Speculative-decoding gates (ISSUE 8): exact-token parity vs decode_loop
 # across k, one-dispatch verify ticks + warmed-server zero-recompile,
 # the steps-per-token bar, rejected-draft KV rewind atomicity vs the

@@ -1,6 +1,34 @@
-"""Autotuning subsystem (reference: ``autotuning/autotuner.py``, README
-workflow ``autotuning/README.md:240-245``)."""
+"""Autotuning subsystem (reference: ``autotuning/autotuner.py`` — 2,722
+LoC of search space + experiment runner + result tables, workflow
+``autotuning/README.md:240-245``).
+
+Layout (ISSUE 14):
+
+- ``space.py``      — declared serving knob space: typed candidates,
+  hard constraints, static compile-ladder pruning
+- ``trace.py``      — seeded, paired Poisson request traces
+- ``search.py``     — grid + successive-halving search
+- ``runner.py``     — crash-safe trial journal (tmp+rename, resume)
+- ``objectives.py`` — serving goodput objective + the training objective
+- ``autotuner.py``  — the legacy training ``Autotuner``/``autotune()``
+  API, now a driver over the shared machinery
+
+CLI entry points: ``python -m shuffle_exchange_tpu.autotuning`` (training)
+and ``scripts/autotune_serving.py`` (serving).
+"""
 
 from .autotuner import Autotuner, Candidate, autotune, estimate_step_memory
+from .objectives import ServingObjective, TrainingObjective
+from .runner import ExperimentRunner, Trial, TrialJournal, atomic_write_json
+from .search import SearchResult, SuccessiveHalving, halving_schedule
+from .space import ServingCandidate, ServingSearchSpace, SpaceContext
+from .trace import PoissonTrace, poisson_arrivals
 
-__all__ = ["Autotuner", "Candidate", "autotune", "estimate_step_memory"]
+__all__ = [
+    "Autotuner", "Candidate", "autotune", "estimate_step_memory",
+    "ServingObjective", "TrainingObjective",
+    "ExperimentRunner", "Trial", "TrialJournal", "atomic_write_json",
+    "SearchResult", "SuccessiveHalving", "halving_schedule",
+    "ServingCandidate", "ServingSearchSpace", "SpaceContext",
+    "PoissonTrace", "poisson_arrivals",
+]

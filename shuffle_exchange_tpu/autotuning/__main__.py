@@ -1,6 +1,8 @@
 """CLI: ``python -m shuffle_exchange_tpu.autotuning --config ds.json
 --model gpt2_small`` (reference workflow: ``deepspeed --autotuning tune``,
-autotuning/README.md)."""
+autotuning/README.md). The serving half of the subsystem is
+``scripts/autotune_serving.py`` (ISSUE 14) — same journal/runner
+machinery, so one results dir retunes training AND serving."""
 
 import argparse
 import json

@@ -1,4 +1,4 @@
-"""The autotuner: search training configs, measure, emit the best.
+"""The training autotuner: search training configs, measure, emit the best.
 
 Capability analog of the reference autotuner (``autotuning/autotuner.py``,
 2,722 LoC; workflow in ``autotuning/README.md``): given a model and a base
@@ -13,19 +13,28 @@ TPU-native differences: no multi-process experiment launcher is needed —
 candidates compile+run in-process through jit; memory pruning uses the known
 HBM capacity per device instead of CUDA allocator probing; "mp_size" maps to
 the mesh's tensor axis.
+
+Since ISSUE 14 this class is a thin driver over the shared subsystem
+machinery: measurement lives in :class:`~.objectives.TrainingObjective`,
+execution rides :class:`~.runner.ExperimentRunner` (pass ``journal_dir``
+to make a tune crash-safe — completed trials journal tmp+rename and a
+restarted tune re-runs nothing), and result files commit atomically. The
+serving half of the subsystem (``space.py``/``search.py``/
+``objectives.ServingObjective``) shares the same runner/journal, so one
+results dir (and one tunnel window) retunes training AND serving.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import itertools
-import json
 import os
-import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..config.config_utils import ConfigError
 from ..utils.logging import log_dist, logger
+from .runner import ExperimentRunner, TrialJournal, atomic_write_json, \
+    sweep_stale_tmp
 
 # bytes per element
 _F32 = 4
@@ -155,7 +164,8 @@ class Autotuner:
     def __init__(self, model, base_config: Dict[str, Any],
                  batch_fn: Callable[[int], Dict[str, Any]],
                  tuning_config=None, world_size: Optional[int] = None,
-                 profile_steps: int = 3, seq_len: Optional[int] = None):
+                 profile_steps: int = 3, seq_len: Optional[int] = None,
+                 journal_dir: Optional[str] = None):
         import jax
 
         self.model = model
@@ -168,6 +178,38 @@ class Autotuner:
         mcfg = getattr(model, "config", None)
         self.seq_len = seq_len or getattr(mcfg, "max_seq_len", 1024)
         self.results: List[Candidate] = []
+        # crash-safe tuning (ISSUE 14): with a journal_dir every measured
+        # trial commits tmp+rename and a restarted tune resumes without
+        # re-running it; None keeps the historical in-memory behavior.
+        # Keys are namespaced by a fingerprint of everything the metric
+        # depends on (base config, model geometry, world/seq/profile
+        # setup) — a journal from a tune of a DIFFERENT config or model
+        # must miss, not restore stale measurements under the same
+        # candidate names.
+        self.runner = ExperimentRunner(
+            TrialJournal(journal_dir) if journal_dir else None)
+        import hashlib
+        import json as _json
+
+        mdesc = repr(mcfg) if mcfg is not None else type(model).__name__
+        self._journal_ns = hashlib.blake2b(
+            _json.dumps([self.base, mdesc, self.world, self.seq_len,
+                         self.profile_steps,
+                         self.at.metric if self.at else "throughput",
+                         # environment: a CPU-box journal must never
+                         # satisfy the TPU-window tune (or survive a jax
+                         # upgrade) — throughput is a property of the
+                         # backend, not just the config
+                         jax.default_backend(), jax.__version__,
+                         getattr(jax.devices()[0], "device_kind", "")],
+                        sort_keys=True, default=repr).encode(),
+            digest_size=6).hexdigest()
+        from .objectives import TrainingObjective
+
+        self._objective = TrainingObjective(
+            model, self.base, batch_fn, profile_steps=profile_steps,
+            seq_len=self.seq_len,
+            metric=(self.at.metric if self.at else "throughput"))
 
     # -- search space --------------------------------------------------
 
@@ -235,42 +277,25 @@ class Autotuner:
     # -- measurement ---------------------------------------------------
 
     def _run_one(self, c: Candidate) -> float:
-        import jax
+        """One measured trial through the shared TrainingObjective
+        (kept for API compatibility; tune() journals via the runner)."""
+        return float(self._objective(c)["metric"])
 
-        import shuffle_exchange_tpu as sxt
-        from ..parallel import reset_topology
-
-        model = self.model
-        mcfg = getattr(model, "config", None)
-        if c.remat is not None and mcfg is not None and mcfg.remat != c.remat:
-            model = type(model)(dataclasses.replace(mcfg, remat=c.remat))
-        # The schema permits the batch wildcard (-1) only on mesh.data, so
-        # the candidate's data=-1 never collides with a base wildcard.
-        cfg = _merge(self.base, c.as_config_patch())
-        cfg.pop("train_batch_size", None)
-        reset_topology()
-        engine, *_ = sxt.initialize(model=model, config=cfg)
-        global_bs = engine.config.train_batch_size
-        if c.seq_len:
-            # seq-length candidates need a batch_fn(global_bs, seq_len=...)
-            batch = self.batch_fn(global_bs, seq_len=c.seq_len)
-        else:
-            batch = self.batch_fn(global_bs)
-        t_first = time.time()
-        loss = engine.train_batch(batch)
-        float(loss)  # sync (compile included; excluded from the metric)
-        compile_s = time.time() - t_first
-        t0 = time.time()
-        for _ in range(self.profile_steps):
-            loss = engine.train_batch(batch)
-        float(loss)
-        dt = (time.time() - t0) / self.profile_steps
-        tokens = global_bs * (c.seq_len or self.seq_len)
-        log_dist(f"autotuning: {c.name} step={dt*1000:.0f}ms "
-                 f"(compile {compile_s:.0f}s, global_bs={global_bs})", ranks=[0])
-        if self.at and self.at.metric == "latency":
-            return -dt
-        return tokens / dt  # throughput (also the flops proxy at fixed model)
+    def _trial(self, c: Candidate) -> Dict[str, Any]:
+        """Journal-shaped payload for one candidate: errors are recorded
+        (and resumed) exactly like successes — a deterministic rerun
+        must not re-pay a failed compile either."""
+        try:
+            detail = self._objective(c)
+            return {"status": "ok", "metric": float(detail["metric"]),
+                    "detail": {k: v for k, v in detail.items()
+                               if k != "metric"}}
+        except Exception as e:  # OOM or compile failure: record, move on
+            status = "oom" if "memory" in str(e).lower() else "error"
+            logger.warning(
+                f"autotuning: {c.name} failed ({status}): {str(e)[:200]}")
+            return {"status": status, "metric": None,
+                    "detail": {"error": str(e)[:500]}}
 
     # -- main loop -----------------------------------------------------
 
@@ -289,13 +314,16 @@ class Autotuner:
                 log_dist(f"autotuning: {c.name} pruned "
                          f"({c.est_bytes/1e9:.1f}GB est > {budget/1e9:.1f}GB)", ranks=[0])
                 continue
-            try:
-                c.metric_val = self._run_one(c)
-                c.status = "ok"
-            except Exception as e:  # OOM or compile failure: record and move on
-                c.status = "oom" if "memory" in str(e).lower() else "error"
-                logger.warning(f"autotuning: {c.name} failed ({c.status}): {str(e)[:200]}")
+            payload, cached = self.runner.run_one(
+                f"train:{self._journal_ns}:{c.name}",
+                lambda c=c: self._trial(c))
+            c.status = str(payload["status"])
+            if cached:
+                log_dist(f"autotuning: {c.name} restored from journal "
+                         f"({c.status})", ranks=[0])
+            if payload["metric"] is None:
                 continue
+            c.metric_val = float(payload["metric"])
             if best is None or c.metric_val > best.metric_val:
                 best, since_best = c, 0
             else:
@@ -311,27 +339,33 @@ class Autotuner:
     # -- output --------------------------------------------------------
 
     def write_results(self, best: Candidate, results_dir: Optional[str] = None) -> str:
+        """Commit the results table and the tuned config atomically
+        (tmp+rename — a kill mid-write leaves the previous files intact,
+        ISSUE 14 satellite), sweeping any stale partials a previously
+        killed writer left in the results dir."""
         results_dir = results_dir or (self.at.results_dir if self.at else "autotuning_results")
         os.makedirs(results_dir, exist_ok=True)
+        sweep_stale_tmp(results_dir)
         table = [{
             "name": c.name, "status": c.status, "metric": None if c.metric_val != c.metric_val
             else c.metric_val, "est_gb": round(c.est_bytes / 1e9, 2),
             **c.as_config_patch(),
         } for c in self.results]
-        with open(os.path.join(results_dir, "autotuning_results.json"), "w") as f:
-            json.dump(table, f, indent=2)
+        atomic_write_json(
+            os.path.join(results_dir, "autotuning_results.json"), table)
         tuned = _merge(self.base, best.as_config_patch())
         tuned.pop("train_batch_size", None)
-        path = os.path.join(results_dir, "ds_config_optimal.json")
-        with open(path, "w") as f:
-            json.dump(tuned, f, indent=2)
+        path = atomic_write_json(
+            os.path.join(results_dir, "ds_config_optimal.json"), tuned)
         log_dist(f"autotuning: best = {best.name}; tuned config at {path}", ranks=[0])
         return path
 
 
 def autotune(model, base_config: Dict[str, Any], batch_fn, **kw) -> Tuple[Dict[str, Any], Candidate]:
     """One-call API: returns (tuned_config_dict, best_candidate) and writes
-    the results dir per the config's ``autotuning`` section."""
+    the results dir per the config's ``autotuning`` section. Trials journal
+    into the results dir, so a killed tune rerun with the same config
+    resumes instead of re-measuring (ISSUE 14)."""
     from ..config import SXConfig
 
     import jax
@@ -339,6 +373,7 @@ def autotune(model, base_config: Dict[str, Any], batch_fn, **kw) -> Tuple[Dict[s
     world = kw.pop("world_size", len(jax.devices()))
     at = SXConfig.load(_merge(base_config, {"train_batch_size": base_config.get(
         "train_batch_size", world)}), world).autotuning
+    kw.setdefault("journal_dir", at.results_dir)
     tuner = Autotuner(model, base_config, batch_fn, tuning_config=at,
                       world_size=world, **kw)
     best, _ = tuner.tune()

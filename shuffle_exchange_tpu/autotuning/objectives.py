@@ -1,0 +1,205 @@
+"""Measurement objectives: what one autotune trial actually runs.
+
+ISSUE 14 tentpole, part 3. Both objectives speak the same protocol the
+search/runner machinery consumes — a JSON-serializable dict with
+``metric`` (higher is better) and ``feasible`` — so one results dir and
+one halving schedule retune training AND serving.
+
+:class:`ServingObjective` scores a :class:`~.space.ServingCandidate` on
+the ``serving_goodput_row`` contract: build a fresh
+``InferenceEngineV2`` + ``ContinuousBatchingScheduler`` at the
+candidate's config, warm the shape-bin ladder (an all-at-once pass
+compiles the capacity shapes, a Poisson replay covers the
+arrival-dependent mixed bins), then serve the paired trace and read
+sustained tokens/s as the metric with TTFT/TPOT p95 as constraints.
+The warmed measured pass must compile NOTHING (``engine.program_shapes``
+unchanged — the zero-recompile contract every trial asserts); a
+candidate that recompiles mid-trace is marked infeasible, never best.
+
+:class:`TrainingObjective` is the existing training measurement
+(short-profiled ``train_batch`` steps through the real engine) extracted
+from ``Autotuner._run_one`` so the legacy ``Autotuner`` API and any new
+search both ride it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.logging import log_dist
+from .space import ServingCandidate
+from .trace import PoissonTrace
+
+__all__ = ["ServingObjective", "TrainingObjective"]
+
+
+class ServingObjective:
+    """Goodput-primary, tail-latency-constrained serving score.
+
+    ``ttft_p95_limit_s`` / ``tpot_p95_limit_s``: optional hard SLO
+    constraints — a candidate whose measured p95 exceeds a limit is
+    recorded with its metric but marked infeasible (ranked behind every
+    feasible candidate, never promoted over one). ``require_zero_
+    recompile`` (default on) marks a trial infeasible when the measured
+    pass compiled any program the warm passes had not — a warmed
+    production server must never recompile, so a config that does is
+    broken at any goodput."""
+
+    def __init__(self, model, params, base_icfg, *,
+                 ttft_p95_limit_s: Optional[float] = None,
+                 tpot_p95_limit_s: Optional[float] = None,
+                 require_zero_recompile: bool = True,
+                 max_warm_iters: int = 8):
+        self.model = model
+        self.params = params
+        self.base_icfg = base_icfg
+        self.ttft_p95_limit_s = ttft_p95_limit_s
+        self.tpot_p95_limit_s = tpot_p95_limit_s
+        self.require_zero_recompile = require_zero_recompile
+        self.max_warm_iters = max(1, int(max_warm_iters))
+        #: engines built (observability: statically-pruned candidates
+        #: must never appear here)
+        self.engines_built = 0
+
+    def __call__(self, cand: ServingCandidate,
+                 trace: PoissonTrace) -> Dict[str, object]:
+        from ..inference import ContinuousBatchingScheduler, InferenceEngineV2
+
+        icfg = cand.apply(self.base_icfg)
+        eng = InferenceEngineV2(self.model, self.params, icfg)
+        self.engines_built += 1
+        prompts = trace.prompt_lists()
+        arrivals = trace.arrival_list()
+
+        # warm pass (all-at-once) compiles the capacity shapes of the
+        # candidate's ladder; then Poisson replays measure — ADAPTIVELY.
+        # Packing under arrivals is timing-dependent: two replays of the
+        # same offsets can mix decode rows and prefill chunks into
+        # different (rows, chunk) bin combos, so any single replay can
+        # hit a combo no warm pass visited and compile it mid-trace,
+        # poisoning the timing by orders of magnitude. The engine's
+        # program set grows monotonically and is bounded by the shape
+        # ladder, so the discipline is: serve the schedule; if the pass
+        # compiled anything it WAS a warm pass — serve again — until a
+        # pass compiles nothing (that clean pass is the measurement) or
+        # the attempt budget runs out (the candidate's shape space does
+        # not converge under warming: infeasible, which is exactly what
+        # the zero-recompile gate exists to disqualify).
+        ContinuousBatchingScheduler(eng).serve(
+            prompts, max_new_tokens=trace.max_new)
+        attempts = 0
+        while True:
+            warmed = eng.program_shapes
+            sched = ContinuousBatchingScheduler(eng)
+            sched.serve(prompts, max_new_tokens=trace.max_new,
+                        arrivals=list(arrivals))
+            attempts += 1
+            recompiles = len(eng.program_shapes - warmed)
+            if recompiles == 0 or attempts >= self.max_warm_iters:
+                break
+        st = sched.stats()
+
+        goodput = float(st["sustained_tokens_per_sec"] or 0.0)
+        feasible, why = True, ""
+        if self.require_zero_recompile and recompiles:
+            feasible, why = False, (
+                f"{recompiles} program(s) compiled during the measured "
+                f"pass — the warmed server recompiled")
+        if (feasible and self.ttft_p95_limit_s is not None
+                and st["ttft_p95_s"] is not None
+                and st["ttft_p95_s"] > self.ttft_p95_limit_s):
+            feasible, why = False, (
+                f"ttft_p95 {st['ttft_p95_s']:.4f}s > limit "
+                f"{self.ttft_p95_limit_s}s")
+        if (feasible and self.tpot_p95_limit_s is not None
+                and st["tpot_p95_s"] is not None
+                and st["tpot_p95_s"] > self.tpot_p95_limit_s):
+            feasible, why = False, (
+                f"tpot_p95 {st['tpot_p95_s']:.4f}s > limit "
+                f"{self.tpot_p95_limit_s}s")
+        return {
+            "metric": goodput,
+            "feasible": feasible,
+            "infeasible_reason": why,
+            "goodput_tokens_per_sec": round(goodput, 2),
+            "ttft_p50_s": _r(st["ttft_p50_s"]),
+            "ttft_p95_s": _r(st["ttft_p95_s"]),
+            "tpot_p50_s": _r(st["tpot_p50_s"]),
+            "tpot_p95_s": _r(st["tpot_p95_s"]),
+            "ticks": st["ticks"],
+            "preemptions": st["preemptions"],
+            "compiled_programs": len(eng.program_shapes),
+            "program_ladder_bound": cand.program_ladder_bound(),
+            "recompiles_measured_pass": recompiles,
+            "warm_iters": attempts - 1,
+            "knobs": sched.knobs(),
+        }
+
+
+def _r(v, nd: int = 4):
+    return None if v is None else round(float(v), nd)
+
+
+class TrainingObjective:
+    """The training measurement the legacy ``Autotuner`` always ran, as
+    a shared-protocol objective: build the engine at the candidate's
+    merged config, one compile step, then ``profile_steps`` measured
+    steps; metric = tokens/s (or negated latency when the autotuning
+    section asks for it)."""
+
+    def __init__(self, model, base_config: Dict[str, Any],
+                 batch_fn: Callable[..., Dict[str, Any]], *,
+                 profile_steps: int = 3, seq_len: int = 1024,
+                 metric: str = "throughput"):
+        self.model = model
+        self.base = base_config
+        self.batch_fn = batch_fn
+        self.profile_steps = profile_steps
+        self.seq_len = seq_len
+        self.metric = metric
+
+    def __call__(self, c) -> Dict[str, object]:
+        import shuffle_exchange_tpu as sxt
+
+        from ..parallel import reset_topology
+        from .autotuner import _merge
+
+        model = self.model
+        mcfg = getattr(model, "config", None)
+        if c.remat is not None and mcfg is not None and mcfg.remat != c.remat:
+            model = type(model)(dataclasses.replace(mcfg, remat=c.remat))
+        # The schema permits the batch wildcard (-1) only on mesh.data, so
+        # the candidate's data=-1 never collides with a base wildcard.
+        cfg = _merge(self.base, c.as_config_patch())
+        cfg.pop("train_batch_size", None)
+        reset_topology()
+        engine, *_ = sxt.initialize(model=model, config=cfg)
+        global_bs = engine.config.train_batch_size
+        if c.seq_len:
+            # seq-length candidates need a batch_fn(global_bs, seq_len=...)
+            batch = self.batch_fn(global_bs, seq_len=c.seq_len)
+        else:
+            batch = self.batch_fn(global_bs)
+        t_first = time.time()
+        loss = engine.train_batch(batch)
+        float(loss)  # sync (compile included; excluded from the metric)
+        compile_s = time.time() - t_first
+        t0 = time.time()
+        for _ in range(self.profile_steps):
+            loss = engine.train_batch(batch)
+        float(loss)
+        dt = (time.time() - t0) / self.profile_steps
+        tokens = global_bs * (c.seq_len or self.seq_len)
+        log_dist(f"autotuning: {c.name} step={dt*1000:.0f}ms "
+                 f"(compile {compile_s:.0f}s, global_bs={global_bs})", ranks=[0])
+        metric = -dt if self.metric == "latency" else tokens / dt
+        return {
+            "metric": metric,
+            "feasible": True,
+            "step_s": round(dt, 6),
+            "compile_s": round(compile_s, 3),
+            "tokens_per_step": tokens,
+            "global_batch_size": int(global_bs),
+        }

@@ -1,0 +1,370 @@
+"""The declared serving search space: typed knobs, hard constraints,
+static pruning.
+
+ISSUE 14 tentpole, part 1. The serving stack has grown five orthogonal
+knob families — the scheduler's packing shape (``token_budget``,
+``max_running``, ``chunk_min``/``chunk_bins``), the speculative lane
+(``k``/``k_bins``/``drafter``), and the engine storage/kernel modes
+(``kv_cache_dtype``, ``decode_kernel``, ``prefix_caching``) — whose
+interactions nobody has searched. This module declares the space those
+candidates live in and rejects the statically-impossible ones BEFORE any
+engine is built or any trace is served:
+
+- hard config constraints (the same invariants ``ServingConfig``
+  enforces at construction — ``token_budget >= max_running * (k + 1)``
+  with speculation on, ``chunk_min <= token_budget``, ...) so an invalid
+  combination is a pruned candidate with a named reason, not a
+  mid-search ``ConfigError``;
+- the compile-shape-ladder bound: a warmed server's zero-recompile
+  contract means every program a candidate can ever dispatch comes off
+  its shape-bin ladder (``engine.program_shapes`` keys — decode row
+  counts and table widths power-of-two binned, chunk sizes from
+  ``chunk_bins``, verify widths from ``k_bins``).
+  :meth:`ServingCandidate.program_ladder_bound` computes the
+  width-invariant upper bound of that set from the declared ladders
+  alone; candidates whose bound blows the ``SpaceContext.max_programs``
+  budget are pruned statically — they would either recompile mid-trace
+  or hold an unbounded executable cache, and measuring them wastes a
+  trial either way (the objective asserts the runtime
+  ``engine.program_shapes`` stays within this bound);
+- optionally, KV arithmetic: a candidate whose running set cannot hold
+  even ``1 / kv_overcommit`` of its worst-case KV footprint permanently
+  thrashes the preemption path.
+
+Every candidate serializes to a ``ServingConfig`` overlay dict
+(:meth:`ServingCandidate.overlay`) loadable through
+``InferenceConfig.from_dict`` / ``with_overlay`` — the artifact
+``scripts/autotune_serving.py`` emits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..config.config_utils import ConfigError
+
+__all__ = ["ServingCandidate", "ServingSearchSpace", "SpaceContext",
+           "pow2_bin_count"]
+
+_KV_DTYPES = ("bf16", "int8", "fp8")
+_DECODE_KERNELS = ("auto", "pallas", "xla")
+_DRAFTERS = ("ngram", "model")
+
+#: the axes ServingSearchSpace accepts, i.e. the tunable knob families
+KNOWN_AXES = ("token_budget", "max_running", "chunk_min", "chunk_bins",
+              "k", "drafter", "k_bins", "decode_kernel", "kv_cache_dtype",
+              "prefix_caching")
+
+
+def pow2_bin_count(n: int) -> int:
+    """Number of power-of-two bins covering row counts 1..n — the
+    engine's ``_bucket`` binning (1, 2, 4, ... up to the covering power
+    of two), so the per-axis factor of the program-ladder bound."""
+    n = max(1, int(n))
+    count, b = 1, 1
+    while b < n:
+        b *= 2
+        count += 1
+    return count
+
+
+def _bins_tag(bins: Sequence[int]) -> str:
+    """Compact, distinct rendering of a declared bin ladder for candidate
+    names (and journal filenames — a 256-entry ladder spelled out would
+    blow the 255-byte filename limit): short ladders list their entries,
+    long ones carry count+range+checksum."""
+    bins = tuple(int(b) for b in bins)
+    if len(bins) <= 6:
+        return "-".join(map(str, bins))
+    return (f"{len(bins)}x{bins[0]}-{bins[-1]}h"
+            f"{zlib.crc32(repr(bins).encode()) & 0xFFFF:04x}")
+
+
+def _ladder(lo: int, hi: int,
+            declared: Optional[Sequence[int]]) -> Tuple[int, ...]:
+    """The doubling ladder ``ServingConfig.bins()`` / ``SpeculativeConfig
+    .bins()`` derive (declared bins win) — replicated here so pruning
+    never needs to construct a config object for an invalid candidate."""
+    if declared:
+        return tuple(sorted({int(b) for b in declared}))
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(dict.fromkeys(out))
+
+
+@dataclasses.dataclass
+class SpaceContext:
+    """Everything a static constraint needs to know about the engine and
+    workload the candidates will be measured against — pool geometry for
+    the KV arithmetic, the compile budget for the ladder bound, and the
+    trace's worst-case request footprint."""
+
+    max_seq_len: int
+    kv_block_size: int
+    num_kv_blocks: int
+    #: warmed-server zero-recompile budget: candidates whose static
+    #: program-ladder bound exceeds this are pruned unmeasured
+    max_programs: int = 256
+    #: longest prompt + max_new the trace offers (None = unknown)
+    request_tokens_hi: Optional[int] = None
+    #: None disables the KV-thrash constraint; a float f prunes
+    #: candidates whose max_running * worst-case blocks > f * usable
+    kv_overcommit: Optional[float] = None
+
+    @property
+    def usable_blocks(self) -> int:
+        return max(1, self.num_kv_blocks - 1)   # block 0 is scratch
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-max(1, int(tokens)) // self.kv_block_size)
+
+
+@dataclasses.dataclass
+class ServingCandidate:
+    """One point in the serving knob space. Field defaults mirror the
+    ``ServingConfig``/``InferenceConfig`` defaults, so
+    ``ServingCandidate()`` IS the default config the tuned winner must
+    beat. ``k = 0`` means speculation off (``k >= 1`` enables it at that
+    draft width)."""
+
+    token_budget: int = 256
+    max_running: int = 8
+    chunk_min: int = 16
+    chunk_bins: Optional[Tuple[int, ...]] = None
+    k: int = 0
+    drafter: str = "ngram"
+    k_bins: Optional[Tuple[int, ...]] = None
+    decode_kernel: str = "auto"
+    kv_cache_dtype: str = "bf16"
+    prefix_caching: Optional[bool] = None   # None = keep the base config's
+    # search bookkeeping (mutated by the space/search, not identity)
+    status: str = "pending"      # pending | pruned_static | ...
+    prune_reason: str = ""
+
+    @property
+    def name(self) -> str:
+        n = f"tb{self.token_budget}_mr{self.max_running}_cm{self.chunk_min}"
+        if self.chunk_bins:
+            n += "_cb" + _bins_tag(self.chunk_bins)
+        if self.k:
+            n += f"_k{self.k}_{self.drafter}"
+            if self.k_bins:
+                n += "_kb" + _bins_tag(self.k_bins)
+        if self.decode_kernel != "auto":
+            n += f"_{self.decode_kernel}"
+        if self.kv_cache_dtype != "bf16":
+            n += f"_{self.kv_cache_dtype}"
+        if self.prefix_caching is not None:
+            n += "_pc1" if self.prefix_caching else "_pc0"
+        return n
+
+    # -- ladders (static; no config construction) -----------------------
+
+    def chunk_ladder(self) -> Tuple[int, ...]:
+        return _ladder(self.chunk_min, self.token_budget, self.chunk_bins)
+
+    def k_ladder(self) -> Tuple[int, ...]:
+        if not self.k:
+            return ()
+        return _ladder(1, self.k, self.k_bins)
+
+    def program_ladder_bound(self) -> int:
+        """Width-invariant upper bound on the warmed server's compiled
+        program set (``engine.program_shapes`` keys): ``decode`` keys bin
+        row counts to powers of two, ``extend`` multiplies by the chunk
+        ladder, ``mixed`` by decode×prefill row bins, and the ``spec``
+        lane by verify-row bins × the k ladder. Block-table width adds a
+        sequence-length-dependent factor identical across candidates of
+        one search (same engine geometry), so comparing this bound
+        against ``SpaceContext.max_programs`` ranks candidates by the
+        only thing they control: their declared ladders."""
+        nb = pow2_bin_count(self.max_running)
+        nc = len(self.chunk_ladder())
+        decode = nb
+        extend = nb * nc
+        mixed = nb * nb * nc
+        spec = 0
+        if self.k:
+            nk = len(self.k_ladder())
+            # spec keys carry decode, prefill AND verify row-count bins
+            # plus the chunk and verify-width ladders
+            spec = nb * nb * nb * nc * nk
+        return decode + extend + mixed + spec
+
+    # -- serialization / application ------------------------------------
+
+    def overlay(self) -> Dict[str, object]:
+        """The candidate as a loadable config overlay: merge into a
+        DS-style inference-config dict (or apply with
+        ``InferenceConfig.with_overlay``) to serve at this point."""
+        sv: Dict[str, object] = {
+            "token_budget": self.token_budget,
+            "max_running": self.max_running,
+            "chunk_min": self.chunk_min,
+        }
+        if self.chunk_bins:
+            sv["chunk_bins"] = list(self.chunk_bins)
+        if self.k:
+            spec: Dict[str, object] = {"enabled": True, "k": self.k,
+                                       "drafter": self.drafter}
+            if self.k_bins:
+                spec["k_bins"] = list(self.k_bins)
+            sv["speculative"] = spec
+        else:
+            sv["speculative"] = {"enabled": False}
+        out: Dict[str, object] = {
+            "serving": sv,
+            "decode_kernel": self.decode_kernel,
+            "kv_cache_dtype": self.kv_cache_dtype,
+        }
+        if self.prefix_caching is not None:
+            out["prefix_caching"] = self.prefix_caching
+        return out
+
+    def apply(self, base_icfg):
+        """A new ``InferenceConfig`` = ``base_icfg`` with this candidate's
+        knobs applied (validated by the config's own invariants — a
+        candidate that passed :meth:`ServingSearchSpace.check` cannot
+        raise here, which is the point of checking statically first)."""
+        return base_icfg.with_overlay(self.overlay())
+
+    @classmethod
+    def from_config(cls, icfg) -> "ServingCandidate":
+        """The candidate occupying ``icfg``'s point in the space — the
+        baseline every search measures its winner against."""
+        sv = icfg.serving
+        spec = sv.speculative
+        return cls(
+            token_budget=sv.token_budget, max_running=sv.max_running,
+            chunk_min=sv.chunk_min, chunk_bins=sv.chunk_bins,
+            k=spec.k if spec.enabled else 0, drafter=spec.drafter,
+            k_bins=spec.k_bins if spec.enabled else None,
+            decode_kernel=icfg.decode_kernel,
+            kv_cache_dtype=icfg.kv_cache_dtype,
+            prefix_caching=icfg.prefix_caching)
+
+
+class ServingSearchSpace:
+    """A grid of :class:`ServingCandidate` points: per-knob value axes
+    applied over a base candidate, statically checked against a
+    :class:`SpaceContext`. ``enumerate()`` returns EVERY grid point —
+    infeasible ones carry ``status="pruned_static"`` and a named
+    ``prune_reason``, and the runner refuses to measure them."""
+
+    def __init__(self, axes: Dict[str, Sequence], context: SpaceContext,
+                 base: Optional[ServingCandidate] = None):
+        unknown = set(axes) - set(KNOWN_AXES)
+        if unknown:
+            raise ConfigError(
+                f"unknown serving search axes {sorted(unknown)} "
+                f"(known: {sorted(KNOWN_AXES)})")
+        for name, vals in axes.items():
+            if not isinstance(vals, (list, tuple)) or not len(vals):
+                raise ConfigError(
+                    f"axis {name!r} must be a non-empty list of values, "
+                    f"got {vals!r}")
+        self.axes = {k: list(v) for k, v in axes.items()}
+        self.context = context
+        self.base = base if base is not None else ServingCandidate()
+
+    def __len__(self) -> int:
+        n = 1
+        for vals in self.axes.values():
+            n *= len(vals)
+        return n
+
+    def enumerate(self) -> List[ServingCandidate]:
+        names = sorted(self.axes)   # deterministic candidate order
+        out: List[ServingCandidate] = []
+        seen = set()
+        for combo in itertools.product(*(self.axes[n] for n in names)):
+            patch = dict(zip(names, combo))
+            for key in ("chunk_bins", "k_bins"):
+                if patch.get(key) is not None:
+                    patch[key] = tuple(patch[key])
+            cand = dataclasses.replace(self.base, status="pending",
+                                       prune_reason="", **patch)
+            if cand.name in seen:   # axes can alias (e.g. k=0 x drafter)
+                continue
+            seen.add(cand.name)
+            ok, why = self.check(cand)
+            if not ok:
+                cand.status = "pruned_static"
+                cand.prune_reason = why
+            out.append(cand)
+        return out
+
+    # -- the hard constraints -------------------------------------------
+
+    def check(self, c: ServingCandidate) -> Tuple[bool, str]:
+        """(feasible, reason-if-not). Mirrors every ``ServingConfig``
+        construction invariant plus the search-only bounds (compile
+        budget, KV arithmetic), so a candidate passing here can always be
+        applied to the base config without raising."""
+        ctx = self.context
+        if c.token_budget < 1:
+            return False, f"token_budget {c.token_budget} < 1"
+        if not 1 <= c.max_running <= c.token_budget:
+            return False, (f"max_running {c.max_running} outside "
+                           f"[1, token_budget={c.token_budget}]")
+        if not 1 <= c.chunk_min <= c.token_budget:
+            return False, (f"chunk_min {c.chunk_min} outside "
+                           f"[1, token_budget={c.token_budget}]")
+        if c.chunk_bins is not None and (not c.chunk_bins
+                                         or min(c.chunk_bins) < 1):
+            return False, f"chunk_bins {c.chunk_bins!r} must be positive"
+        if c.k < 0:
+            return False, f"k {c.k} < 0"
+        if c.k:
+            if c.drafter not in _DRAFTERS:
+                return False, f"drafter {c.drafter!r} not in {_DRAFTERS}"
+            if c.token_budget < c.max_running * (c.k + 1):
+                return False, (
+                    f"token_budget {c.token_budget} < max_running * (k+1) "
+                    f"= {c.max_running} * {c.k + 1} — every running "
+                    f"sequence may submit k drafts plus its pending token")
+            if c.k_bins is not None and (not c.k_bins or min(c.k_bins) < 1
+                                         or max(c.k_bins) < c.k):
+                return False, (f"k_bins {c.k_bins!r} must be positive and "
+                               f"cover k={c.k}")
+        if c.decode_kernel not in _DECODE_KERNELS:
+            return False, (f"decode_kernel {c.decode_kernel!r} not in "
+                           f"{_DECODE_KERNELS}")
+        if c.kv_cache_dtype not in _KV_DTYPES:
+            return False, (f"kv_cache_dtype {c.kv_cache_dtype!r} not in "
+                           f"{_KV_DTYPES}")
+        if c.token_budget > ctx.max_seq_len * ctx.usable_blocks:
+            return False, (f"token_budget {c.token_budget} exceeds the "
+                           f"pool's total token capacity")
+        # compile-shape-ladder budget: the zero-recompile contract's cost
+        bound = c.program_ladder_bound()
+        if bound > ctx.max_programs:
+            return False, (
+                f"program ladder bound {bound} exceeds the warmed-server "
+                f"compile budget {ctx.max_programs} (chunk ladder "
+                f"{len(c.chunk_ladder())} bins x row bins "
+                f"{pow2_bin_count(c.max_running)}"
+                + (f" x k ladder {len(c.k_ladder())} bins" if c.k else "")
+                + ")")
+        # KV arithmetic: a running set that cannot hold 1/overcommit of
+        # its worst case permanently lives in the preemption path
+        if ctx.kv_overcommit is not None and ctx.request_tokens_hi:
+            if ctx.request_tokens_hi > ctx.max_seq_len:
+                return False, (
+                    f"trace request footprint {ctx.request_tokens_hi} "
+                    f"tokens exceeds max_seq_len {ctx.max_seq_len}")
+            worst = c.max_running * ctx.blocks_for(ctx.request_tokens_hi)
+            budget = ctx.kv_overcommit * ctx.usable_blocks
+            if worst > budget:
+                return False, (
+                    f"max_running {c.max_running} x "
+                    f"{ctx.blocks_for(ctx.request_tokens_hi)} worst-case "
+                    f"blocks = {worst} exceeds {ctx.kv_overcommit}x the "
+                    f"{ctx.usable_blocks}-block pool — permanent KV thrash")
+        return True, ""

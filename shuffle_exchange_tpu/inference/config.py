@@ -9,7 +9,7 @@ so reference configs load cleanly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from ..config.config_utils import ConfigError
 from ..utils.logging import logger
@@ -212,6 +212,23 @@ class ServingConfig:
         while out < c:
             out *= 2
         return out
+
+    def knob_values(self) -> Dict[str, Any]:
+        """The EFFECTIVE tunable serving knobs (ISSUE 14 introspection):
+        what the scheduler actually packs/compiles against — derived
+        ladders included — keyed by the autotuner's knob-family names, so
+        trial logs and fleet post-mortems record the searched point, not
+        just the raw config fields."""
+        spec = self.speculative
+        return {
+            "token_budget": self.token_budget,
+            "max_running": self.max_running,
+            "chunk_min": self.chunk_min,
+            "chunk_bins": list(self.bins()),
+            "speculative_k": spec.k if spec.enabled else 0,
+            "k_bins": list(spec.bins()) if spec.enabled else [],
+            "drafter": spec.drafter if spec.enabled else None,
+        }
 
 
 @dataclasses.dataclass
@@ -513,6 +530,97 @@ class InferenceConfig:
             return cls(**d)
         except TypeError as e:  # pragma: no cover
             raise ConfigError(f"bad inference config: {e}") from e
+
+    # -- tunable-overlay seam (ISSUE 14) --------------------------------
+
+    #: the top-level keys a serving overlay may carry (the serving knob
+    #: families the autotuner searches; everything else about an engine —
+    #: model geometry, pool size, dtypes — is NOT a serving knob and must
+    #: not ride in through an overlay file)
+    OVERLAY_KEYS = ("serving", "kv_cache_dtype", "decode_kernel",
+                    "prefix_caching")
+
+    def serving_overlay(self) -> Dict[str, Any]:
+        """This config's point in the serving knob space as a standalone
+        overlay dict — the artifact ``scripts/autotune_serving.py`` emits
+        for its winner, loadable back with :meth:`with_overlay` (or by
+        merging into a DS-style config dict before ``from_dict``)."""
+        sv: Dict[str, Any] = {
+            "token_budget": self.serving.token_budget,
+            "max_running": self.serving.max_running,
+            "chunk_min": self.serving.chunk_min,
+        }
+        if self.serving.chunk_bins:
+            sv["chunk_bins"] = list(self.serving.chunk_bins)
+        spec = self.serving.speculative
+        if spec.enabled:
+            sp: Dict[str, Any] = {"enabled": True, "k": spec.k,
+                                  "drafter": spec.drafter}
+            if spec.k_bins:
+                sp["k_bins"] = list(spec.k_bins)
+            sv["speculative"] = sp
+        else:
+            sv["speculative"] = {"enabled": False}
+        return {"serving": sv, "kv_cache_dtype": self.kv_cache_dtype,
+                "decode_kernel": self.decode_kernel,
+                "prefix_caching": self.prefix_caching}
+
+    def with_overlay(self, overlay: Dict[str, Any]) -> "InferenceConfig":
+        """A new config = this one with a serving-knob overlay applied.
+        Nested ``serving`` (and ``serving.speculative``) keys MERGE over
+        the current values; the result passes full construction
+        validation, so an overlay can never smuggle in an invariant
+        violation a hand-written config would be refused for. Unknown
+        keys are rejected by name (an overlay is a tuned artifact — a
+        typo in one must fail loudly, not silently skip a knob)."""
+        d = dict(overlay or {})
+        unknown = set(d) - set(self.OVERLAY_KEYS)
+        if unknown:
+            raise ConfigError(
+                f"unknown serving-overlay keys {sorted(unknown)} "
+                f"(allowed: {sorted(self.OVERLAY_KEYS)})")
+        serving = self.serving
+        sv_patch = d.pop("serving", None)
+        if sv_patch is not None:
+            if not isinstance(sv_patch, dict):
+                raise ConfigError(
+                    f"overlay 'serving' must be a dict, got "
+                    f"{type(sv_patch).__name__}")
+            sv_patch = dict(sv_patch)
+            allowed = {f.name for f in dataclasses.fields(ServingConfig)}
+            unknown = set(sv_patch) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown serving overlay keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            spec_patch = sv_patch.pop("speculative", None)
+            cur = {f.name: getattr(serving, f.name)
+                   for f in dataclasses.fields(ServingConfig)}
+            if spec_patch is not None:
+                if not isinstance(spec_patch, dict):
+                    raise ConfigError(
+                        f"overlay 'serving.speculative' must be a dict, "
+                        f"got {type(spec_patch).__name__}")
+                sp_allowed = {f.name
+                              for f in dataclasses.fields(SpeculativeConfig)}
+                sp_unknown = set(spec_patch) - sp_allowed
+                if sp_unknown:
+                    raise ConfigError(
+                        f"unknown speculative overlay keys "
+                        f"{sorted(sp_unknown)} (allowed: "
+                        f"{sorted(sp_allowed)})")
+                sp_cur = {f.name: getattr(serving.speculative, f.name)
+                          for f in dataclasses.fields(SpeculativeConfig)}
+                cur["speculative"] = SpeculativeConfig(
+                    **{**sp_cur, **spec_patch})
+            serving = ServingConfig(**{**cur, **sv_patch})
+        dk = d.get("decode_kernel")
+        if dk is not None and dk not in ("auto", "pallas", "xla"):
+            # __post_init__ leaves decode_kernel to from_dict; an overlay
+            # bypasses from_dict, so validate here
+            raise ConfigError(
+                f'decode_kernel must be "auto", "pallas" or "xla", got {dk!r}')
+        return dataclasses.replace(self, serving=serving, **d)
 
     def jax_dtype(self) -> Any:
         import jax.numpy as jnp

@@ -753,6 +753,26 @@ class ContinuousBatchingScheduler:
         self.requests[r.uid] = r
         self.active.append(r)
 
+    def knobs(self) -> Dict[str, object]:
+        """The effective tunable-knob point this replica serves at
+        (ISSUE 14 introspection): the serving families the autotuner
+        searches — packing shape, derived chunk/k ladders, speculation —
+        plus the engine's storage/kernel modes. Autotuner trial logs
+        record this dict verbatim, so a winner's provenance names the
+        exact knobs it was measured with, and a fleet post-mortem can
+        diff what each replica actually ran."""
+        ecfg = self.engine.config
+        out = dict(self.cfg.knob_values())
+        out.update({
+            "decode_kernel": getattr(self.engine, "_decode_kernel",
+                                     ecfg.decode_kernel),
+            "kv_cache_dtype": ecfg.kv_cache_dtype,
+            "prefix_caching": ecfg.prefix_caching,
+            "kv_block_size": ecfg.kv_block_size,
+            "num_kv_blocks": ecfg.num_kv_blocks,
+        })
+        return out
+
     def load(self) -> Dict[str, object]:
         """Cheap placement snapshot for the router: queue depth, running
         set, and KV-pool pressure, every tick-independent number the

@@ -40,6 +40,12 @@ Sites (``Fault.site``):
   new weights; the two-phase flip must roll every staged replica back and
   leave the whole fleet serving the OLD weight version atomically
   (tests/test_rlhf.py).
+- ``autotune_trial``      — kill an autotune trial-journal commit
+  (autotuning/runner.py ``TrialJournal.record``) between the tmp write and
+  the rename: the stale ``.tmp-*`` partial must be swept on resume and the
+  resumed search must re-run nothing already committed
+  (tests/test_autotune_serving.py; arm with ``fire_nth=N`` to kill at the
+  Nth commit).
 - ``corrupt_manifest`` / ``drop_manifest`` / ``corrupt_shard`` — post-commit
   damage to an already-committed tag (drives checksum verification and the
   newest-complete-tag fallback on load). ``index`` selects the manifest
@@ -109,6 +115,7 @@ SITES = (
     "corrupt_manifest", "drop_manifest", "corrupt_shard",
     "kv_transfer", "kv_transfer_stall", "weight_publish",
     "replica_crash", "replica_hang", "tick_exception",
+    "autotune_trial",
 )
 
 

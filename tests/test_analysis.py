@@ -1038,3 +1038,9 @@ def test_annotations_present_on_real_seams():
     assert "records" in HealthMonitor.__sxt_locked_by__["_mu"]
     assert "_busy" in KVTransferChannel.__sxt_locked_by__["_cv"]
     assert "_aborting" in KVTransferChannel.__sxt_locked_by__["_cv"]
+    # the ISSUE 14 autotuner journal seam: a rejected record (duplicate
+    # key, unserializable payload) must mutate neither journal state nor
+    # the results dir — the crash-safe resume contract depends on it
+    from shuffle_exchange_tpu.autotuning.runner import TrialJournal
+
+    assert hasattr(TrialJournal.record, "__sxt_atomic_on_reject__")

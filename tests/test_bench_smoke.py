@@ -274,6 +274,65 @@ def test_serving_speculative_row_runs_at_toy_size():
     assert row["token_mismatches_draft_vs_k0"] == 0
 
 
+@pytest.mark.slow   # ~60s: real bounded search; nightly via ci_full (tier-1 budget)
+def test_serving_autotune_row_runs_at_toy_size():
+    """The config-5 serving-autotune row (bench.serving_autotune_row) at
+    toy size: a 2-round successive-halving search over the max_running
+    ladder (plus the statically-pruned insane-chunk-ladder candidates)
+    against one paired Poisson trace — winner config, trials run, and the
+    tuned-vs-default goodput delta all present, the static-prune and
+    winner-zero-recompile contracts green — on CPU, so the published row
+    cannot rot on the driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_autotune_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    # a deliberately mid-range default (max_running=2): the space above
+    # it holds configs that pack fatter ticks, so the search has a real
+    # delta to find — the same shape scripts/autotune_serving.py --smoke
+    # drills in ci_full
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=96,
+        serving={"token_budget": 64, "max_running": 2, "chunk_min": 4})
+    row = serving_autotune_row(model, params, icfg, mcfg.vocab_size,
+                               n_requests=12, prompt_lo=4, prompt_hi=20,
+                               max_new=6, load=2.0, rounds=2)
+    # winner config present and loadable as an overlay
+    assert row["winner"]
+    overlay = row["winner_overlay"]
+    icfg.with_overlay(overlay)                      # validates
+    assert overlay["serving"]["max_running"] >= 1
+    # trials run + goodput delta fields (the published headline)
+    assert row["trials_measured"] >= 4
+    assert row["pruned_static"] >= 1
+    assert row["pruned_never_measured"] is True
+    assert row["goodput_default_tokens_per_sec"] > 0
+    assert row["goodput_tuned_tokens_per_sec"] > 0
+    assert "goodput_delta_pct" in row
+    # the winner and the baseline measured with warmed, zero-recompile
+    # passes (an unwarmable candidate may legitimately appear infeasible
+    # in the ranked list — never as the winner)
+    assert row["winner_zero_recompile"] is True
+    assert row["default_zero_recompile"] is True
+    # the knob ranking the BASELINE.md retune plan reads
+    assert "max_running" in row["knob_effects"]
+    assert row["trace"]["seed"] == 0 and len(row["trace"]["arrivals_s"]) == 12
+    # tuned beats default on the paired trace (the ISSUE 14 acceptance
+    # bar; the deliberately small default leaves a wide margin)
+    assert (row["goodput_tuned_tokens_per_sec"]
+            > row["goodput_default_tokens_per_sec"])
+
+
 def test_rlhf_rollout_row_runs_at_toy_size():
     """The config-5 RLHF row (bench.rlhf_rollout_row) at toy size: three
     train -> publish -> generate flips on a warmed 2-replica fleet with
