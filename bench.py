@@ -770,6 +770,273 @@ def serving_failover_row(model, params, icfg, vocab, *, n_requests=16,
     }
 
 
+def serving_longctx_row(model, params, icfg, vocab, *, n_requests=12,
+                        prompt_blocks=16, grow_blocks=2, load=4.0, seed=0):
+    """Config-5 long-context tier row (ISSUE 15): the SAME Poisson trace —
+    contexts whose AGGREGATE KV exceeds the resident pool — served three
+    ways on identically-constrained pools:
+
+      - *refuse-admission baseline* (``kv_tier`` off): overflow waits in
+        the queue and decode growth past the pool PREEMPTS the youngest
+        sequence — flush + full re-prefill replay;
+      - *spill-on* (``kv_tier`` on): the same overflow PARKS host-ward —
+        cold blocks spill byte-exactly over the AIO pinned-buffer path
+        and fetch back when pressure subsides, zero re-prefill compute;
+      - *unconstrained reference*: a pool big enough to hold everything,
+        the token-parity oracle.
+
+    The trace is shaped to force the overflow deterministically: every
+    prompt fills ``prompt_blocks`` KV blocks to one token short of the
+    boundary and generates ``grow_blocks`` blocks of new tokens, while
+    the constrained pool holds exactly ``max_running`` prompts' worth —
+    admission fills the pool, decode growth overflows it. Token parity
+    is ASSERTED for bf16 KV (int8/fp8 are deterministic-not-bit-equal
+    per the PR 6 chunk-boundary contract and only reported). Headline:
+    goodput + TTFT/TPOT p95 for both, the tier's prefetch hit-rate, and
+    spill-on's preemption count (must be 0 — parks replace preempts).
+    Reused at toy size by tests/test_bench_smoke.py."""
+    import dataclasses as _dc
+
+    from shuffle_exchange_tpu.autotuning import poisson_arrivals
+    from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                                InferenceEngineV2)
+    from shuffle_exchange_tpu.inference.paged import blocks_needed
+
+    rng = np.random.default_rng(seed)
+    bs = icfg.kv_block_size
+    sv = icfg.serving
+    prompt_len = prompt_blocks * bs - 1
+    max_new = grow_blocks * bs
+    prompts = [rng.integers(1, vocab, size=prompt_len).tolist()
+               for _ in range(n_requests)]
+    per_req = blocks_needed(prompt_len + max_new, bs)
+    # constrained pool: admission fits max_running prompts, growth does
+    # not (+1 scratch, +1 slack so the first boundary crossing parks
+    # rather than stalls); reference pool holds the whole trace resident
+    small = sv.max_running * prompt_blocks + 2
+    big = n_requests * per_req + 2
+
+    def run(num_blocks, spill, arrivals=None):
+        eng = InferenceEngineV2(model, params, _dc.replace(
+            icfg, num_kv_blocks=num_blocks,
+            kv_tier=_dc.replace(icfg.kv_tier, enabled=spill)))
+        # throwaway pass warms the shape-bin ladder with the SAME
+        # arrivals — staggered admission reaches decode-batch / park
+        # widths an all-at-once warm never compiles, and those compiles
+        # would land mid-measurement otherwise
+        ContinuousBatchingScheduler(eng).serve(prompts,
+                                               max_new_tokens=max_new,
+                                               arrivals=arrivals)
+        if eng.tier is not None:
+            # the warm pass parked/fetched through the SAME tier — zero
+            # the traffic counters so the published spills/fetches/
+            # hit-rate describe only the measured pass
+            eng.tier.reset_counters()
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=max_new,
+                          arrivals=arrivals)
+        return out, sched.stats()
+
+    out_ref, st_ref = run(big, False)
+    # arrivals calibrated on the BASELINE capacity and replayed at the
+    # same offsets for all three, so the comparison is variance-paired
+    _, st_cap = run(small, False)
+    cap = st_cap["sustained_tokens_per_sec"]
+    span = n_requests * max_new / cap / load
+    arrivals = poisson_arrivals(rng, n_requests, span)
+    out_off, st_off = run(small, False, arrivals=list(arrivals))
+    out_on, st_on = run(small, True, arrivals=list(arrivals))
+    mism_off = sum(out_off[u] != out_ref[u] for u in out_ref)
+    mism_on = sum(out_on[u] != out_ref[u] for u in out_ref)
+    if icfg.kv_cache_dtype == "bf16":
+        assert mism_on == 0 and mism_off == 0, (
+            f"long-context token parity broken: spill-on {mism_on} / "
+            f"baseline {mism_off} requests diverge from the "
+            f"unconstrained-pool reference under bf16 KV")
+    tier = st_on["kv_tier"]
+    return {
+        "trace": _trace_record(seed, prompts, max_new, load, arrivals,
+                               capacity=cap),
+        "n_requests": n_requests,
+        "prompt_tokens": prompt_len,
+        "max_new_tokens": max_new,
+        "kv_block_size": bs,
+        "pool_blocks_constrained": small,
+        "pool_blocks_reference": big,
+        "aggregate_kv_blocks": n_requests * per_req,
+        "offered_load_x": load,
+        "kv_cache_dtype": icfg.kv_cache_dtype,
+        "hot_block_fraction": icfg.kv_tier.hot_block_fraction,
+        "prefetch_depth": icfg.kv_tier.prefetch_depth,
+        "token_mismatches_spill_on": mism_on,
+        "token_mismatches_baseline": mism_off,
+        "preemptions_baseline": st_off["preemptions"],
+        "preemptions_spill_on": st_on["preemptions"],
+        "parks": tier["parks"],
+        "unparks": tier["unparks"],
+        "spills": tier["spills"],
+        "fetches": tier["fetches"],
+        "tier_hit_rate": (round(tier["hit_rate"], 3)
+                          if tier["hit_rate"] is not None else None),
+        "sustained_tokens_per_sec_baseline": round(
+            st_off["sustained_tokens_per_sec"], 1),
+        "sustained_tokens_per_sec_spill_on": round(
+            st_on["sustained_tokens_per_sec"], 1),
+        "sustained_tokens_per_sec_unconstrained": round(
+            st_ref["sustained_tokens_per_sec"], 1),
+        "goodput_vs_baseline": round(
+            st_on["sustained_tokens_per_sec"]
+            / st_off["sustained_tokens_per_sec"], 3),
+        "ttft_p95_s_baseline": round(st_off["ttft_p95_s"], 4),
+        "ttft_p95_s_spill_on": round(st_on["ttft_p95_s"], 4),
+        "tpot_p95_s_baseline": round(st_off["tpot_p95_s"], 4),
+        "tpot_p95_s_spill_on": round(st_on["tpot_p95_s"], 4),
+    }
+
+
+def _jaxpr_peak_var_bytes(jaxpr) -> int:
+    """Largest single intermediate array (bytes) in the jaxpr's MANUAL
+    region (the shard_map body — vars there have per-chip local shapes),
+    subjaxprs included; falls back to the whole jaxpr when no manual
+    region exists. The honest per-chip working-set proxy the ring scaling
+    row reports: the outer jaxpr's operands keep their GLOBAL [B, T, ...]
+    shapes at every CP degree, so only the in-region vars show the
+    O(seq/CP) attention-memory scaling."""
+    import jax
+
+    j = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+    def find_manual(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "shard_map":
+                inner = eqn.params["jaxpr"]
+                return inner.jaxpr if hasattr(inner, "jaxpr") else inner
+        for sub in jax.core.subjaxprs(jx):
+            got = find_manual(sub)
+            if got is not None:
+                return got
+        return None
+
+    j = find_manual(j) or j
+    best = 0
+
+    def visit(jx):
+        nonlocal best
+        for eqn in jx.eqns:
+            for var in list(eqn.outvars) + list(eqn.invars):
+                aval = getattr(var, "aval", None)
+                shape = getattr(aval, "shape", None)
+                dtype = getattr(aval, "dtype", None)
+                if shape is not None and dtype is not None:
+                    n = int(np.prod(shape)) if len(shape) else 1
+                    best = max(best, n * dtype.itemsize)
+        for sub in jax.core.subjaxprs(jx):
+            visit(sub)
+
+    visit(j)
+    return best
+
+
+def ring_scaling_row(*, cp_degrees=(1, 2, 4), d=256, heads=4, layers=2,
+                     seq=512, vocab=512, batch=8, steps=3, seed=0):
+    """Config-2 ring-attention context-parallel scaling entry (ISSUE 15):
+    tokens/s and per-chip attention peak-memory vs CP degree on the
+    virtual mesh (SURVEY §2.6's missing parallelism; Ring Attention +
+    FPDT §5.7). Per degree: a full ``sxt.initialize`` training engine
+    with ``context_parallel.degree`` set (ring KV rotation via ppermute,
+    online-softmax accumulation), measuring steady-state train-step
+    tokens/s, the first-step loss (parity across degrees — exact
+    softmax), and the largest single intermediate in the local attention
+    region's jaxpr (O(seq/CP): the per-chip score tile shrinks with the
+    ring). CPU-mesh numbers are SHAPE evidence, not speed — the on-chip
+    row is pending the tunnel (BASELINE.md). Reused at toy size by
+    tests/test_bench_smoke.py."""
+    import time as _time
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import shuffle_exchange_tpu as sxt
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.parallel import reset_topology
+    from shuffle_exchange_tpu.parallel.mesh import (MeshTopology,
+                                                    shard_map)
+    from shuffle_exchange_tpu.parallel.sequence import ring_attention
+
+    n_dev = len(jax.devices())
+    degrees = [c for c in cp_degrees if c <= n_dev and seq % c == 0
+               and n_dev % c == 0]
+    if not degrees:
+        return {"pending": f"needs a multi-device mesh (have {n_dev}); "
+                           f"publish on the next TPU window"}
+    rng = np.random.default_rng(seed)
+    # ONE batch shared by every degree — the loss-parity claim is exact
+    # softmax over IDENTICAL data, so the same tokens must divide each
+    # degree's data world; any multiple of n_dev does (data world =
+    # n_dev / cp for every surviving degree)
+    b = ((max(batch, n_dev) + n_dev - 1) // n_dev) * n_dev
+    batch_ids = rng.integers(0, vocab, size=(b, seq)).astype(np.int32)
+    entries = []
+    for cp in degrees:
+        reset_topology()
+        model = Transformer(tiny(vocab=vocab, d=d, layers=layers,
+                                 heads=heads, seq=seq,
+                                 activation="swiglu", norm="rmsnorm",
+                                 position="rope"))
+        cfg = {"train_batch_size": b,
+               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+               "steps_per_print": 10**9}
+        if cp > 1:
+            cfg["context_parallel"] = {"degree": cp}
+        eng, *_ = sxt.initialize(model=model, config=cfg)
+        loss0 = float(eng.train_batch({"input_ids": batch_ids}))
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            eng.train_batch({"input_ids": batch_ids})
+        dt = (_time.perf_counter() - t0) / steps
+        # per-chip attention working set: the local ring region's largest
+        # intermediate at this degree's shard length (seq/cp)
+        from shuffle_exchange_tpu.config.config import MeshConfig
+
+        reset_topology()
+        topo = MeshTopology.build(
+            MeshConfig(data=1, seq=max(1, cp)), n_devices=max(1, cp))
+        B, H, D = 1, heads, d // heads
+        q = np.zeros((B, seq, H, D), np.float32)
+        spec = P(None, "seq", None, None)
+        fn = shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
+                                           causal=True, use_kernel=False),
+            mesh=topo.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        attn_bytes = _jaxpr_peak_var_bytes(
+            jax.make_jaxpr(fn)(q, q, q))
+        entries.append({
+            "cp": cp,
+            "batch_run": b,
+            "tokens_per_sec": round(b * seq / dt, 1),
+            "step_s": round(dt, 4),
+            "loss": round(loss0, 6),
+            "attention_peak_bytes_per_chip": attn_bytes,
+        })
+    base = entries[0]
+    for e in entries:
+        e["attention_mem_vs_cp1"] = round(
+            e["attention_peak_bytes_per_chip"]
+            / base["attention_peak_bytes_per_chip"], 3)
+    reset_topology()
+    return {
+        "seq": seq, "batch": batch, "d_model": d, "layers": layers,
+        "degrees": degrees,
+        "entries": entries,
+        "loss_parity": max(abs(e["loss"] - base["loss"])
+                           for e in entries),
+        "note": ("CPU virtual-mesh shape evidence: attention memory "
+                 "O(seq/CP); tokens/s on chip pending the TPU window "
+                 "(BASELINE.md)"),
+    }
+
+
 def serving_autotune_row(model, params, icfg, vocab, *, n_requests=16,
                          prompt_lo=48, prompt_hi=192, max_new=16,
                          load=2.0, seed=0, rounds=2, max_programs=512,
@@ -1174,6 +1441,18 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         failover_row = None
 
+    # ---- long-context tiered KV: the same Poisson trace on constrained
+    # pools, spill-on vs the refuse-admission baseline vs an
+    # unconstrained-pool reference (ISSUE 15) — goodput, TTFT/TPOT p95,
+    # tier hit-rate, with token parity asserted under bf16 KV
+    try:
+        longctx_row = serving_longctx_row(model, params, icfg,
+                                          cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving longctx bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        longctx_row = None
+
     # ---- serving autotune: bounded successive-halving search of the
     # serving knobs against the paired Poisson goodput trace (ISSUE 14) —
     # tuned-vs-default delta, static-prune and zero-recompile contracts,
@@ -1239,6 +1518,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_fleet": fleet_row,
         "serving_speculative": spec_row,
         "serving_failover": failover_row,
+        "serving_longctx": longctx_row,
         "serving_autotune": autotune_row,
         "rlhf_rollout": rlhf_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]
@@ -1358,6 +1638,16 @@ def _config2(peak, hbm, n_chips, on_tpu, hbm_bw=None):
         print(f"SXT_WARN host-offload ladder bench failed: {_short_err(e)}",
               file=sys.stderr, flush=True)
         row["host_offload_row"] = {"error": _short_err(e)}
+    # Ring-attention CP scaling entry (ISSUE 15): tokens/s + per-chip
+    # attention peak-memory vs CP degree. On a single-chip tunnel this
+    # reports pending (the ring needs a live multi-device mesh); the CPU
+    # driver's virtual mesh measures the shape claims.
+    try:
+        row["ring_attention_row"] = ring_scaling_row()
+    except Exception as e:
+        print(f"SXT_WARN ring scaling bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        row["ring_attention_row"] = {"error": _short_err(e)}
     return "config2_llama3_zero3_fused_adam", row
 
 

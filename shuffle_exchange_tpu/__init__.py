@@ -118,6 +118,29 @@ def initialize(
 
     topology = initialize_topology(cfg.mesh, force=True)
 
+    # Context parallelism (ISSUE 15): ``context_parallel.degree`` maps
+    # onto the mesh "seq" axis (config._map_parallel_sizes) and ring
+    # attention is the one CP attention shape — route zoo models onto it
+    # here, carrying the section's kv_chunk/use_kernel knobs into the
+    # model config the attention region reads.
+    if cfg.context_parallel.degree > 1:
+        tcfg = getattr(model, "config", None)
+        if tcfg is not None and hasattr(tcfg, "sp_attention"):
+            import dataclasses as _dc
+
+            model.config = _dc.replace(
+                tcfg, sp_attention="ring",
+                cp_kv_chunk=cfg.context_parallel.kv_chunk,
+                cp_use_kernel=cfg.context_parallel.use_kernel)
+        else:
+            from .utils.logging import logger
+
+            logger.warning(
+                "context_parallel.degree=%d but the model exposes no "
+                "sp_attention config — the seq axis will shard activations "
+                "without ring attention (zoo Transformer models route "
+                "automatically)", cfg.context_parallel.degree)
+
     # Pipeline parallelism: wrap zoo models so the 1F1B microbatch loop runs
     # inside the jitted step (the reference's PipelineEngine path,
     # runtime/pipe/engine.py:338 — here a model wrapper, see parallel/pipeline.py).

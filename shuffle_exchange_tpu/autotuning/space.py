@@ -56,7 +56,11 @@ _DRAFTERS = ("ngram", "model")
 #: the axes ServingSearchSpace accepts, i.e. the tunable knob families
 KNOWN_AXES = ("token_budget", "max_running", "chunk_min", "chunk_bins",
               "k", "drafter", "k_bins", "decode_kernel", "kv_cache_dtype",
-              "prefix_caching")
+              "prefix_caching",
+              # tiered paged KV (ISSUE 15): park-instead-of-preempt
+              # spill to the host tier, its hot-tail size, and how many
+              # parked sequences prefetch-stage one tick ahead
+              "spill_enabled", "hot_block_fraction", "prefetch_depth")
 
 
 def pow2_bin_count(n: int) -> int:
@@ -143,6 +147,11 @@ class ServingCandidate:
     decode_kernel: str = "auto"
     kv_cache_dtype: str = "bf16"
     prefix_caching: Optional[bool] = None   # None = keep the base config's
+    # tiered paged KV (ISSUE 15): None keeps the base config's tier;
+    # True/False toggles park-instead-of-preempt spill explicitly
+    spill_enabled: Optional[bool] = None
+    hot_block_fraction: float = 0.0
+    prefetch_depth: int = 1
     # search bookkeeping (mutated by the space/search, not identity)
     status: str = "pending"      # pending | pruned_static | ...
     prune_reason: str = ""
@@ -162,6 +171,17 @@ class ServingCandidate:
             n += f"_{self.kv_cache_dtype}"
         if self.prefix_caching is not None:
             n += "_pc1" if self.prefix_caching else "_pc0"
+        if self.spill_enabled is not None:
+            n += "_sp1" if self.spill_enabled else "_sp0"
+        if self.spill_enabled is not False and (
+                self.hot_block_fraction != 0.0 or self.prefetch_depth != 1):
+            # live under True AND None (inherit — the base config's tier
+            # may be on): a name that omitted them would let enumerate()'s
+            # dedup collapse the whole hf/pd grid to one point. Under an
+            # EXPLICIT False the knobs are inert, so the suffix is
+            # dropped and dedup collapses the duplicates instead of the
+            # search burning a measured trial per identical config
+            n += f"_hf{self.hot_block_fraction:g}_pd{self.prefetch_depth}"
         return n
 
     # -- ladders (static; no config construction) -----------------------
@@ -225,6 +245,20 @@ class ServingCandidate:
         }
         if self.prefix_caching is not None:
             out["prefix_caching"] = self.prefix_caching
+        if self.spill_enabled is not None:
+            out["kv_tier"] = {
+                "enabled": self.spill_enabled,
+                "hot_block_fraction": self.hot_block_fraction,
+                "prefetch_depth": self.prefetch_depth,
+            }
+        elif self.hot_block_fraction != 0.0 or self.prefetch_depth != 1:
+            # spill inherits the base config's tier, but the searched
+            # knobs must still land — with_overlay merges this partial
+            # section over the base's, keeping its enabled flag
+            out["kv_tier"] = {
+                "hot_block_fraction": self.hot_block_fraction,
+                "prefetch_depth": self.prefetch_depth,
+            }
         return out
 
     def apply(self, base_icfg):
@@ -247,7 +281,10 @@ class ServingCandidate:
             k_bins=spec.k_bins if spec.enabled else None,
             decode_kernel=icfg.decode_kernel,
             kv_cache_dtype=icfg.kv_cache_dtype,
-            prefix_caching=icfg.prefix_caching)
+            prefix_caching=icfg.prefix_caching,
+            spill_enabled=icfg.kv_tier.enabled,
+            hot_block_fraction=icfg.kv_tier.hot_block_fraction,
+            prefetch_depth=icfg.kv_tier.prefetch_depth)
 
 
 class ServingSearchSpace:
@@ -352,13 +389,49 @@ class ServingSearchSpace:
                 f"{pow2_bin_count(c.max_running)}"
                 + (f" x k ladder {len(c.k_ladder())} bins" if c.k else "")
                 + ")")
-        # KV arithmetic: a running set that cannot hold 1/overcommit of
-        # its worst case permanently lives in the preemption path
-        if ctx.kv_overcommit is not None and ctx.request_tokens_hi:
-            if ctx.request_tokens_hi > ctx.max_seq_len:
+        # tiered paged KV (ISSUE 15): knob validity, then geometry — the
+        # tier changes what KV pressure MEANS (reclaimable-not-free), but
+        # a single request must still fit the resident pool at dispatch
+        if not 0.0 <= float(c.hot_block_fraction) <= 1.0:
+            return False, (f"hot_block_fraction {c.hot_block_fraction} "
+                           f"outside [0, 1]")
+        if not isinstance(c.prefetch_depth, int) or c.prefetch_depth < 0:
+            return False, f"prefetch_depth {c.prefetch_depth!r} must be >= 0"
+        # one request must fit max_seq_len no matter what the tier does —
+        # the engine rejects longer requests at submit, so a too-long
+        # trace footprint is infeasible for EVERY candidate
+        if (ctx.request_tokens_hi
+                and ctx.request_tokens_hi > ctx.max_seq_len):
+            return False, (
+                f"trace request footprint {ctx.request_tokens_hi} "
+                f"tokens exceeds max_seq_len {ctx.max_seq_len}")
+        if c.spill_enabled and ctx.request_tokens_hi:
+            worst = ctx.blocks_for(ctx.request_tokens_hi)
+            if worst > ctx.usable_blocks:
                 return False, (
-                    f"trace request footprint {ctx.request_tokens_hi} "
-                    f"tokens exceeds max_seq_len {ctx.max_seq_len}")
+                    f"spill cannot help: one request's {worst} worst-case "
+                    f"blocks exceed the {ctx.usable_blocks}-block pool — "
+                    f"dispatch needs FULL residency, so the tier only "
+                    f"rotates sequences, never splits one past the pool")
+            import math
+
+            hot = int(math.ceil(c.hot_block_fraction * worst))
+            if worst - hot < 1:
+                return False, (
+                    f"hot_block_fraction {c.hot_block_fraction} keeps all "
+                    f"{worst} worst-case blocks hot — nothing is ever "
+                    f"spillable, the tier is a no-op with bookkeeping cost "
+                    f"(lower it or disable spill)")
+        # KV arithmetic: a running set that cannot hold 1/overcommit of
+        # its worst case permanently lives in the preemption path —
+        # UNLESS the tier is on, where overflow parks host-ward instead
+        # of thrashing the preemption/replay path. Only a KNOWN-off tier
+        # prunes: spill_enabled=None inherits the base config's tier at
+        # apply time, which may be enabled — a static prune must never
+        # drop a candidate that could be feasible (it can lose on merit,
+        # it cannot lose unmeasured)
+        if (ctx.kv_overcommit is not None and ctx.request_tokens_hi
+                and c.spill_enabled is False):
             worst = c.max_running * ctx.blocks_for(ctx.request_tokens_hi)
             budget = ctx.kv_overcommit * ctx.usable_blocks
             if worst > budget:
@@ -366,5 +439,6 @@ class ServingSearchSpace:
                     f"max_running {c.max_running} x "
                     f"{ctx.blocks_for(ctx.request_tokens_hi)} worst-case "
                     f"blocks = {worst} exceeds {ctx.kv_overcommit}x the "
-                    f"{ctx.usable_blocks}-block pool — permanent KV thrash")
+                    f"{ctx.usable_blocks}-block pool — permanent KV thrash "
+                    f"(spill_enabled=True would park instead)")
         return True, ""

@@ -211,6 +211,54 @@ class ZeroPPConfig(ConfigModel):
                         f"(use one of {valid})")
 
 
+@dataclass
+class ContextParallelConfig(ConfigModel):
+    """Ring-attention context parallelism (ISSUE 15; SURVEY §2.6's "we may
+    add ring attention as the TPU-idiomatic CP", Ring Attention /
+    Liu et al. + FPDT §5.7).
+
+    ``degree`` maps onto the mesh "seq" axis (the same axis Ulysses SP
+    uses; the two are mutually exclusive owners of it — set one). The
+    engine then forces the model's attention onto the RING path: a
+    full-manual shard_map region over {data, fsdp, seq} where each chip
+    keeps its Q shard and KV blocks rotate around the ring via
+    ``ppermute``, accumulating online-softmax partials (running max/sum
+    + lse) — per-chip attention memory is O(seq/degree) with
+    exact-softmax numerics, and causal rings skip later-source hops
+    entirely (~2x; ``lax.cond`` around the hop kernel).
+
+    ``kv_chunk``: the per-hop KV tile (flash-style) for the jnp chunked
+    path; the Pallas hop-kernel path tiles itself. ``use_kernel``:
+    "auto" routes each hop through the ``flash_attention_lse`` Pallas
+    kernel when the shape gate passes, "pallas" forces it (errors
+    surface), "xla" keeps the jnp chunked online-softmax.
+
+    Composition on jax 0.4.x (this box): CP x pipe is a committed
+    ConfigError (scripts/repro_wire_nesting_xla_check.py — the ring
+    region cannot nest in the pipeline's manual region without
+    first-class jax.shard_map), as is CP x the ZeRO++ quantized wire
+    (scripts/repro_wire_nesting_xla_check.py from the other direction);
+    CP x pipe x tensor is rejected on every jax (spmd_partitioner_util
+    CHECK, scripts/repro_seq_pipe_tensor_xla_check.py). CP x fsdp/data
+    (ZeRO 1-3) composes everywhere.
+
+    With ``remat_policy: save_flash_lse`` the ring's per-hop checkpoint
+    saves exactly the kernel's own (out, lse) residuals, so the backward
+    ring enters the dq/dkv kernels from SAVED lse — the forward kernel
+    never re-runs (the PR 3 discipline, now per hop)."""
+
+    degree: int = config_field(1, ge=1)
+    kv_chunk: int = config_field(1024, ge=1)
+    use_kernel: str = config_field("auto")
+
+    def _validate(self, path=""):
+        super()._validate(path)
+        if self.use_kernel not in ("auto", "pallas", "xla"):
+            raise ConfigError(
+                f'context_parallel.use_kernel must be "auto", "pallas" or '
+                f'"xla", got {self.use_kernel!r}')
+
+
 # ---------------------------------------------------------------------------
 # Optimizer / scheduler (reference: engine._configure_basic_optimizer, lr_schedules.py)
 # ---------------------------------------------------------------------------
@@ -635,6 +683,7 @@ class SXConfig(ConfigModel):
     tensor_parallel: TensorParallelConfig = config_field(default_factory=TensorParallelConfig, aliases=("autotp",))
     sequence_parallel_size: int = config_field(1, ge=1)
     pipeline_parallel_size: int = config_field(1, ge=1)
+    context_parallel: ContextParallelConfig = config_field(default_factory=ContextParallelConfig)
 
     autotuning: AutotuningConfig = config_field(default_factory=AutotuningConfig)
 
@@ -687,7 +736,18 @@ class SXConfig(ConfigModel):
 
         merge("pipe", "pipeline.stages", self.pipeline.stages)
         merge("pipe", "pipeline_parallel_size", self.pipeline_parallel_size)
+        if (self.context_parallel.degree > 1
+                and self.sequence_parallel_size > 1):
+            # both claim the "seq" axis with DIFFERENT attention shapes
+            # (ring KV rotation vs Ulysses a2a) — one owner only
+            raise ConfigError(
+                f"context_parallel.degree={self.context_parallel.degree} and "
+                f"sequence_parallel_size={self.sequence_parallel_size} both "
+                f"claim the mesh 'seq' axis; set exactly one (ring CP and "
+                f"Ulysses SP are alternative attention shapes over the same "
+                f"axis)")
         merge("seq", "sequence_parallel_size", self.sequence_parallel_size)
+        merge("seq", "context_parallel.degree", self.context_parallel.degree)
         merge("tensor", "tensor_parallel.tp_size", self.tensor_parallel.tp_size)
 
     @property

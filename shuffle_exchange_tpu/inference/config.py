@@ -123,6 +123,60 @@ class SpeculativeConfig:
 
 
 @dataclasses.dataclass
+class KVTierConfig:
+    """Tiered paged-KV storage (ISSUE 15): serving contexts larger than
+    resident KV by spilling COLD blocks host-ward through the AIO
+    pinned-buffer substrate (the same ``PinnedBufferPool`` path the
+    disaggregated prefill->decode transfer stages through — byte-exact
+    payload + scale planes, never re-quantized).
+
+    The scheduler PARKS a sequence under KV pressure instead of
+    preempting it: its exclusive blocks move to the host tier (the pool
+    slots free up), the request keeps its generated tokens and engine
+    descriptor, and a later tick FETCHES the bytes back into fresh
+    blocks — no re-prefill compute, token-identical under greedy
+    decoding (bf16 exact; int8/fp8 deterministic, the PR 6 contract,
+    because the quantized planes round-trip byte-exactly).
+
+    - ``hot_block_fraction``: fraction of a parked sequence's blocks
+      KEPT resident (the tail of the decode window — its most recently
+      written, first re-read blocks), so un-parking fetches only the
+      cold prefix. 0.0 spills everything spillable.
+    - ``prefetch_depth``: parked sequences whose host bytes are staged
+      into pinned buffers one tick AHEAD of their expected un-park (the
+      double-buffer: assembly runs off the fetch critical path; a fetch
+      that finds its staging ready is a prefetch hit).
+    - ``spill_dir``: optional directory for AsyncIOEngine file spill
+      (the NVMe tier below host RAM); None keeps spilled bytes in host
+      memory."""
+
+    enabled: bool = False
+    hot_block_fraction: float = 0.0
+    prefetch_depth: int = 1
+    spill_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if not isinstance(self.enabled, bool):
+            raise ConfigError(
+                f"kv_tier.enabled must be a bool, got {self.enabled!r}")
+        if (not isinstance(self.hot_block_fraction, (int, float))
+                or not 0.0 <= float(self.hot_block_fraction) <= 1.0):
+            raise ConfigError(
+                f"kv_tier.hot_block_fraction must be in [0, 1] (fraction of "
+                f"a parked sequence's blocks kept resident), got "
+                f"{self.hot_block_fraction!r}")
+        self.hot_block_fraction = float(self.hot_block_fraction)
+        if not isinstance(self.prefetch_depth, int) or self.prefetch_depth < 0:
+            raise ConfigError(
+                f"kv_tier.prefetch_depth must be an int >= 0 (0 disables "
+                f"prefetch staging), got {self.prefetch_depth!r}")
+        if self.spill_dir is not None and not isinstance(self.spill_dir, str):
+            raise ConfigError(
+                f"kv_tier.spill_dir must be a path or None, got "
+                f"{self.spill_dir!r}")
+
+
+@dataclasses.dataclass
 class ServingConfig:
     """Continuous-batching scheduler knobs (``inference/scheduler.py`` —
     the Dynamic-SplitFuse scheduler the reference FastGen engine runs,
@@ -413,6 +467,10 @@ class InferenceConfig:
     # so outputs are token-identical in practice but not guaranteed
     # bit-identical — production serving configs opt in.
     prefix_caching: bool = False
+    # tiered paged KV (ISSUE 15): cold blocks spill host-ward over the
+    # AIO pinned-buffer substrate so serving contexts can outgrow the
+    # resident pool; the scheduler parks/unparks under KV pressure
+    kv_tier: KVTierConfig = dataclasses.field(default_factory=KVTierConfig)
     # continuous-batching scheduler (inference/scheduler.py, engine_v2.step)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     # multi-replica serving front (serving/router.py: placement, sticky
@@ -433,6 +491,16 @@ class InferenceConfig:
             self.router = RouterConfig()
         elif isinstance(self.router, dict):
             self.router = RouterConfig(**self.router)
+        if self.kv_tier is None:
+            self.kv_tier = KVTierConfig()
+        elif isinstance(self.kv_tier, dict):
+            allowed = {f.name for f in dataclasses.fields(KVTierConfig)}
+            unknown = set(self.kv_tier) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown kv_tier config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            self.kv_tier = KVTierConfig(**self.kv_tier)
         self.kv_cache_dtype = _normalize_kv_cache_dtype(self.kv_cache_dtype)
         if not isinstance(self.prefix_caching, bool):
             raise ConfigError(
@@ -508,6 +576,20 @@ class InferenceConfig:
         elif sv is not None and not isinstance(sv, ServingConfig):
             raise ConfigError(f"serving must be a dict or ServingConfig, "
                               f"got {type(sv).__name__}")
+        kt = d.get("kv_tier")
+        if kt is None:
+            d.pop("kv_tier", None)   # empty section -> defaults
+        elif isinstance(kt, dict):
+            allowed = {f.name for f in dataclasses.fields(KVTierConfig)}
+            unknown = set(kt) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown kv_tier config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            d["kv_tier"] = KVTierConfig(**kt)
+        elif not isinstance(kt, KVTierConfig):
+            raise ConfigError(f"kv_tier must be a dict or KVTierConfig, "
+                              f"got {type(kt).__name__}")
         rt = d.get("router")
         if rt is None:
             d.pop("router", None)   # empty section -> defaults
@@ -538,7 +620,7 @@ class InferenceConfig:
     #: model geometry, pool size, dtypes — is NOT a serving knob and must
     #: not ride in through an overlay file)
     OVERLAY_KEYS = ("serving", "kv_cache_dtype", "decode_kernel",
-                    "prefix_caching")
+                    "prefix_caching", "kv_tier")
 
     def serving_overlay(self) -> Dict[str, Any]:
         """This config's point in the serving knob space as a standalone
@@ -561,9 +643,22 @@ class InferenceConfig:
             sv["speculative"] = sp
         else:
             sv["speculative"] = {"enabled": False}
-        return {"serving": sv, "kv_cache_dtype": self.kv_cache_dtype,
-                "decode_kernel": self.decode_kernel,
-                "prefix_caching": self.prefix_caching}
+        out = {"serving": sv, "kv_cache_dtype": self.kv_cache_dtype,
+               "decode_kernel": self.decode_kernel,
+               "prefix_caching": self.prefix_caching}
+        if self.kv_tier.enabled:
+            out["kv_tier"] = {
+                "enabled": True,
+                "hot_block_fraction": self.kv_tier.hot_block_fraction,
+                "prefetch_depth": self.kv_tier.prefetch_depth,
+            }
+        else:
+            # spill OFF is a point in the knob space too (same shape as
+            # the speculative section): an overlay from a tier-disabled
+            # config applied to a tier-enabled base must turn spill off,
+            # not silently inherit it
+            out["kv_tier"] = {"enabled": False}
+        return out
 
     def with_overlay(self, overlay: Dict[str, Any]) -> "InferenceConfig":
         """A new config = this one with a serving-knob overlay applied.
@@ -614,13 +709,30 @@ class InferenceConfig:
                 cur["speculative"] = SpeculativeConfig(
                     **{**sp_cur, **spec_patch})
             serving = ServingConfig(**{**cur, **sv_patch})
+        kt_patch = d.pop("kv_tier", None)
+        kv_tier = self.kv_tier
+        if kt_patch is not None:
+            if not isinstance(kt_patch, dict):
+                raise ConfigError(
+                    f"overlay 'kv_tier' must be a dict, got "
+                    f"{type(kt_patch).__name__}")
+            kt_allowed = {f.name for f in dataclasses.fields(KVTierConfig)}
+            kt_unknown = set(kt_patch) - kt_allowed
+            if kt_unknown:
+                raise ConfigError(
+                    f"unknown kv_tier overlay keys {sorted(kt_unknown)} "
+                    f"(allowed: {sorted(kt_allowed)})")
+            kt_cur = {f.name: getattr(self.kv_tier, f.name)
+                      for f in dataclasses.fields(KVTierConfig)}
+            kv_tier = KVTierConfig(**{**kt_cur, **kt_patch})
         dk = d.get("decode_kernel")
         if dk is not None and dk not in ("auto", "pallas", "xla"):
             # __post_init__ leaves decode_kernel to from_dict; an overlay
             # bypasses from_dict, so validate here
             raise ConfigError(
                 f'decode_kernel must be "auto", "pallas" or "xla", got {dk!r}')
-        return dataclasses.replace(self, serving=serving, **d)
+        return dataclasses.replace(self, serving=serving, kv_tier=kv_tier,
+                                   **d)
 
     def jax_dtype(self) -> Any:
         import jax.numpy as jnp

@@ -62,6 +62,11 @@ class SequenceDescriptor:
     # from MIXED weights: its blocks must never enter the content index
     # (a fresh admission would hash the same tokens and hit stale KV)
     no_commit: bool = False
+    # tiered KV (ISSUE 15): descriptor positions whose blocks were
+    # spilled host-ward — ``blocks[i]`` holds the -1 sentinel for every
+    # i in here, and the sequence cannot be dispatched until
+    # ``fetch_spilled`` restores full residency
+    spilled: set = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -176,6 +181,15 @@ class InferenceEngineV2(InferenceEngine):
         self.weight_version = 0
         self._staged_weights: Optional[Tuple[object, Optional[int]]] = None
         self._pending_weights: Optional[Tuple[object, Optional[int]]] = None
+        # tiered paged KV (ISSUE 15): host tier for cold spilled blocks —
+        # the scheduler parks sequences here under KV pressure instead of
+        # preempting them (byte-exact spill/fetch over the AIO substrate)
+        self.tier = None
+        if cfg.kv_tier.enabled:
+            from .kv_tier import HostKVTier
+
+            self.tier = HostKVTier(spill_dir=cfg.kv_tier.spill_dir,
+                                   prefetch_depth=cfg.kv_tier.prefetch_depth)
 
     # -- scheduling queries (engine_v2.py:158-232) ---------------------
 
@@ -247,8 +261,18 @@ class InferenceEngineV2(InferenceEngine):
         if need > self.allocator.free_blocks:
             cache_note = (f" after {worst_cached} prefix-cached" if worst_cached
                           else "")
+            tier_note = ""
+            if self.tier is not None:
+                # tier-aware accounting (ISSUE 15): spillable blocks are
+                # reclaimable-not-free — a spill pass could fund this ask
+                # without losing any sequence's KV, so the refusal names
+                # them next to the free count for the scheduler's
+                # park-instead-of-preempt decision
+                tier_note = (f" + {self.spillable_blocks(exclude=uids)} "
+                             f"reclaimable via kv_tier spill")
             return False, need, (
-                f"needs {need} KV blocks, {self.allocator.free_blocks} free "
+                f"needs {need} KV blocks, {self.allocator.free_blocks} free"
+                f"{tier_note} "
                 f"(largest single ask: uid {worst_uid} wants {worst_ask} new"
                 f"{cache_note}); flush finished sequences or raise "
                 f"num_kv_blocks")
@@ -620,6 +644,170 @@ class InferenceEngineV2(InferenceEngine):
         if need > 0:
             desc.blocks.extend(self.allocator.allocate(need))
 
+    # -- tiered KV: spill / fetch (ISSUE 15) ----------------------------
+
+    def _pool_planes(self):
+        """The pool's storage planes in wire order (data, then scales) —
+        the same per-plane layout KVBlockPayload ships."""
+        c = self.cache
+        return ([c.k, c.v, c.k_scale, c.v_scale] if c.quantized
+                else [c.k, c.v])
+
+    def _require_resident(self, uids: Sequence[int], what: str) -> None:
+        """Dispatch paths need FULL residency: a sequence with spilled
+        blocks must be fetched back before its KV can be read or written
+        (the block table addresses pool slots the spill freed)."""
+        if self.tier is None:
+            return
+        for uid in uids:
+            desc = self._seqs.get(uid)
+            if desc is not None and desc.spilled:
+                raise RuntimeError(
+                    f"cannot {what}: uid {uid} has {len(desc.spilled)} KV "
+                    f"blocks spilled to the host tier — fetch_spilled"
+                    f"({uid}) first (the scheduler un-parks before "
+                    f"dispatching)")
+
+    def is_resident(self, uid: int) -> bool:
+        desc = self._seqs.get(uid)
+        return desc is not None and not desc.spilled
+
+    def _keep_hot(self, desc: SequenceDescriptor) -> int:
+        """Blocks of ``desc`` kept resident on a spill: the TAIL of the
+        decode window (most recently written, first re-read), sized by
+        ``kv_tier.hot_block_fraction``."""
+        import math
+
+        frac = self.config.kv_tier.hot_block_fraction
+        return int(math.ceil(frac * len(desc.blocks)))
+
+    def spillable_blocks(self, exclude: Sequence[int] = ()) -> int:
+        """Reclaimable-not-free blocks (ISSUE 15 accounting): exclusively
+        held (refcount 1), resident, cold (outside the hot tail) blocks
+        of live sequences not in ``exclude`` — what a spill pass could
+        return to the free pool without losing any token's KV. Shared
+        prefix blocks are NOT spillable (another sequence may be
+        dispatched against them this tick)."""
+        if self.tier is None:
+            return 0
+        skip = set(exclude)
+        total = 0
+        for uid, desc in self._seqs.items():
+            if uid in skip:
+                continue
+            limit = max(0, len(desc.blocks) - self._keep_hot(desc))
+            total += sum(
+                1 for i in range(limit)
+                if i not in desc.spilled
+                and self.allocator.ref_count(desc.blocks[i]) == 1)
+        return total
+
+    @atomic_on_reject(check="validate")
+    def spill_sequence(self, uid: int,
+                       keep_hot: Optional[int] = None) -> int:
+        """Spill ``uid``'s cold exclusive blocks host-ward, freeing their
+        pool slots; returns the number of blocks reclaimed. The host copy
+        is the pool's OWN storage bytes (data + quantized scale planes,
+        byte-exact — the KVBlockPayload discipline), so a later
+        ``fetch_spilled`` restores the sequence with no re-prefill and no
+        re-quantization. Shared (refcount > 1) blocks stay resident;
+        ``keep_hot`` tail blocks (default from
+        ``kv_tier.hot_block_fraction``) stay resident as the hot set.
+
+        Crash discipline (the ``kv_spill`` fault site): the host gather
+        happens BEFORE any engine mutation, and the tier store commits
+        before the allocator free — a spill killed at the fault site
+        leaves pool, allocator, and tier byte-identically unchanged."""
+        from ..testing import faults
+
+        if self.tier is None:
+            raise RuntimeError("kv_tier is not enabled on this engine "
+                               "(set inference config kv_tier.enabled)")
+        desc = self._seqs.get(uid)
+        if desc is None:
+            raise ValueError(f"unknown uid {uid}")
+        if keep_hot is None:
+            keep_hot = self._keep_hot(desc)
+        limit = max(0, len(desc.blocks) - keep_hot)
+        cand = [i for i in range(limit)
+                if i not in desc.spilled
+                and self.allocator.ref_count(desc.blocks[i]) == 1]
+        if not cand:
+            return 0
+        # gather width binned to a power of two (scratch-padded rows,
+        # sliced off host-side): the device gather is a compiled program
+        # per shape, and unbinned widths would compile a fresh executable
+        # for every distinct spill size — a mid-trace compile that poisons
+        # goodput exactly like the unbinned block tables of round 9
+        n = len(cand)
+        W = _bucket(n, minimum=1)
+        idx = np.asarray([desc.blocks[i] for i in cand]
+                         + [self._scratch] * (W - n), np.int32)
+        planes = [np.asarray(p[:, idx])[:, :n]
+                  for p in self._pool_planes()]
+        if faults.ACTIVE:
+            faults.maybe_crash("kv_spill", 0)
+        self.tier.store(uid, cand, planes)
+        self.allocator.free([desc.blocks[i] for i in cand])
+        for i in cand:
+            desc.blocks[i] = -1
+            desc.spilled.add(i)
+        return len(cand)
+
+    @atomic_on_reject(check="validate")
+    def fetch_spilled(self, uid: int) -> int:
+        """Restore ``uid``'s spilled blocks into FRESH pool slots (one
+        jitted scatter — the disagg import program); returns the block
+        count fetched. Atomic-on-reject: the free-pool check and the tier
+        read happen before any allocation, and a failure after the
+        allocation (the ``kv_fetch`` fault site) frees the fresh blocks
+        again — engine and tier end exactly as before the call."""
+        from ..testing import faults
+
+        desc = self._seqs.get(uid)
+        if desc is None:
+            raise ValueError(f"unknown uid {uid}")
+        if not desc.spilled:
+            return 0
+        idxs = sorted(desc.spilled)
+        n = len(idxs)
+        if n > self.allocator.free_blocks:
+            raise RuntimeError(
+                f"cannot fetch uid {uid}'s {n} spilled KV blocks: only "
+                f"{self.allocator.free_blocks} free "
+                f"({self.spillable_blocks(exclude=[uid])} reclaimable via "
+                f"further spill); park another sequence or raise "
+                f"num_kv_blocks")
+        tidx, planes = self.tier.load(uid)
+        assert tidx == idxs, (uid, tidx, idxs)
+        new = self.allocator.allocate(n)
+        try:
+            if faults.ACTIVE:
+                faults.maybe_crash("kv_fetch", 0)
+            # scatter width binned like the spill gather: pad the index
+            # row with the scratch block (duplicate scratch writes land
+            # in the garbage slot) and the planes with zero rows, so the
+            # import program compiles once per power-of-two width instead
+            # of once per distinct spilled-block count
+            W = _bucket(n, minimum=1)
+            idx_pad = np.asarray(list(new) + [self._scratch] * (W - n),
+                                 np.int32)
+            planes_pad = [
+                p if W == n else np.concatenate(
+                    [p, np.zeros(p.shape[:1] + (W - n,) + p.shape[2:],
+                                 p.dtype)], axis=1)
+                for p in planes]
+            fn = self._import_fn(W, self.cache.quantized)
+            self.cache = fn(self.cache, idx_pad, *planes_pad)
+        except BaseException:
+            self.allocator.free(new)
+            raise
+        for j, i in enumerate(idxs):
+            desc.blocks[i] = new[j]
+        desc.spilled.clear()
+        self.tier.drop(uid)
+        return n
+
     # -- speculative rollback (ISSUE 8) ---------------------------------
 
     def rewind(self, uid: int, n_tokens: int) -> None:
@@ -643,6 +831,7 @@ class InferenceEngineV2(InferenceEngine):
         desc = self._seqs.get(uid)
         if desc is None:
             raise ValueError(f"unknown uid {uid}")
+        self._require_resident([uid], "rewind()")
         self._rewind(desc, int(n_tokens))
 
     def _rewind(self, desc: SequenceDescriptor, n_tokens: int) -> None:
@@ -776,6 +965,7 @@ class InferenceEngineV2(InferenceEngine):
             raise ValueError(f"unknown parent uid {parent_uid}")
         if new_uid in self._seqs:
             raise ValueError(f"uid {new_uid} is already live")
+        self._require_resident([parent_uid], "fork()")
         self.allocator.retain(parent.blocks)
         self._seqs[new_uid] = SequenceDescriptor(
             uid=new_uid, seen_tokens=parent.seen_tokens,
@@ -879,6 +1069,7 @@ class InferenceEngineV2(InferenceEngine):
         for uid, toks in zip(uids, tokens):
             if uid not in self._seqs and not len(toks):
                 raise ValueError(f"new uid {uid} with no tokens")
+        self._require_resident(uids, "put()")
         new_tokens = {u: list(map(int, t)) for u, t in zip(uids, tokens)
                       if u not in self._seqs}
         # Admission check BEFORE any KV mutation (prefix acquisition
@@ -1185,6 +1376,7 @@ class InferenceEngineV2(InferenceEngine):
                     f"speculative uid {uid} with {len(chunk)} tokens — a "
                     "verify row is [pending_token, drafts...]; a row with "
                     "no drafts belongs in decode_uids")
+        self._require_resident(all_uids, "step()")
         ok, _, why = self._admission_detail(
             all_uids, [1] * len(decode_uids) + [len(c) for _, c in prefills]
             + [len(c) for _, c in speculative])
@@ -1370,6 +1562,7 @@ class InferenceEngineV2(InferenceEngine):
         The reference's FastGen equivalent is host-looped puts
         (inference/v2/engine_v2.py:107) — on TPU the fused loop is the
         shape a serving process should prefer for long generations."""
+        self._require_resident(uids, "decode_loop()")
         descs = [self._seqs[u] for u in uids]
         # Admission control BEFORE any mutation (same contract as put():
         # a rejected call leaves allocator + descriptors untouched), via
@@ -1432,21 +1625,42 @@ class InferenceEngineV2(InferenceEngine):
         bs = self.cache.block_size
         nb = blocks_needed(desc.seen_tokens, bs)
         assert len(desc.blocks) >= nb, (uid, len(desc.blocks), nb)
-        idx = np.asarray(desc.blocks[:nb], np.int32)
-        kq, ksc = kv_parts((self.cache.k, self.cache.k_scale)
-                           if self.cache.quantized else self.cache.k)
-        vq, vsc = kv_parts((self.cache.v, self.cache.v_scale)
-                           if self.cache.quantized else self.cache.v)
+        spilled = sorted(i for i in desc.spilled if i < nb)
+        if not spilled:
+            idx = np.asarray(desc.blocks[:nb], np.int32)
+            planes = [np.asarray(p[:, idx]) for p in self._pool_planes()]
+        else:
+            # tiered compose (ISSUE 15): a parked sequence's payload is
+            # assembled from BOTH tiers — resident positions gather pool
+            # storage, spilled positions read the host tier's byte-exact
+            # copy — so a failover KV-migration of a spilled sequence
+            # ships the same bytes a fully-resident export would (no
+            # fetch, no re-prefill, no re-quantization)
+            resident = [i for i in range(nb) if i not in desc.spilled]
+            idx = np.asarray([desc.blocks[i] for i in resident], np.int32)
+            pool_planes = [np.asarray(p[:, idx])
+                           for p in self._pool_planes()]
+            tidx, tplanes = self.tier.load(uid, count=False)
+            planes = []
+            for pp, tp in zip(pool_planes, tplanes):
+                full = np.empty((pp.shape[0], nb) + pp.shape[2:], pp.dtype)
+                for j, i in enumerate(resident):
+                    full[:, i] = pp[:, j]
+                for j, i in enumerate(tidx):
+                    if i < nb:
+                        full[:, i] = tp[:, j]
+                planes.append(full)
+        quantized = self.cache.quantized
         return KVBlockPayload(
             uid=uid,
             tokens=list(desc.tokens),
             seen_tokens=desc.seen_tokens,
             last_logits=None if desc.last_logits is None
             else np.asarray(desc.last_logits),
-            k=np.asarray(kq[:, idx]),
-            v=np.asarray(vq[:, idx]),
-            k_scale=None if ksc is None else np.asarray(ksc[:, idx]),
-            v_scale=None if vsc is None else np.asarray(vsc[:, idx]),
+            k=planes[0],
+            v=planes[1],
+            k_scale=planes[2] if quantized else None,
+            v_scale=planes[3] if quantized else None,
             kv_cache_dtype=self.config.kv_cache_dtype,
             block_size=bs,
             weight_version=self.weight_version,
@@ -1719,9 +1933,15 @@ class InferenceEngineV2(InferenceEngine):
         return self.commit_staged_weights(force=force, defer=defer)
 
     def flush(self, uids: Sequence[int]) -> None:
-        """Free all state for finished sequences (engine_v2.py:242)."""
+        """Free all state for finished sequences (engine_v2.py:242).
+        Spilled blocks (ISSUE 15) have no pool slot to free — their host
+        tier entry is dropped instead."""
         for uid in uids:
             desc = self._seqs.pop(uid, None)
             if desc is None:
                 raise ValueError(f"unknown uid {uid}")
-            self.allocator.free(desc.blocks)
+            if desc.spilled:
+                self.allocator.free([b for b in desc.blocks if b >= 0])
+                self.tier.drop(uid)
+            else:
+                self.allocator.free(desc.blocks)

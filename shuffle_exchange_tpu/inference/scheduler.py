@@ -66,6 +66,10 @@ from .paged import blocks_needed
 
 QUEUED, PREFILL, RUNNING, FINISHED = "queued", "prefill", "running", "finished"
 FAILED = "failed"
+# tiered KV (ISSUE 15): a PARKED request's cold blocks live in the host
+# tier — it keeps its engine descriptor and generated tokens, takes no
+# budget, and resumes via fetch (no re-prefill) when pressure subsides
+PARKED = "parked"
 
 
 class DeadlineExceededError(RuntimeError):
@@ -119,6 +123,10 @@ class ServingRequest:
     retries: int = 0
     replica_deaths: int = 0
     error: Optional[BaseException] = None
+    # tiered KV (ISSUE 15): the state a PARKED request resumes into
+    # (PREFILL mid-prompt, RUNNING mid-decode) — recorded at park time
+    # because ``prefill_target`` keeps growing with generated tokens
+    parked_state: str = ""
 
     @property
     def prefill_target(self) -> List[int]:
@@ -167,6 +175,22 @@ class ContinuousBatchingScheduler:
         self.cfg: ServingConfig = engine.config.serving
         self.queue: Deque[ServingRequest] = deque()  # FIFO; preempted at front
         self.active: List[ServingRequest] = []       # admission order
+        # tiered KV (ISSUE 15): requests parked host-ward under pressure,
+        # park order (oldest first — the unpark order); the engine's tier
+        # is None unless the config enables kv_tier
+        self.parked: List[ServingRequest] = []
+        self.tier = getattr(engine, "tier", None)
+        self.parks = 0
+        self.unparks = 0
+        # spillable_blocks() walks every live descriptor's block list —
+        # too hot AND too racy for the router's load() polls (they run on
+        # router threads while the tick thread mutates eng._seqs under
+        # the replica lock), so ONLY the tick thread ever walks: the tick
+        # tail (and the force-unpark early return) refresh this cache and
+        # load() reads the plain int. Early-return ticks that free blocks
+        # (deadline expiry on a backoff-gated tick) can leave it one tick
+        # stale — acceptable for a placement-pressure heuristic.
+        self._spillable_cache: int = 0
         self.requests: Dict[int, ServingRequest] = {}
         self.on_token = on_token
         self.clock = clock
@@ -281,6 +305,43 @@ class ContinuousBatchingScheduler:
             f"serving: preempted uid {r.uid} ({len(r.generated)} tokens "
             f"generated) — KV pool pressure; requeued at front")
 
+    def _park(self, r: ServingRequest) -> bool:
+        """Park an admitted sequence host-ward instead of preempting it
+        (ISSUE 15): its cold exclusive blocks spill to the tier (byte-
+        exact), the descriptor and generated tokens stay, and a later
+        tick fetches the bytes back — zero re-prefill compute, token-
+        identical resume. Returns False when nothing was spillable (all
+        blocks shared/hot) so the caller can fall back to preemption."""
+        reclaimed = self.engine.spill_sequence(r.uid)
+        if reclaimed <= 0:
+            return False
+        self.active.remove(r)
+        r.parked_state = r.state
+        r.state = PARKED
+        self.parked.append(r)
+        self.parks += 1
+        logger.info(
+            f"serving: parked uid {r.uid} ({reclaimed} KV blocks spilled "
+            f"host-ward, {len(r.generated)} tokens kept) — KV pool "
+            f"pressure; resumes via fetch, no re-prefill")
+        return True
+
+    def _unpark(self, r: ServingRequest) -> None:
+        """Fetch a parked request's spilled blocks back into fresh pool
+        slots and return it to the admitted set in its pre-park state."""
+        self.engine.fetch_spilled(r.uid)
+        self.parked.remove(r)
+        r.state = r.parked_state or RUNNING
+        r.parked_state = ""
+        # re-enter at the request's ADMISSION-ORDER position, not the
+        # tail: the park/preempt victim scans pick reversed(active) as
+        # "youngest", so a tail append would re-victimize the unparked
+        # request over genuinely younger ones, tick after tick
+        idx = next((i for i, a in enumerate(self.active)
+                    if a.submitted_at > r.submitted_at), len(self.active))
+        self.active.insert(idx, r)
+        self.unparks += 1
+
     def _finish(self, r: ServingRequest, now: float) -> None:
         r.state = FINISHED
         r.finished_at = now
@@ -290,6 +351,8 @@ class ContinuousBatchingScheduler:
             self.drafter.forget(r.uid)
         if r in self.active:
             self.active.remove(r)
+        if r in self.parked:
+            self.parked.remove(r)
 
     def fail(self, r: ServingRequest, err: BaseException, now: float) -> None:
         """Terminally fail a request (deadline expiry, poison quarantine,
@@ -305,6 +368,8 @@ class ContinuousBatchingScheduler:
             self.drafter.forget(r.uid)
         if r in self.active:
             self.active.remove(r)
+        if r in self.parked:
+            self.parked.remove(r)
         if r in self.queue:
             self.queue.remove(r)
         logger.warning(f"serving: replica {self.replica_id} failed uid "
@@ -315,7 +380,7 @@ class ContinuousBatchingScheduler:
         tick entry — the dispatch boundary — so an expiry never interleaves
         a half-executed tick, and the freed budget/KV goes to requests that
         can still meet theirs."""
-        for r in [a for a in self.active] + list(self.queue):
+        for r in [a for a in self.active] + list(self.parked) + list(self.queue):
             if r.deadline_s is None:
                 continue
             elapsed = now - r.submitted_at
@@ -401,6 +466,21 @@ class ContinuousBatchingScheduler:
         if pre_events:
             self._write_events(pre_events)
 
+        # 0.7) tiered KV (ISSUE 15): un-park in park order while the pool
+        # can fund the fetch plus headroom (one block per running sequence
+        # and one for the un-parked sequence's own next decode write) —
+        # the conservative gate that keeps park/unpark from thrashing
+        if self.tier is not None and self.parked:
+            while self.parked and len(self.active) < cfg.max_running:
+                r = self.parked[0]
+                desc = eng._seqs.get(r.uid)
+                need = len(desc.spilled) if desc is not None else 0
+                headroom = 1 + sum(1 for a in self.active
+                                   if a.state == RUNNING)
+                if need + headroom > eng.free_blocks:
+                    break
+                self._unpark(r)
+
         # 1) decode set: every running sequence takes one budget slot — or
         # 1+k slots when its drafter proposes k tokens this tick (ISSUE 8:
         # the pending token plus the drafts are one verify row through the
@@ -457,6 +537,21 @@ class ContinuousBatchingScheduler:
             if victim is not None:
                 spec_rows.pop(victim.uid)
                 continue
+            # tiered KV (ISSUE 15): spillable blocks are reclaimable-not-
+            # free — park the youngest admitted sequence host-ward
+            # (byte-exact spill, no lost work) before ever preempting one
+            # (flush + full re-prefill replay). Preemption remains the
+            # fallback when nothing is spillable (all blocks shared).
+            if self.tier is not None:
+                # youngest-first, but keep probing older actives when the
+                # youngest has nothing spillable (all blocks shared via
+                # the prefix cache, or all hot): preemption is the
+                # fallback only when NOTHING on the replica can spill
+                pv = next((r for r in reversed(self.active)
+                           if r.uid in eng._seqs and self._park(r)), None)
+                if pv is not None:
+                    spec_rows.pop(pv.uid, None)
+                    continue
             self._preempt(self.active[-1])
 
         decode_cost = sum(row_cost(r) for r in decodes)
@@ -481,6 +576,20 @@ class ContinuousBatchingScheduler:
                 # the head would stall every request behind it for the
                 # whole backoff
                 continue
+            if from_queue and self.parked and \
+                    self.parked[0].submitted_at <= r.submitted_at:
+                # tiered KV (ISSUE 15): freed blocks must fund the oldest
+                # parked fetch before any YOUNGER arrival may consume
+                # them — otherwise sustained arrivals absorb every freed
+                # block chunk-by-chunk and the parked head starves
+                # against the all-at-once unpark gate. Seniority is by
+                # submission time, not queue-vs-parked lane: a preempted
+                # request re-queued at the front can be OLDER than every
+                # parked sequence and then packs ahead of them. Stop the
+                # queue lane at the first younger request; in-flight
+                # prefills above still pack (finishing them is what
+                # frees blocks).
+                break
             if from_queue and len(self.active) + len(admitted) >= cfg.max_running:
                 break
             target = r.prefill_target
@@ -524,8 +633,45 @@ class ContinuousBatchingScheduler:
 
         # 3) nothing packable?
         if not decodes and not prefills:
-            if not (self.active or self.queue):
+            if not (self.active or self.queue or self.parked):
                 return False
+            if self.parked and not self.active:
+                # tiered KV (ISSUE 15): everything admitted is parked —
+                # force-unpark the oldest past the headroom gate (nothing
+                # else will free blocks) so progress resumes next tick.
+                # The fetch must ALSO fund the sequence's own next decode
+                # write when it sits on a block boundary: an equality
+                # admit there leaves free_blocks == 0, the next tick
+                # parks it right back, and the park/unpark pair livelocks
+                # serve() without ever reaching the loud error below.
+                r = self.parked[0]
+                desc = eng._seqs.get(r.uid)
+                need = len(desc.spilled) if desc is not None else 0
+                if desc is not None and desc.seen_tokens % \
+                        eng.config.kv_block_size == 0:
+                    need += 1
+                if desc is not None and need > eng.free_blocks:
+                    # the OTHER parked sequences' hot tails
+                    # (hot_block_fraction keeps them resident through
+                    # _park) are reclaimable — spill them fully before
+                    # declaring a stall the pool could still serve
+                    for other in self.parked[1:]:
+                        if eng.free_blocks >= need:
+                            break
+                        if other.uid in eng._seqs:
+                            eng.spill_sequence(other.uid, keep_hot=0)
+                if desc is not None and need <= eng.free_blocks:
+                    self._unpark(r)
+                    # this early return skips the tick-tail cache
+                    # refresh, and the fetch just moved block state
+                    self._spillable_cache = eng.spillable_blocks()
+                    return True
+                raise RuntimeError(
+                    f"serving stalled: parked uid {r.uid} needs "
+                    f"{need} KV blocks (spilled fetch + next decode "
+                    f"write) but only {eng.free_blocks} of "
+                    f"{eng.allocator.num_blocks} are free and nothing is "
+                    f"running to release more; raise num_kv_blocks")
             if any(r.not_before > now0 for r in self.queue):
                 # everything eligible is in its failover backoff window —
                 # work remains, it just may not pack yet
@@ -623,8 +769,38 @@ class ContinuousBatchingScheduler:
                  self.spec_accepted / max(1, self.spec_proposed), self.ticks),
                 ("speculative/rollbacks", eng.spec_rollbacks, self.ticks),
             ]
+        if self.tier is not None:
+            # tiered-KV group (ISSUE 15): spill/fetch traffic, prefetch
+            # effectiveness, and the current host-tier footprint
+            ts = self.tier.stats()
+            events += [
+                ("kv_tier/spills", ts["spills"], self.ticks),
+                ("kv_tier/fetches", ts["fetches"], self.ticks),
+                ("kv_tier/hit_rate",
+                 ts["hit_rate"] if ts["hit_rate"] is not None else 0.0,
+                 self.ticks),
+                ("kv_tier/prefetch_misses", ts["prefetch_misses"],
+                 self.ticks),
+                ("kv_tier/spilled_blocks", ts["spilled_blocks"], self.ticks),
+                ("kv_tier/host_bytes", ts["host_bytes"], self.ticks),
+                ("kv_tier/parked", len(self.parked), self.ticks),
+                ("kv_tier/parks", self.parks, self.ticks),
+                ("kv_tier/unparks", self.unparks, self.ticks),
+            ]
+            # double-buffered prefetch (ISSUE 15): stage the next
+            # ``prefetch_depth`` parked sequences' host bytes into pinned
+            # buffers NOW — one tick ahead of the decode window they
+            # rejoin — so their fetch is only the device scatter
+            depth = max(0, eng.config.kv_tier.prefetch_depth)
+            for r in self.parked[:depth]:
+                self.tier.prefetch(r.uid)
+        # block state settled for this tick — refresh the placement-
+        # pressure cache HERE, on the tick thread, where the _seqs walk
+        # is safe (see __init__); load() only ever reads the int
+        if self.tier is not None:
+            self._spillable_cache = eng.spillable_blocks()
         self._write_events(events)
-        return bool(self.active or self.queue)
+        return bool(self.active or self.queue or self.parked)
 
     # -- elastic drain / requeue (ISSUE 7) ------------------------------
 
@@ -642,21 +818,27 @@ class ContinuousBatchingScheduler:
         can be lost or served twice."""
         self.draining = True
         # active is admission order (oldest first); preempting frees KV and
-        # folds the continuation into each request's prefill target
+        # folds the continuation into each request's prefill target.
+        # Parked requests (ISSUE 15) drain the same way — flush drops both
+        # their resident blocks and their host-tier entry, and the replay
+        # elsewhere re-prefills prompt + generated token-identically.
         exported: List[ServingRequest] = []
-        for r in list(self.active):
+        for r in list(self.active) + list(self.parked):
             if r.uid in self.engine._seqs:
                 self.engine.flush([r.uid])
             if self.drafter is not None:
                 self.drafter.forget(r.uid)
             r.state = QUEUED
             r.prefill_done = 0
+            r.parked_state = ""
             r.preemptions += 1
             self.preemptions += 1
             exported.append(r)
         exported.extend(self.queue)
         self.active.clear()
+        self.parked.clear()
         self.queue.clear()
+        self._spillable_cache = 0
         for r in exported:
             self.requests.pop(r.uid, None)
         self._write_events([
@@ -770,6 +952,9 @@ class ContinuousBatchingScheduler:
             "prefix_caching": ecfg.prefix_caching,
             "kv_block_size": ecfg.kv_block_size,
             "num_kv_blocks": ecfg.num_kv_blocks,
+            "spill_enabled": ecfg.kv_tier.enabled,
+            "hot_block_fraction": ecfg.kv_tier.hot_block_fraction,
+            "prefetch_depth": ecfg.kv_tier.prefetch_depth,
         })
         return out
 
@@ -779,12 +964,22 @@ class ContinuousBatchingScheduler:
         placement score needs."""
         eng = self.engine
         usable = max(1, eng.allocator.num_blocks - 1)
+        # tier-aware pressure (ISSUE 15): spillable blocks are reclaimable
+        # — a replica that could spill its way to room is less pressured
+        # than its raw free count says, so the router's placement sees
+        # free + spillable over usable. A plain int read: load() runs on
+        # router threads, so it must never walk eng._seqs itself (the
+        # tick thread refreshes the cache; see __init__)
+        spillable = self._spillable_cache if self.tier is not None else 0
         return {
             "replica_id": self.replica_id,
             "queue_depth": len(self.queue),
             "running": len(self.active),
+            "parked": len(self.parked),
             "free_blocks": eng.free_blocks,
-            "kv_pressure": 1.0 - (eng.free_blocks / usable),
+            "spillable_blocks": spillable,
+            "kv_pressure": max(
+                0.0, 1.0 - (eng.free_blocks + spillable) / usable),
             "draining": self.draining,
         }
 
@@ -819,7 +1014,7 @@ class ContinuousBatchingScheduler:
         pending = deque(enumerate(items))
         t0 = self.clock()
         uids: List[int] = []
-        while pending or self.active or self.queue:
+        while pending or self.active or self.queue or self.parked:
             while pending and (arrivals is None
                                or self.clock() - t0 >= arrivals[pending[0][0]]):
                 _, (prompt, mn) = pending.popleft()
@@ -870,6 +1065,15 @@ class ContinuousBatchingScheduler:
             "tpot_p99_s": pct(tpot, 99),
             "ticks": self.ticks,
             "preemptions": self.preemptions,
+            # tiered KV (ISSUE 15): None when kv_tier is off; with it on,
+            # the host-tier traffic + park/unpark counts — parks that did
+            # NOT become preemptions are re-prefill compute saved
+            "kv_tier": (None if self.tier is None else {
+                **self.tier.stats(),
+                "parks": self.parks,
+                "unparks": self.unparks,
+                "parked": len(self.parked),
+            }),
             # request-level robustness (ISSUE 12): terminally-failed
             # requests by cause — deadline expiries counted here, poison
             # quarantines / exhausted retries land via router fail()s

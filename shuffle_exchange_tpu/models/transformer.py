@@ -114,6 +114,13 @@ class TransformerConfig:
     # checkpointed, so backward residuals are O(T/sp * D) per layer
     # (score tiles are recomputed hop by hop, never saved).
     sp_attention: str = "ulysses"
+    # Ring-CP tuning (ISSUE 15; set by sxt.initialize from the engine
+    # config's context_parallel section): per-hop KV tile for the jnp
+    # chunked ring, and the hop-kernel routing ("auto" gates on shape/
+    # backend, "pallas" forces the flash_attention_lse hop kernel,
+    # "xla" keeps the jnp chunked online-softmax).
+    cp_kv_chunk: int = 1024
+    cp_use_kernel: str = "auto"
 
     @property
     def kv_heads(self) -> int:
@@ -806,10 +813,33 @@ class Transformer:
         slopes_all = (jnp.asarray(alibi, jnp.float32)
                       if alibi is not None else None)
         if cfg.sp_attention == "ring":
+            import os
+
             from ..parallel.sequence import ring_attention
 
+            # save_flash_lse x ring (ISSUE 15): drop the ring's inner
+            # per-hop checkpoint so THIS layer's checkpoint policy saves
+            # each hop kernel's tagged (out, lse) — backward enters the
+            # dq/dkv kernels from saved lse, no forward re-run (PR 3
+            # discipline per hop). Every other policy keeps the per-hop
+            # checkpoint (O(T/sp · D) residuals, fwd recomputed per hop).
+            lse_policy = bool(cfg.remat
+                              and cfg.remat_policy == "save_flash_lse")
+            if cfg.cp_use_kernel not in ("auto", "pallas", "xla"):
+                # the config-section path validates this in
+                # ContextParallelConfig; the low-level spelling
+                # (TransformerConfig built directly) bypasses it
+                raise ValueError(
+                    f'cp_use_kernel must be "auto", "pallas" or "xla", '
+                    f'got {cfg.cp_use_kernel!r}')
+            use_kernel = {"auto": "auto", "pallas": True,
+                          "xla": False}[cfg.cp_use_kernel]
+            interp = bool(os.environ.get("SXT_LSE_INTERPRET"))
             sp_fn = ft.partial(ring_attention, axis_name="seq",
-                               causal=cfg.causal, alibi_slopes=slopes_all)
+                               causal=cfg.causal, alibi_slopes=slopes_all,
+                               kv_chunk=cfg.cp_kv_chunk,
+                               use_kernel=use_kernel, interpret=interp,
+                               hop_remat=not lse_policy)
         elif cfg.sp_attention == "ulysses":
             if slopes_all is None:
                 local = ft.partial(causal_attention,

@@ -156,7 +156,8 @@ def _ring_hop_kernel_ok(q, interpret: bool) -> bool:
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
                    kv_chunk: int = 1024, use_kernel: str = "auto",
-                   interpret: bool = False, alibi_slopes=None):
+                   interpret: bool = False, alibi_slopes=None,
+                   hop_remat: bool = True):
     """Blockwise full-sequence attention with rotating KV — flash-grade.
 
     q/k/v: [B, T_local, H|Hkv, D] — this device's sequence shard (layout
@@ -178,6 +179,20 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     outputs merge by logsumexp — the MXU sees flash tiles, not jnp einsum
     chunks. ``use_kernel``: "auto" | True | False. The jnp chunked path
     remains for shapes the kernel gate rejects.
+
+    ``hop_remat=False`` (ISSUE 15, the ``save_flash_lse`` composition):
+    drops the per-hop ``jax.checkpoint`` so an ENCLOSING layer-level
+    checkpoint with ``remat_policy="save_flash_lse"`` governs instead —
+    each hop's kernel (out, lse) pair carries the ``flash_out``/
+    ``flash_lse`` checkpoint names, the policy saves exactly those, and
+    the backward ring enters the dq/dkv kernels from SAVED lse with the
+    forward kernel DCE'd out of the recompute (the PR 3 discipline, per
+    hop). Residuals are then sp x O(T/sp · D) per layer = the unsharded
+    activation footprint, vs the default hop checkpoint's O(T/sp · D)
+    with a per-hop forward re-run in backward. Kernel path only: the jnp
+    chunked path has no named hop outputs for the policy to save, so it
+    keeps its per-hop checkpoint regardless (dropping it would just let
+    backward linearize all sp hops' score chunks at once).
     """
     import jax
     import jax.numpy as jnp
@@ -204,7 +219,8 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
             f"(Tq={Tq}, D={D}; need D in (64,128) and a swept block "
             f"size dividing Tq)")
     if kernel_on:
-        return _ring_attention_kernel(q, k, v, axis_name, causal, interpret)
+        return _ring_attention_kernel(q, k, v, axis_name, causal, interpret,
+                                      hop_remat=hop_remat)
     # GQA: rotate the UN-repeated kv shards (KV-sized ring hops — repeating
     # first would multiply ppermute bytes by H/KV); expand per chunk inside
     # the accumulate step, where the broadcast stays local (and is
@@ -259,7 +275,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
         return carry
 
     # Remat per hop: backward recomputes one hop's score tiles at a time
-    # instead of saving sp of them.
+    # instead of saving sp of them. Unconditional on this jnp path —
+    # hop_remat=False exists for the KERNEL path, whose hop outputs carry
+    # the save_flash_lse names an enclosing layer checkpoint saves; here
+    # there are no named hop outputs, so dropping the boundary would only
+    # let backward linearize all sp hops at once (O(sp) score-chunk
+    # residuals on exactly the long-context shapes CP targets).
     hop_attn = jax.checkpoint(hop_attn)
 
     def rotate(kv):
@@ -292,7 +313,7 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
 
 
 def _ring_attention_kernel(q, k, v, axis_name: str, causal: bool,
-                           interpret: bool):
+                           interpret: bool, hop_remat: bool = True):
     """Ring attention with a Pallas flash kernel inside each hop.
 
     Each hop attends the local Q shard against one rotated KV shard through
@@ -369,7 +390,14 @@ def _ring_attention_kernel(q, k, v, axis_name: str, causal: bool,
 
     # Remat per hop: residuals are the hop inputs (O(Tq·D)), and the
     # kernel's own custom_vjp recomputes score tiles in its dq/dkv passes.
-    hop = jax.checkpoint(hop)
+    # hop_remat=False (save_flash_lse composition): no inner boundary —
+    # the enclosing layer checkpoint's save_only_these_names policy saves
+    # each hop's tagged (flash_out, flash_lse) pair, so the backward ring
+    # enters the dq/dkv kernels from saved lse and the forward kernel is
+    # DCE'd out of the backward recompute entirely (asserted by pallas-
+    # call counting in tests/test_context_parallel.py).
+    if hop_remat:
+        hop = jax.checkpoint(hop)
 
     def rotate(kv):
         perm = [(i, (i + 1) % sp) for i in range(sp)]

@@ -155,6 +155,24 @@ class Engine:
                 raise ConfigError("sequence-parallel mesh axis (seq > 1) is "
                                   "not supported with the decentralized "
                                   "ensemble (shuffle_exchange) mode")
+            # ring-attention CP (ISSUE 15): the context_parallel section
+            # rides the same "seq" axis, so every guard below applies —
+            # but the pipe composition gets its own CP-worded rejection
+            # first, naming the committed 0.4.x repro (the generic seq
+            # message would point a CP user at Ulysses docs).
+            if (config.context_parallel.degree > 1
+                    and topology.axis_sizes.get("pipe", 1) > 1
+                    and not native_shard_map()):
+                raise ConfigError(
+                    "context_parallel (ring attention) x pipe needs "
+                    "jax >= 0.5 (first-class jax.shard_map): this jax's "
+                    "0.4.x lowering cannot nest the ring's manual region "
+                    "inside the pipeline's manual stage region — the "
+                    "ppermute KV rotation CHECK-aborts XLA's partial-manual "
+                    "partitioner (committed repro: scripts/"
+                    "repro_wire_nesting_xla_check.py). Compose CP with "
+                    "fsdp/data (ZeRO 1-3) on this jax, or upgrade jax for "
+                    "CP x pipe.")
             # seq x pipe composes (round 5, VERDICT r4 #7): the Ulysses/ring
             # shard_map is partial-manual over {data,fsdp,seq(,tensor)} and
             # nests inside the pipeline's manual-over-"pipe" stage region —

@@ -1094,9 +1094,34 @@ class ReplicaRouter:
             # replicas; acceptance_rate re-derived from the sums so it is
             # token-weighted, not an average of per-replica averages
             "speculative": self._spec_aggregate(),
+            "kv_tier": self._tier_aggregate(),
             "per_replica": [dict(r.scheduler.load(), state=r.state,
                                  preemptions=r.scheduler.preemptions)
                             for r in self.replicas],
+        }
+
+    def _tier_aggregate(self) -> Dict[str, object]:
+        """Fleet-wide tiered-KV traffic (ISSUE 15): the scheduler's
+        kv_tier/* counter group summed over replicas whose engine carries
+        a tier (enabled stays False on a tier-less fleet)."""
+        tiers = [(r, r.scheduler.tier) for r in self.replicas
+                 if r.scheduler.tier is not None]
+        if not tiers:
+            return {"enabled": False}
+        ts = [t.stats() for _, t in tiers]
+        hits = sum(t["prefetch_hits"] for t in ts)
+        misses = sum(t["prefetch_misses"] for t in ts)
+        return {
+            "enabled": True,
+            "spills": sum(t["spills"] for t in ts),
+            "fetches": sum(t["fetches"] for t in ts),
+            "prefetch_misses": misses,
+            "hit_rate": (hits / (hits + misses)) if hits + misses else None,
+            "spilled_blocks": sum(t["spilled_blocks"] for t in ts),
+            "host_bytes": sum(t["host_bytes"] for t in ts),
+            "parks": sum(r.scheduler.parks for r, _ in tiers),
+            "unparks": sum(r.scheduler.unparks for r, _ in tiers),
+            "parked": sum(len(r.scheduler.parked) for r, _ in tiers),
         }
 
     def _spec_aggregate(self) -> Dict[str, object]:

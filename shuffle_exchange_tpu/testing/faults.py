@@ -40,6 +40,16 @@ Sites (``Fault.site``):
   new weights; the two-phase flip must roll every staged replica back and
   leave the whole fleet serving the OLD weight version atomically
   (tests/test_rlhf.py).
+- ``kv_spill``            — kill a tiered-KV spill (engine_v2
+  ``spill_sequence``, ISSUE 15) after the host gather but BEFORE the tier
+  store and the allocator free: a replica dying mid-spill must leave the
+  pool, the allocator, and the host tier byte-identically unchanged (the
+  sequence is still fully resident; tests/test_kv_tier.py drills it).
+- ``kv_fetch``            — kill a tiered-KV fetch (engine_v2
+  ``fetch_spilled``) after the fresh blocks are allocated but before the
+  device scatter commits: the cleanup frees the fresh blocks again, the
+  tier entry survives untouched (NON-destructive load), and a retried
+  fetch succeeds — atomic-on-reject at the tier boundary.
 - ``autotune_trial``      — kill an autotune trial-journal commit
   (autotuning/runner.py ``TrialJournal.record``) between the tmp write and
   the rename: the stale ``.tmp-*`` partial must be swept on resume and the
@@ -116,6 +126,7 @@ SITES = (
     "kv_transfer", "kv_transfer_stall", "weight_publish",
     "replica_crash", "replica_hang", "tick_exception",
     "autotune_trial",
+    "kv_spill", "kv_fetch",
 )
 
 

@@ -59,10 +59,14 @@ LOCK_ORDER = {
     # held while a tick is in flight may rank above it.
     "Replica.lock": 10,
     # rank 20 — the transfer substrate (KV migration / weight wire
-    # staging slots + the drain barrier condition).
+    # staging slots + the drain barrier condition, and the tiered-KV
+    # host store — ISSUE 15: spill/fetch bookkeeping touched from
+    # replica ticks and the failover export path; a leaf, acquires
+    # nothing while held).
     "KVTransferChannel._mu": 20,
     "KVTransferChannel._cv": 20,
     "WeightWire._mu": 20,
+    "HostKVTier._mu": 20,
     # rank 30 — leaf locks: health records and monitor rings. Everything
     # reports into these; they call out to nothing.
     "HealthMonitor._mu": 30,

@@ -1044,3 +1044,18 @@ def test_annotations_present_on_real_seams():
     from shuffle_exchange_tpu.autotuning.runner import TrialJournal
 
     assert hasattr(TrialJournal.record, "__sxt_atomic_on_reject__")
+    # the ISSUE 15 tiered-KV seams: spill/fetch are validate-then-mutate
+    # (a refused tier transition touches neither pool nor tier), and the
+    # host tier's entries/staging/counters ride its rank-20 lock
+    from shuffle_exchange_tpu.inference.kv_tier import HostKVTier
+    from shuffle_exchange_tpu.utils.invariants import LOCK_ORDER
+
+    assert hasattr(InferenceEngineV2.spill_sequence,
+                   "__sxt_atomic_on_reject__")
+    assert hasattr(InferenceEngineV2.fetch_spilled,
+                   "__sxt_atomic_on_reject__")
+    assert "_mu" in HostKVTier.__sxt_locked_by__
+    for attr in ("_entries", "_staged", "spills", "fetches",
+                 "prefetch_hits", "prefetch_misses", "spilled_blocks"):
+        assert attr in HostKVTier.__sxt_locked_by__["_mu"], attr
+    assert LOCK_ORDER["HostKVTier._mu"] == 20   # transfer-substrate rank

@@ -50,6 +50,22 @@ class TestSpace:
         # deterministic order (sorted axis names, product order)
         assert cands == sorted(cands, key=lambda c: 0 or 0) or True
 
+    def test_tier_knob_axes_survive_spill_inherit(self):
+        """hot_block_fraction/prefetch_depth are live knobs even when
+        spill_enabled=None (inherit the base config's tier): the name
+        must distinguish them (or dedup collapses the grid) and the
+        overlay must carry them (without forcing an enabled flag)."""
+        sp = ServingSearchSpace(
+            {"hot_block_fraction": [0.0, 0.25, 0.5]}, _ctx())
+        cands = sp.enumerate()
+        assert len(cands) == 3
+        assert len({c.name for c in cands}) == 3
+        hf25 = next(c for c in cands if c.hot_block_fraction == 0.25)
+        ov = hf25.overlay()
+        assert ov["kv_tier"] == {"hot_block_fraction": 0.25,
+                                 "prefetch_depth": 1}
+        assert "enabled" not in ov["kv_tier"]
+
     def test_unknown_axis_rejected(self):
         with pytest.raises(ConfigError, match="unknown serving search axes"):
             ServingSearchSpace({"warp_factor": [9]}, _ctx())
@@ -88,11 +104,81 @@ class TestSpace:
         ctx = _ctx(num_kv_blocks=17, request_tokens_hi=64, kv_overcommit=1.0)
         sp = ServingSearchSpace({"max_running": [1, 16]}, ctx,
                                 base=ServingCandidate(token_budget=64,
-                                                      chunk_min=4))
+                                                      chunk_min=4,
+                                                      spill_enabled=False))
         by = {c.max_running: c for c in sp.enumerate()}
         assert by[1].status == "pending"
         assert by[16].status == "pruned_static"
         assert "thrash" in by[16].prune_reason
+        # spill_enabled=None inherits the base CONFIG's tier at apply
+        # time, which may be on — the static prune must not fire on a
+        # candidate that could be feasible
+        sp_inherit = ServingSearchSpace(
+            {"max_running": [16]}, ctx,
+            base=ServingCandidate(token_budget=64, chunk_min=4))
+        (c,) = sp_inherit.enumerate()
+        assert c.spill_enabled is None and c.status == "pending"
+
+    def test_tier_knob_range_constraints(self):
+        """ISSUE 15 tier knobs: fraction outside [0,1] and negative/
+        non-int prefetch depth are statically invalid."""
+        sp = ServingSearchSpace({"max_running": [4]}, _ctx())
+        for c in (ServingCandidate(hot_block_fraction=1.5),
+                  ServingCandidate(hot_block_fraction=-0.1),
+                  ServingCandidate(prefetch_depth=-1)):
+            ok, why = sp.check(c)
+            assert not ok and why, c
+
+    def test_spill_cannot_split_one_request_past_the_pool(self):
+        """Dispatch needs FULL residency: when a single request's worst
+        case exceeds the pool, the tier only rotates sequences — spill
+        candidates prune statically instead of burning a trial."""
+        ctx = _ctx(num_kv_blocks=5, request_tokens_hi=64)
+        sp = ServingSearchSpace({"spill_enabled": [True]}, ctx)
+        (c,) = sp.enumerate()
+        assert c.status == "pruned_static"
+        assert "spill cannot help" in c.prune_reason
+
+    def test_all_hot_fraction_makes_tier_a_noop(self):
+        ctx = _ctx(request_tokens_hi=32)
+        sp = ServingSearchSpace({"max_running": [4]}, ctx)
+        ok, why = sp.check(ServingCandidate(spill_enabled=True,
+                                            hot_block_fraction=1.0))
+        assert not ok and "nothing is ever spillable" in why
+
+    def test_spill_exempts_kv_thrash_prune(self):
+        """The overcommit thrash prune models the PREEMPTION path; with
+        the tier on, overflow parks host-ward instead — the same
+        geometry stays searchable."""
+        ctx = _ctx(num_kv_blocks=17, request_tokens_hi=64,
+                   kv_overcommit=1.0)
+        sp = ServingSearchSpace({"max_running": [16],
+                                 "spill_enabled": [False, True]}, ctx,
+                                base=ServingCandidate(token_budget=64,
+                                                      chunk_min=4))
+        by = {c.spill_enabled: c for c in sp.enumerate()}
+        assert by[False].status == "pruned_static"
+        assert "spill_enabled=True would park" in by[False].prune_reason
+        assert by[True].status == "pending"
+
+    def test_tier_knobs_overlay_roundtrip(self):
+        """Candidate -> overlay -> InferenceConfig -> candidate carries
+        the tier point (the PR 13 tunnel-window contract: the winner's
+        knobs replay verbatim from its overlay)."""
+        c = ServingCandidate(token_budget=32, spill_enabled=True,
+                             hot_block_fraction=0.25, prefetch_depth=2)
+        ov = c.overlay()
+        assert ov["kv_tier"] == {"enabled": True,
+                                 "hot_block_fraction": 0.25,
+                                 "prefetch_depth": 2}
+        assert "_sp1_hf0.25_pd2" in c.name
+        icfg = InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40).with_overlay(ov)
+        assert icfg.kv_tier.enabled
+        back = ServingCandidate.from_config(icfg)
+        assert (back.spill_enabled, back.hot_block_fraction,
+                back.prefetch_depth) == (True, 0.25, 2)
 
     def test_basic_range_constraints(self):
         sp = ServingSearchSpace({"max_running": [4]}, _ctx())

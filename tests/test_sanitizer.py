@@ -218,3 +218,24 @@ def test_fleet_locks_are_wrapped_when_armed(armed):
     hm.beat_start(0)
     hm.beat_end(0)
     assert sanitizer.reports() == []
+
+
+def test_host_kv_tier_lock_is_wrapped_when_armed(armed):
+    """The tiered-KV host store (ISSUE 15) rides the same discipline: a
+    HostKVTier built while armed carries an instrumented rank-20 _mu,
+    and a store/prefetch/load/drop cycle is order-clean."""
+    import numpy as np
+
+    from shuffle_exchange_tpu.inference.kv_tier import HostKVTier
+    from shuffle_exchange_tpu.utils.invariants import lock_rank
+
+    tier = HostKVTier()
+    assert isinstance(tier._mu, sanitizer._SanLock)
+    assert tier._mu.name == "HostKVTier._mu"
+    assert lock_rank("HostKVTier._mu") == 20
+    planes = [np.ones((2, 1, 2, 4, 4), np.float32)] * 2
+    tier.store(1, [0], planes)
+    tier.prefetch(1)
+    tier.load(1)
+    tier.drop(1)
+    assert sanitizer.reports() == []
