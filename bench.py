@@ -690,6 +690,166 @@ def serving_speculative_row(model, params, icfg, vocab, *, n_requests=12,
     }
 
 
+def serving_sampling_row(model, params, icfg, vocab, *, n_requests=16,
+                         prompt_lo=48, prompt_hi=128, max_new=32,
+                         temperature=0.8, top_p=0.9, spec_k=4,
+                         spec_top_k=2, load=2.0, seed=0):
+    """Config-5 one-dispatch-sampling row (ISSUE 16): the SAME Poisson
+    trace served greedy, sampled stop-DISABLED, and sampled with EOS
+    early-stop, all at identical arrivals on one warmed engine.
+
+    Sampling happens inside the fused serving dispatch (the logits never
+    leave the device), so the greedy-vs-sampled goodput delta measures
+    the fused sampler's marginal cost, and the stop-disabled-vs-EOS delta
+    measures what early termination RETURNS to the fleet — dead tokens
+    never decoded, KV blocks freed at the stop tick. The EOS id is the
+    MODAL token of the stop-disabled sampled run, so the stop condition
+    provably fires on this workload instead of being vacuously absent.
+    The row also re-serves the sampled trace on a fresh scheduler and
+    asserts bit-exact tokens (``seeded_replay_verified`` — the per-row
+    Gumbel chain is a pure function of seed and position), and runs a
+    side trace with the draft-model drafter (the target as its own
+    draft, the acceptance ceiling) at ``temperature`` with
+    ``top_k=spec_top_k`` to pin speculative acceptance > 0 at
+    temperature > 0 AND spec-on/off token parity under sampling (the
+    generalized accept rule emits the seeded chain either way; top_k
+    keeps the chain near the draft's greedy proposals so acceptance is
+    measurable on a toy model too). Seed-reproducible; ``trace``
+    returned (ISSUE 14). Reused at toy size by
+    tests/test_bench_smoke.py."""
+    import dataclasses as _dc
+    import time as _time
+
+    from shuffle_exchange_tpu.autotuning import poisson_arrivals
+    from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                                DraftModelDrafter,
+                                                InferenceEngineV2)
+    from shuffle_exchange_tpu.inference.config import SamplingParams
+
+    rng = np.random.default_rng(seed)
+    eng = InferenceEngineV2(model, params, icfg)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+
+    def sps(eos=-1):
+        return [SamplingParams(temperature=temperature, top_p=top_p,
+                               seed=seed * 1000 + i, eos_token_id=eos)
+                for i in range(n_requests)]
+
+    def run(sampling=None, arrivals=None):
+        sched = ContinuousBatchingScheduler(eng)
+        t0 = _time.perf_counter()
+        out = sched.serve(prompts, max_new_tokens=max_new,
+                          arrivals=arrivals, sampling=sampling)
+        return out, sched.stats(), _time.perf_counter() - t0
+
+    # throwaway greedy + sampled passes compile both program families.
+    # Seeded chains are arrival-invariant, so the throwaway stop-disabled
+    # run already yields the measured run's tokens — pick EOS from it
+    # (the modal token, guaranteed to recur under THIS model/temperature
+    # so early stop actually fires). The greedy capacity pass then
+    # calibrates the shared arrivals.
+    run()
+    out_w, _, _ = run(sampling=sps())
+    all_toks = [t for u in out_w for t in out_w[u]]
+    eos = int(np.bincount(all_toks).argmax())
+    _, cold, _ = run()
+    cap = cold["sustained_tokens_per_sec"]
+    span = n_requests * max_new / cap / load
+    arrivals = poisson_arrivals(rng, n_requests, span)
+
+    # each measured variant runs TWICE at identical arrivals and times
+    # the second: arrivals (and, for EOS, mid-stream stops) create batch
+    # compositions the no-arrivals warmups never compiled, and a single
+    # pass would bill those compiles to the variant that hit them first
+    def measured(sampling=None):
+        warm, _, _ = run(sampling=sampling, arrivals=list(arrivals))
+        out, st, wall = run(sampling=sampling, arrivals=list(arrivals))
+        return warm, out, st, wall
+
+    _, out_g, st_g, wall_g = measured()
+    _, out_ns, st_ns, wall_ns = measured(sps())
+    warm_es, _, _ = run(sampling=sps(eos=eos), arrivals=list(arrivals))
+    freed0 = eng.early_stop_freed_blocks  # cumulative; warm pass freed some
+    out_es, st_es, wall_es = run(sampling=sps(eos=eos),
+                                 arrivals=list(arrivals))
+    freed_measured = eng.early_stop_freed_blocks - freed0
+    # the warm pass ran on a fresh scheduler — its bit-identity with the
+    # measured pass IS the seeded-replay check
+    replay_ok = [warm_es[u] for u in warm_es] == [out_es[u] for u in out_es]
+
+    # speculative acceptance at temperature > 0 (the generalized accept
+    # rule): target-as-draft side trace — proposals are the greedy chain,
+    # so acceptance measures how often the seeded chain agrees with
+    # argmax; spec on vs off must emit identical seeded chains
+    spec_prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+                    for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                          size=max(4, n_requests // 2))]
+    spec_sps = [SamplingParams(temperature=temperature, top_k=spec_top_k,
+                               seed=7000 + i)
+                for i in range(len(spec_prompts))]
+    sv = _dc.replace(
+        icfg.serving,
+        token_budget=max(icfg.serving.token_budget,
+                         icfg.serving.max_running * (spec_k + 1)),
+        speculative=_dc.replace(icfg.serving.speculative, enabled=True,
+                                k=spec_k))
+    spec_icfg = _dc.replace(icfg, serving=sv)
+    spec_eng = InferenceEngineV2(model, params, spec_icfg)
+    spec_sched = ContinuousBatchingScheduler(
+        spec_eng, drafter=DraftModelDrafter.for_target(model, params,
+                                                       spec_icfg))
+    out_sp = spec_sched.serve(spec_prompts, max_new_tokens=max_new,
+                              sampling=spec_sps)
+    spec_st = spec_sched.stats()
+    base_sched = ContinuousBatchingScheduler(eng)
+    out_sq = base_sched.serve(spec_prompts, max_new_tokens=max_new,
+                              sampling=spec_sps)
+    spec_parity = [out_sp[u] for u in out_sp] == [out_sq[u] for u in out_sq]
+
+    def _summ(st, wall, out):
+        return {
+            "sustained_tokens_per_sec": round(
+                st["sustained_tokens_per_sec"], 1),
+            "requests_per_sec": round(n_requests / wall, 2),
+            "emitted_tokens": sum(len(out[u]) for u in out),
+            "ttft_p50_s": round(st["ttft_p50_s"], 4),
+            "tpot_p50_s": round(st["tpot_p50_s"], 4),
+            "ticks": st["ticks"],
+        }
+
+    samp = st_es["sampling"]
+    return {
+        "trace": _trace_record(seed, prompts, max_new, load, arrivals,
+                               capacity=cap),
+        "n_requests": n_requests,
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "temperature": temperature, "top_p": top_p,
+        "eos_token_id": eos,
+        "greedy": _summ(st_g, wall_g, out_g),
+        "sampled_no_stop": _summ(st_ns, wall_ns, out_ns),
+        "sampled_eos": _summ(st_es, wall_es, out_es),
+        # the fused sampler's marginal cost on identical arrivals
+        "sampling_overhead_x": round(wall_ns / wall_g, 3),
+        # what early stop returns to the fleet vs the stop-disabled run
+        "goodput_eos_vs_no_stop_x": round(
+            (n_requests / wall_es) / (n_requests / wall_ns), 3),
+        "early_stop_fraction": round(samp["early_stops"] / n_requests, 3),
+        "dead_tokens_saved": samp["dead_tokens_saved"],
+        "early_stop_freed_blocks": freed_measured,
+        "seeded_replay_verified": bool(replay_ok),
+        "spec_acceptance_at_temp": (
+            round(spec_st["speculative"]["acceptance_rate"], 3)
+            if spec_st["speculative"]["acceptance_rate"] is not None
+            else None),
+        "spec_resamples": spec_st["sampling"]["resamples"],
+        "spec_token_parity_at_temp": bool(spec_parity),
+    }
+
+
 def serving_failover_row(model, params, icfg, vocab, *, n_requests=16,
                          prompt_lo=48, prompt_hi=192, max_new=24,
                          kill_after_ticks=4, load=2.0, seed=0):
@@ -1429,6 +1589,18 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         spec_row = None
 
+    # ---- one-dispatch sampling: greedy vs fused in-dispatch sampled vs
+    # EOS-early-stop on the same Poisson trace (ISSUE 16) — sampler
+    # overhead, early-stop goodput return, seeded-replay verification,
+    # and speculative acceptance at temperature > 0
+    try:
+        sampling_row = serving_sampling_row(model, params, icfg,
+                                            cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving sampling bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        sampling_row = None
+
     # ---- serving failover: the same Poisson trace clean vs with one
     # mid-trace unclean replica kill (ISSUE 12) — goodput retention,
     # recovered-request count, and the TTFT p95 delta an unclean death
@@ -1517,6 +1689,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_prefix_cache": prefix_row,
         "serving_fleet": fleet_row,
         "serving_speculative": spec_row,
+        "serving_sampling": sampling_row,
         "serving_failover": failover_row,
         "serving_longctx": longctx_row,
         "serving_autotune": autotune_row,

@@ -71,6 +71,14 @@ python scripts/autotune_serving.py --smoke --out "$(mktemp -d)"
 # prefix-cache commit chain, and the prefix x speculative x kv-dtype
 # compose matrix.
 python -m pytest tests/test_speculative.py -q "$@"
+# One-dispatch sampling gates (ISSUE 16): fused temp/top-k/top-p sampling
+# inside the serving dispatch (no logits to host), temp-0 bit-identity
+# with the greedy scheduler, seeded-chain determinism across fresh
+# engines / preemption / drain, EOS + stop-sequence early termination
+# with KV returned at the stop tick, the generalized (seeded-chain)
+# speculative accept with spec-on/off token parity, and the logit-mask
+# constrained-decoding hook. Sanitized like the other serving suites.
+env SXT_SANITIZE=1 python -m pytest tests/test_sampling.py -q "$@"
 # RLHF / HybridEngine v2 gates (ISSUE 11): train->serve flip parity with
 # a fresh engine on the gathered weights, zero recompiles across flips on
 # a warmed fleet, bit-exact rollout replay at the recorded weight
@@ -91,5 +99,6 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_disagg.py \
     --ignore=tests/test_failover.py \
     --ignore=tests/test_speculative.py \
+    --ignore=tests/test_sampling.py \
     --ignore=tests/test_rlhf.py \
     --ignore=tests/test_hybrid_engine.py "$@"

@@ -117,6 +117,16 @@ COLLECTIVES = frozenset({
     "jax.lax.ppermute", "jax.lax.all_gather", "jax.lax.all_to_all",
 })
 
+#: jit SEAMS beyond ``jax.jit`` itself — helper names whose function-
+#: typed arguments end up inside ``jax.jit``. ISSUE 16's sampled serving
+#: steps compile through ``InferenceEngineV2._sampled_fn(key, impl)``,
+#: so the bare ``jax.jit(self._x_impl)`` prepass no longer sees every
+#: jitted body by name; any ``self.<attr>``/name argument at one of
+#: these call sites is treated as a jitted body for SXT008 (sampling
+#: must stay ``jax.random.fold_in``-seeded — a host ``np.random`` draw
+#: in a sampled impl would bake ONE "random" token into the program).
+JIT_SEAMS = frozenset({"_sampled_fn"})
+
 _NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
@@ -380,6 +390,16 @@ class FileChecker(ast.NodeVisitor):
                         attr = self_attr(tgt)
                         if attr:
                             self._jit_names.add(attr)
+                elif _last_attr(node.func) in JIT_SEAMS:
+                    # a jit seam compiles the function it is handed —
+                    # every function-shaped argument is a jitted body
+                    for tgt in node.args:
+                        if isinstance(tgt, ast.Name):
+                            self._jit_names.add(tgt.id)
+                        else:
+                            attr = self_attr(tgt)
+                            if attr:
+                                self._jit_names.add(attr)
         if self._jit_names:
             for node in ast.walk(self.tree):
                 if (isinstance(node, ast.FunctionDef)

@@ -10,8 +10,8 @@ Capability analog of the reference's two inference stacks:
     state manager, and a continuous-batching ``put/query/flush`` API.
 """
 
-from .config import (InferenceConfig, RouterConfig, ServingConfig,
-                     SpeculativeConfig)
+from .config import (InferenceConfig, RouterConfig, SamplingParams,
+                     ServingConfig, SpeculativeConfig)
 from .engine import InferenceEngine, init_inference, load_serving_weights
 from .paged import BlockedAllocator, PagedKVCache
 from .engine_v2 import (ImportReservation, InferenceEngineV2, KVBlockPayload,
@@ -23,6 +23,7 @@ from .speculative import DraftModelDrafter, NGramDrafter, make_drafter
 __all__ = [
     "InferenceConfig",
     "RouterConfig",
+    "SamplingParams",
     "ServingConfig",
     "SpeculativeConfig",
     "DraftModelDrafter",

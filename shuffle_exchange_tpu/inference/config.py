@@ -122,6 +122,118 @@ class SpeculativeConfig:
         return out
 
 
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling spec for the one-dispatch serving step
+    (ISSUE 16). The defaults are exactly greedy decoding with no stop
+    condition, so a request without params is bit-identical to the
+    historical greedy scheduler.
+
+    Sampling happens ON DEVICE inside the fused serving step: the
+    sampled token at absolute sequence index ``i`` is
+    ``argmax(filtered_logits / T + gumbel(fold_in(PRNGKey(seed), i)))``
+    — a pure function of ``(seed, position, distribution)``. That makes
+    every sampled chain deterministic and bit-exactly replayable across
+    preemption/drain replay, failover re-prefill, and speculative
+    verification (which samples the SAME chain at the same positions),
+    and temperature 0 degenerates to plain argmax (greedy).
+
+    - ``temperature``: 0 = greedy (top_k/top_p then ignored).
+    - ``top_k``: keep the k highest logits (0 = off).
+    - ``top_p``: nucleus — keep the smallest probability mass >= top_p
+      of the temperature-scaled distribution (1.0 = off).
+    - ``seed``: per-request PRNG seed; recorded so replays reproduce the
+      chain bit-exactly.
+    - ``eos_token_id``: on-device early-stop token (-1 = never stop);
+      the EOS token itself is emitted, then the request finishes and its
+      KV blocks free at that tick.
+    - ``stop``: stop token SEQUENCES, matched host-side as a suffix of
+      the generated tokens (the multi-token analog of EOS).
+    - ``logit_mask``: constrained-decoding hook — a host callable
+      ``mask(history_tokens) -> bool[vocab]`` (True = allowed) computed
+      per step and applied in-dispatch (greedy and sampled rows both
+      respect it). Not serializable: it never rides wire records."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_token_id: int = -1
+    stop: Tuple[Tuple[int, ...], ...] = ()
+    logit_mask: Optional[Any] = None
+
+    def __post_init__(self):
+        if (not isinstance(self.temperature, (int, float))
+                or self.temperature < 0):
+            raise ConfigError(
+                f"sampling.temperature must be >= 0 (0 = greedy), got "
+                f"{self.temperature!r}")
+        object.__setattr__(self, "temperature", float(self.temperature))
+        if not isinstance(self.top_k, int) or self.top_k < 0:
+            raise ConfigError(
+                f"sampling.top_k must be an int >= 0 (0 = off), got "
+                f"{self.top_k!r}")
+        if (not isinstance(self.top_p, (int, float))
+                or not 0.0 < float(self.top_p) <= 1.0):
+            raise ConfigError(
+                f"sampling.top_p must be in (0, 1] (1 = off), got "
+                f"{self.top_p!r}")
+        object.__setattr__(self, "top_p", float(self.top_p))
+        if (not isinstance(self.seed, int) or isinstance(self.seed, bool)
+                or not 0 <= self.seed < 2 ** 31):
+            raise ConfigError(
+                f"sampling.seed must be an int in [0, 2**31) (it rides as "
+                f"an int32 device operand), got {self.seed!r}")
+        if not isinstance(self.eos_token_id, int) or self.eos_token_id < -1:
+            raise ConfigError(
+                f"sampling.eos_token_id must be an int >= -1 (-1 = never "
+                f"stop), got {self.eos_token_id!r}")
+        try:
+            stop = tuple(tuple(int(t) for t in s) for s in (self.stop or ()))
+        except (TypeError, ValueError) as e:
+            raise ConfigError(
+                f"sampling.stop must be a list of token sequences: {e}"
+            ) from e
+        if any(not s for s in stop):
+            raise ConfigError(
+                "sampling.stop sequences must be non-empty (an empty stop "
+                "sequence would stop every request at its first token)")
+        object.__setattr__(self, "stop", stop)
+        if self.logit_mask is not None and not callable(self.logit_mask):
+            raise ConfigError(
+                f"sampling.logit_mask must be a callable "
+                f"mask(history) -> bool[vocab] or None, got "
+                f"{type(self.logit_mask).__name__}")
+
+    @property
+    def greedy(self) -> bool:
+        """True when decoding draws no randomness (temperature 0)."""
+        return self.temperature == 0.0
+
+    def to_wire(self) -> dict:
+        """JSON-friendly dict for records/snapshots (RolloutRecord,
+        replay logs). ``logit_mask`` is a host callable and deliberately
+        does NOT ride: a replayed record re-attaches its own mask."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed,
+                "eos_token_id": self.eos_token_id,
+                "stop": [list(s) for s in self.stop]}
+
+    @classmethod
+    def from_wire(cls, d: Optional[dict]) -> "Optional[SamplingParams]":
+        if d is None:
+            return None
+        allowed = {"temperature", "top_k", "top_p", "seed", "eos_token_id",
+                   "stop"}
+        unknown = set(d) - allowed
+        if unknown:
+            raise ConfigError(
+                f"unknown sampling keys {sorted(unknown)} "
+                f"(allowed: {sorted(allowed)})")
+        return cls(**{k: (tuple(tuple(s) for s in v) if k == "stop" else v)
+                      for k, v in d.items()})
+
+
 @dataclasses.dataclass
 class KVTierConfig:
     """Tiered paged-KV storage (ISSUE 15): serving contexts larger than
@@ -476,6 +588,12 @@ class InferenceConfig:
     # multi-replica serving front (serving/router.py: placement, sticky
     # sessions, elastic drain/scale — ISSUE 7)
     router: RouterConfig = dataclasses.field(default_factory=RouterConfig)
+    # default per-request sampling for the fused in-dispatch sampler
+    # (ISSUE 16): applied to requests submitted without their own
+    # SamplingParams. The dataclass default is exactly greedy with no
+    # stop condition — the historical scheduler behavior.
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams)
     # misc
     seed: int = 0
 
@@ -501,6 +619,16 @@ class InferenceConfig:
                     f"unknown kv_tier config keys {sorted(unknown)} "
                     f"(allowed: {sorted(allowed)})")
             self.kv_tier = KVTierConfig(**self.kv_tier)
+        if self.sampling is None:
+            self.sampling = SamplingParams()
+        elif isinstance(self.sampling, dict):
+            allowed = {f.name for f in dataclasses.fields(SamplingParams)}
+            unknown = set(self.sampling) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown sampling config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            self.sampling = SamplingParams(**self.sampling)
         self.kv_cache_dtype = _normalize_kv_cache_dtype(self.kv_cache_dtype)
         if not isinstance(self.prefix_caching, bool):
             raise ConfigError(
@@ -590,6 +718,22 @@ class InferenceConfig:
         elif not isinstance(kt, KVTierConfig):
             raise ConfigError(f"kv_tier must be a dict or KVTierConfig, "
                               f"got {type(kt).__name__}")
+        smp = d.get("sampling")
+        if smp is None:
+            d.pop("sampling", None)   # empty section -> defaults
+        elif isinstance(smp, dict):
+            allowed = {f.name for f in dataclasses.fields(SamplingParams)}
+            unknown = set(smp) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown sampling config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            d["sampling"] = SamplingParams(
+                **{k: (tuple(tuple(s) for s in v) if k == "stop" else v)
+                   for k, v in smp.items()})
+        elif not isinstance(smp, SamplingParams):
+            raise ConfigError(f"sampling must be a dict or SamplingParams, "
+                              f"got {type(smp).__name__}")
         rt = d.get("router")
         if rt is None:
             d.pop("router", None)   # empty section -> defaults

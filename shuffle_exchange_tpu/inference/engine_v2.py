@@ -67,6 +67,10 @@ class SequenceDescriptor:
     # i in here, and the sequence cannot be dispatched until
     # ``fetch_spilled`` restores full residency
     spilled: set = dataclasses.field(default_factory=set)
+    # per-request sampling (ISSUE 16): a SamplingParams for step_sampled's
+    # fused in-dispatch sampler. None means greedy with no EOS — exactly
+    # the pre-sampling engine contract, so step() callers never see it.
+    sampling: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -154,6 +158,17 @@ class InferenceEngineV2(InferenceEngine):
         # proposed/accepted tallies)
         self.spec_rollbacks = 0
         self.spec_rolled_tokens = 0
+        # one-dispatch sampling observability (ISSUE 16): KV blocks
+        # returned to the pool by EOS/stop early termination (the
+        # scheduler's sampling/* counter group reads this), and the output
+        # avals of every sampled program dispatched — the no-logits-to-host
+        # proof (tests assert no [*, vocab]-shaped leaf ever ships).
+        self.early_stop_freed_blocks = 0
+        self.sampled_output_shapes: Dict[Tuple, Tuple] = {}
+        # SamplingParams registered before their uid's first prefill lands
+        # (configure_sampling on a not-yet-live uid); step_sampled pops
+        # these into the descriptor it creates.
+        self._pending_sampling: Dict[int, object] = {}
         # block 0 is scratch: padding table entries scribble here, never read.
         self._scratch = self.allocator.allocate(1)[0]
         self._seqs: Dict[int, SequenceDescriptor] = {}
@@ -270,12 +285,20 @@ class InferenceEngineV2(InferenceEngine):
                 # park-instead-of-preempt decision
                 tier_note = (f" + {self.spillable_blocks(exclude=uids)} "
                              f"reclaimable via kv_tier spill")
+            stop_note = ""
+            if self.early_stop_freed_blocks:
+                # EOS/stop accounting (ISSUE 16): early terminations have
+                # already been returning blocks — name them so a refusal
+                # under sampled load reads against the right baseline
+                stop_note = (f"; early stops have returned "
+                             f"{self.early_stop_freed_blocks} blocks to the "
+                             f"pool so far")
             return False, need, (
                 f"needs {need} KV blocks, {self.allocator.free_blocks} free"
                 f"{tier_note} "
                 f"(largest single ask: uid {worst_uid} wants {worst_ask} new"
                 f"{cache_note}); flush finished sequences or raise "
-                f"num_kv_blocks")
+                f"num_kv_blocks{stop_note}")
         return True, need, ""
 
     # -- device programs ----------------------------------------------
@@ -973,7 +996,8 @@ class InferenceEngineV2(InferenceEngine):
             last_logits=None if parent.last_logits is None
             else np.array(parent.last_logits),
             tokens=list(parent.tokens), committed=parent.committed,
-            last_key=parent.last_key, no_commit=parent.no_commit)
+            last_key=parent.last_key, no_commit=parent.no_commit,
+            sampling=parent.sampling)
 
     def _table(self, desc: SequenceDescriptor,
                width: Optional[int] = None) -> np.ndarray:
@@ -1309,6 +1333,68 @@ class InferenceEngineV2(InferenceEngine):
         return self._cache_of(kp, vp), dlogits, plogits, sres
 
     @atomic_on_reject
+    def _admit_step(self, decode_uids, decode_tokens, prefills, speculative,
+                    what: str):
+        """The shared validation + all-or-nothing admission front half of
+        step()/step_sampled(): normalize the lane lists, validate lane
+        membership, admit the WHOLE tick before any state mutation, then
+        create descriptors for new prefill uids and ensure every
+        participant's KV blocks. Returns (prefills, speculative, ddescs,
+        pdescs, sdescs)."""
+        prefills = [(u, list(map(int, c))) for u, c in prefills]
+        speculative = [(u, list(map(int, c))) for u, c in speculative]
+        if len(decode_uids) != len(decode_tokens):
+            raise ValueError("decode_uids and decode_tokens must align")
+        all_uids = (list(decode_uids) + [u for u, _ in prefills]
+                    + [u for u, _ in speculative])
+        if len(set(all_uids)) != len(all_uids):
+            raise ValueError(
+                f"duplicate uid in one {what}: a sequence is either "
+                "decoding, prefilling or verifying drafts in a tick, never "
+                "two at once")
+        for uid in decode_uids:
+            if uid not in self._seqs:
+                raise ValueError(f"decode uid {uid} unknown — prefill it "
+                                 "first (step(prefills=...) or put())")
+        for uid, chunk in prefills:
+            if not chunk:
+                raise ValueError(f"prefill uid {uid} with an empty chunk")
+        for uid, chunk in speculative:
+            if uid not in self._seqs:
+                raise ValueError(f"speculative uid {uid} unknown — a draft "
+                                 "row verifies an already-running sequence")
+            if len(chunk) < 2:
+                raise ValueError(
+                    f"speculative uid {uid} with {len(chunk)} tokens — a "
+                    "verify row is [pending_token, drafts...]; a row with "
+                    "no drafts belongs in decode_uids")
+        self._require_resident(all_uids, what)
+        ok, _, why = self._admission_detail(
+            all_uids, [1] * len(decode_uids) + [len(c) for _, c in prefills]
+            + [len(c) for _, c in speculative])
+        if not ok:
+            raise RuntimeError(f"cannot schedule {what}: {why}")
+
+        # admission passed: create descriptors for new prefill uids
+        pdescs = []
+        for uid, chunk in prefills:
+            desc = self._seqs.get(uid)
+            if desc is None:
+                desc = SequenceDescriptor(uid=uid)
+                desc.sampling = self._pending_sampling.pop(uid, None)
+                self._seqs[uid] = desc
+            pdescs.append(desc)
+        ddescs = [self._seqs[u] for u in decode_uids]
+        sdescs = [self._seqs[u] for u, _ in speculative]
+        for d in ddescs:
+            self._ensure_blocks(d, d.seen_tokens + 1)
+        for d, (_, chunk) in zip(pdescs, prefills):
+            self._ensure_blocks(d, d.seen_tokens + len(chunk))
+        for d, (_, chunk) in zip(sdescs, speculative):
+            self._ensure_blocks(d, d.seen_tokens + len(chunk))
+        return prefills, speculative, ddescs, pdescs, sdescs
+
+    @atomic_on_reject
     def step(self, decode_uids: Sequence[int], decode_tokens: Sequence[int],
              prefills: Sequence[Tuple[int, Sequence[int]]] = (),
              speculative: Sequence[Tuple[int, Sequence[int]]] = ()):
@@ -1350,55 +1436,8 @@ class InferenceEngineV2(InferenceEngine):
         ``emitted_tokens`` is the accepted drafts plus the verifier's
         correction/bonus token, every one of them exactly the greedy
         reference chain."""
-        prefills = [(u, list(map(int, c))) for u, c in prefills]
-        speculative = [(u, list(map(int, c))) for u, c in speculative]
-        if len(decode_uids) != len(decode_tokens):
-            raise ValueError("decode_uids and decode_tokens must align")
-        all_uids = (list(decode_uids) + [u for u, _ in prefills]
-                    + [u for u, _ in speculative])
-        if len(set(all_uids)) != len(all_uids):
-            raise ValueError(
-                "duplicate uid in one step(): a sequence is either decoding, "
-                "prefilling or verifying drafts in a tick, never two at once")
-        for uid in decode_uids:
-            if uid not in self._seqs:
-                raise ValueError(f"decode uid {uid} unknown — prefill it "
-                                 "first (step(prefills=...) or put())")
-        for uid, chunk in prefills:
-            if not chunk:
-                raise ValueError(f"prefill uid {uid} with an empty chunk")
-        for uid, chunk in speculative:
-            if uid not in self._seqs:
-                raise ValueError(f"speculative uid {uid} unknown — a draft "
-                                 "row verifies an already-running sequence")
-            if len(chunk) < 2:
-                raise ValueError(
-                    f"speculative uid {uid} with {len(chunk)} tokens — a "
-                    "verify row is [pending_token, drafts...]; a row with "
-                    "no drafts belongs in decode_uids")
-        self._require_resident(all_uids, "step()")
-        ok, _, why = self._admission_detail(
-            all_uids, [1] * len(decode_uids) + [len(c) for _, c in prefills]
-            + [len(c) for _, c in speculative])
-        if not ok:
-            raise RuntimeError(f"cannot schedule step(): {why}")
-
-        # admission passed: create descriptors for new prefill uids
-        pdescs = []
-        for uid, chunk in prefills:
-            desc = self._seqs.get(uid)
-            if desc is None:
-                desc = SequenceDescriptor(uid=uid)
-                self._seqs[uid] = desc
-            pdescs.append(desc)
-        ddescs = [self._seqs[u] for u in decode_uids]
-        sdescs = [self._seqs[u] for u, _ in speculative]
-        for d in ddescs:
-            self._ensure_blocks(d, d.seen_tokens + 1)
-        for d, (_, chunk) in zip(pdescs, prefills):
-            self._ensure_blocks(d, d.seen_tokens + len(chunk))
-        for d, (_, chunk) in zip(sdescs, speculative):
-            self._ensure_blocks(d, d.seen_tokens + len(chunk))
+        prefills, speculative, ddescs, pdescs, sdescs = self._admit_step(
+            decode_uids, decode_tokens, prefills, speculative, "step()")
 
         if sdescs:
             return self._speculative_dispatch(
@@ -1515,6 +1554,424 @@ class InferenceEngineV2(InferenceEngine):
             self._commit(d)
             spec_results.append((a, chunk[1:1 + a] + [int(ver[i, a])]))
         return dlogits[:len(ddescs)], plogits[:len(pdescs)], spec_results
+
+    # -- one-dispatch sampling (ISSUE 16) ------------------------------
+    # The sampled serving tick: temperature/top-k/top-p (greedy as the
+    # temp=0 degenerate case) runs INSIDE the mixed/spec step programs, so
+    # the host receives int32 tokens + bool EOS flags and logits never
+    # ship over the tunnel. Every sampling knob is a traced per-row
+    # operand, so the warmed server's program-key ladder is the SAME one
+    # the greedy step compiles — a greedy/sampled mix in one tick is one
+    # program. Randomness is the Gumbel-max coupling
+    # ``argmax(filtered/T + gumbel(fold_in(PRNGKey(seed), position)))``
+    # with ``position`` the token's ABSOLUTE sequence index, computed
+    # in-dispatch from operands the tick already carries (decode: dpos+1,
+    # prefill finish: pstart+pnnew, verify slot j: sstart+j+1) — the chain
+    # is a pure function of (seed, position, distribution), hence
+    # bit-exactly replayable across batch composition, preemption,
+    # failover re-prefill, and speculative verification.
+
+    def configure_sampling(self, uid: int, params) -> None:
+        """Attach per-request ``SamplingParams`` to ``uid``. Live uids
+        update in place; unknown uids are registered pending and picked up
+        when their first prefill chunk creates the descriptor. ``None``
+        restores the greedy/no-EOS default."""
+        desc = self._seqs.get(uid)
+        if desc is not None:
+            desc.sampling = params
+        elif params is None:
+            self._pending_sampling.pop(uid, None)
+        else:
+            self._pending_sampling[uid] = params
+
+    def _sampling_operands(self, descs, B: int):
+        """Per-row traced sampling operands, padded to the binned batch:
+        (seeds u32, temperature f32, top_k i32 0=off, top_p f32,
+        eos i32 -1=off). Padding rows are greedy with EOS off, so they
+        sample nothing and can never flag done."""
+        seeds = np.zeros((B,), np.uint32)
+        temps = np.zeros((B,), np.float32)
+        topks = np.zeros((B,), np.int32)
+        topps = np.ones((B,), np.float32)
+        eos = np.full((B,), -1, np.int32)
+        for i, d in enumerate(descs):
+            sp = d.sampling
+            if sp is None:
+                continue
+            seeds[i] = np.uint32(sp.seed)
+            temps[i] = sp.temperature
+            topks[i] = sp.top_k
+            topps[i] = sp.top_p
+            eos[i] = sp.eos_token_id
+        return seeds, temps, topks, topps, eos
+
+    def _lane_masks(self, descs, tails, B: int):
+        """Constrained-decoding plane for one lane: [B, V] bool (True =
+        allowed), or None when no row constrains. Each masked row's
+        ``logit_mask(history)`` callable sees the FULL consumed history —
+        the descriptor's written tokens plus this tick's new tokens
+        (``tails[i]``: the pending decode token, or the prefill chunk) —
+        and must allow at least one token."""
+        if not any(d.sampling is not None and d.sampling.logit_mask is not None
+                   for d in descs):
+            return None
+        V = self._mcfg.vocab_size
+        m = np.ones((B, V), bool)
+        for i, (d, tail) in enumerate(zip(descs, tails)):
+            sp = d.sampling
+            if sp is None or sp.logit_mask is None:
+                continue
+            row = np.asarray(sp.logit_mask(list(d.tokens) + list(tail)),
+                             dtype=bool)
+            if row.shape != (V,):
+                raise ValueError(
+                    f"logit_mask for uid {d.uid} returned shape {row.shape}, "
+                    f"want ({V},)")
+            if not row.any():
+                raise ValueError(
+                    f"logit_mask for uid {d.uid} allows no tokens — a "
+                    "constrained row must keep at least one candidate")
+            m[i] = row
+        return m
+
+    def _sampled_fn(self, key, impl):
+        fn = self._mixed_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        fn = jax.jit(impl, donate_argnums=_donate_cache())
+        self._mixed_cache[key] = fn
+        return fn
+
+    def _assert_on_device_sampling(self, key, outs) -> None:
+        """The no-logits-to-host proof: every leaf a sampled dispatch
+        returns must be token/flag-shaped — nothing with a vocab-sized
+        trailing dim may cross to host. Records the avals per program key
+        so tests can audit the full set."""
+        import jax
+
+        V = self._mcfg.vocab_size
+        shapes = tuple(tuple(int(s) for s in x.shape)
+                       for x in jax.tree_util.tree_leaves(outs))
+        for s in shapes:
+            assert not (s and s[-1] == V), (
+                f"sampled step {key} ships a vocab-shaped output {s} to "
+                "host — sampling must stay in-dispatch")
+        self.sampled_output_shapes[key] = shapes
+
+    def _mixed_sampled_impl(self, params, cache: PagedKVCache, dtok, dpos,
+                            dtables, dsp, dmask, pids, pstart, pnnew,
+                            ptables, psp, pmask):
+        """The mixed step with the sampler fused at the head: identical
+        trunk to ``_mixed_step_impl`` (same layer scan, same gather-last
+        head projections), then ``seeded_tokens`` per lane. Returns
+        (cache, decode_tokens [Bd], decode_eos [Bd], prefill_tokens [Bp],
+        prefill_eos [Bp]) — int32/bool only, never [*, V]."""
+        from .sampling import seeded_tokens
+
+        cache, dlogits, plogits = self._mixed_step_impl(
+            params, cache, dtok, dpos, dtables, pids, pstart, pnnew, ptables)
+        dseeds, dtemp, dtk, dtp, deos = dsp
+        pseeds, ptemp, ptk, ptp, peos = psp
+        # decode row emits the token at absolute index dpos+1 (dpos is the
+        # slot the input token writes); a finished prefill's first
+        # generated token sits at pstart+pnnew
+        dtoks = seeded_tokens(dlogits, dseeds, dpos + 1, dtemp, dtk, dtp,
+                              mask=dmask)
+        ptoks = seeded_tokens(plogits, pseeds, pstart + pnnew, ptemp, ptk,
+                              ptp, mask=pmask)
+        ddone = (dtoks == deos) & (deos >= 0)
+        pdone = (ptoks == peos) & (peos >= 0)
+        return cache, dtoks, ddone, ptoks, pdone
+
+    def _decode_sampled_impl(self, params, cache: PagedKVCache, dtok, dpos,
+                             dtables, dsp, dmask):
+        from .sampling import seeded_tokens
+
+        cache, dlogits = self._paged_decode_impl(params, cache, dtok, dpos,
+                                                 dtables)
+        dseeds, dtemp, dtk, dtp, deos = dsp
+        dtoks = seeded_tokens(dlogits, dseeds, dpos + 1, dtemp, dtk, dtp,
+                              mask=dmask)
+        ddone = (dtoks == deos) & (deos >= 0)
+        return cache, dtoks, ddone
+
+    def _extend_sampled_impl(self, params, cache: PagedKVCache, pids, pstart,
+                             pnnew, ptables, psp, pmask):
+        from .sampling import seeded_tokens
+
+        cache, plogits = self._extend_impl(params, cache, pids, pstart,
+                                           pnnew, ptables)
+        pseeds, ptemp, ptk, ptp, peos = psp
+        ptoks = seeded_tokens(plogits, pseeds, pstart + pnnew, ptemp, ptk,
+                              ptp, mask=pmask)
+        pdone = (ptoks == peos) & (peos >= 0)
+        return cache, ptoks, pdone
+
+    def _spec_sampled_impl(self, params, cache: PagedKVCache, dops, pops,
+                           sops, dsp, psp, ssp, dmask, pmask):
+        """The speculative mixed step generalized to TRUE speculative
+        sampling: the verify lane evaluates the seeded sampling chain
+        ``st[j] = seeded_tokens(logits_after_j, seed, sstart+j+1)`` at
+        EVERY chunk position and accepts the longest draft prefix that
+        MATCHES the chain. Our drafters are deterministic (point-mass
+        proposals), for which Gumbel-coupled chain-matching IS the
+        Leviathan accept/residual-resample rule: a draft is accepted iff
+        the target chain would have emitted it, and the first rejected
+        slot's chain token is exactly the residual resample. The emitted
+        tokens are therefore the seeded chain itself — bit-identical with
+        speculation on or off, at any k, greedy or sampled. Returns
+        (cache, (dtoks, ddone) | None, (ptoks, pdone) | None,
+        (chain [Bs, Cs] i32, accepted [Bs])) — the [Bs, Cs, V] verify
+        logits never leave the device (the greedy path ships last_logits
+        [Bs, V]; this path ships nothing vocab-shaped at all)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .sampling import seeded_tokens
+
+        dops, pops, sops = tuple(dops), tuple(pops), tuple(sops)
+        xd = xp = xs = None
+        cos = sin = None
+        if dops:
+            dtok, dpos, dtables = dops
+            xd, (cos, sin), _ = self._embed_at(params, dtok[:, None], dpos)
+        if pops:
+            pids, pstart, pnnew, ptables = pops
+            xp, (cos, sin), ppos = self._embed_at(params, pids, pstart)
+        sids, sstart, snnew, stables = sops
+        xs, (cos, sin), spos = self._embed_at(params, sids, sstart)
+
+        def layer_fn(carry, layer_and_cache):
+            hd, hp, hs = carry
+            lw, ck, cv = layer_and_cache
+            if hd is not None:
+                hd, (ck, cv) = self._decode_layer(lw, hd, ck, cv, cos, sin,
+                                                  dpos, dtables)
+            if hp is not None:
+                hp, (ck, cv) = self._extend_layer(lw, hp, ck, cv, cos, sin,
+                                                  ppos, pstart, pnnew,
+                                                  ptables)
+            hs, (ck, cv) = self._extend_layer(lw, hs, ck, cv, cos, sin,
+                                              spos, sstart, snnew, stables)
+            return (hd, hp, hs), (ck, cv)
+
+        (xd, xp, xs), (kp, vp) = jax.lax.scan(
+            layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache))
+        dres = pres = None
+        if dops:
+            dlogits = self.model.head(params, xd)[:, 0]
+            dseeds, dtemp, dtk, dtp, deos = dsp
+            dtoks = seeded_tokens(dlogits, dseeds, dpos + 1, dtemp, dtk,
+                                  dtp, mask=dmask)
+            dres = (dtoks, (dtoks == deos) & (deos >= 0))
+        if pops:
+            x_last = jnp.take_along_axis(
+                xp, (pnnew - 1)[:, None, None].astype(jnp.int32), axis=1)
+            plogits = self.model.head(params, x_last)[:, 0]
+            pseeds, ptemp, ptk, ptp, peos = psp
+            ptoks = seeded_tokens(plogits, pseeds, pstart + pnnew, ptemp,
+                                  ptk, ptp, mask=pmask)
+            pres = (ptoks, (ptoks == peos) & (peos >= 0))
+        slog = self.model.head(params, xs)          # [Bs, Cs, V], on device
+        Bs, Cs = sids.shape
+        sseeds, stemp, stk, stp, _ = ssp
+        spositions = sstart[:, None] + jnp.arange(Cs)[None, :] + 1
+        bc = lambda a: jnp.broadcast_to(a[:, None], (Bs, Cs))  # noqa: E731
+        chain = seeded_tokens(slog, bc(sseeds), spositions, bc(stemp),
+                              bc(stk), bc(stp))
+        nxt = jnp.concatenate(
+            [sids[:, 1:], jnp.zeros((Bs, 1), sids.dtype)], axis=1)
+        j = jnp.arange(Cs)[None, :]
+        m = jnp.where(j < (snnew - 1)[:, None], chain == nxt, False)
+        accepted = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1),
+                           axis=1)                   # [Bs] in [0, k]
+        return self._cache_of(kp, vp), dres, pres, (chain, accepted)
+
+    @atomic_on_reject
+    def step_sampled(self, decode_uids: Sequence[int],
+                     decode_tokens: Sequence[int],
+                     prefills: Sequence[Tuple[int, Sequence[int]]] = (),
+                     speculative: Sequence[Tuple[int, Sequence[int]]] = ()):
+        """step() with sampling fused into the dispatch: same lanes, same
+        admission, same shape-bin ladder — but the return is tokens and
+        EOS flags, never logits. Per-uid behavior comes off the
+        descriptor's ``SamplingParams`` (``configure_sampling``); uids
+        without one run greedy with EOS off, bit-identical to step()'s
+        argmax chain.
+
+        Returns ``(decode_tokens [nd], decode_eos [nd], prefill_tokens
+        [np], prefill_eos [np])`` int32/bool — prefill entries are only
+        meaningful on a sequence's FINAL chunk (mid-prompt chunks sample a
+        position the prompt will overwrite; callers ignore them, exactly
+        as they ignored mid-chunk logits). With ``speculative`` rows a
+        5-tuple appends ``spec_results[i] = (accepted, emitted_tokens)``
+        where every emitted token is the row's seeded chain (EOS inside
+        the emitted list is the caller's host-side cut — the flags here
+        cover the single-token lanes). Commits set ``last_logits = None``:
+        a sampled sequence has no host logits by design, and anything that
+        silently assumed them fails loudly instead of reading stale rows.
+
+        Constrained rows (``SamplingParams.logit_mask``) dispatch masked
+        program variants (distinct ``*_m`` program keys) and are rejected
+        from the speculative lane — the mask changes the target chain
+        mid-flight, which drafters can't see."""
+        prefills, speculative, ddescs, pdescs, sdescs = self._admit_step(
+            decode_uids, decode_tokens, prefills, speculative,
+            "step_sampled()")
+        for d in sdescs:
+            if d.sampling is not None and d.sampling.logit_mask is not None:
+                raise ValueError(
+                    f"speculative uid {d.uid} carries a logit_mask — "
+                    "constrained sequences must decode one token at a time "
+                    "(schedule it in decode_uids)")
+        if sdescs:
+            return self._speculative_sampled_dispatch(
+                decode_tokens, ddescs, prefills, pdescs, speculative, sdescs)
+
+        nd, npre = len(ddescs), len(pdescs)
+        dtoks = np.zeros((0,), np.int32)
+        ddone = np.zeros((0,), bool)
+        ptoks = np.zeros((0,), np.int32)
+        pdone = np.zeros((0,), bool)
+        if ddescs and pdescs:
+            Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs,
+                                                          decode_tokens)
+            chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
+            cmax = max(len(c) for _, c in chunks)
+            Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
+                chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
+            dsp = self._sampling_operands(ddescs, Bd)
+            psp = self._sampling_operands(pdescs, Bp)
+            dmask = self._lane_masks(ddescs, [[t] for t in decode_tokens], Bd)
+            pmask = self._lane_masks(pdescs, [c for _, c in prefills], Bp)
+            masked = dmask is not None or pmask is not None
+            key = (("mixed_m" if masked else "mixed"), Bd, Wd, Bp, C, Wp)
+            fn = self._sampled_fn(("s",) + key, self._mixed_sampled_impl)
+            self.cache, dt, dd, pt, pd = fn(
+                self.params, self.cache, tok, pos, dtables, dsp, dmask,
+                ids, start, nnew, ptables, psp, pmask)
+            self._assert_on_device_sampling(key, (dt, dd, pt, pd))
+            self._program_keys.add(key)
+            dtoks, ddone = np.asarray(dt), np.asarray(dd)
+            ptoks, pdone = np.asarray(pt), np.asarray(pd)
+        elif ddescs:
+            Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs,
+                                                          decode_tokens)
+            dsp = self._sampling_operands(ddescs, Bd)
+            dmask = self._lane_masks(ddescs, [[t] for t in decode_tokens], Bd)
+            key = (("decode_m" if dmask is not None else "decode"), Bd, Wd)
+            fn = self._sampled_fn(("s",) + key, self._decode_sampled_impl)
+            self.cache, dt, dd = fn(self.params, self.cache, tok, pos,
+                                    dtables, dsp, dmask)
+            self._assert_on_device_sampling(key, (dt, dd))
+            self._program_keys.add(key)
+            dtoks, ddone = np.asarray(dt), np.asarray(dd)
+        elif pdescs:
+            chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
+            cmax = max(len(c) for _, c in chunks)
+            Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
+                chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
+            psp = self._sampling_operands(pdescs, Bp)
+            pmask = self._lane_masks(pdescs, [c for _, c in prefills], Bp)
+            key = (("extend_m" if pmask is not None else "extend"), Bp, C, Wp)
+            fn = self._sampled_fn(("s",) + key, self._extend_sampled_impl)
+            self.cache, pt, pd = fn(self.params, self.cache, ids, start,
+                                    nnew, ptables, psp, pmask)
+            self._assert_on_device_sampling(key, (pt, pd))
+            self._program_keys.add(key)
+            ptoks, pdone = np.asarray(pt), np.asarray(pd)
+        else:
+            return dtoks, ddone, ptoks, pdone
+        self.dispatch_count += 1
+
+        for i, d in enumerate(ddescs):
+            d.seen_tokens += 1
+            d.tokens.append(int(decode_tokens[i]))
+            d.last_logits = None
+            self._commit(d)
+        for i, (d, (_, chunk)) in enumerate(zip(pdescs, prefills)):
+            d.seen_tokens += len(chunk)
+            d.tokens.extend(chunk)
+            d.last_logits = None
+            self._commit(d)
+        return dtoks[:nd], ddone[:nd], ptoks[:npre], pdone[:npre]
+
+    def _speculative_sampled_dispatch(self, decode_tokens, ddescs, prefills,
+                                      pdescs, speculative, sdescs):
+        """The spec-lane tail of step_sampled(): pack all three lanes plus
+        their sampling operands, run ONE ``_spec_sampled_impl`` dispatch,
+        apply chain-match acceptance, rewind rejected draft KV, and emit
+        the seeded chain per row."""
+        sv = self.config.serving
+        dops = pops = ()
+        dsp = psp = ()
+        dmask = pmask = None
+        Bd = Wd = Bp = C = Wp = 0
+        if ddescs:
+            Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs,
+                                                          decode_tokens)
+            dops = (tok, pos, dtables)
+            dsp = self._sampling_operands(ddescs, Bd)
+            dmask = self._lane_masks(ddescs, [[t] for t in decode_tokens], Bd)
+        if pdescs:
+            chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
+            cmax = max(len(c) for _, c in chunks)
+            Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
+                chunks, pad_chunk=sv.bin_chunk(cmax))
+            pops = (ids, start, nnew, ptables)
+            psp = self._sampling_operands(pdescs, Bp)
+            pmask = self._lane_masks(pdescs, [c for _, c in prefills], Bp)
+        schunks = [(d, c) for d, (_, c) in zip(sdescs, speculative)]
+        kmax = max(len(c) for _, c in schunks) - 1
+        Bs, Cs, Ws, sids, sstart, snnew, stables = self._pack_chunks(
+            schunks, pad_chunk=sv.speculative.bin_k(kmax) + 1)
+        sops = (sids, sstart, snnew, stables)
+        ssp = self._sampling_operands(sdescs, Bs)
+
+        masked = dmask is not None or pmask is not None
+        key = (("spec_m" if masked else "spec"),
+               Bd, Wd, Bp, C, Wp, Bs, Cs, Ws)
+        fn = self._sampled_fn(("s",) + key, self._spec_sampled_impl)
+        self.cache, dres, pres, sres = fn(self.params, self.cache, dops,
+                                          pops, sops, dsp, psp, ssp,
+                                          dmask, pmask)
+        self.dispatch_count += 1
+        self._assert_on_device_sampling(key, (dres, pres, sres))
+        self._program_keys.add(key)
+        if dres is not None:
+            dtoks, ddone = np.asarray(dres[0]), np.asarray(dres[1])
+        else:
+            dtoks, ddone = np.zeros((0,), np.int32), np.zeros((0,), bool)
+        if pres is not None:
+            ptoks, pdone = np.asarray(pres[0]), np.asarray(pres[1])
+        else:
+            ptoks, pdone = np.zeros((0,), np.int32), np.zeros((0,), bool)
+        chain, accepted = (np.asarray(x) for x in sres)
+
+        for i, d in enumerate(ddescs):
+            d.seen_tokens += 1
+            d.tokens.append(int(decode_tokens[i]))
+            d.last_logits = None
+            self._commit(d)
+        for i, (d, (_, chunk)) in enumerate(zip(pdescs, prefills)):
+            d.seen_tokens += len(chunk)
+            d.tokens.extend(chunk)
+            d.last_logits = None
+            self._commit(d)
+        spec_results = []
+        for i, (d, chunk) in enumerate(schunks):
+            n, a = len(chunk), int(accepted[i])
+            d.seen_tokens += n
+            d.tokens.extend(chunk)
+            if a < n - 1:
+                self._rewind(d, d.seen_tokens - (n - 1 - a))
+            d.last_logits = None
+            self._commit(d)
+            spec_results.append((a, chunk[1:1 + a] + [int(chain[i, a])]))
+        return (dtoks[:len(ddescs)], ddone[:len(ddescs)],
+                ptoks[:len(pdescs)], pdone[:len(pdescs)], spec_results)
 
     # -- fused multi-token decode --------------------------------------
 
@@ -1932,14 +2389,22 @@ class InferenceEngineV2(InferenceEngine):
         self.stage_weights(params)
         return self.commit_staged_weights(force=force, defer=defer)
 
-    def flush(self, uids: Sequence[int]) -> None:
+    def flush(self, uids: Sequence[int], early_stop: bool = False) -> None:
         """Free all state for finished sequences (engine_v2.py:242).
         Spilled blocks (ISSUE 15) have no pool slot to free — their host
-        tier entry is dropped instead."""
+        tier entry is dropped instead. ``early_stop=True`` marks an
+        EOS/stop-sequence termination (ISSUE 16): the freed pool slots are
+        tallied in ``early_stop_freed_blocks`` so the scheduler's
+        sampling/* counters can report the KV the stop returned ahead of
+        the request's budgeted lifetime."""
         for uid in uids:
             desc = self._seqs.pop(uid, None)
             if desc is None:
                 raise ValueError(f"unknown uid {uid}")
+            self._pending_sampling.pop(uid, None)
+            if early_stop:
+                self.early_stop_freed_blocks += sum(
+                    1 for b in desc.blocks if b >= 0)
             if desc.spilled:
                 self.allocator.free([b for b in desc.blocks if b >= 0])
                 self.tier.drop(uid)

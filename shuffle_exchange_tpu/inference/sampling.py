@@ -49,3 +49,66 @@ def sample_or_greedy(logits, rng, temperature: float, top_k: int = 0, top_p: flo
     if temperature == 0.0:
         return greedy(logits)
     return sample(logits, rng, temperature=temperature, top_k=top_k, top_p=top_p)
+
+
+def seeded_tokens(logits, seeds, positions, temperature, top_k, top_p,
+                  mask=None):
+    """Fused per-row seeded sampler for the one-dispatch serving step
+    (ISSUE 16). EVERY parameter is a traced per-row operand — one
+    compiled program serves any mix of greedy and sampled rows, so the
+    warmed server's program-key ladder never grows with sampling config.
+
+    ``logits`` ``[..., V]`` (any float dtype), and per-row ``[...]``:
+    ``seeds`` (uint32-range ints), ``positions`` (the ABSOLUTE sequence
+    index of the token being emitted), ``temperature`` (0 = greedy),
+    ``top_k`` (0 = off), ``top_p`` (1 = off). ``mask`` is an optional
+    ``[..., V]`` bool (True = allowed) constrained-decoding plane,
+    respected by greedy and sampled rows alike. Returns int32 tokens
+    ``[...]``.
+
+    Gumbel-max coupling: the sampled token is
+    ``argmax(filtered_logits / T + gumbel(fold_in(PRNGKey(seed), pos)))``
+    — a pure function of ``(seed, position, distribution)``. Because the
+    key depends only on the request's seed and the token's absolute
+    index, the chain is invariant to batch composition, tick boundaries,
+    preemption/failover replay, and speculative verification (which
+    evaluates the SAME function at the same positions); at temperature 0
+    it degenerates to plain argmax, bit-identical to the greedy path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    lead = logits.shape[:-1]
+    flat = logits.reshape(-1, V).astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    if mask is not None:
+        flat = jnp.where(mask.reshape(-1, V), flat, neg)
+    T = temperature.reshape(-1).astype(jnp.float32)
+    tk = top_k.reshape(-1).astype(jnp.int32)
+    tp = top_p.reshape(-1).astype(jnp.float32)
+    greedy_tok = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+
+    def _noise(seed, pos):
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed.astype(jnp.uint32)),
+            pos.astype(jnp.uint32))
+        return jax.random.gumbel(key, (V,), jnp.float32)
+
+    g = jax.vmap(_noise)(seeds.reshape(-1), positions.reshape(-1))
+    # top-k/top-p filtering in sorted space (the cutoff idiom sample()
+    # uses): compute the smallest kept logit per row and drop below it
+    Tsafe = jnp.maximum(T, 1e-6)[:, None]
+    svals = jax.lax.top_k(flat, V)[0]                 # descending
+    probs = jax.nn.softmax(svals / Tsafe, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    j = jnp.arange(V)[None, :]
+    # rank 0 always kept (cum - probs == 0 there), so the filter can
+    # never empty a row even at top_k == 1 or vanishing top_p
+    keep = (cum - probs) < jnp.maximum(tp, 1e-6)[:, None]
+    keep &= j < jnp.where(tk > 0, tk, V)[:, None]
+    cutoff = jnp.where(keep, svals, jnp.inf).min(axis=-1, keepdims=True)
+    filt = jnp.where(flat >= cutoff, flat, neg)
+    sampled = jnp.argmax(filt / Tsafe + g, axis=-1).astype(jnp.int32)
+    out = jnp.where(T > 0.0, sampled, greedy_tok)
+    return out.reshape(lead)

@@ -60,7 +60,7 @@ from ..monitor import InMemoryMonitor, Monitor
 from ..testing import faults, sanitizer
 from ..utils.invariants import atomic_on_reject
 from ..utils.logging import logger
-from .config import ServingConfig
+from .config import SamplingParams, ServingConfig
 from .engine_v2 import InferenceEngineV2
 from .paged import blocks_needed
 
@@ -127,6 +127,14 @@ class ServingRequest:
     # (PREFILL mid-prompt, RUNNING mid-decode) — recorded at park time
     # because ``prefill_target`` keeps growing with generated tokens
     parked_state: str = ""
+    # one-dispatch sampling (ISSUE 16): per-request SamplingParams (None =
+    # greedy, no EOS — the historical scheduler contract). The params ride
+    # every export/inject/failover snapshot, so a re-placed request's
+    # seeded chain replays bit-exactly on the survivor. ``stopped`` marks
+    # EOS/stop-sequence early termination — the request finished before
+    # its token budget, returning its KV blocks and running slot early.
+    sampling: Optional[SamplingParams] = None
+    stopped: bool = False
 
     @property
     def prefill_target(self) -> List[int]:
@@ -138,7 +146,7 @@ class ServingRequest:
 
     @property
     def done(self) -> bool:
-        return len(self.generated) >= self.max_new_tokens
+        return self.stopped or len(self.generated) >= self.max_new_tokens
 
 
 class ContinuousBatchingScheduler:
@@ -219,21 +227,44 @@ class ContinuousBatchingScheduler:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_rejected = 0
+        # one-dispatch sampling (ISSUE 16): counters for the sampling/*
+        # monitor group. ``sampling_seen`` latches once any request
+        # carries SamplingParams — greedy-only serving never switches off
+        # the step() path, so its dispatch behavior (and program-key
+        # ladder) is bit-identical to pre-sampling builds.
+        self.sampling_seen = False
+        self.early_stops = 0
+        self.dead_tokens_saved = 0
+        self.sampling_resamples = 0
 
     # -- request intake ------------------------------------------------
 
     @atomic_on_reject(check="validate")
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                uid: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               sampling: Optional[SamplingParams] = None) -> int:
         """Queue one request; returns its uid. Validates against the
         engine's hard caps up front so impossible requests fail at submit
         time with named numbers, not mid-serve. ``deadline_s`` caps the
         request's wall time from submission (ISSUE 12): a request still
         unfinished past it FAILS with a typed ``DeadlineExceededError``
-        at the next tick boundary instead of holding budget forever."""
+        at the next tick boundary instead of holding budget forever.
+        ``sampling`` (ISSUE 16) attaches per-request SamplingParams —
+        temperature/top-k/top-p + seed sample in-dispatch off the seeded
+        Gumbel chain, EOS/stop sequences end the request at the tick the
+        stop hits. None inherits the engine config's ``sampling`` section
+        (whose own default is exactly the historical greedy contract)."""
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if sampling is not None and not isinstance(sampling, SamplingParams):
+            raise TypeError(
+                f"sampling must be a SamplingParams, got "
+                f"{type(sampling).__name__}")
+        if sampling is None:
+            base = self.engine.config.sampling
+            if base != SamplingParams():
+                sampling = base
         if self.draining:
             raise RuntimeError(
                 f"replica {self.replica_id} is draining and admits no new "
@@ -272,7 +303,10 @@ class ContinuousBatchingScheduler:
         r = ServingRequest(uid=uid, prompt=prompt,
                            max_new_tokens=int(max_new_tokens),
                            submitted_at=self.clock(),
-                           deadline_s=deadline_s)
+                           deadline_s=deadline_s,
+                           sampling=sampling)
+        if sampling is not None:
+            self.sampling_seen = True
         self.requests[uid] = r
         self.queue.append(r)
         return uid
@@ -346,7 +380,9 @@ class ContinuousBatchingScheduler:
         r.state = FINISHED
         r.finished_at = now
         if r.uid in self.engine._seqs:
-            self.engine.flush([r.uid])
+            # an early-stopped flush (ISSUE 16) tallies the KV blocks the
+            # stop returned ahead of the request's budgeted lifetime
+            self.engine.flush([r.uid], early_stop=r.stopped)
         if self.drafter is not None:
             self.drafter.forget(r.uid)
         if r in self.active:
@@ -396,7 +432,20 @@ class ContinuousBatchingScheduler:
             events.append(("serving/deadline_expired",
                            self.deadline_expired, self.ticks))
 
-    def _emit(self, r: ServingRequest, tok: int, now: float, events: list) -> None:
+    def _stop_hit(self, r: ServingRequest) -> bool:
+        """Host-side stop-sequence check (ISSUE 16): does the generated
+        stream now end with one of the request's stop sequences? EOS is
+        the on-device flag; multi-token stop sequences are a suffix match
+        on the small emitted list — the only per-token host work."""
+        sp = r.sampling
+        if sp is None or not sp.stop:
+            return False
+        g = r.generated
+        return any(len(g) >= len(s) and tuple(g[-len(s):]) == s
+                   for s in sp.stop)
+
+    def _emit(self, r: ServingRequest, tok: int, now: float, events: list,
+              eos: bool = False) -> None:
         r.generated.append(tok)
         if r.first_token_at is None:
             r.first_token_at = now
@@ -407,6 +456,15 @@ class ContinuousBatchingScheduler:
         r.last_token_at = now
         if self.on_token is not None:
             self.on_token(r.uid, tok)
+        # EOS (the on-device flag) / stop sequence (host suffix match)
+        # terminate the request at THIS tick: the stop token is kept in
+        # ``generated``, the dead remainder of the budget never decodes,
+        # and _finish returns the KV blocks and the running slot now
+        if (eos or self._stop_hit(r)) and \
+                len(r.generated) < r.max_new_tokens:
+            r.stopped = True
+            self.early_stops += 1
+            self.dead_tokens_saved += r.max_new_tokens - len(r.generated)
         if r.done:
             self._finish(r, now)
 
@@ -492,6 +550,11 @@ class ContinuousBatchingScheduler:
             reqs = []
             for r in self.active:
                 if r.state != RUNNING:
+                    continue
+                # constrained rows (ISSUE 16): a logit_mask changes the
+                # target chain per step, which drafters can't see — masked
+                # requests decode one token at a time
+                if r.sampling is not None and r.sampling.logit_mask is not None:
                     continue
                 # cap the draft width so an accepted run can never emit
                 # past max_new_tokens or write past max_seq_len
@@ -630,6 +693,11 @@ class ContinuousBatchingScheduler:
             got = eng.acquire_prefix(r.uid, r.prefill_target)
             assert got == hit, (r.uid, got, hit)
             r.prefill_done = hit
+            # one-dispatch sampling (ISSUE 16): the descriptor exists now
+            # — attach the request's SamplingParams so the sampled step's
+            # per-row operands pick them up from the first chunk onward
+            if r.sampling is not None:
+                eng.configure_sampling(r.uid, r.sampling)
 
         # 3) nothing packable?
         if not decodes and not prefills:
@@ -695,8 +763,24 @@ class ContinuousBatchingScheduler:
         spec_batch = [(r, spec_rows[r.uid]) for r in decodes
                       if r.uid in spec_rows]
         plain = [r for r in decodes if r.uid not in spec_rows]
+        # one-dispatch sampling (ISSUE 16): any participant carrying
+        # SamplingParams flips the WHOLE tick onto step_sampled — greedy
+        # rows inside it are bit-identical to step()'s argmax chain, and
+        # logits never ship to host. A tick with no sampled participant
+        # keeps the historical step() path byte-for-byte.
+        sampled = any(r.sampling is not None
+                      for r in decodes) or any(r.sampling is not None
+                                               for r, _ in prefills)
         t0 = self.clock()
-        if spec_batch:
+        dtoks = ddone = ptoks = pdone = None
+        if sampled:
+            out = eng.step_sampled(
+                [r.uid for r in plain], [r.generated[-1] for r in plain],
+                [(r.uid, c) for r, c in prefills],
+                speculative=[(r.uid, c) for r, c in spec_batch])
+            dtoks, ddone, ptoks, pdone = out[:4]
+            sres = out[4] if spec_batch else []
+        elif spec_batch:
             dlogits, plogits, sres = eng.step(
                 [r.uid for r in plain], [r.generated[-1] for r in plain],
                 [(r.uid, c) for r, c in prefills],
@@ -715,26 +799,46 @@ class ContinuousBatchingScheduler:
 
         # 5) results: decode tokens stream immediately; a verify row
         # streams its accepted drafts plus the verifier's correction/bonus
-        # token (every one the exact greedy chain); a finished prefill
-        # yields the sequence's next token (its FIRST for fresh requests)
+        # token (every one the exact greedy/seeded chain); a finished
+        # prefill yields the sequence's next token (its FIRST for fresh
+        # requests)
         now = self.clock()
         events: list = []
         for i, r in enumerate(plain):
             r.decode_ticks += 1
-            self._emit(r, int(np.argmax(dlogits[i])), now, events)
+            if sampled:
+                self._emit(r, int(dtoks[i]), now, events,
+                           eos=bool(ddone[i]))
+            else:
+                self._emit(r, int(np.argmax(dlogits[i])), now, events)
         for (r, chunk), (a, emitted) in zip(spec_batch, sres):
             j = len(chunk) - 1
             r.decode_ticks += 1
             self.spec_proposed += j
             self.spec_accepted += a
             self.spec_rejected += j - a
+            sp = r.sampling
+            if sp is not None and sp.temperature > 0 and a < j:
+                # the residual-resample event (Leviathan): the chain
+                # replaced the first rejected draft with its own token
+                self.sampling_resamples += 1
+            eos_id = sp.eos_token_id if sp is not None else -1
             for t in emitted:
-                self._emit(r, int(t), now, events)
+                self._emit(r, int(t), now, events,
+                           eos=(eos_id >= 0 and int(t) == eos_id))
+                if r.done:
+                    # EOS/stop inside the accepted run: the tokens after
+                    # it are dead — never emitted, request already flushed
+                    break
         for i, (r, chunk) in enumerate(prefills):
             r.prefill_done += len(chunk)
             if r.prefill_done == len(r.prefill_target):
                 r.state = RUNNING
-                self._emit(r, int(np.argmax(plogits[i])), now, events)
+                if sampled:
+                    self._emit(r, int(ptoks[i]), now, events,
+                               eos=bool(pdone[i]))
+                else:
+                    self._emit(r, int(np.argmax(plogits[i])), now, events)
         events += [
             ("serving/queue_depth", len(self.queue), self.ticks),
             ("serving/running", len(decodes), self.ticks),
@@ -768,6 +872,20 @@ class ContinuousBatchingScheduler:
                 ("speculative/acceptance_rate",
                  self.spec_accepted / max(1, self.spec_proposed), self.ticks),
                 ("speculative/rollbacks", eng.spec_rollbacks, self.ticks),
+            ]
+        if self.sampling_seen:
+            # sampling group (cumulative; ISSUE 16): early_stops counts
+            # EOS/stop-sequence terminations, dead_tokens_saved the budget
+            # tokens they never decoded (the goodput lever), resamples the
+            # speculative residual-resample events at temperature>0, and
+            # early_stop_freed_blocks the KV the stops returned early
+            events += [
+                ("sampling/early_stops", self.early_stops, self.ticks),
+                ("sampling/dead_tokens_saved", self.dead_tokens_saved,
+                 self.ticks),
+                ("sampling/resamples", self.sampling_resamples, self.ticks),
+                ("sampling/early_stop_freed_blocks",
+                 eng.early_stop_freed_blocks, self.ticks),
             ]
         if self.tier is not None:
             # tiered-KV group (ISSUE 15): spill/fetch traffic, prefetch
@@ -881,6 +999,10 @@ class ContinuousBatchingScheduler:
                 f"bigger replica")
         r.state = QUEUED
         r.prefill_done = 0
+        if r.sampling is not None:
+            # the seed rides the request (ISSUE 16): its re-prefill replay
+            # resumes the SAME seeded chain at the same absolute positions
+            self.sampling_seen = True
         self.requests[r.uid] = r
         if front:
             self.queue.appendleft(r)
@@ -932,6 +1054,9 @@ class ContinuousBatchingScheduler:
                 f"={self.cfg.max_running}; requeue uid {r.uid} instead")
         r.state = RUNNING
         r.prefill_done = len(r.prompt) + len(r.generated)
+        if r.sampling is not None:
+            self.sampling_seen = True
+            self.engine.configure_sampling(r.uid, r.sampling)
         self.requests[r.uid] = r
         self.active.append(r)
 
@@ -993,15 +1118,20 @@ class ContinuousBatchingScheduler:
     def serve(self, requests: Sequence[Union[Sequence[int], Tuple[Sequence[int], int]]],
               max_new_tokens: int = 32,
               arrivals: Optional[Sequence[float]] = None,
-              deadline_s: Optional[float] = None) -> Dict[int, List[int]]:
+              deadline_s: Optional[float] = None,
+              sampling: Optional[Union[SamplingParams,
+                                       Sequence[Optional[SamplingParams]]]]
+              = None) -> Dict[int, List[int]]:
         """Serve a batch of requests to completion, continuous-batching
         style. ``requests``: prompts, or ``(prompt, max_new)`` pairs.
         ``arrivals``: optional arrival offsets in seconds (e.g. a Poisson
         trace) — request i is submitted once ``clock() - t0 >=
         arrivals[i]``; None submits everything up front. ``deadline_s``
         applies one per-request deadline to every submission (an expired
-        request FAILS with its partial tokens retained). Returns
-        ``{uid: generated tokens}`` in submission order."""
+        request FAILS with its partial tokens retained). ``sampling``
+        (ISSUE 16): one SamplingParams for every request, or a per-request
+        sequence (None entries run greedy). Returns ``{uid: generated
+        tokens}`` in submission order."""
         items = []
         for req in requests:
             if (isinstance(req, tuple) and len(req) == 2
@@ -1011,15 +1141,22 @@ class ContinuousBatchingScheduler:
                 items.append((list(req), int(max_new_tokens)))
         if arrivals is not None and len(arrivals) != len(items):
             raise ValueError("arrivals must align with requests")
+        if isinstance(sampling, SamplingParams) or sampling is None:
+            samplings: List[Optional[SamplingParams]] = [sampling] * len(items)
+        else:
+            samplings = list(sampling)
+            if len(samplings) != len(items):
+                raise ValueError("sampling must align with requests")
         pending = deque(enumerate(items))
         t0 = self.clock()
         uids: List[int] = []
         while pending or self.active or self.queue or self.parked:
             while pending and (arrivals is None
                                or self.clock() - t0 >= arrivals[pending[0][0]]):
-                _, (prompt, mn) = pending.popleft()
+                i, (prompt, mn) = pending.popleft()
                 uids.append(self.submit(prompt, max_new_tokens=mn,
-                                        deadline_s=deadline_s))
+                                        deadline_s=deadline_s,
+                                        sampling=samplings[i]))
             if not self.tick() and pending and arrivals is not None:
                 # idle: sleep until the next arrival is due (clock() may be
                 # a test fake, so never pass a negative to sleep)
@@ -1112,5 +1249,15 @@ class ContinuousBatchingScheduler:
                 "steps_per_emitted_token": (
                     sum(r.decode_ticks for r in done) / total if total
                     else None),
+            },
+            # one-dispatch sampling (ISSUE 16): early-stop effectiveness —
+            # dead_tokens_saved is decode budget EOS/stop returned to the
+            # pool, early_stop_freed_blocks the KV it released early
+            "sampling": {
+                "seen": self.sampling_seen,
+                "early_stops": self.early_stops,
+                "dead_tokens_saved": self.dead_tokens_saved,
+                "resamples": self.sampling_resamples,
+                "early_stop_freed_blocks": eng.early_stop_freed_blocks,
             },
         }

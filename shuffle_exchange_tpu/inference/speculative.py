@@ -2,10 +2,17 @@
 
 The scheduler's speculative tick needs k candidate continuations per
 running sequence; the verifier is the engine's own mixed-batch extend
-path (engine_v2._spec_step_impl), so a drafter only has to PROPOSE — the
-acceptance contract (greedy: longest draft prefix matching the verifier
-argmax chain) is enforced entirely on the target engine. Two sources,
-both behind ``serving.speculative``:
+path (engine_v2._spec_step_impl / _spec_sampled_impl), so a drafter only
+has to PROPOSE — the acceptance contract is enforced entirely on the
+target engine: the longest draft prefix matching the target's own token
+chain (the greedy argmax chain at temperature 0, the seeded Gumbel
+sampling chain under ISSUE 16's per-request SamplingParams). Both
+drafters here are DETERMINISTIC (point-mass proposals), for which
+chain-prefix matching is exactly the Leviathan/Chen speculative-sampling
+accept rule — a proposal is accepted iff the target chain would have
+emitted it, and the first rejected slot's chain token is the residual
+resample — so speculation changes nothing about the emitted distribution
+at any temperature. Two sources, both behind ``serving.speculative``:
 
   - :class:`NGramDrafter` — self-speculation / prompt-lookup (the LLMA /
     prompt-lookup-decoding idiom): match the sequence's trailing n-gram

@@ -300,6 +300,17 @@ def decode_fusion_eligibility(cfg: "TransformerConfig",
     explicit: with ``speculative_k > 0`` the verify rows must take the
     paged-EXTEND path (the chunked-prefill kernel, which is multi-token
     by construction), and only plain 1-token decode rows stay fused.
+
+    One-dispatch sampling (ISSUE 16) does not change this
+    classification: the fused sampler
+    (``inference/sampling.py::seeded_tokens``) composes AFTER the layer
+    stack, on the gathered final-position logits, inside the same
+    compiled program — so every sampling mode (greedy, temperature/
+    top-k/top-p, logit-masked, EOS early-stop) keeps whatever fused
+    decode path the structure earns here. The only sampling-adjacent
+    routing change is the one speculation already imposes: sampled
+    verify rows are still ``k+1`` tokens wide and still take the
+    paged-extend route per the ``"verify"`` entry.
     """
     from ..ops.fused_decode import FUSABLE_ACTIVATIONS
 

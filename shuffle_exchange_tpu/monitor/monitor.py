@@ -309,6 +309,24 @@ class FleetMonitor(Monitor):
                 spec["accepted"] / spec["proposed"]
                 if spec.get("proposed") else None)
             out["speculative"] = spec
+        # one-dispatch sampling (ISSUE 16): same cumulative-sum discipline
+        # for the scheduler's sampling/* counters (the group only appears
+        # once some request actually carried SamplingParams — greedy
+        # fleets publish no sampling aggregate at all)
+        samp = {}
+        for key in ("early_stops", "dead_tokens_saved", "resamples",
+                    "early_stop_freed_blocks"):
+            total, seen = 0, False
+            for r in sorted(self._replica_ids):
+                label = f"replica{r}/sampling/{key}"
+                vals = [v for lbl, v, _ in events if lbl == label]
+                if vals:
+                    total += vals[-1]
+                    seen = True
+            if seen:
+                samp[key] = total
+        if samp:
+            out["sampling"] = samp
         # fleet fault tolerance (ISSUE 12): the router writes the
         # fleet/health/*, failover/* and shed/* counter groups straight
         # into the ring (they are fleet-level, not per-replica); the
@@ -338,6 +356,9 @@ class FleetMonitor(Monitor):
                    for r, v in (agg.get("weight_version") or {}).items()]
         events += [(f"fleet/speculative/{k}", v, self._step)
                    for k, v in (agg.get("speculative") or {}).items()
+                   if isinstance(v, (int, float))]
+        events += [(f"fleet/sampling/{k}", v, self._step)
+                   for k, v in (agg.get("sampling") or {}).items()
                    if isinstance(v, (int, float))]
         # fault-tolerance groups (ISSUE 12) ride downstream under fleet/*
         # namespacing (health labels are already fleet/health/<k> in the

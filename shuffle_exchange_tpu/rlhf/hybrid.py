@@ -18,10 +18,12 @@ allgather + LoRA fuse + host-offload join) and a two-phase fleet publish
 that never tears down KV pools or compiled programs — a warmed fleet
 stays zero-recompile across any number of flips.
 
-Every rollout is recorded ``(prompt, sampled tokens, weight_version)`` in
-a :class:`rlhf.loop.ReplayLog`; greedy scheduling makes the replay
-bit-exact at the recorded version (the drain-replay discipline applied to
-RLHF debugging).
+Every rollout is recorded ``(prompt, sampled tokens, weight_version,
+sampling)`` in a :class:`rlhf.loop.ReplayLog`; greedy scheduling is
+deterministic and sampled scheduling is seeded (the fused in-dispatch
+Gumbel chain is a pure function of seed and position), so the replay is
+bit-exact at the recorded version either way (the drain-replay
+discipline applied to RLHF debugging).
 """
 
 from __future__ import annotations
@@ -329,26 +331,53 @@ class HybridEngineV2:
                              "[B, T] prompt array")
         return [[int(t) for t in p] for p in prompts]
 
+    @staticmethod
+    def _normalize_sampling(sampling, n: int) -> List[Optional[object]]:
+        """One SamplingParams broadcast to every prompt, or a per-prompt
+        sequence (None entries = greedy); length-checked."""
+        from ..inference.config import SamplingParams
+
+        if sampling is None:
+            return [None] * n
+        if isinstance(sampling, SamplingParams):
+            return [sampling] * n
+        sps = list(sampling)
+        if len(sps) != n:
+            raise ValueError(f"sampling sequence has {len(sps)} entries "
+                             f"for {n} prompts")
+        for sp in sps:
+            if sp is not None and not isinstance(sp, SamplingParams):
+                raise TypeError(f"sampling entries must be SamplingParams "
+                                f"or None, got {type(sp).__name__}")
+        return sps
+
     def rollout(self, prompts, max_new_tokens: Optional[int] = None,
                 prompt_lengths=None, session_ids=None,
-                record: bool = True) -> List[RolloutRecord]:
+                record: bool = True, sampling=None) -> List[RolloutRecord]:
         """Generate rollouts with the CURRENT training weights through the
         scheduler-driven fleet (continuous batching; shared-prompt batches
         hit the prefix cache, speculative drafters ride the serving
         config). Publishes first if an optimizer step ran since the last
-        flip. Every rollout is recorded ``(prompt, tokens,
-        weight_version)`` in the replay log (``record=False`` skips the
-        log, not the metering). Returns the records in submission order."""
+        flip. ``sampling`` is one :class:`SamplingParams` for every
+        prompt or a per-prompt sequence (None = greedy); the request's
+        ``to_wire()`` dict (seed included) rides each record so sampled
+        rollouts replay bit-exactly. Every rollout is recorded
+        ``(prompt, tokens, weight_version, sampling)`` in the replay log
+        (``record=False`` skips the log, not the metering). Returns the
+        records in submission order."""
         t0 = self.clock()
         version = self.publish_weights()
         plist = self._normalize_prompts(prompts, prompt_lengths)
+        sps = self._normalize_sampling(sampling, len(plist))
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self._inference_config().max_new_tokens)
         out = self.router.serve(plist, max_new_tokens=max_new,
-                                session_ids=session_ids)
+                                session_ids=session_ids, sampling=sps)
         records = [RolloutRecord(prompt=p, tokens=list(toks),
-                                 weight_version=version, uid=uid)
-                   for (uid, toks), p in zip(out.items(), plist)]
+                                 weight_version=version, uid=uid,
+                                 sampling=None if sp is None
+                                 else sp.to_wire())
+                   for (uid, toks), p, sp in zip(out.items(), plist, sps)]
         if record:
             self.replay_log.extend(records)
         dt = self.clock() - t0
@@ -360,50 +389,77 @@ class HybridEngineV2:
                      self.generate_calls)])
         return records
 
+    def _generate_seed(self, seed, rng) -> int:
+        """Base seed for a generate() call: explicit ``seed`` wins, then
+        a value drawn from ``rng`` (numpy Generator/RandomState or a JAX
+        PRNG key), then the serving config's ``sampling.seed``."""
+        if seed is not None:
+            return int(seed)
+        if rng is not None:
+            if hasattr(rng, "integers"):          # np.random.Generator
+                return int(rng.integers(0, 2**31 - 1))
+            if hasattr(rng, "randint"):           # np.random.RandomState
+                return int(rng.randint(0, 2**31 - 1))
+            import jax
+
+            return int(np.asarray(
+                jax.random.randint(rng, (), 0, 2**31 - 1)))
+        return int(self._inference_config().sampling.seed)
+
     def generate(self, input_ids, prompt_lengths=None,
                  max_new_tokens: Optional[int] = None,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 eos_token_id: Optional[int] = None, rng=None, **kwargs):
+                 eos_token_id: Optional[int] = None,
+                 seed: Optional[int] = None, stop=None, rng=None, **kwargs):
         """v1-shaped rollout API: right-padded int32 [B, T] prompts in,
-        int32 [B, max_new_tokens] greedy tokens out — but served by the
-        fleet scheduler instead of the v1 whole-batch generate loop.
+        int32 [B, max_new_tokens] tokens out — served by the fleet
+        scheduler instead of the v1 whole-batch generate loop.
 
-        The v1 sampling kwargs are accepted at their GREEDY no-op values
-        only (the scheduler's token-parity and replay contracts are
-        greedy, and it never stops at EOS): anything else raises a
-        targeted error instead of silently changing semantics — callers
-        that need sampled or EOS-stopped rollouts should drive a v1
-        ``InferenceEngine`` on ``module_weights()`` directly."""
+        The v1 sampling kwargs map onto per-request
+        :class:`SamplingParams` (ISSUE 16's fused in-dispatch sampler):
+        ``temperature``/``top_k``/``top_p`` shape the distribution,
+        ``eos_token_id``/``stop`` enable early termination, and each row
+        ``i`` samples under seed ``base + i`` (``base`` = explicit
+        ``seed``, else drawn from ``rng``, else the serving config's
+        ``sampling.seed``) so the whole batch replays bit-exactly from
+        the recorded per-row seeds. Rows that stop early are right-padded
+        with ``eos_token_id`` (0 when no EOS is set) to keep the fixed
+        [B, max_new_tokens] shape."""
         if kwargs:
             raise TypeError(f"HybridEngineV2.generate: unsupported kwargs "
                             f"{sorted(kwargs)}")
-        if temperature not in (None, 0, 0.0):
-            raise ValueError(
-                f"HybridEngineV2.generate decodes greedily (the fleet "
-                f"scheduler's parity/replay contract): temperature="
-                f"{temperature!r} is not supported — use a v1 "
-                "InferenceEngine on module_weights() for sampled rollouts")
-        if top_k not in (None, 0) or top_p not in (None, 1, 1.0):
-            raise ValueError(
-                f"HybridEngineV2.generate decodes greedily: top_k={top_k!r}"
-                f"/top_p={top_p!r} are not supported — use a v1 "
-                "InferenceEngine on module_weights() for sampled rollouts")
-        if eos_token_id not in (None, -1):
-            raise ValueError(
-                f"HybridEngineV2.generate emits exactly max_new_tokens "
-                f"(the scheduler has no EOS early-stop): eos_token_id="
-                f"{eos_token_id!r} is not supported — trim at EOS on the "
-                "host, or drive a v1 InferenceEngine directly")
-        # rng is accepted and unused: greedy decoding draws no randomness
-        records = self.rollout(input_ids, max_new_tokens=max_new_tokens,
-                               prompt_lengths=prompt_lengths)
-        return np.asarray([r.tokens for r in records], dtype=np.int32)
+        from ..inference.config import SamplingParams
+
+        temp = float(temperature) if temperature is not None else 0.0
+        tk = int(top_k) if top_k is not None else 0
+        tp = float(top_p) if top_p is not None else 1.0
+        eos = int(eos_token_id) if eos_token_id is not None else -1
+        stops = tuple(tuple(int(t) for t in s) for s in (stop or ()))
+        plist = self._normalize_prompts(input_ids, prompt_lengths)
+        sampled = (temp > 0.0 or tk > 0 or tp < 1.0 or eos >= 0 or stops
+                   or seed is not None or rng is not None)
+        sps = None
+        if sampled:
+            base = self._generate_seed(seed, rng)
+            sps = [SamplingParams(temperature=temp, top_k=tk, top_p=tp,
+                                  seed=base + i, eos_token_id=eos,
+                                  stop=stops)
+                   for i in range(len(plist))]
+        records = self.rollout(plist, max_new_tokens=max_new_tokens,
+                               sampling=sps)
+        width = int(max_new_tokens if max_new_tokens is not None
+                    else self._inference_config().max_new_tokens)
+        pad = eos if eos >= 0 else 0
+        return np.asarray([list(r.tokens) + [pad] * (width - len(r.tokens))
+                           for r in records], dtype=np.int32)
 
     def replay(self, rec: RolloutRecord) -> List[int]:
-        """Bit-exact replay of a recorded rollout: re-serve its prompt
-        greedily at the SAME weight version and return the tokens (the
-        drain-replay discipline — greedy scheduling is deterministic, so
+        """Bit-exact replay of a recorded rollout: re-serve its prompt at
+        the SAME weight version under the record's ``sampling`` wire dict
+        (None = greedy) and return the tokens (the drain-replay
+        discipline — greedy scheduling is deterministic and the sampled
+        chain is a pure function of the recorded seed and position, so
         the replay reproduces the recording token for token). Refuses
         when the fleet has moved past the record's version — replaying
         old rollouts on new weights would silently "reproduce" different
@@ -416,8 +472,14 @@ class HybridEngineV2:
                 f"{rec.weight_version}: the fleet serves version {version} "
                 "(replay before training past the recording, or keep a "
                 "checkpoint of that version)")
+        sp = None
+        if rec.sampling is not None:
+            from ..inference.config import SamplingParams
+
+            sp = SamplingParams.from_wire(rec.sampling)
         out = self.router.serve([rec.prompt],
-                                max_new_tokens=max(1, len(rec.tokens)))
+                                max_new_tokens=max(1, len(rec.tokens)),
+                                sampling=sp)
         return next(iter(out.values()))
 
     # -- meters --------------------------------------------------------

@@ -18,9 +18,11 @@ reused verbatim — only the loss function differs, passed to
   trains, so its term is data, not graph).
 
 Replay discipline: every rollout is a :class:`RolloutRecord`
-``(prompt, sampled tokens, weight_version)`` in a :class:`ReplayLog`.
-Greedy fleet scheduling is deterministic, so any record can be replayed
-bit-exactly at its recorded weight version
+``(prompt, sampled tokens, weight_version, sampling)`` in a
+:class:`ReplayLog`. Greedy fleet scheduling is deterministic, and
+sampled scheduling is seeded (ISSUE 16's per-request Gumbel chain is a
+pure function of ``(seed, position, distribution)``), so any record can
+be replayed bit-exactly at its recorded weight version
 (``HybridEngineV2.replay`` / ``ReplayLog.verify``) — the same
 token-identical contract the serving drain/requeue path keeps, applied
 to RLHF debugging ("which weights sampled this token, and can I
@@ -40,13 +42,19 @@ import numpy as np
 class RolloutRecord:
     """One rollout: the prompt, what the policy sampled, and the exact
     weight version it sampled under. ``reward`` is filled by the scorer;
-    ``uid`` is the fleet uid that served it (debugging breadcrumb)."""
+    ``uid`` is the fleet uid that served it (debugging breadcrumb).
+    ``sampling`` is the request's ``SamplingParams.to_wire()`` dict
+    (None = greedy) — together with ``weight_version`` it is everything
+    replay needs to reproduce a SAMPLED chain bit-exactly, because the
+    seed rides in the wire dict and the engine's per-token Gumbel noise
+    is keyed only on ``(seed, absolute position)``."""
 
     prompt: List[int]
     tokens: List[int]
     weight_version: int
     reward: Optional[float] = None
     uid: Optional[int] = None
+    sampling: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -55,16 +63,18 @@ class RolloutRecord:
     def from_json(cls, d: dict) -> "RolloutRecord":
         return cls(**{k: d.get(k) for k in
                       ("prompt", "tokens", "weight_version", "reward",
-                       "uid")})
+                       "uid", "sampling")})
 
 
 class ReplayLog:
     """Append-only token-identical replay log (JSONL-serializable).
 
     ``verify(hybrid)`` replays every record at the fleet's CURRENT weight
-    version and asserts bit-exact token equality; records from other
-    versions are skipped (they need that version's weights), so the
-    return value distinguishes verified from unverifiable."""
+    version and asserts bit-exact token equality — sampled records
+    replay under their recorded ``sampling`` wire dict (seed included),
+    so stochastic rollouts verify exactly like greedy ones; records from
+    other versions are skipped (they need that version's weights), so
+    the return value distinguishes verified from unverifiable."""
 
     def __init__(self, records: Optional[Sequence[RolloutRecord]] = None):
         self.records: List[RolloutRecord] = list(records or [])

@@ -11,10 +11,12 @@ acceptance bars:
 
 - **zero lost requests**: every non-shed request reaches a terminal
   state, and (with quarantine/deadlines off) every one FINISHES;
-- **token parity**: every finished request's tokens are identical to a
-  sequential single-engine reference — greedy decoding makes the
-  crash-replay deterministic, so unclean failure costs latency, never
-  output fidelity;
+- **token parity**: every finished request's tokens are identical to the
+  drill's oracle — a sequential single-engine greedy reference, or, when
+  the drill runs SAMPLED (ISSUE 16), the clean no-kill fleet run under
+  the same per-request seeds (the seeded Gumbel chain is deterministic,
+  so stochastic decoding keeps the same bar: unclean failure costs
+  latency, never output fidelity);
 - **ACTIVE-only recovery**: once the trace drains, every non-stopped
   replica is healthy (no SUSPECT residue, every DEAD replica fenced and
   failed over);
@@ -72,10 +74,11 @@ def _poisson_arrivals(n: int, span_s: float, rng) -> List[float]:
 
 
 def _serve_clean(engine_factory, n_replicas: int,
-                 prompts, arrivals, max_new: int) -> Dict[str, object]:
+                 prompts, arrivals, max_new: int,
+                 sampling=None) -> Dict[str, object]:
     router = ReplicaRouter([engine_factory() for _ in range(n_replicas)])
     out = router.serve(prompts, max_new_tokens=max_new,
-                       arrivals=list(arrivals))
+                       arrivals=list(arrivals), sampling=sampling)
     st = router.stats()
     return {"tokens": [out[u] for u in out], "stats": st}
 
@@ -108,6 +111,7 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
                     require_migration: bool = False,
                     timeout_s: float = 180.0,
                     arm_wait_s: float = 15.0,
+                    sampling=None,
                     check: bool = True) -> Dict[str, object]:
     """Run the drill; returns a machine-readable report (and raises
     ``AssertionError`` on a violated bar unless ``check=False``).
@@ -123,7 +127,11 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
     with zero re-prefill tokens (arm a hang against a replica holding
     RUNNING work). ``arm_wait_s`` bounds the wait for the kill target to
     hold (RUNNING) work before arming — raise it on cold caches, where a
-    tick can sit in a multi-second compile.
+    tick can sit in a multi-second compile. ``sampling`` (ISSUE 16): one
+    ``SamplingParams`` for every request or a per-request sequence; the
+    parity oracle then becomes the clean no-kill fleet run under the
+    SAME seeds (the sequential greedy reference no longer applies), so
+    the drill proves seed-carrying failover end to end.
 
     Sizing ``router.tick_timeout_s`` for the drill host matters: the
     injected hang parks FOREVER, so a generous threshold only delays
@@ -146,20 +154,34 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
     prompts = [rng.integers(1, vocab, size=int(n)).tolist()
                for n in rng.integers(prompt_lo, prompt_hi + 1,
                                      size=n_requests)]
-    reference = _reference_tokens(engine_factory, prompts, max_new)
+    if sampling is None or not isinstance(sampling, (list, tuple)):
+        samplings = [sampling] * n_requests
+    else:
+        samplings = list(sampling)
+        if len(samplings) != n_requests:
+            raise ValueError("sampling must align with n_requests")
+    sampled = any(sp is not None for sp in samplings)
 
     # clean run calibrates the arrival span AND the TTFT baseline: total
     # service time / 2 offers ~2x capacity, the heavy-traffic regime
     probe = ReplicaRouter([engine_factory() for _ in range(n_replicas)])
-    probe.serve(prompts, max_new_tokens=max_new)
+    probe.serve(prompts, max_new_tokens=max_new, sampling=samplings)
     cap = probe.stats()["sustained_tokens_per_sec"] or 1.0
     span = n_requests * max_new / cap / 2.0
     arrivals = _poisson_arrivals(n_requests, span, rng)
     clean = _serve_clean(engine_factory, n_replicas, prompts, arrivals,
-                         max_new)
-    assert clean["tokens"] == reference, (
-        "clean fleet run diverges from the sequential reference — fix "
-        "serving before drilling faults")
+                         max_new, sampling=samplings)
+    if sampled:
+        # seeded drill (ISSUE 16): the per-request Gumbel chain is a pure
+        # function of (seed, position, weights), so the clean no-kill run
+        # IS the oracle — a sequential greedy reference would assert the
+        # wrong distribution
+        reference = clean["tokens"]
+    else:
+        reference = _reference_tokens(engine_factory, prompts, max_new)
+        assert clean["tokens"] == reference, (
+            "clean fleet run diverges from the sequential reference — fix "
+            "serving before drilling faults")
 
     # ---- chaos run ----------------------------------------------------
     router = ReplicaRouter([engine_factory() for _ in range(n_replicas)],
@@ -210,7 +232,8 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
                 try:
                     uids.append(router.submit(prompts[i],
                                               max_new_tokens=max_new,
-                                              deadline_s=deadline_s))
+                                              deadline_s=deadline_s,
+                                              sampling=samplings[i]))
                 except LoadShedError:
                     uids.append(None)
                     shed += 1
@@ -274,6 +297,11 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
                        if clean_p95 and chaos_p95 else None),
         "goodput_clean": clean["stats"]["sustained_tokens_per_sec"],
         "goodput_chaos": st["sustained_tokens_per_sec"],
+        # ISSUE 16: whether this drill exercised seeded sampling (the
+        # oracle was the clean seeded run) plus the fleet's sampling
+        # counters from the chaos run
+        "sampled": sampled,
+        "sampling": st["sampling"],
     }
     san_new = sanitizer.reports()[san_before:]
     report["sanitizer"] = {

@@ -283,7 +283,8 @@ class ReplicaRouter:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                session_id: Optional[object] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               sampling=None) -> int:
         """Route one request; returns its fleet-global uid. When NO active
         replica can ever take the request, the error aggregates every
         replica's own needed-vs-free numbers (the ``_admission_detail``
@@ -292,7 +293,10 @@ class ReplicaRouter:
         typed ``LoadShedError`` once the fleet's total queued requests
         cross the bound (ISSUE 12) — a loud early refusal instead of a
         silent deadline miss later. ``deadline_s`` rides to the
-        scheduler's per-request deadline."""
+        scheduler's per-request deadline; ``sampling`` (ISSUE 16) rides
+        per-request :class:`SamplingParams` to whichever replica the
+        request lands on — the seed travels WITH the request, so drains
+        and failovers replay the same chain on the survivor."""
         with self._lock:
             bound = self.rcfg.shed_queue_depth
             if bound:
@@ -313,7 +317,8 @@ class ReplicaRouter:
                     rep.scheduler.submit(prompt,
                                          max_new_tokens=max_new_tokens,
                                          uid=uid,
-                                         deadline_s=deadline_s)
+                                         deadline_s=deadline_s,
+                                         sampling=sampling)
             # RuntimeError included (ISSUE 12): the placed replica may
             # have been fenced/drained between place() and the lock — a
             # draining refusal is retryable on the survivors
@@ -329,7 +334,8 @@ class ReplicaRouter:
                         with other.lock:
                             other.scheduler.submit(
                                 prompt, max_new_tokens=max_new_tokens,
-                                uid=uid, deadline_s=deadline_s)
+                                uid=uid, deadline_s=deadline_s,
+                                sampling=sampling)
                         rep = other
                         break
                     except (ValueError, RuntimeError) as e:
@@ -576,7 +582,11 @@ class ReplicaRouter:
                     decode_ticks=old.decode_ticks,
                     deadline_s=old.deadline_s,
                     retries=old.retries,
-                    replica_deaths=old.replica_deaths)
+                    replica_deaths=old.replica_deaths,
+                    # ISSUE 16: the seed travels with the victim, so the
+                    # survivor's replay re-samples the identical chain
+                    sampling=old.sampling,
+                    stopped=old.stopped)
                 self.requests[uid] = snap
                 if mid_exec:
                     snap.replica_deaths += 1
@@ -724,14 +734,17 @@ class ReplicaRouter:
               max_new_tokens: int = 32,
               arrivals: Optional[Sequence[float]] = None,
               session_ids: Optional[Sequence[object]] = None,
-              deadline_s: Optional[float] = None
+              deadline_s: Optional[float] = None,
+              sampling=None
               ) -> Dict[int, List[int]]:
         """Serve a batch to completion across the fleet — the scheduler's
         Poisson-trace ``serve`` contract, routed. Returns ``{uid: tokens}``
         in submission order (a FAILED request contributes its partial
         tokens; check ``requests[uid].state``/``.error`` for the verdict).
         Results survive mid-serve drains AND failovers: the router tracks
-        the live ``ServingRequest`` objects, wherever they run."""
+        the live ``ServingRequest`` objects, wherever they run.
+        ``sampling`` (ISSUE 16): one ``SamplingParams`` for every request
+        or a per-request sequence (None entries = greedy)."""
         items = []
         for req in requests:
             if (isinstance(req, tuple) and len(req) == 2
@@ -743,6 +756,12 @@ class ReplicaRouter:
             raise ValueError("arrivals must align with requests")
         if session_ids is not None and len(session_ids) != len(items):
             raise ValueError("session_ids must align with requests")
+        if sampling is None or not isinstance(sampling, (list, tuple)):
+            samplings = [sampling] * len(items)
+        else:
+            samplings = list(sampling)
+            if len(samplings) != len(items):
+                raise ValueError("sampling must align with requests")
         pending = deque(enumerate(items))
         t0 = self.clock()
         uids: List[int] = []
@@ -754,7 +773,8 @@ class ReplicaRouter:
                 sid = session_ids[i] if session_ids is not None else None
                 uids.append(self.submit(prompt, max_new_tokens=mn,
                                         session_id=sid,
-                                        deadline_s=deadline_s))
+                                        deadline_s=deadline_s,
+                                        sampling=samplings[i]))
             if not self.tick() and pending and arrivals is not None:
                 wait = arrivals[pending[0][0]] - (self.clock() - t0)
                 if wait > 0:
@@ -1094,6 +1114,9 @@ class ReplicaRouter:
             # replicas; acceptance_rate re-derived from the sums so it is
             # token-weighted, not an average of per-replica averages
             "speculative": self._spec_aggregate(),
+            # one-dispatch sampling (ISSUE 16): fleet-summed early-stop /
+            # resample accounting, same sums-not-averages discipline
+            "sampling": self._sampling_aggregate(),
             "kv_tier": self._tier_aggregate(),
             "per_replica": [dict(r.scheduler.load(), state=r.state,
                                  preemptions=r.scheduler.preemptions)
@@ -1134,6 +1157,19 @@ class ReplicaRouter:
             "rejected": sum(r.scheduler.spec_rejected for r in self.replicas),
             "acceptance_rate": (accepted / proposed) if proposed else None,
             "rollbacks": sum(r.engine.spec_rollbacks for r in self.replicas),
+        }
+
+    def _sampling_aggregate(self) -> Dict[str, object]:
+        return {
+            "seen": any(r.scheduler.sampling_seen for r in self.replicas),
+            "early_stops": sum(r.scheduler.early_stops
+                               for r in self.replicas),
+            "dead_tokens_saved": sum(r.scheduler.dead_tokens_saved
+                                     for r in self.replicas),
+            "resamples": sum(r.scheduler.sampling_resamples
+                             for r in self.replicas),
+            "early_stop_freed_blocks": sum(r.engine.early_stop_freed_blocks
+                                           for r in self.replicas),
         }
 
     def publish(self) -> dict:

@@ -23,7 +23,12 @@ LOCKED_BY_ATTR = "__sxt_locked_by__"
 REQUIRES_LOCK_ATTR = "__sxt_requires_lock__"
 
 #: the default admission-check method names for :func:`atomic_on_reject`
-DEFAULT_ADMISSION_CHECKS = ("_admission_detail", "can_schedule")
+#: (``_admit_step`` is the shared validate+admit front half of
+#: ``step()``/``step_sampled()`` — ISSUE 16; it is itself
+#: ``@atomic_on_reject`` so the checker proves it runs
+#: ``_admission_detail`` before its own descriptor/block mutations)
+DEFAULT_ADMISSION_CHECKS = ("_admission_detail", "can_schedule",
+                            "_admit_step")
 
 #: ``check="validate"`` selects raise-barrier mode: the method must not
 #: mutate ``self`` state on any path where a validation ``raise`` is
@@ -49,6 +54,16 @@ VALIDATE = "validate"
 #: underlying mutex (``KVTransferChannel._cv`` wraps ``._mu``) share a
 #: rank: acquiring one while holding the other is a self-deadlock and
 #: the equal rank refuses it.
+#:
+#: One-dispatch sampling (ISSUE 16) deliberately adds NO rank here: the
+#: device sampler is stateless (`fold_in(PRNGKey(seed), position)` —
+#: the seed is per-request DATA carried on ``ServingRequest``, guarded
+#: like the rest of the request under rank-10 ``Replica.lock`` / rank-0
+#: router bookkeeping), and the new sampling counters are per-replica
+#: scheduler/engine attributes mutated only inside the tick, under the
+#: same rank-10 lock as every other serving counter. A shared host RNG
+#: would have needed a lock AND broken seeded replay; its absence is
+#: the design.
 LOCK_ORDER = {
     # rank 0 — fleet membership/placement/failover bookkeeping. Held
     # across placement decisions and failover re-homing; must NEVER wait

@@ -985,7 +985,8 @@ def test_runtime_markers_are_noops():
         return 43
 
     assert f() == 42 and g() == 43
-    assert f.__sxt_atomic_on_reject__ == ("_admission_detail", "can_schedule")
+    assert f.__sxt_atomic_on_reject__ == ("_admission_detail",
+                                          "can_schedule", "_admit_step")
     assert g.__sxt_atomic_on_reject__ == "begin_import"
 
     @locked_by("_mu", "a", "b")

@@ -364,6 +364,64 @@ def test_rlhf_rollout_row_runs_at_toy_size():
     assert row["weight_version"] == row["train_steps"] - 1
 
 
+@pytest.mark.slow   # ~50s: warm+measure pairs x 3 variants; nightly via ci_full
+def test_serving_sampling_row_runs_at_toy_size():
+    """The config-5 one-dispatch-sampling row (bench.serving_sampling_row)
+    at toy size: the same Poisson trace greedy vs sampled (temp=0.8 /
+    top_p=0.9) vs sampled-with-EOS-stop at identical arrivals — seeded
+    replay verified inside the row, EOS early-stop returning real budget,
+    and the generalized speculative accept at temperature > 0 with
+    spec-on/off parity — runs on CPU, so the published row cannot rot on
+    the driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_sampling_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = serving_sampling_row(model, params, icfg, mcfg.vocab_size,
+                               n_requests=6, prompt_lo=6, prompt_hi=16,
+                               max_new=10, load=2.0, seed=0)
+    # the EOS id really is a token the sampled run emits, so the stop
+    # condition fires (early_stop_fraction > 0) and returns real budget
+    assert row["early_stop_fraction"] > 0
+    assert row["dead_tokens_saved"] > 0
+    assert row["early_stop_freed_blocks"] > 0
+    assert row["sampled_eos"]["emitted_tokens"] < \
+        row["sampled_no_stop"]["emitted_tokens"]
+    # the seeded Gumbel chain: a fresh scheduler re-serving the trace
+    # under the same seeds emitted bit-identical tokens
+    assert row["seeded_replay_verified"] is True
+    # the generalized accept rule at temperature > 0: the target-as-draft
+    # side trace accepts real drafts, resamples on rejects, and spec
+    # on/off emit identical seeded chains
+    assert row["spec_acceptance_at_temp"] is not None
+    assert row["spec_acceptance_at_temp"] > 0
+    assert row["spec_resamples"] > 0
+    assert row["spec_token_parity_at_temp"] is True
+    for v in ("greedy", "sampled_no_stop", "sampled_eos"):
+        assert row[v]["sustained_tokens_per_sec"] > 0
+        assert row[v]["ttft_p50_s"] > 0
+    assert row["sampling_overhead_x"] > 0
+    assert row["goodput_eos_vs_no_stop_x"] > 0
+    assert row["trace"]["seed"] == 0 and len(row["trace"]["arrivals_s"]) == 6
+    # the CPU pin asserts structure + determinism contracts; the goodput
+    # HEADLINE (EOS early-stop vs stop-disabled at identical arrivals)
+    # is the driver-box row's to publish — toy wall-clock noise can swamp
+    # the dead-token signal
+
+
 @pytest.mark.slow   # ~60s: 4-pass tier row (ref/cap/baseline/spill); nightly via ci_full
 def test_serving_longctx_row_runs_at_toy_size():
     """The config-5 long-context tier row (bench.serving_longctx_row) at

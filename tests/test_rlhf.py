@@ -399,21 +399,19 @@ class TestShimAndConfig:
         with pytest.raises(TypeError, match="Transformer"):
             HybridEngineV2(stack.engine, object())
 
-    def test_generate_v1_kwargs_greedy_noops_accepted_rest_refused(
-            self, stack):
-        """The v1 sampling kwargs are accepted at their greedy no-op
-        values and refused (named error, no silent semantics change)
-        otherwise — the scheduler's parity/replay contract is greedy and
-        has no EOS early-stop."""
+    def test_generate_v1_kwargs_map_to_seeded_sampling(self, stack):
+        """The v1 sampling kwargs are honored (ISSUE 16): greedy no-op
+        values reproduce the greedy fleet path, and temperature>0 maps
+        onto per-request SamplingParams with row seeds ``base + i`` —
+        so the same explicit seed replays the batch bit-exactly."""
         hy = stack.hy
         prompts = np.asarray([stack.prompts[0][:7],
                               stack.prompts[2][:7]], np.int32)
         out = hy.generate(prompts, max_new_tokens=2, temperature=0.0,
                           top_k=0, top_p=1.0, eos_token_id=-1, rng=None)
         assert out.shape == (2, 2)
-        with pytest.raises(ValueError, match="greedily"):
-            hy.generate(prompts, max_new_tokens=2, temperature=0.7)
-        with pytest.raises(ValueError, match="greedily"):
-            hy.generate(prompts, max_new_tokens=2, top_k=5)
-        with pytest.raises(ValueError, match="EOS"):
-            hy.generate(prompts, max_new_tokens=2, eos_token_id=2)
+        a = hy.generate(prompts, max_new_tokens=2, temperature=0.7,
+                        seed=123)
+        b = hy.generate(prompts, max_new_tokens=2, temperature=0.7,
+                        seed=123)
+        assert a.shape == (2, 2) and np.array_equal(a, b)

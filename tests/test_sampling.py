@@ -1,0 +1,577 @@
+"""One-dispatch sampling (ISSUE 16): fused on-device temperature/top-k/
+top-p decoding, EOS/stop early termination, and seeded replay.
+
+Contracts pinned here:
+  (a) temperature 0 is bit-identical to the historical greedy scheduler
+      (sampling is a degenerate case, not a second path);
+  (b) a sampled chain is a pure function of (seed, absolute position,
+      distribution): deterministic across fresh engines, invariant to
+      batch composition, KV-pressure preemption, and drain-export ->
+      inject requeue — the failover/replay currency of the fleet;
+  (c) the sampled serving step stays ONE dispatch per tick and never
+      ships logits to the host (``sampled_output_shapes`` audit: no
+      output leaf carries a vocab-sized trailing dim);
+  (d) EOS/stop-sequence early termination emits the stop token, frees
+      the request's KV blocks at the stop tick, and accounts the
+      returned decode budget (``dead_tokens_saved``) through the
+      scheduler counters, monitor events, and fleet aggregation;
+  (e) ``logit_mask`` constrains greedy AND sampled rows in-dispatch;
+  (f) speculative decoding under sampling matches the spec-off seeded
+      chain exactly (seeded-chain verification, with resamples).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shuffle_exchange_tpu.config import ConfigError
+from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                            DraftModelDrafter,
+                                            InferenceConfig,
+                                            InferenceEngineV2,
+                                            SamplingParams)
+from shuffle_exchange_tpu.inference.sampling import seeded_tokens
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.monitor import FleetMonitor
+
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    # EXACT tiny-model shapes of tests/test_serving_scheduler.py so the
+    # persistent compile cache is shared across the serving suites
+    cfg = tiny(vocab=VOCAB, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _icfg(num_kv_blocks=40, **serving):
+    serving = {"token_budget": 16, "max_running": 4, "chunk_min": 4,
+               **serving}
+    return InferenceConfig(dtype="float32", max_seq_len=64, kv_block_size=8,
+                           num_kv_blocks=num_kv_blocks, serving=serving)
+
+
+def _prompts(rng, sizes):
+    return [rng.integers(1, 90, size=int(n)).tolist() for n in sizes]
+
+
+def _sps(n, temperature=0.8, top_p=0.9, base_seed=41, **kw):
+    return [SamplingParams(temperature=temperature, top_p=top_p,
+                           seed=base_seed + i, **kw) for i in range(n)]
+
+
+def _serve(model, params, prompts, sampling, max_new=8, icfg=None):
+    eng = InferenceEngineV2(model, params, icfg or _icfg())
+    sched = ContinuousBatchingScheduler(eng)
+    out = sched.serve(prompts, max_new_tokens=max_new, sampling=sampling)
+    return eng, sched, [out[u] for u in out]
+
+
+# ---------------------------------------------------------------------------
+# SamplingParams config surface
+# ---------------------------------------------------------------------------
+
+
+class TestSamplingParams:
+    def test_defaults_are_exactly_greedy(self):
+        sp = SamplingParams()
+        assert sp.greedy
+        assert (sp.temperature, sp.top_k, sp.top_p) == (0.0, 0, 1.0)
+        assert sp.eos_token_id == -1 and sp.stop == ()
+
+    @pytest.mark.parametrize("bad", [
+        {"temperature": -0.1},
+        {"top_k": -1},
+        {"top_k": 2.0},
+        {"top_p": 0.0},
+        {"top_p": 1.5},
+        {"seed": -1},
+        {"seed": 2 ** 31},
+        {"seed": True},
+        {"eos_token_id": -2},
+        {"stop": ((),)},
+        {"logit_mask": 42},
+    ])
+    def test_validation_rejects(self, bad):
+        with pytest.raises(ConfigError):
+            SamplingParams(**bad)
+
+    def test_wire_roundtrip_drops_mask_and_rejects_unknown_keys(self):
+        sp = SamplingParams(temperature=0.7, top_k=5, top_p=0.9, seed=11,
+                            eos_token_id=3, stop=((1, 2),),
+                            logit_mask=lambda hist: np.ones(VOCAB, bool))
+        wire = sp.to_wire()
+        assert "logit_mask" not in wire
+        back = SamplingParams.from_wire(wire)
+        assert back == SamplingParams(temperature=0.7, top_k=5, top_p=0.9,
+                                      seed=11, eos_token_id=3, stop=((1, 2),))
+        assert SamplingParams.from_wire(None) is None
+        with pytest.raises(ConfigError):
+            SamplingParams.from_wire({"temperature": 1.0, "beams": 4})
+
+
+# ---------------------------------------------------------------------------
+# seeded_tokens: the fused per-row sampler (pure, no model)
+# ---------------------------------------------------------------------------
+
+
+def _rows(rng, b=16):
+    logits = jnp.asarray(rng.normal(size=(b, VOCAB)) * 3.0, jnp.float32)
+    seeds = jnp.asarray(rng.integers(0, 2 ** 31, size=b), jnp.int32)
+    pos = jnp.asarray(rng.integers(0, 64, size=b), jnp.int32)
+    return logits, seeds, pos
+
+
+def _call(logits, seeds, pos, T, tk, tp, mask=None):
+    b = logits.shape[0]
+    return np.asarray(seeded_tokens(
+        logits, seeds, pos,
+        jnp.full((b,), T, jnp.float32),
+        jnp.full((b,), tk, jnp.int32),
+        jnp.full((b,), tp, jnp.float32), mask=mask))
+
+
+class TestSeededTokens:
+    def test_temperature_zero_is_argmax_whatever_the_seed(self):
+        rng = np.random.default_rng(0)
+        logits, seeds, pos = _rows(rng)
+        toks = _call(logits, seeds, pos, 0.0, 3, 0.5)
+        assert np.array_equal(toks, np.argmax(np.asarray(logits), axis=-1))
+
+    def test_same_seed_and_position_is_deterministic(self):
+        rng = np.random.default_rng(1)
+        logits, seeds, pos = _rows(rng)
+        a = _call(logits, seeds, pos, 1.0, 0, 1.0)
+        b = _call(logits, seeds, pos, 1.0, 0, 1.0)
+        assert np.array_equal(a, b)
+
+    def test_position_and_seed_both_mix_the_draw(self):
+        rng = np.random.default_rng(2)
+        row = jnp.asarray(rng.normal(size=(1, VOCAB)), jnp.float32)
+        logits = jnp.tile(row, (32, 1))
+        # same seed, marching positions -> the chain moves
+        by_pos = _call(logits, jnp.zeros(32, jnp.int32),
+                       jnp.arange(32, dtype=jnp.int32), 1.5, 0, 1.0)
+        assert len(set(by_pos.tolist())) > 1
+        # same position, different seeds -> independent chains
+        by_seed = _call(logits, jnp.arange(32, dtype=jnp.int32),
+                        jnp.zeros(32, jnp.int32), 1.5, 0, 1.0)
+        assert len(set(by_seed.tolist())) > 1
+
+    def test_top_k_bounds_the_support(self):
+        rng = np.random.default_rng(3)
+        logits, seeds, pos = _rows(rng, b=64)
+        toks = _call(logits, seeds, pos, 1.5, 3, 1.0)
+        top3 = np.argsort(np.asarray(logits), axis=-1)[:, -3:]
+        assert all(t in row for t, row in zip(toks, top3))
+
+    def test_top_p_keeps_the_nucleus_only(self):
+        rng = np.random.default_rng(4)
+        logits, seeds, pos = _rows(rng, b=64)
+        T, tp = 1.0, 0.6
+        toks = _call(logits, seeds, pos, T, 0, tp)
+        lg = np.asarray(logits, np.float64)
+        for i, t in enumerate(toks):
+            order = np.argsort(lg[i])[::-1]
+            p = np.exp(lg[i][order] / T)
+            p /= p.sum()
+            cum = np.cumsum(p)
+            keep = (cum - p) < tp          # rank 0 always kept
+            assert t in order[keep]
+        # a dominant token under a tight nucleus is always emitted
+        peak = np.zeros((8, VOCAB), np.float32)
+        peak[:, 7] = 20.0
+        toks = _call(jnp.asarray(peak), seeds[:8], pos[:8], 1.0, 0, 0.5)
+        assert np.all(toks == 7)
+
+    def test_mask_restricts_greedy_and_sampled_rows(self):
+        rng = np.random.default_rng(5)
+        logits, seeds, pos = _rows(rng, b=32)
+        allowed = np.zeros((32, VOCAB), bool)
+        cols = rng.integers(0, VOCAB, size=(32, 4))
+        np.put_along_axis(allowed, cols, True, axis=1)
+        for T in (0.0, 1.2):
+            toks = _call(logits, seeds, pos, T, 0, 1.0,
+                         mask=jnp.asarray(allowed))
+            assert all(allowed[i, t] for i, t in enumerate(toks))
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: the one-dispatch sampled serving step
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ref(model_and_params):
+    """ONE shared sampled reference run (temp 0.8 / top-p 0.9, seeds
+    41..44). A seeded chain is a pure function of (seed, absolute
+    position, distribution) — invariant to batch composition, pool
+    size, preemption, and drain/requeue — so every integration test
+    below reuses these chains as its oracle; each comparison asserts
+    exactly that invariance (the tier-1 budget discipline: one
+    reference serve, many contracts)."""
+    from types import SimpleNamespace
+
+    model, params = model_and_params
+    prompts = _prompts(np.random.default_rng(4), (10, 18, 7, 13))
+    sps = _sps(4)
+    _, _, chains = _serve(model, params, prompts, sps, max_new=10)
+    return SimpleNamespace(prompts=prompts, sps=sps, chains=chains,
+                           max_new=10)
+
+
+class TestServeSampled:
+    def test_temperature_zero_bit_identical_to_greedy(self, model_and_params):
+        """The acceptance bar: a temp-0 SamplingParams run produces the
+        EXACT tokens of the unsampled greedy scheduler — sampling rides
+        the same fused program with the sampler degenerate at T=0."""
+        model, params = model_and_params
+        prompts = _prompts(np.random.default_rng(0), (12, 5))
+        _, _, want = _serve(model, params, prompts, None, max_new=6)
+        eng, _, got = _serve(
+            model, params, prompts,
+            [SamplingParams(temperature=0.0, seed=i) for i in range(2)],
+            max_new=6)
+        assert got == want
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_seeded_chain_batch_invariant_one_dispatch_no_logits(
+            self, model_and_params, ref):
+        """A fresh engine serving a DIFFERENT batch (a duplicate prompt
+        under a new seed wedged in) reproduces the reference chains
+        bit-exactly — and the duplicate's new seed moves its chain.
+        Along the way: sampled ticks stay ONE dispatch each, and the
+        audit trail proves no dispatch output carries a vocab-sized
+        trailing dim — tokens, not logits, cross the device boundary."""
+        model, params = model_and_params
+        prompts = [ref.prompts[0], ref.prompts[0], ref.prompts[1]]
+        sps = [ref.sps[0],
+               SamplingParams(temperature=0.8, top_p=0.9, seed=9999),
+               ref.sps[1]]
+        eng, sched, got = _serve(model, params, prompts, sps,
+                                 max_new=ref.max_new)
+        assert got[0] == ref.chains[0]
+        assert got[2] == ref.chains[1]
+        assert got[1] != got[0], "a different seed must move the chain"
+        assert eng.dispatch_count == sched.ticks
+        assert eng.sampled_output_shapes, "sampled dispatches must audit"
+        assert any(k[0] == "mixed" for k in eng.sampled_output_shapes)
+        for shapes in eng.sampled_output_shapes.values():
+            assert all(not (s and s[-1] == VOCAB) for s in shapes)
+
+    def test_submit_rejects_non_params_and_inherits_config_default(
+            self, model_and_params, ref):
+        model, params = model_and_params
+        icfg = InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40,
+            serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+            sampling={"temperature": 0.8, "top_p": 0.9, "seed": 41})
+        assert icfg.sampling == ref.sps[0]
+        eng = InferenceEngineV2(model, params, icfg)
+        sched = ContinuousBatchingScheduler(eng)
+        with pytest.raises(TypeError):
+            sched.submit([1, 2, 3], sampling={"temperature": 1.0})
+        # submit(None) inherits the engine config's sampling section:
+        # the served chain IS the reference chain for that seed
+        out = sched.serve([ref.prompts[0]], max_new_tokens=ref.max_new)
+        assert sched.sampling_seen
+        assert list(out.values()) == [ref.chains[0]]
+
+    def test_preemption_preserves_the_seeded_chain(self, model_and_params,
+                                                   ref):
+        """6 usable blocks < the two requests' KV: preempt -> requeue ->
+        replay re-samples the SAME tokens at the same absolute positions
+        (fold_in(seed, position) is batch- and history-agnostic)."""
+        model, params = model_and_params
+        eng, sched, got = _serve(
+            model, params, [ref.prompts[1], ref.prompts[3]],
+            [ref.sps[1], ref.sps[3]], max_new=ref.max_new,
+            icfg=_icfg(num_kv_blocks=7))
+        assert sched.preemptions > 0, "pool was sized to force preemption"
+        assert got == [ref.chains[1], ref.chains[3]]
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+
+    def test_export_inject_resumes_the_chain(self, model_and_params, ref):
+        """Elastic drain mid-generation: exported sampled requests carry
+        their seed, and the re-injected replay on a FRESH engine finishes
+        the identical chain."""
+        model, params = model_and_params
+        eng_a = InferenceEngineV2(model, params, _icfg())
+        sched_a = ContinuousBatchingScheduler(eng_a)
+        uids = [sched_a.submit(p, max_new_tokens=ref.max_new, sampling=sp)
+                for p, sp in zip(ref.prompts, ref.sps)]
+        for _ in range(3):
+            sched_a.tick()
+        exported = sched_a.export_requests()
+        assert {r.uid for r in exported} == set(uids)
+        assert eng_a.free_blocks == eng_a.allocator.num_blocks - 1
+        assert any(r.generated for r in exported), "drained mid-chain"
+        assert all(r.sampling == sp for r, sp in
+                   zip(sorted(exported, key=lambda r: uids.index(r.uid)),
+                       ref.sps)), "the seed rides the exported request"
+
+        eng_b = InferenceEngineV2(model, params, _icfg())
+        sched_b = ContinuousBatchingScheduler(eng_b)
+        for r in exported:
+            sched_b.inject(r, front=False)
+        sched_b.drain()
+        got = [sched_b.requests[u].generated for u in uids]
+        assert got == ref.chains
+
+
+# ---------------------------------------------------------------------------
+# EOS / stop sequences: on-device early termination
+# ---------------------------------------------------------------------------
+
+
+class TestStops:
+    def test_eos_early_stop_frees_kv_and_accounts_the_budget(
+            self, model_and_params, ref):
+        model, params = model_and_params
+        max_new = ref.max_new
+        free_run = ref.chains
+        # the chains' mode token guarantees at least one interior hit
+        eos = int(np.bincount(np.concatenate(free_run)).argmax())
+        sps_eos = _sps(4, eos_token_id=eos)
+        eng, sched, got = _serve(model, params, ref.prompts, sps_eos,
+                                 max_new=max_new)
+        stopped = 0
+        for chain, full in zip(got, free_run):
+            if eos in full:
+                cut = full.index(eos) + 1
+                assert chain == full[:cut], \
+                    "early stop must truncate the SAME chain at the stop"
+                assert chain[-1] == eos, "the stop token itself is emitted"
+                if cut < max_new:
+                    stopped += 1
+            else:
+                assert chain == full
+        assert stopped >= 1, "mode token should stop something early"
+        assert sched.early_stops == stopped
+        assert sched.dead_tokens_saved == sum(
+            max_new - len(c) for c in got) > 0
+        assert eng.early_stop_freed_blocks > 0
+        assert eng.free_blocks == eng.allocator.num_blocks - 1
+        # counters reach the monitor ring and the stats() group
+        assert (sched.memory_monitor.latest("sampling/early_stops")
+                == sched.early_stops)
+        st = sched.stats()["sampling"]
+        assert st["seen"] and st["early_stops"] == stopped
+        assert st["early_stop_freed_blocks"] == eng.early_stop_freed_blocks
+
+    def test_stop_sequence_suffix_match(self, model_and_params, ref):
+        model, params = model_and_params
+        full = ref.chains[2]
+        a, b = full[1], full[2]
+        hit = next(i for i in range(1, len(full))
+                   if full[i - 1:i + 1] == [a, b])
+        sp_stop = SamplingParams(temperature=0.8, top_p=0.9, seed=43,
+                                 stop=((a, b),))
+        _, sched, (got,) = _serve(model, params, [ref.prompts[2]],
+                                  [sp_stop], max_new=ref.max_new)
+        assert got == full[:hit + 1] and got[-2:] == [a, b]
+        assert sched.early_stops == 1
+
+
+# ---------------------------------------------------------------------------
+# logit_mask: constrained decoding in-dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestLogitMask:
+    def test_mask_constrains_greedy_and_sampled_serving(
+            self, model_and_params):
+        model, params = model_and_params
+        prompts = _prompts(np.random.default_rng(8), (9, 14))
+        allowed = np.zeros(VOCAB, bool)
+        allowed[[3, 17, 29, 44, 61, 88]] = True
+
+        def mask(history):
+            return allowed
+
+        sps = [SamplingParams(temperature=0.8, top_p=0.9, seed=201,
+                              logit_mask=mask),
+               SamplingParams(temperature=0.0, logit_mask=mask)]
+        eng, _, got = _serve(model, params, prompts, sps, max_new=6)
+        for chain in got:
+            assert all(allowed[t] for t in chain)
+        # masked rows dispatch through the masked program variants
+        assert any(k[0].endswith("_m") for k in eng.sampled_output_shapes)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding under sampling: seeded-chain verification
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSpeculativeSampled:
+    """The heavy compose corner (@slow per the tier-1 budget; ci_full
+    runs this file unfiltered under SXT_SANITIZE=1)."""
+
+    def test_spec_on_off_sampled_parity_with_resamples(
+            self, model_and_params):
+        """Speculation must be invisible to the sampled chain at every
+        k: the verify step evaluates the SAME fold_in(seed, position)
+        draw at every drafted slot, accepts matches, and RESAMPLES the
+        first divergence from the target distribution — so spec on/off
+        emit identical tokens while acceptance and resamples both
+        move."""
+        model, params = model_and_params
+        rng = np.random.default_rng(9)
+        prompts = _prompts(rng, (15, 9, 20))
+        sps = [SamplingParams(temperature=0.8, top_k=2, seed=7000 + i)
+               for i in range(3)]
+        icfg_off = InferenceConfig(
+            dtype="float32", max_seq_len=128, kv_block_size=8,
+            num_kv_blocks=64,
+            serving={"token_budget": 64, "max_running": 4, "chunk_min": 4})
+        eng_off = InferenceEngineV2(model, params, icfg_off)
+        out_off = ContinuousBatchingScheduler(eng_off).serve(
+            prompts, max_new_tokens=10, sampling=sps)
+        want = [out_off[u] for u in out_off]
+
+        for k in (1, 4):
+            icfg_spec = InferenceConfig(
+                dtype="float32", max_seq_len=128, kv_block_size=8,
+                num_kv_blocks=64,
+                serving={"token_budget": 64, "max_running": 4,
+                         "chunk_min": 4,
+                         "speculative": {"enabled": True, "k": k}})
+            eng_on = InferenceEngineV2(model, params, icfg_spec)
+            sched_on = ContinuousBatchingScheduler(
+                eng_on, drafter=DraftModelDrafter.for_target(model, params,
+                                                             icfg_spec))
+            out_on = sched_on.serve(prompts, max_new_tokens=10,
+                                    sampling=sps)
+            assert [out_on[u] for u in out_on] == want, f"k={k}"
+            assert sched_on.stats()["sampling"]["resamples"] > 0, \
+                f"k={k}: rejected drafts must consume the resample path"
+        # the k=4 run's acceptance: greedy drafts against a top-k=2
+        # chain land sometimes (~0.26 on this fixture)
+        assert sched_on.stats()["speculative"]["accepted"] > 0
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation (no engines: the monitor contract alone)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAggregation:
+    def test_fleet_monitor_sums_sampling_counters(self):
+        fm = FleetMonitor()
+        s0, s1 = fm.sink(0), fm.sink(1)
+        for sink, stops, dead in ((s0, 2, 9), (s1, 1, 4)):
+            sink.write_events([
+                ("sampling/early_stops", stops, 1),
+                ("sampling/dead_tokens_saved", dead, 1),
+                ("sampling/resamples", 3, 1),
+                ("sampling/early_stop_freed_blocks", 2, 1),
+            ])
+        agg = fm.aggregate()
+        assert agg["sampling"] == {"early_stops": 3, "dead_tokens_saved": 13,
+                                   "resamples": 6,
+                                   "early_stop_freed_blocks": 4}
+
+    def test_greedy_fleet_publishes_no_sampling_group(self):
+        fm = FleetMonitor()
+        fm.sink(0).write_events([("serving/ttft_s", 0.1, 1)])
+        assert "sampling" not in fm.aggregate()
+
+
+# ---------------------------------------------------------------------------
+# @slow corners: hybrid RLHF rollouts and chaos failover under sampling
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestHybridSampled:
+    def test_rollout_replay_and_generate_are_seed_deterministic(self):
+        """Sampled rollouts through the hybrid fleet record their wire
+        sampling params and replay bit-exactly; the v1-shaped generate()
+        API is deterministic under an explicit seed."""
+        import shuffle_exchange_tpu as sxt
+        from shuffle_exchange_tpu.rlhf import HybridEngineV2, pg_loss_fn
+
+        voc = 64
+        model = Transformer(tiny(vocab=voc, d=32, layers=2, heads=2,
+                                 seq=32))
+        engine, *_ = sxt.initialize(model=model, loss_fn=pg_loss_fn(model),
+                                    config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"fsdp": 2, "data": -1},
+            "steps_per_print": 10 ** 9,
+        })
+        hy = HybridEngineV2(engine, model, inference_config={
+            "dtype": "float32", "max_seq_len": 32, "kv_block_size": 8,
+            "num_kv_blocks": 40,
+            "serving": {"token_budget": 16, "max_running": 4,
+                        "chunk_min": 4},
+        }, n_replicas=2)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, voc - 2, size=n).tolist()
+                   for n in (9, 12, 7)]
+        sps = [SamplingParams(temperature=0.8, top_p=0.9, seed=500 + i)
+               for i in range(3)]
+        recs = hy.rollout(prompts, max_new_tokens=6, sampling=sps)
+        for rec, sp in zip(recs, sps):
+            assert rec.sampling == sp.to_wire(), \
+                "the wire dict rides the record for replay"
+            assert hy.replay(rec) == list(rec.tokens)
+        # generate(): v1 kwargs -> per-row seeds base+i, deterministic
+        width = max(len(p) for p in prompts)
+        ids = np.zeros((3, width), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
+        lens = [len(p) for p in prompts]
+        a = hy.generate(ids, prompt_lengths=lens, max_new_tokens=6,
+                        temperature=0.8, top_p=0.9, seed=123)
+        b = hy.generate(ids, prompt_lengths=lens, max_new_tokens=6,
+                        temperature=0.8, top_p=0.9, seed=123)
+        assert np.array_equal(a, b)
+        c = hy.generate(ids, prompt_lengths=lens, max_new_tokens=6,
+                        temperature=0.8, top_p=0.9, seed=124)
+        assert not np.array_equal(a, c)
+
+
+@pytest.mark.slow
+class TestChaosSampled:
+    def test_crash_failover_preserves_sampled_chains(self, model_and_params):
+        """The chaos drill under per-request seeds: a mid-trace replica
+        crash fails over with the seed riding each exported request, and
+        every surviving chain matches the clean no-kill seeded oracle."""
+        from shuffle_exchange_tpu.serving import run_chaos_drill
+
+        model, params = model_and_params
+
+        def mk():
+            return InferenceEngineV2(model, params, InferenceConfig(
+                dtype="float32", max_seq_len=64, kv_block_size=8,
+                num_kv_blocks=40,
+                serving={"token_budget": 16, "max_running": 4,
+                         "chunk_min": 4},
+                router={"heartbeat_interval_s": 0.25,
+                        "suspect_after_misses": 4,
+                        "dead_after_misses": 12, "tick_timeout_s": 3.0,
+                        "health_check_interval_s": 0.05,
+                        "retry_backoff_s": 0.001}))
+
+        sps = [SamplingParams(temperature=0.8, top_p=0.9, seed=300 + i)
+               for i in range(6)]
+        report = run_chaos_drill(
+            mk, n_replicas=2, n_requests=6, prompt_lo=5, prompt_hi=20,
+            max_new=8, vocab=90, seed=3, kills=[(2, "crash", 0)],
+            threaded=True, revive=True, sampling=sps)
+        assert report["lost"] == 0
+        assert report["token_mismatches"] == 0
+        assert report["sampled"] is True
+        assert report["sampling"]["seen"]
