@@ -13,6 +13,9 @@ and, at toy size, tests/test_failover.py.
 Usage:
     python scripts/chaos_drill.py                  # default crash+hang drill
     python scripts/chaos_drill.py --kills 3:crash:0 6:hang:1 --requests 12
+    python scripts/chaos_drill.py --process        # ISSUE 17: REAL worker
+        # processes behind the RPC boundary, killed with real SIGKILL /
+        # SIGSTOP (kinds: kill|stop); same bars, kernel-visible failures
 """
 
 import argparse
@@ -43,6 +46,10 @@ def main() -> int:
     ap.add_argument("--cooperative", action="store_true",
                     help="drive ticks inline instead of threaded replicas "
                          "(crash/tick_exception kills only)")
+    ap.add_argument("--process", action="store_true",
+                    help="ISSUE 17: spawn REAL worker processes behind the "
+                         "RPC boundary and kill them with real SIGKILL/"
+                         "SIGSTOP (kill kinds: kill|stop)")
     ap.add_argument("--no-revive", action="store_true")
     ap.add_argument("--ttft-bound-x", type=float, default=None,
                     help="assert chaos TTFT p95 <= bound * clean p95")
@@ -63,6 +70,9 @@ def main() -> int:
                                                 InferenceEngineV2)
     from shuffle_exchange_tpu.models import Transformer, tiny
     from shuffle_exchange_tpu.serving import run_chaos_drill
+
+    if args.process:
+        return _process_drill(args)
 
     cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
                activation="swiglu", norm="rmsnorm", position="rope",
@@ -114,6 +124,57 @@ def main() -> int:
               f"shed {report['shed']}, active_only={report['active_only']}, "
               f"ttft_p95 {report['ttft_p95_s_clean']} -> "
               f"{report['ttft_p95_s_chaos']}")
+    print("chaos drill: ok")
+    return 0
+
+
+def _process_drill(args) -> int:
+    """ISSUE 17 acceptance drill: 2+ real worker processes, >= 1 real
+    SIGKILL and >= 1 real SIGSTOP mid-trace, zero lost + token parity +
+    ACTIVE-only. The spec is the deterministic engine recipe every
+    worker rebuilds (same init seed => byte-identical weights), with RPC
+    timeouts sized so a frozen worker costs seconds, not minutes."""
+    from shuffle_exchange_tpu.serving import run_process_chaos_drill
+
+    spec = {
+        "model": dict(vocab=97, d=32, layers=2, heads=4, seq=128,
+                      activation="swiglu", norm="rmsnorm", position="rope",
+                      n_kv_heads=2, tie_embeddings=False),
+        "init_seed": 0,
+        "inference": dict(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40,
+            serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+            router={"heartbeat_interval_s": 0.25, "suspect_after_misses": 4,
+                    "dead_after_misses": 16, "tick_timeout_s": 10.0,
+                    "health_check_interval_s": 0.05,
+                    "poison_death_threshold": 3, "fleet_mode": "process",
+                    "rpc_call_timeout_s": 2.0, "rpc_ping_timeout_s": 1.0}),
+    }
+    n_replicas = max(2, args.replicas if args.replicas != 3 else 2)
+    if args.kills:
+        kills = []
+        for spec_s in args.kills:
+            after, kind, rid = spec_s.split(":")
+            kills.append((int(after), kind, int(rid)))
+    else:
+        kills = [(max(1, args.requests // 3), "kill", 0),
+                 (max(2, 2 * args.requests // 3), "stop", 1)]
+    report = run_process_chaos_drill(
+        spec, n_replicas=n_replicas, n_requests=args.requests,
+        max_new=args.max_new, seed=args.seed, kills=kills,
+        revive=not args.no_revive, timeout_s=600.0)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        fo = report["failover"]
+        print(f"process chaos drill: {report['finished']}/"
+              f"{report['n_requests']} finished, {report['lost']} lost, "
+              f"{report['token_mismatches']} token mismatches, "
+              f"kills={[(k['kind'], k['replica']) for k in report['kills']]}"
+              f", {fo['deaths']} deaths -> {fo['recovered_requests']} "
+              f"recovered ({fo['reprefill_tokens']} re-prefill tokens), "
+              f"active_only={report['active_only']}")
     print("chaos drill: ok")
     return 0
 

@@ -57,6 +57,13 @@ env SXT_SANITIZE=1 python -m pytest tests/test_failover.py -q "$@"
 # revived through the factory — zero lost requests, token parity with
 # the clean run, KV migration, ACTIVE-only recovery.
 env SXT_SANITIZE=1 python scripts/chaos_drill.py
+# Process-mode chaos drill (ISSUE 17): REAL worker processes behind the
+# RPC boundary, one real kill -9 and one real SIGSTOP mid-trace — zero
+# lost requests, token parity with the deterministic-spec oracle, every
+# signalled pid fenced+SIGKILLed+reaped, ACTIVE-only recovery. (The
+# sanitizer instruments the ROUTER process; each worker arms its own
+# gates from the inherited SXT_SANITIZE.)
+env SXT_SANITIZE=1 python scripts/chaos_drill.py --process
 # Serving-autotuner smoke (ISSUE 14): bounded successive-halving search
 # (tiny model, 2-round halving, <= 8 search trials) with the crash drill —
 # the search is killed at its 3rd trial-journal commit, resumed, and must

@@ -463,6 +463,28 @@ class RouterConfig:
     # the bound (the SLO guard: a queue past this depth means deadlines
     # are already lost — refusing loudly beats timing out silently).
     shed_queue_depth: int = 0
+    # -- cross-process fleet (ISSUE 17, serving/rpc.py + procfleet.py) --
+    # "threads" keeps N in-process replicas (the fast CPU-correctness
+    # path); "process" lifts the router<->replica boundary onto the RPC
+    # transport: one real worker process per replica
+    # (serving/worker.py), typed RpcTimeout/RpcConnectionLost errors
+    # feeding the SUSPECT/DEAD machine, and pushed load reports instead
+    # of shared-memory load() calls. The RPC knobs: `rpc_call_timeout_s`
+    # bounds ordinary calls (submit/poll/drain/stage); `rpc_ping_timeout_s`
+    # bounds the liveness probe (short — a worker that cannot answer a
+    # ping inside it is hung, not slow: pings never wait on the replica
+    # lock); connects retry `rpc_connect_retries` times behind
+    # `rpc_connect_backoff_s * 2**k` capped at `rpc_backoff_cap_s` (plus
+    # deterministic jitter — serving/rpc.py backoff_delays);
+    # `worker_start_timeout_s` bounds the spawn->ready-file handshake
+    # (cold workers sit in jax import + first compiles).
+    fleet_mode: str = "threads"
+    rpc_call_timeout_s: float = 60.0
+    rpc_ping_timeout_s: float = 5.0
+    rpc_connect_retries: int = 5
+    rpc_connect_backoff_s: float = 0.05
+    rpc_backoff_cap_s: float = 2.0
+    worker_start_timeout_s: float = 180.0
 
     def __post_init__(self):
         if self.num_replicas < 1:
@@ -518,6 +540,21 @@ class RouterConfig:
             raise ConfigError(
                 f"router.shed_queue_depth must be an int >= 0 (0 = off), "
                 f"got {self.shed_queue_depth!r}")
+        if self.fleet_mode not in ("threads", "process"):
+            raise ConfigError(
+                f"router.fleet_mode must be 'threads' or 'process', got "
+                f"{self.fleet_mode!r}")
+        for name in ("rpc_call_timeout_s", "rpc_ping_timeout_s",
+                     "rpc_connect_backoff_s", "rpc_backoff_cap_s",
+                     "worker_start_timeout_s"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or v <= 0:
+                raise ConfigError(f"router.{name} must be > 0, got {v!r}")
+        if not isinstance(self.rpc_connect_retries, int) \
+                or self.rpc_connect_retries < 0:
+            raise ConfigError(
+                f"router.rpc_connect_retries must be an int >= 0, got "
+                f"{self.rpc_connect_retries!r}")
 
 
 @dataclasses.dataclass

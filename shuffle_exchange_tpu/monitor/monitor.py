@@ -332,9 +332,13 @@ class FleetMonitor(Monitor):
         # into the ring (they are fleet-level, not per-replica); the
         # aggregate surfaces each label's LATEST value so SLO dashboards
         # see health/failover/shed state next to the latency tails
+        # rpc/* joins them in ISSUE 17: ProcessReplicaRouter.
+        # publish_metrics() writes cumulative RPC call/timeout/reconnect
+        # sums the same fleet-scoped way
         for group, prefix in (("health", "fleet/health/"),
                               ("failover", "failover/"),
-                              ("shed", "shed/")):
+                              ("shed", "shed/"),
+                              ("rpc", "rpc/")):
             vals = {}
             for lbl, v, _ in events:
                 if lbl.startswith(prefix):
@@ -363,7 +367,7 @@ class FleetMonitor(Monitor):
         # fault-tolerance groups (ISSUE 12) ride downstream under fleet/*
         # namespacing (health labels are already fleet/health/<k> in the
         # ring; failover/shed gain the fleet/ prefix here)
-        for group in ("health", "failover", "shed"):
+        for group in ("health", "failover", "shed", "rpc"):
             events += [(f"fleet/{group}/{k}", v, self._step)
                        for k, v in (agg.get(group) or {}).items()
                        if isinstance(v, (int, float))]

@@ -16,16 +16,31 @@ ACTIVE/SUSPECT/DEAD state machine with hysteresis), the router's
 hung replicas), per-request deadlines/retries/poison-quarantine/load
 shedding with typed errors, and ``chaos.py`` (the kill/hang/revive drill
 harness behind ``scripts/chaos_drill.py`` and dryrun config 14).
+
+ISSUE 17 lifts the replica boundary OUT of the process: ``rpc.py`` (the
+length-prefixed frame transport with per-call timeouts, typed
+``RpcTimeout``/``RpcConnectionLost`` failures, and deterministic
+retry/backoff), ``worker.py`` (the replica process entry — one
+engine+scheduler behind an RpcServer, §5.3 hostfile identity, pushed
+load reports), and ``procfleet.py`` (``ProcessReplicaRouter``, selected
+by ``router.fleet_mode: process`` — the same placement/health/failover
+policy re-based onto real pids, drilled with REAL kill -9/SIGSTOP by
+``chaos.run_process_chaos_drill``).
 """
 
-from .chaos import run_chaos_drill
+from .chaos import run_chaos_drill, run_process_chaos_drill
 from .disagg import DisaggregatedServer, KVTransferChannel, TransferAborted
 from .health import HealthMonitor
 from .lifecycle import (ElasticServingSupervisor, install_sigterm_drain,
                         uninstall_sigterm_drain)
+from .procfleet import ProcessReplicaRouter
 from .router import (LoadShedError, NoActiveReplicaError,
                      PoisonQuarantinedError, Replica, ReplicaRouter,
                      RetriesExhaustedError, fleet_commands)
+from .rpc import (RpcClient, RpcConnectionLost, RpcError, RpcProtocolError,
+                  RpcRemoteError, RpcServer, RpcTimeout, backoff_delays)
+from .worker import (ReplicaWorker, build_engine_from_spec,
+                     resolve_replica_identity)
 
 __all__ = [
     "DisaggregatedServer",
@@ -43,4 +58,17 @@ __all__ = [
     "ReplicaRouter",
     "fleet_commands",
     "run_chaos_drill",
+    "run_process_chaos_drill",
+    "ProcessReplicaRouter",
+    "ReplicaWorker",
+    "RpcClient",
+    "RpcConnectionLost",
+    "RpcError",
+    "RpcProtocolError",
+    "RpcRemoteError",
+    "RpcServer",
+    "RpcTimeout",
+    "backoff_delays",
+    "build_engine_from_spec",
+    "resolve_replica_identity",
 ]

@@ -38,6 +38,7 @@ makes the drill reproducible on machines of any speed.
 
 from __future__ import annotations
 
+import signal
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -345,4 +346,217 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
     return report
 
 
-__all__ = ["run_chaos_drill", "KINDS"]
+# ---------------------------------------------------------------------------
+# process-mode drill: REAL kill -9 / SIGSTOP against worker processes
+# ---------------------------------------------------------------------------
+
+#: process-mode kill kinds: ``kill`` = SIGKILL (the process vanishes —
+#: refused connections, immediate DEAD/engine-lost), ``stop`` = SIGSTOP
+#: (the process freezes but its listen backlog still accepts — RPC
+#: timeouts, SUSPECT, then the miss budget's DEAD). These are REAL
+#: signals against real pids, not simulated faults: the threaded drill
+#: proves the policy, this one proves the kernel-visible failure shapes
+#: drive the same machine (ISSUE 17).
+PROC_KINDS = ("kill", "stop")
+_PROC_SIG = {"kill": signal.SIGKILL, "stop": signal.SIGSTOP}
+
+
+def _worker_has_work(fleet, rid: int) -> bool:
+    h = fleet.workers.get(rid)
+    if h is None or h.state != "active":
+        return False
+    return any(owner == rid and fleet.requests[u].state
+               not in ("finished", "failed")
+               for u, owner in fleet.owner.items())
+
+
+def run_process_chaos_drill(spec: Dict[str, object], *,
+                            n_replicas: int = 2,
+                            n_requests: int = 8,
+                            prompt_lo: int = 6, prompt_hi: int = 16,
+                            max_new: int = 8,
+                            vocab: Optional[int] = None,
+                            seed: int = 0,
+                            span_s: float = 2.0,
+                            kills: Optional[Sequence[Tuple[int, str, int]]]
+                            = None,
+                            revive: bool = True,
+                            timeout_s: float = 420.0,
+                            arm_wait_s: float = 30.0,
+                            worker_env: Optional[Dict[int, Dict[str, str]]]
+                            = None,
+                            check: bool = True) -> Dict[str, object]:
+    """Kill -9 / SIGSTOP real worker processes under a live Poisson trace
+    (the ISSUE 17 acceptance drill) and assert the ISSUE 12 bars held
+    across the RPC boundary:
+
+    - **zero lost requests** — every submission reaches a terminal state
+      from the ROUTER's own bookkeeping (the dead process was never
+      asked anything);
+    - **token parity** — every finished request matches the sequential
+      single-engine greedy oracle, rebuilt from the same deterministic
+      ``spec`` (same init seed => byte-identical weights in every
+      process, so replayed continuations are token-identical);
+    - **ACTIVE-only recovery** — the post-drill live fleet carries no
+      SUSPECT residue; every signalled worker was fenced, SIGKILLed
+      (a thawing SIGSTOP corpse must never double-serve) and reaped;
+    - **observed deaths >= armed kills** — both failure shapes actually
+      drove the health machine to DEAD.
+
+    ``kills``: ``(after_request, kind, replica_id)`` with kind in
+    ``PROC_KINDS``; default one mid-trace SIGKILL of worker 0 and, with
+    n_replicas > 1, one SIGSTOP of worker 1. ``worker_env`` passes
+    per-replica environment (the ``SXT_FAULTS`` arming seam — satellite
+    1) straight through to :class:`ProcessReplicaRouter`. The spec's
+    ``inference.router`` block should size ``rpc_call_timeout_s`` /
+    ``dead_after_misses`` for the host: a SIGSTOPped worker costs one
+    RPC timeout per control-loop pass until the miss budget expires."""
+    # lazy: keep `import chaos` free of the process-fleet modules (and
+    # their jax treedef import) for threaded-only callers
+    from .procfleet import ProcessReplicaRouter
+    from .worker import build_engine_from_spec
+
+    if kills is None:
+        kills = [(max(1, n_requests // 3), "kill", 0)]
+        if n_replicas > 1:
+            kills = kills + [(max(2, 2 * n_requests // 3), "stop", 1)]
+    for _, kind, _rid in kills:
+        if kind not in PROC_KINDS:
+            raise ValueError(f"unknown process kill kind {kind!r}; known: "
+                             f"{PROC_KINDS}")
+    if vocab is None:
+        vocab = int(spec.get("model", {}).get("vocab", 90))
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+    arrivals = _poisson_arrivals(n_requests, span_s, rng)
+    # the oracle lives in THIS process; the workers rebuild the identical
+    # engine from the identical spec (deterministic init seed)
+    reference = _reference_tokens(lambda: build_engine_from_spec(spec),
+                                  prompts, max_new)
+
+    fleet = ProcessReplicaRouter(spec, n_replicas, worker_env=worker_env)
+    pending_kills = sorted(kills)
+    armed: List[Tuple[str, int, int]] = []   # (kind, rid, pid)
+    uids: List[Optional[int]] = []
+    shed = 0
+    try:
+        t0 = fleet.clock()
+        i = 0
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"process chaos drill did not drain in "
+                    f"{timeout_s:.0f}s (uids={len(uids)}/{n_requests}, "
+                    f"failover={fleet.stats()['failover']})")
+            while pending_kills and len(uids) >= pending_kills[0][0]:
+                _, kind, rid = pending_kills.pop(0)
+                if (fleet.workers.get(rid) is None
+                        or fleet.workers[rid].state != "active"):
+                    # the named target already died (cascading kills);
+                    # redirect to the busiest survivor so the kill lands
+                    live = fleet.active_workers
+                    if not live:
+                        break
+                    rid = max(live, key=lambda h: sum(
+                        1 for u, o in fleet.owner.items()
+                        if o == h.replica_id)).replica_id
+                wait_until = time.monotonic() + arm_wait_s
+                while (not _worker_has_work(fleet, rid)
+                       and time.monotonic() < wait_until):
+                    fleet.poll()
+                    time.sleep(0.01)
+                pid = fleet.kill_worker(rid, _PROC_SIG[kind])
+                armed.append((kind, rid, pid))
+                logger.warning(f"chaos: sent {kind} to worker {rid} "
+                               f"(pid {pid}) after {len(uids)} "
+                               f"submissions")
+            if i < n_requests and fleet.clock() - t0 >= arrivals[i]:
+                submitted = True
+                try:
+                    uids.append(fleet.submit(prompts[i],
+                                             max_new_tokens=max_new))
+                except LoadShedError:
+                    uids.append(None)
+                    shed += 1
+                except RuntimeError:
+                    # every placement refused this pass (e.g. the whole
+                    # fleet is mid-failover) — fall through to the
+                    # health/revive sweep, then retry the same prompt
+                    submitted = False
+                if submitted:
+                    i += 1
+                    continue
+            fleet.poll()
+            fleet.check_health()
+            fleet._place_pending()
+            if revive and len(fleet.active_workers) < n_replicas:
+                fleet.scale_to(n_replicas)
+            if i >= n_requests and not pending_kills:
+                live = [u for u in uids if u is not None]
+                if (all(fleet.requests[u].state in ("finished", "failed")
+                        for u in live) and not fleet._pending):
+                    break
+            time.sleep(0.005)
+    finally:
+        fleet.stop()
+
+    st = fleet.stats()
+    live_uids = [u for u in uids if u is not None]
+    finished = [u for u in live_uids
+                if fleet.requests[u].state == "finished"]
+    failed = [u for u in live_uids if fleet.requests[u].state == "failed"]
+    lost = [u for u in live_uids
+            if fleet.requests[u].state not in ("finished", "failed")]
+    mismatches = [u for j, u in enumerate(uids)
+                  if u is not None
+                  and fleet.requests[u].state == "finished"
+                  and fleet.requests[u].generated != reference[j]]
+    states = fleet.health.states()
+    live_handles = [h for h in fleet.workers.values()
+                    if h.state == "active"]
+    active_only = bool(live_handles) and all(
+        states.get(h.replica_id) == "active" for h in live_handles)
+    report = {
+        "fleet_mode": "process",
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "kills": [{"kind": k, "replica": r, "pid": p}
+                  for k, r, p in armed],
+        "shed": shed,
+        "finished": len(finished),
+        "failed": len(failed),
+        "lost": len(lost),
+        "token_mismatches": len(mismatches),
+        "failover": st["failover"],
+        "health": dict(states),
+        "active_replicas": len(live_handles),
+        "active_only": active_only,
+        "ttft_p95_s": st["ttft_p95_s"],
+        "goodput": st["sustained_tokens_per_sec"],
+        "rpc": st["rpc"],
+    }
+    if check:
+        assert not lost, f"lost requests (no terminal state): {lost}"
+        quarantined = set(st["failover"]["quarantined"])
+        hard_failed = [u for u in failed if u not in quarantined]
+        assert not hard_failed, (
+            f"non-shed requests failed: "
+            f"{[(u, str(fleet.requests[u].error)) for u in hard_failed]}")
+        assert not mismatches, (
+            f"recovered requests diverged from the greedy oracle: "
+            f"{mismatches}")
+        assert active_only, (
+            f"fleet did not return to ACTIVE-only health: "
+            f"{report['health']}")
+        assert st["failover"]["deaths"] >= len(armed), (
+            f"{len(armed)} real signal(s) sent but only "
+            f"{st['failover']['deaths']} failover death(s) observed")
+    return report
+
+
+__all__ = ["run_chaos_drill", "run_process_chaos_drill", "KINDS",
+           "PROC_KINDS"]

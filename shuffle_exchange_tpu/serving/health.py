@@ -159,6 +159,71 @@ class HealthMonitor:
                 logger.info(f"health: replica {replica_id} recovered "
                             f"(SUSPECT -> ACTIVE)")
 
+    # -- RPC outcome observations (process fleets, ISSUE 17) -----------
+    #
+    # Across a process boundary the heartbeat source is the RPC exchange
+    # itself, and the transport's typed errors discriminate the two
+    # failure shapes the threaded fleet needed thread-liveness for:
+    #
+    # - ``RpcTimeout``       -> :meth:`rpc_hung` — the kernel still
+    #   completes the TCP handshake on a SIGSTOPped process's listen
+    #   backlog, so the worker is REACHABLE but making no progress: the
+    #   hang shape. SUSPECT now; DEAD when the miss budget (elapsed
+    #   since the last successful exchange vs ``dead_after_misses`` x
+    #   ``heartbeat_interval_s``) runs out in :meth:`check` — the same
+    #   clock-driven decision path as threaded hangs, fake-clock
+    #   testable with no sleeps.
+    # - ``RpcConnectionLost`` -> :meth:`rpc_unreachable` — nothing is
+    #   listening (connect refused / reset / EOF): the kill -9 shape.
+    #   Immediately DEAD with the engine (and its KV pool) LOST.
+    # - success              -> :meth:`rpc_ok` — the beat. Resets the
+    #   strike streak and recovers SUSPECT -> ACTIVE (hysteresis, same
+    #   rule as a completed tick).
+
+    def rpc_ok(self, replica_id: int) -> None:
+        """A successful RPC exchange IS the heartbeat in a process
+        fleet: stamp the beat, reset strikes, recover SUSPECT."""
+        rec = self.records.get(replica_id)
+        if rec is None or rec.state == H_DEAD:
+            return
+        with self._mu:
+            rec.last_beat = self.clock()
+            rec.strikes = 0
+            rec.hang_flagged = False
+            if rec.state == H_SUSPECT:
+                rec.state = H_ACTIVE
+                rec.reason = ""
+                self.transitions += 1
+                logger.info(f"health: replica {replica_id} recovered "
+                            f"(SUSPECT -> ACTIVE, rpc answered)")
+
+    def rpc_hung(self, replica_id: int, reason: str) -> str:
+        """An RPC TIMED OUT: the peer accepted the connection but never
+        answered — REACHABLE-hung (the SIGSTOP shape). SUSPECT now; the
+        DEAD decision stays clock-driven in :meth:`check` (miss budget
+        against the last successful exchange), so recovery hysteresis
+        and escalation match the threaded hang path exactly."""
+        rec = self.records.get(replica_id)
+        if rec is None or rec.state == H_DEAD:
+            return H_DEAD
+        with self._mu:
+            rec.hang_flagged = True
+            if rec.state == H_ACTIVE:
+                rec.state = H_SUSPECT
+                rec.reason = reason
+                self.transitions += 1
+                logger.warning(f"health: replica {replica_id} SUSPECT — "
+                               f"rpc timeout ({reason})")
+            return rec.state
+
+    def rpc_unreachable(self, replica_id: int, reason: str) -> None:
+        """The connection was REFUSED/reset/EOF: nothing is listening on
+        a local socket, so the process is gone (the kill -9 shape) —
+        immediately DEAD with the engine and its KV pool LOST."""
+        self.mark_dead(replica_id,
+                       f"rpc connection lost ({reason})",
+                       engine_reachable=False)
+
     # -- synchronous failure reports -----------------------------------
 
     def strike(self, replica_id: int, reason: str) -> str:

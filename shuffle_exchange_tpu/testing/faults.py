@@ -80,6 +80,15 @@ never leaves a half-executed tick behind):
   ``index``'s tick: a transient tick failure. The health layer counts it
   as a strike (SUSPECT), not an immediate death; consecutive strikes
   escalate to DEAD.
+- ``rpc_drain_reply`` — KILL the worker PROCESS (``os._exit``, via
+  :func:`maybe_die`) between a drain's ``export_requests`` and its RPC
+  reply (serving/worker.py, ISSUE 17): the worker has already torn its
+  scheduler down but the router never receives the export, so the drain
+  must roll back to the router-side snapshots and re-place through the
+  normal failover path (tests/test_procfleet.py drills it). In a process
+  fleet this site is armed in the WORKER's environment via ``SXT_FAULTS``
+  — this module parses the plan at import, so ``fire_nth`` schedules stay
+  deterministic across the process boundary.
 
 Arm programmatically (``faults.arm(...)``) or via the environment::
 
@@ -125,6 +134,7 @@ SITES = (
     "corrupt_manifest", "drop_manifest", "corrupt_shard",
     "kv_transfer", "kv_transfer_stall", "weight_publish",
     "replica_crash", "replica_hang", "tick_exception",
+    "rpc_drain_reply",
     "autotune_trial",
     "kv_spill", "kv_fetch",
 )
@@ -265,6 +275,18 @@ def on_write(site: str, index: int, path: str, data) -> None:
         with open(path, "wb") as fh:
             fh.write(buf)
     raise InjectedFault(f"injected crash at {site}[{index}] ({path})")
+
+
+def maybe_die(site: str, index: int = 0, code: int = 17) -> None:
+    """KILL this process (``os._exit`` — no atexit, no flush, no cleanup)
+    when (site, index) is armed: the process-fleet analog of
+    :func:`maybe_crash`, for sites where the simulated failure must be a
+    REAL process death the parent observes as a refused connection
+    (ISSUE 17; the ``rpc_drain_reply`` drain-mid-death window)."""
+    if ACTIVE and trip(site, index) is not None:
+        logger.error(f"faults: unclean process death at {site}[{index}] "
+                     f"(os._exit({code}))")
+        os._exit(code)
 
 
 def maybe_sigterm(site: str, index: int = 0) -> None:

@@ -71,8 +71,14 @@ LOCK_ORDER = {
     "ReplicaRouter._lock": 0,
     # rank 10 — one replica's scheduler guard (tick vs submit/inject/
     # export). The tick dispatch runs under it, so nothing that can be
-    # held while a tick is in flight may rank above it.
+    # held while a tick is in flight may rank above it. The process
+    # fleet's worker guard (ISSUE 17: tick thread vs RPC handler
+    # threads, serving/worker.py) is the SAME role on the other side of
+    # the wire — it shares the rank, and is instrumented under the
+    # sanitizer name "Replica.lock" so the tick's hold-while-blocking
+    # allowance applies identically in both fleet modes.
     "Replica.lock": 10,
+    "ReplicaWorker._lock": 10,
     # rank 20 — the transfer substrate (KV migration / weight wire
     # staging slots + the drain barrier condition, and the tiered-KV
     # host store — ISSUE 15: spill/fetch bookkeeping touched from
@@ -82,10 +88,18 @@ LOCK_ORDER = {
     "KVTransferChannel._cv": 20,
     "WeightWire._mu": 20,
     "HostKVTier._mu": 20,
-    # rank 30 — leaf locks: health records and monitor rings. Everything
-    # reports into these; they call out to nothing.
+    # rank 30 — leaf locks: health records, monitor rings, and the RPC
+    # server's connection roster (ISSUE 17 — handler dispatch runs
+    # OUTSIDE it; it guards only the accept-loop's conn/thread lists).
+    # Everything reports into these; they call out to nothing.
     "HealthMonitor._mu": 30,
     "FleetMonitor._mu": 30,
+    "RpcServer._mu": 30,
+    # The remaining ISSUE 17 transport state is deliberately UNLOCKED:
+    # RpcClient is single-owner by contract (the process router's serve
+    # loop — concurrent calls would interleave frames on one stream),
+    # and ProcessReplicaRouter is a single-threaded control loop (its
+    # workers are processes; there is nothing in-process to race).
 }
 
 

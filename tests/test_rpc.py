@@ -1,0 +1,300 @@
+"""RPC transport hardening (ISSUE 17, satellite 3): frame fuzz must
+produce a TYPED error (never a hang, never a crash of the server),
+backoff schedules must be deterministic, and the worker entry must parse
+its §5.3 identity exactly.
+
+Tier-1 discipline: everything here is stdlib + numpy — no engine, no
+process spawns, no jax compile. The in-process client/server pairs talk
+over a real localhost socket (the transport under test) but the handlers
+are plain functions, so the whole file runs in seconds.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shuffle_exchange_tpu.inference import SamplingParams, ServingRequest
+from shuffle_exchange_tpu.serving.rpc import (MAGIC, MAX_FRAME_BYTES,
+                                              RpcClient, RpcConnectionLost,
+                                              RpcProtocolError,
+                                              RpcRemoteError, RpcServer,
+                                              RpcTimeout, backoff_delays,
+                                              decode_frame, encode_frame)
+from shuffle_exchange_tpu.serving.worker import (request_from_wire,
+                                                 request_to_wire,
+                                                 resolve_replica_identity,
+                                                 sampling_from_wire,
+                                                 sampling_to_wire)
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+class TestFraming:
+    def test_roundtrip_meta_only(self):
+        meta, bufs = decode_frame(encode_frame({"method": "ping", "id": 7}))
+        assert meta["method"] == "ping" and meta["id"] == 7
+        assert bufs == []
+
+    def test_roundtrip_planes_byte_exact(self):
+        planes = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+                  np.array([[1, 2], [3, 4]], dtype=np.int8),
+                  np.frombuffer(b"\x00\x01\xfe\xff", dtype=np.uint8)]
+        meta, out = decode_frame(encode_frame({"m": "kv"}, planes))
+        assert len(out) == len(planes)
+        for a, b in zip(planes, out):
+            assert b.dtype == a.dtype and b.shape == a.shape
+            assert a.tobytes() == b.tobytes()
+
+    def test_empty_plane_ok(self):
+        _, out = decode_frame(
+            encode_frame({}, [np.zeros((0, 4), dtype=np.float16)]))
+        assert out[0].shape == (0, 4) and out[0].dtype == np.float16
+
+    @pytest.mark.parametrize("mutate", [
+        lambda f: f[: len(f) // 2],                       # truncated body
+        lambda f: f[:3],                                  # truncated header
+        lambda f: b"HTTP" + f[4:],                        # wrong magic
+        lambda f: f[:4] + struct.pack(">I", MAX_FRAME_BYTES + 1) + f[8:],
+        lambda f: f[:8] + b"\xff" * (len(f) - 8),         # garbage body
+        lambda f: f + b"extra",                           # trailing bytes
+    ])
+    def test_fuzz_is_typed_never_a_hang(self, mutate):
+        frame = encode_frame({"method": "x"},
+                             [np.ones(3, dtype=np.float64)])
+        with pytest.raises(RpcProtocolError):
+            decode_frame(mutate(frame))
+
+    def test_meta_len_overrun_is_typed(self):
+        # meta length word pointing past the body must not over-read
+        body = struct.pack(">I", 1 << 20) + b"{}"
+        frame = struct.pack(">4sI", MAGIC, len(body)) + body
+        with pytest.raises(RpcProtocolError):
+            decode_frame(frame)
+
+    def test_plane_table_overrun_is_typed(self):
+        # declared plane larger than the tail it ships with
+        frame = encode_frame({"x": 1}, [np.zeros(4, dtype=np.float32)])
+        meta, _ = decode_frame(frame)
+        evil = dict(meta)
+        evil["bufs"] = [{"dtype": "<f4", "shape": [1 << 24]}]
+        import json
+        mb = json.dumps(evil).encode()
+        body = struct.pack(">I", len(mb)) + mb + b"\x00" * 16
+        with pytest.raises(RpcProtocolError):
+            decode_frame(struct.pack(">4sI", MAGIC, len(body)) + body)
+
+    def test_oversize_encode_refused(self):
+        big = np.zeros(MAX_FRAME_BYTES // 4 + 16, dtype=np.float32)
+        with pytest.raises(RpcProtocolError):
+            encode_frame({}, [big])
+
+
+# ---------------------------------------------------------------------------
+# backoff
+# ---------------------------------------------------------------------------
+
+
+class TestBackoff:
+    def test_deterministic_across_calls(self):
+        a = backoff_delays(6, 0.05, seed=3)
+        b = backoff_delays(6, 0.05, seed=3)
+        assert a == b   # exact float equality — the schedule is pinned
+
+    def test_exponential_then_capped(self):
+        d = backoff_delays(8, 0.05, factor=2.0, cap_s=0.4, jitter=0.0)
+        assert d[:4] == [0.05, 0.1, 0.2, 0.4]
+        assert all(x == 0.4 for x in d[3:])
+
+    def test_jitter_bounded_and_seed_varies(self):
+        base = backoff_delays(5, 0.1, jitter=0.0)
+        jit = backoff_delays(5, 0.1, jitter=0.25, seed=1)
+        for b, j in zip(base, jit):
+            assert b <= j < b * 1.25
+        assert jit != backoff_delays(5, 0.1, jitter=0.25, seed=2)
+
+    def test_zero_attempts(self):
+        assert backoff_delays(0, 0.05) == []
+        with pytest.raises(ValueError):
+            backoff_delays(-1, 0.05)
+
+
+# ---------------------------------------------------------------------------
+# client/server over a real localhost socket
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    hung = threading.Event()
+
+    def echo(payload, bufs):
+        return {"echo": payload, "n_bufs": len(bufs)}, bufs
+
+    def boom(payload, bufs):
+        raise ValueError(f"refused: {payload.get('why', '?')}")
+
+    def hang(payload, bufs):
+        hung.wait(30.0)
+        return {}
+
+    srv = RpcServer({"echo": echo, "boom": boom, "hang": hang},
+                    load_provider=lambda: {"queue_depth": 5,
+                                           "kv_pressure": 0.25}).start()
+    yield srv
+    hung.set()
+    srv.stop()
+
+
+def _client(srv, **kw):
+    kw.setdefault("connect_retries", 1)
+    kw.setdefault("default_timeout_s", 10.0)
+    return RpcClient(srv.host, srv.port, **kw)
+
+
+class TestClientServer:
+    def test_echo_and_planes(self, server):
+        c = _client(server)
+        planes = [np.arange(6, dtype=np.int32).reshape(2, 3)]
+        result, out = c.call("echo", {"k": "v"}, planes)
+        assert result["echo"] == {"k": "v"} and result["n_bufs"] == 1
+        assert out[0].tobytes() == planes[0].tobytes()
+        c.close()
+
+    def test_load_report_piggybacks(self, server):
+        c = _client(server)
+        assert c.last_load is None
+        c.call("echo", {})
+        assert c.last_load == {"queue_depth": 5, "kv_pressure": 0.25}
+        c.close()
+
+    def test_remote_error_is_typed(self, server):
+        c = _client(server)
+        with pytest.raises(RpcRemoteError) as ei:
+            c.call("boom", {"why": "testing"})
+        assert ei.value.remote_type == "ValueError"
+        assert "testing" in ei.value.remote_message
+        # the connection survived a typed refusal
+        assert c.call("echo", {})[0]["echo"] == {}
+        c.close()
+
+    def test_unknown_method_is_remote_error(self, server):
+        c = _client(server)
+        with pytest.raises(RpcRemoteError):
+            c.call("no_such_method")
+        c.close()
+
+    def test_timeout_is_typed_and_server_survives(self, server):
+        c = _client(server)
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeout):
+            c.call("hang", timeout_s=0.2)
+        assert time.monotonic() - t0 < 5.0   # bounded, never a hang
+        assert c.timeouts == 1
+        # the poisoned stream reconnects transparently on the next call
+        assert c.call("echo", {})[0]["echo"] == {}
+        assert c.reconnects == 1
+        c.close()
+
+    def test_connection_refused_is_lost(self):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()   # nothing listens here now
+        c = RpcClient("127.0.0.1", port, connect_retries=1,
+                      connect_backoff_s=0.01)
+        with pytest.raises(RpcConnectionLost):
+            c.call("echo")
+
+    def test_garbage_bytes_do_not_kill_server(self, server):
+        raw = socket.create_connection((server.host, server.port))
+        raw.sendall(b"GET / HTTP/1.1\r\n\r\n" * 4)
+        raw.close()
+        deadline = time.monotonic() + 5.0
+        while server.protocol_errors == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.protocol_errors >= 1
+        # a well-formed client on a FRESH connection still works
+        c = _client(server)
+        assert c.call("echo", {"after": "garbage"})[0]["echo"] == {
+            "after": "garbage"}
+        c.close()
+
+    def test_server_eof_mid_frame_is_lost_not_hang(self, server):
+        # handshake, then the peer dies mid-reply: EOF must surface as
+        # RpcConnectionLost promptly, not wait out the full timeout
+        c = _client(server)
+        c.call("echo", {})
+        server.stop()
+        with pytest.raises((RpcConnectionLost, RpcTimeout)):
+            c.call("echo", {}, timeout_s=2.0)
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# worker identity (§5.3 hostfile parse) + request/sampling wire records
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerIdentity:
+    def test_explicit_env_wins(self):
+        assert resolve_replica_identity(
+            {"SXT_REPLICA_ID": "2", "SXT_NUM_REPLICAS": "4"}) == (2, 4)
+
+    def test_explicit_env_validates(self):
+        with pytest.raises(ValueError):
+            resolve_replica_identity(
+                {"SXT_REPLICA_ID": "4", "SXT_NUM_REPLICAS": "4"})
+        with pytest.raises(ValueError):
+            resolve_replica_identity({"SXT_REPLICA_ID": "-1",
+                                      "SXT_NUM_REPLICAS": "2"})
+
+    def test_hostfile_position(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("tpu-a slots=4\ntpu-b slots=4\ntpu-c slots=4\n")
+        assert resolve_replica_identity(
+            {"SXT_HOSTFILE": str(hf), "SXT_HOST": "tpu-b"}) == (1, 3)
+
+    def test_hostfile_unknown_host_is_typed(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("tpu-a slots=4\n")
+        with pytest.raises(ValueError):
+            resolve_replica_identity(
+                {"SXT_HOSTFILE": str(hf), "SXT_HOST": "not-there"})
+
+    def test_solo_default(self):
+        assert resolve_replica_identity({}) == (0, 1)
+
+
+class TestWireRecords:
+    def test_request_roundtrip_carries_continuation(self):
+        r = ServingRequest(uid=9, prompt=[1, 2, 3], max_new_tokens=8,
+                           deadline_s=2.5,
+                           sampling=SamplingParams(temperature=0.7,
+                                                   top_k=5, seed=42))
+        r.generated = [7, 8]
+        r.retries = 1
+        r.replica_deaths = 1
+        back = request_from_wire(request_to_wire(r))
+        assert back.uid == 9 and back.prompt == [1, 2, 3]
+        assert back.generated == [7, 8] and back.max_new_tokens == 8
+        assert back.retries == 1 and back.replica_deaths == 1
+        assert back.deadline_s == 2.5
+        assert back.sampling.temperature == 0.7
+        assert back.sampling.top_k == 5 and back.sampling.seed == 42
+
+    def test_greedy_sampling_is_none_on_wire(self):
+        assert sampling_to_wire(None) is None
+        assert sampling_from_wire(None) is None
+
+    def test_logit_mask_refused(self):
+        sp = SamplingParams(temperature=1.0,
+                            logit_mask=lambda history: np.ones(
+                                16, dtype=bool))
+        with pytest.raises(ValueError, match="logit_mask"):
+            sampling_to_wire(sp)
