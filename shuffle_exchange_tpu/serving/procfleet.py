@@ -138,6 +138,11 @@ class ProcessReplicaRouter:
         self.requests: Dict[int, object] = {}
         self.owner: Dict[int, int] = {}
         self._pending: List[int] = []
+        # uids whose submit/inject TIMED OUT against a still-live worker:
+        # the worker may have admitted the request before the reply was
+        # lost, leaving an untracked duplicate holding KV — reaped via a
+        # best-effort cancel on the worker's next successful exchange
+        self._maybe_resident: Dict[int, set] = {}
         self._last_health_check = 0.0
         # failover/drain bookkeeping (the threaded stats() vocabulary)
         self.failovers = 0
@@ -210,7 +215,20 @@ class ProcessReplicaRouter:
             backoff_cap_s=self.rcfg.rpc_backoff_cap_s,
             default_timeout_s=self.rcfg.rpc_call_timeout_s, seed=rid)
         h = WorkerHandle(rid, proc, client, int(info["port"]), log_path)
-        client.call("ping", timeout_s=self.rcfg.rpc_ping_timeout_s)
+        try:
+            client.call("ping", timeout_s=self.rcfg.rpc_ping_timeout_s)
+        except Exception:
+            # the handle is not registered yet, so no failover path will
+            # ever reap this process — kill it here or it leaks live
+            # outside all router bookkeeping
+            client.close()
+            proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                logger.error(f"procfleet: worker {rid} (pid {proc.pid}) "
+                             f"did not reap after a failed handshake ping")
+            raise
         self.workers[rid] = h
         self.health.register(rid)
         logger.info(f"procfleet: worker {rid} up (pid {h.pid}, port "
@@ -275,6 +293,7 @@ class ProcessReplicaRouter:
             raise
         self.health.rpc_ok(h.replica_id)
         self._consume_strikes(h)
+        self._reap_maybe_resident(h)
         return out
 
     def _consume_strikes(self, h: WorkerHandle) -> None:
@@ -292,6 +311,28 @@ class ProcessReplicaRouter:
                 self._fail_over(h.replica_id,
                                 f"consecutive tick exceptions ({reason})",
                                 engine_reachable=True)
+
+    def _reap_maybe_resident(self, h: WorkerHandle) -> None:
+        """Cancel possible duplicate sequences on a worker that answered
+        again after a timed-out submit/inject. The router placed those
+        uids elsewhere (or requeued them), so any copy still live here is
+        an untracked duplicate decoding into KV it will never release —
+        and it would refuse a later legitimate inject of the same uid
+        with 'uid already live'. Best-effort by design: a direct client
+        call (no health consequence, no recursion into _call); a failed
+        reap keeps the set and retries on the next successful exchange."""
+        uids = self._maybe_resident.get(h.replica_id)
+        if not uids or h.state != ACTIVE:
+            return
+        doomed = sorted(u for u in uids
+                        if self.owner.get(u) != h.replica_id)
+        try:
+            if doomed:
+                h.client.call("cancel", {"uids": doomed},
+                              timeout_s=self.rcfg.rpc_call_timeout_s)
+        except RpcError:
+            return
+        self._maybe_resident.pop(h.replica_id, None)
 
     # -- placement / intake ---------------------------------------------
 
@@ -344,6 +385,15 @@ class ProcessReplicaRouter:
             except RpcRemoteError as e:
                 refusals.append(f"replica {h.replica_id}: "
                                 f"{e.remote_type}: {e.remote_message}")
+                continue
+            except RpcTimeout as e:
+                # a slow-but-alive worker may have ADMITTED the request
+                # before the reply was lost; remember the uid so the
+                # duplicate gets reaped once the worker answers again
+                if h.state == ACTIVE:
+                    self._maybe_resident.setdefault(
+                        h.replica_id, set()).add(uid)
+                refusals.append(f"replica {h.replica_id}: {e}")
                 continue
             except RpcError as e:
                 refusals.append(f"replica {h.replica_id}: {e}")
@@ -425,8 +475,14 @@ class ProcessReplicaRouter:
         (oldest first — fleet FIFO)."""
         now = self.clock()
         placed = 0
+        # Take the batch and leave self._pending EMPTY while we work: an
+        # inject below can trigger _fail_over, whose victims append to
+        # self._pending concurrently with this loop — a final overwrite
+        # from a pre-loop snapshot would silently drop them (zero-lost
+        # invariant), so the unplaced remainder is merged back instead.
+        batch, self._pending = self._pending, []
         remaining: List[int] = []
-        for uid in sorted(self._pending):
+        for uid in sorted(batch):
             r = self.requests[uid]
             if r.state in _TERMINAL:
                 continue
@@ -439,6 +495,13 @@ class ProcessReplicaRouter:
                     self._call(h, "inject",
                                {"request": request_to_wire(r),
                                 "front": True})
+                except RpcTimeout:
+                    # the worker may have admitted the inject before the
+                    # reply was lost — remember the possible duplicate
+                    if h.state == ACTIVE:
+                        self._maybe_resident.setdefault(
+                            h.replica_id, set()).add(uid)
+                    continue
                 except RpcError:
                     continue
                 target = h
@@ -446,11 +509,12 @@ class ProcessReplicaRouter:
             if target is None:
                 remaining.append(uid)
                 continue
+            self._maybe_resident.get(target.replica_id, set()).discard(uid)
             self.owner[uid] = target.replica_id
             self.recovered += 1
             self.reprefill_tokens += len(r.prompt) + len(r.generated)
             placed += 1
-        self._pending = remaining
+        self._pending.extend(remaining)
         return placed
 
     def fail_orphans(self) -> int:
@@ -474,6 +538,24 @@ class ProcessReplicaRouter:
         return failed
 
     # -- failover --------------------------------------------------------
+
+    def _requeue_from_mirror(self, uid: int,
+                             generated: Optional[Sequence[int]] = None
+                             ) -> None:
+        """Hand one request back to the pending path from the router's
+        own mirror (the transfer_kv failure half: the source has already
+        detached the sequence, so the mirror is the only live copy).
+        Idempotent against _fail_over's requeue — a connection loss
+        inside the same exchange may have beaten us here."""
+        r = self.requests.get(uid)
+        if r is None or r.state in _TERMINAL:
+            return
+        if generated is not None:
+            r.generated = [int(t) for t in generated]
+        r.state = "queued"
+        self.owner.pop(uid, None)
+        if uid not in self._pending:
+            self._pending.append(uid)
 
     def _fail_over(self, replica_id: int, reason: str,
                    engine_reachable: bool) -> int:
@@ -499,6 +581,8 @@ class ProcessReplicaRouter:
             logger.error(f"procfleet: worker {replica_id} (pid {h.pid}) "
                          f"did not reap after SIGKILL")
         h.client.close()
+        # the process is gone — nothing can still be resident on it
+        self._maybe_resident.pop(replica_id, None)
         victims = sorted(u for u, rid in self.owner.items()
                          if rid == replica_id
                          and self.requests[u].state not in _TERMINAL)
@@ -641,29 +725,45 @@ class ProcessReplicaRouter:
         the destination reserves, commits, and adopts mid-decode in one
         message (abort-on-failure leaves its pool clean). Returns the
         number of tokens whose KV moved without re-prefill."""
+        uid = int(uid)
         src = self.workers.get(src_rid)
         dst = self.workers.get(dst_rid)
         if src is None or src.state != ACTIVE:
             raise ValueError(f"source replica {src_rid} is not ACTIVE")
         if dst is None or dst.state != ACTIVE:
             raise ValueError(f"destination replica {dst_rid} is not ACTIVE")
-        result, planes = self._call(src, "export_kv",
-                                    {"uid": int(uid), "handoff": True})
+        try:
+            result, planes = self._call(src, "export_kv",
+                                        {"uid": uid, "handoff": True})
+        except RpcTimeout:
+            # the source may have detached the sequence (handoff=True)
+            # before the reply was lost — the router mirror is then the
+            # only live copy, so requeue it rather than leave it orphaned
+            # in 'running'; if the export never actually ran, the stale
+            # source copy is reaped as maybe-resident on recovery.
+            # (RpcConnectionLost needs nothing here: _call already ran
+            # _fail_over on src, which requeued every src-owned uid.)
+            if src.state == ACTIVE:
+                self._maybe_resident.setdefault(src_rid, set()).add(uid)
+            self._requeue_from_mirror(uid)
+            raise
         try:
             self._call(dst, "import_kv",
                        {"payload": result["payload"],
                         "request": result["request"]}, bufs=planes)
-        except RpcRemoteError:
-            # the destination refused (pressure/version/shape) and
-            # aborted its reservation; the source already detached — fall
-            # back to drain-replay via the pending path
-            r = self.requests.get(int(uid))
-            if r is not None:
-                r.generated = [int(t)
-                               for t in result["request"]["generated"]]
-                r.state = "queued"
-                self.owner.pop(int(uid), None)
-                self._pending.append(int(uid))
+        except RpcError as e:
+            # the source has already detached the sequence, so EVERY
+            # import failure must hand the request back to the pending
+            # path: a typed refusal (RpcRemoteError — the destination
+            # aborted its reservation), a vanished destination
+            # (RpcConnectionLost — dst's _fail_over requeues only
+            # dst-OWNED uids, and owner still maps this one to src), or
+            # a lost reply (RpcTimeout — the import may have landed;
+            # reap the possible duplicate on recovery)
+            if isinstance(e, RpcTimeout) and dst.state == ACTIVE:
+                self._maybe_resident.setdefault(dst_rid, set()).add(uid)
+            self._requeue_from_mirror(
+                uid, generated=result["request"]["generated"])
             raise
         r = self.requests.get(int(uid))
         if r is not None:

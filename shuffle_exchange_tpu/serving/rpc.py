@@ -498,7 +498,21 @@ class RpcServer:
                 envelope["load"] = self.load_provider()
             except Exception as e:
                 logger.warning(f"rpc: load_provider raised: {e}")
-        return encode_frame(envelope, planes)
+        try:
+            return encode_frame(envelope, planes)
+        except RpcProtocolError as e:
+            # an unencodable reply (e.g. result planes past
+            # MAX_FRAME_BYTES) must NOT escape and tear the connection
+            # down — the client would see EOF -> RpcConnectionLost and
+            # the router would SIGKILL a healthy worker. Answer with a
+            # typed error envelope instead, planes dropped.
+            self.protocol_errors += 1
+            logger.warning(f"rpc: reply to {method!r} unencodable: {e}")
+            envelope.pop("result", None)
+            envelope["ok"] = False
+            envelope["error"] = {"type": "RpcProtocolError",
+                                 "message": str(e)}
+            return encode_frame(envelope, ())
 
     def stop(self) -> None:
         self._stopping = True

@@ -269,6 +269,7 @@ class ReplicaWorker:
             "ping": self._h_ping,
             "submit": self._h_submit,
             "inject": self._h_inject,
+            "cancel": self._h_cancel,
             "poll": self._h_poll,
             "load": self._h_load,
             "stats": self._h_stats,
@@ -352,6 +353,28 @@ class ReplicaWorker:
         with self._lock:
             self.scheduler.inject(r, front=bool(payload.get("front", True)))
         return {"uid": r.uid}
+
+    def _h_cancel(self, payload, bufs):
+        """Reap possibly-duplicate sequences (router timeout hygiene): a
+        submit/inject whose reply was lost may have admitted the uid
+        here while the router placed it elsewhere — drop each named uid
+        from the scheduler and free its KV. Unknown uids are the common
+        case (the timed-out call never landed) and are silently fine."""
+        cancelled = []
+        now = time.monotonic()
+        with self._lock:
+            for uid in payload.get("uids", ()):
+                uid = int(uid)
+                r = self.scheduler.requests.get(uid)
+                if r is None:
+                    continue
+                if r.state not in ("finished", "failed"):
+                    self.scheduler.fail(
+                        r, RuntimeError("cancelled by router (duplicate "
+                                        "reap after a lost reply)"), now)
+                self.scheduler.requests.pop(uid, None)
+                cancelled.append(uid)
+        return {"cancelled": cancelled}
 
     def _h_poll(self, payload, bufs):
         """Token/state pickup for the router's bookkeeping mirror — the

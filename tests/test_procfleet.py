@@ -9,15 +9,19 @@ drills, the drain-mid-death regression, the KV handoff — is @slow
 (ci_full), because each worker is a fresh Python + jax process.
 """
 
+import threading
 import time
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
 
 from shuffle_exchange_tpu.config import ConfigError
-from shuffle_exchange_tpu.inference import InferenceConfig, KVBlockPayload
+from shuffle_exchange_tpu.inference import (InferenceConfig, KVBlockPayload,
+                                            ServingRequest)
 from shuffle_exchange_tpu.serving.health import (H_ACTIVE, H_DEAD,
                                                  H_SUSPECT, HealthMonitor)
+from shuffle_exchange_tpu.serving.rpc import RpcConnectionLost, RpcTimeout
 from shuffle_exchange_tpu.serving.worker import (kv_payload_from_wire,
                                                  kv_payload_to_wire)
 
@@ -229,6 +233,148 @@ class TestKVPayloadWire:
         meta, planes = kv_payload_to_wire(p)
         with pytest.raises(ValueError):
             kv_payload_from_wire(meta, planes[:-1])
+
+
+# ---------------------------------------------------------------------------
+# router bookkeeping regressions (duck-typed fleet, no processes)
+# ---------------------------------------------------------------------------
+
+
+def _bare_fleet():
+    """A ProcessReplicaRouter skeleton with just the bookkeeping the
+    placement/failover/transfer paths touch — ``_call`` is substituted
+    per test, so no process (or socket) ever exists."""
+    from shuffle_exchange_tpu.serving.procfleet import ProcessReplicaRouter
+
+    fleet = object.__new__(ProcessReplicaRouter)
+    fleet.clock = lambda: 100.0
+    fleet.requests = {}
+    fleet.owner = {}
+    fleet._pending = []
+    fleet._maybe_resident = {}
+    fleet.recovered = 0
+    fleet.reprefill_tokens = 0
+    fleet.migrated_sequences = 0
+    fleet.migrated_blocks = 0
+    fleet.workers = {}
+    fleet._placement_order = lambda handles: sorted(
+        handles, key=lambda h: h.replica_id)
+    return fleet
+
+
+def _req(uid, state="queued", generated=()):
+    r = ServingRequest(uid=uid, prompt=[1, 2], max_new_tokens=8)
+    r.state = state
+    r.generated = list(generated)
+    return r
+
+
+class TestRouterBookkeepingRegressions:
+    def test_place_pending_keeps_concurrent_failover_appends(self):
+        """A worker dying DURING _place_pending's inject appends its
+        victims to self._pending mid-loop (via _fail_over); the final
+        bookkeeping must not overwrite them with a pre-loop snapshot —
+        a dropped victim stays 'queued' with no owner forever."""
+        fleet = _bare_fleet()
+        fleet.workers = {0: SimpleNamespace(replica_id=0, state="active")}
+        fleet.requests = {1: _req(1), 2: _req(2, state="running")}
+        fleet.owner = {2: 9}
+        fleet._pending = [1]
+
+        def call(h, method, payload=None, bufs=(), timeout_s=None):
+            # mid-inject, a different worker fails over and requeues 2
+            fleet.requests[2].state = "queued"
+            fleet.owner.pop(2, None)
+            fleet._pending.append(2)
+            return {}, []
+
+        fleet._call = call
+        assert fleet._place_pending() == 1
+        assert fleet.owner[1] == 0
+        assert fleet._pending == [2]   # the concurrent append survived
+
+    def test_place_pending_timeout_marks_maybe_resident(self):
+        """An inject that times out may still have been admitted by a
+        slow worker — the uid must be remembered for the duplicate reap,
+        and stay pending (no silent loss, no untracked copy)."""
+        fleet = _bare_fleet()
+        fleet.workers = {0: SimpleNamespace(replica_id=0, state="active")}
+        fleet.requests = {1: _req(1)}
+        fleet._pending = [1]
+
+        def call(h, method, payload=None, bufs=(), timeout_s=None):
+            raise RpcTimeout(method, 0.5)
+
+        fleet._call = call
+        assert fleet._place_pending() == 0
+        assert fleet._pending == [1]
+        assert 1 in fleet._maybe_resident[0]
+
+    def test_transfer_kv_export_timeout_requeues_from_mirror(self):
+        """A lost export_kv reply may have happened AFTER the source
+        detached the sequence (handoff=True): the router mirror is then
+        the only live copy — it must land on the pending path, never
+        orphan in 'running' with a stale owner."""
+        fleet = _bare_fleet()
+        fleet.workers = {0: SimpleNamespace(replica_id=0, state="active"),
+                         1: SimpleNamespace(replica_id=1, state="active")}
+        fleet.requests = {5: _req(5, state="running", generated=[3])}
+        fleet.owner = {5: 0}
+
+        def call(h, method, payload=None, bufs=(), timeout_s=None):
+            raise RpcTimeout(method, 1.0)
+
+        fleet._call = call
+        with pytest.raises(RpcTimeout):
+            fleet.transfer_kv(0, 1, 5)
+        assert fleet._pending == [5] and 5 not in fleet.owner
+        assert fleet.requests[5].state == "queued"
+        assert 5 in fleet._maybe_resident[0]   # export may never have run
+
+    def test_transfer_kv_import_connection_lost_requeues(self):
+        """The destination vanishing mid-import must requeue the uid:
+        dst's own failover only reclaims dst-OWNED uids, and this one
+        still maps to the source — which has already detached it."""
+        fleet = _bare_fleet()
+        fleet.workers = {0: SimpleNamespace(replica_id=0, state="active"),
+                         1: SimpleNamespace(replica_id=1, state="active")}
+        fleet.requests = {5: _req(5, state="running", generated=[3])}
+        fleet.owner = {5: 0}
+
+        def call(h, method, payload=None, bufs=(), timeout_s=None):
+            if method == "export_kv":
+                return {"payload": {"seen_tokens": 4, "block_size": 8},
+                        "request": {"generated": [3, 4]}}, []
+            raise RpcConnectionLost("peer reset")
+
+        fleet._call = call
+        with pytest.raises(RpcConnectionLost):
+            fleet.transfer_kv(0, 1, 5)
+        assert fleet._pending == [5] and 5 not in fleet.owner
+        r = fleet.requests[5]
+        assert r.state == "queued"
+        assert r.generated == [3, 4]   # the export's fresher continuation
+
+    def test_worker_cancel_reaps_known_and_ignores_unknown(self):
+        """The worker half of the duplicate reap: named uids leave the
+        scheduler (KV freed via fail()), unknown uids — the common case,
+        where the timed-out call never landed — are silently fine."""
+        from shuffle_exchange_tpu.serving.worker import ReplicaWorker
+
+        class _Sched:
+            def __init__(self):
+                self.requests = {5: _req(5, state="running")}
+                self.failed = []
+
+            def fail(self, r, err, now):
+                r.state = "failed"
+                self.failed.append(r.uid)
+
+        w = SimpleNamespace(_lock=threading.RLock(), scheduler=_Sched())
+        out = ReplicaWorker._h_cancel(w, {"uids": [5, 9]}, [])
+        assert out == {"cancelled": [5]}
+        assert w.scheduler.failed == [5]
+        assert 5 not in w.scheduler.requests
 
 
 # ---------------------------------------------------------------------------

@@ -225,6 +225,31 @@ class TestClientServer:
             "after": "garbage"}
         c.close()
 
+    def test_oversized_reply_is_typed_not_connection_death(self, monkeypatch):
+        # a reply past MAX_FRAME_BYTES must come back as an ERROR
+        # envelope on the live connection — if it escaped, the thread
+        # would die, the client would see EOF -> RpcConnectionLost, and
+        # the router would SIGKILL a healthy worker
+        import shuffle_exchange_tpu.serving.rpc as rpc_mod
+
+        srv = RpcServer({
+            "big": lambda p, b: ({}, [np.zeros(4096, dtype=np.float32)]),
+            "echo": lambda p, b: {"ok": 1},
+        }).start()
+        try:
+            monkeypatch.setattr(rpc_mod, "MAX_FRAME_BYTES", 2048)
+            c = _client(srv)
+            with pytest.raises(RpcRemoteError) as ei:
+                c.call("big")
+            assert ei.value.remote_type == "RpcProtocolError"
+            assert srv.protocol_errors >= 1
+            # the SAME connection still serves — no reconnect, no death
+            assert c.call("echo")[0]["ok"] == 1
+            assert c.reconnects == 0
+            c.close()
+        finally:
+            srv.stop()
+
     def test_server_eof_mid_frame_is_lost_not_hang(self, server):
         # handshake, then the peer dies mid-reply: EOF must surface as
         # RpcConnectionLost promptly, not wait out the full timeout
