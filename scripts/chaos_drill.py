@@ -50,6 +50,11 @@ def main() -> int:
                     help="ISSUE 17: spawn REAL worker processes behind the "
                          "RPC boundary and kill them with real SIGKILL/"
                          "SIGSTOP (kill kinds: kill|stop)")
+    ap.add_argument("--adapters", type=int, default=0, metavar="N",
+                    help="ISSUE 18: stripe requests across N LoRA "
+                         "adapters on a 2-slot pool (threads mode) — "
+                         "failover must re-place onto adapter-resident "
+                         "survivors and replay token-identically")
     ap.add_argument("--no-revive", action="store_true")
     ap.add_argument("--ttft-bound-x", type=float, default=None,
                     help="assert chaos TTFT p95 <= bound * clean p95")
@@ -80,10 +85,30 @@ def main() -> int:
     model = Transformer(cfg)
     params = model.init(jax.random.PRNGKey(0))
 
+    adapter_names = [f"drill-tenant-{i}" for i in range(args.adapters)]
+
+    def _adapter_factors(i):
+        import numpy as np
+
+        from shuffle_exchange_tpu.inference.adapters import target_dims
+
+        frng = np.random.default_rng(7000 + i)
+        out = {}
+        for t in ("wq", "wv"):
+            din, dout = target_dims(cfg, t)
+            out[t] = (0.5 * frng.standard_normal(
+                          (cfg.n_layers, din, 4)).astype("float32"),
+                      0.5 * frng.standard_normal(
+                          (cfg.n_layers, 4, dout)).astype("float32"))
+        return out
+
     def mk():
-        return InferenceEngineV2(model, params, InferenceConfig(
+        eng = InferenceEngineV2(model, params, InferenceConfig(
             dtype="float32", max_seq_len=64, kv_block_size=8,
             num_kv_blocks=40,
+            adapters=({"enabled": True, "slots": 2, "max_rank": 4,
+                       "targets": ("wq", "wv")} if args.adapters
+                      else {"enabled": False}),
             serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
             # detection thresholds sized for a 1-core CPU box where a
             # NORMAL warm tick takes a few hundred ms but a COLD one can
@@ -93,6 +118,15 @@ def main() -> int:
                     "dead_after_misses": 40, "tick_timeout_s": 10.0,
                     "health_check_interval_s": 0.05,
                     "poison_death_threshold": 3}))
+        # register in the FACTORY (content-keyed, deterministic versions)
+        # so revived replacement replicas know every tenant too
+        for i, name in enumerate(adapter_names):
+            eng.adapters.register(name, _adapter_factors(i), alpha=8.0)
+        return eng
+
+    adapter_ids = ([adapter_names[i % args.adapters] if i % 4 else None
+                    for i in range(args.requests)]
+                   if args.adapters else None)
 
     if args.kills:
         kills = []
@@ -110,7 +144,7 @@ def main() -> int:
         threaded=not args.cooperative, revive=not args.no_revive,
         ttft_p95_bound_x=args.ttft_bound_x,
         require_migration=any(k[1] == "hang" for k in kills),
-        timeout_s=600.0, arm_wait_s=60.0)
+        timeout_s=600.0, arm_wait_s=60.0, adapter_ids=adapter_ids)
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:
@@ -124,6 +158,12 @@ def main() -> int:
               f"shed {report['shed']}, active_only={report['active_only']}, "
               f"ttft_p95 {report['ttft_p95_s_clean']} -> "
               f"{report['ttft_p95_s_chaos']}")
+        if report["adapters_enabled"] and report["adapters"]:
+            ad = report["adapters"]
+            print(f"chaos drill adapters: {args.adapters} tenants on "
+                  f"2-slot pools, hits {ad.get('hits')}, "
+                  f"misses {ad.get('misses')}, parks {ad.get('parks')}, "
+                  f"token parity held through failover")
     print("chaos drill: ok")
     return 0
 

@@ -64,6 +64,11 @@ env SXT_SANITIZE=1 python scripts/chaos_drill.py
 # sanitizer instruments the ROUTER process; each worker arms its own
 # gates from the inherited SXT_SANITIZE.)
 env SXT_SANITIZE=1 python scripts/chaos_drill.py --process
+# Adapters-enabled chaos drill (ISSUE 18): the same crash+hang trace with
+# requests striped across 3 LoRA tenants on 2-slot pools — failover must
+# re-place victims onto adapter-resident survivors and replay
+# token-identically (the reference oracle binds each uid's adapter).
+env SXT_SANITIZE=1 python scripts/chaos_drill.py --adapters 3
 # Serving-autotuner smoke (ISSUE 14): bounded successive-halving search
 # (tiny model, 2-round halving, <= 8 search trials) with the crash drill —
 # the search is killed at its 3rd trial-journal commit, resumed, and must
@@ -86,6 +91,15 @@ python -m pytest tests/test_speculative.py -q "$@"
 # speculative accept with spec-on/off token parity, and the logit-mask
 # constrained-decoding hook. Sanitized like the other serving suites.
 env SXT_SANITIZE=1 python -m pytest tests/test_sampling.py -q "$@"
+# Multi-tenant LoRA serving gates (ISSUE 18): adapter-pool LRU/refcount/
+# content-key semantics with the adapter_fetch atomicity drill, grouped-
+# GEMM interpret parity vs the XLA gather oracle, mixed-adapter exact-
+# token parity vs dedicated single-adapter engines, park-on-missing-
+# adapter (zero preemptions), zero-recompile on fresh adapter ids,
+# adapter x prefix-cache x speculative x kv-dtype compose, fleet
+# publish/affinity/failover-replay. Sanitized: the pool lock is rank 20
+# in the declared hierarchy and router threads touch it.
+env SXT_SANITIZE=1 python -m pytest tests/test_adapters.py -q "$@"
 # RLHF / HybridEngine v2 gates (ISSUE 11): train->serve flip parity with
 # a fresh engine on the gathered weights, zero recompiles across flips on
 # a warmed fleet, bit-exact rollout replay at the recorded weight
@@ -108,4 +122,5 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_speculative.py \
     --ignore=tests/test_sampling.py \
     --ignore=tests/test_rlhf.py \
-    --ignore=tests/test_hybrid_engine.py "$@"
+    --ignore=tests/test_hybrid_engine.py \
+    --ignore=tests/test_adapters.py "$@"

@@ -60,7 +60,12 @@ KNOWN_AXES = ("token_budget", "max_running", "chunk_min", "chunk_bins",
               # tiered paged KV (ISSUE 15): park-instead-of-preempt
               # spill to the host tier, its hot-tail size, and how many
               # parked sequences prefetch-stage one tick ahead
-              "spill_enabled", "hot_block_fraction", "prefetch_depth")
+              "spill_enabled", "hot_block_fraction", "prefetch_depth",
+              # multi-tenant LoRA (ISSUE 18): resident adapter-pool slots
+              # (0 = adapters off, None = inherit the base config's pool)
+              # and how many queued-but-non-resident adapters stage into
+              # pinned buffers one tick ahead of their expected acquire
+              "adapter_slots", "adapter_prefetch_depth")
 
 
 def pow2_bin_count(n: int) -> int:
@@ -120,6 +125,15 @@ class SpaceContext:
     #: None disables the KV-thrash constraint; a float f prunes
     #: candidates whose max_running * worst-case blocks > f * usable
     kv_overcommit: Optional[float] = None
+    #: multi-tenant LoRA pool geometry (ISSUE 18): bytes ONE padded
+    #: adapter slot spends in HBM at the pool's rank ceiling — i.e.
+    #: ``inference.adapters.pool_bytes(tcfg, 0, max_rank)``, which is
+    #: exactly one slot's worth since the device pool carries slots+1.
+    #: None disables the pool-footprint constraint.
+    adapter_slot_bytes: Optional[int] = None
+    #: HBM bytes a candidate's adapter pool may spend (slots+1 slots x
+    #: adapter_slot_bytes must fit). None disables the constraint.
+    adapter_hbm_budget: Optional[int] = None
 
     @property
     def usable_blocks(self) -> int:
@@ -152,6 +166,11 @@ class ServingCandidate:
     spill_enabled: Optional[bool] = None
     hot_block_fraction: float = 0.0
     prefetch_depth: int = 1
+    # multi-tenant LoRA (ISSUE 18): None keeps the base config's pool;
+    # an int >= 1 sets the resident slot count (enabling adapters);
+    # 0 disables adapters explicitly
+    adapter_slots: Optional[int] = None
+    adapter_prefetch_depth: int = 1
     # search bookkeeping (mutated by the space/search, not identity)
     status: str = "pending"      # pending | pruned_static | ...
     prune_reason: str = ""
@@ -182,6 +201,15 @@ class ServingCandidate:
             # dropped and dedup collapses the duplicates instead of the
             # search burning a measured trial per identical config
             n += f"_hf{self.hot_block_fraction:g}_pd{self.prefetch_depth}"
+        if self.adapter_slots is not None:
+            n += f"_as{self.adapter_slots}"
+        if self.adapter_slots != 0 and self.adapter_prefetch_depth != 1:
+            # same dedup discipline as the kv_tier knobs: the depth is
+            # live under any slot count >= 1 AND under None (inherit —
+            # the base config's pool may be on), but inert under an
+            # EXPLICIT 0, where omitting the suffix lets enumerate()'s
+            # dedup collapse the identical configs
+            n += f"_apd{self.adapter_prefetch_depth}"
         return n
 
     # -- ladders (static; no config construction) -----------------------
@@ -259,6 +287,23 @@ class ServingCandidate:
                 "hot_block_fraction": self.hot_block_fraction,
                 "prefetch_depth": self.prefetch_depth,
             }
+        if self.adapter_slots is not None:
+            if self.adapter_slots:
+                out["adapters"] = {
+                    "enabled": True,
+                    "slots": self.adapter_slots,
+                    "prefetch_depth": self.adapter_prefetch_depth,
+                }
+            else:
+                out["adapters"] = {"enabled": False}
+        elif self.adapter_prefetch_depth != 1:
+            # slot count inherits the base config's pool, but the
+            # searched prefetch depth must still land — with_overlay
+            # merges this partial section over the base's, keeping its
+            # enabled flag and slot/rank geometry
+            out["adapters"] = {
+                "prefetch_depth": self.adapter_prefetch_depth,
+            }
         return out
 
     def apply(self, base_icfg):
@@ -284,7 +329,10 @@ class ServingCandidate:
             prefix_caching=icfg.prefix_caching,
             spill_enabled=icfg.kv_tier.enabled,
             hot_block_fraction=icfg.kv_tier.hot_block_fraction,
-            prefetch_depth=icfg.kv_tier.prefetch_depth)
+            prefetch_depth=icfg.kv_tier.prefetch_depth,
+            adapter_slots=(icfg.adapters.slots
+                           if icfg.adapters.enabled else 0),
+            adapter_prefetch_depth=icfg.adapters.prefetch_depth)
 
 
 class ServingSearchSpace:
@@ -422,6 +470,30 @@ class ServingSearchSpace:
                     f"{worst} worst-case blocks hot — nothing is ever "
                     f"spillable, the tier is a no-op with bookkeeping cost "
                     f"(lower it or disable spill)")
+        # multi-tenant LoRA (ISSUE 18): knob validity, then the static
+        # pool-geometry bound — the device pool carries slots+1 padded
+        # factor-pair slots (slot 0 is the null adapter), each costing a
+        # fixed byte count at the rank ceiling, so a pool that blows the
+        # HBM budget is known infeasible before any engine is built
+        if c.adapter_slots is not None and (
+                not isinstance(c.adapter_slots, int)
+                or isinstance(c.adapter_slots, bool)
+                or c.adapter_slots < 0):
+            return False, (f"adapter_slots {c.adapter_slots!r} must be an "
+                           f"int >= 0 (0 = adapters off) or None (inherit)")
+        if not isinstance(c.adapter_prefetch_depth, int) \
+                or c.adapter_prefetch_depth < 0:
+            return False, (f"adapter_prefetch_depth "
+                           f"{c.adapter_prefetch_depth!r} must be >= 0")
+        if (c.adapter_slots and ctx.adapter_slot_bytes
+                and ctx.adapter_hbm_budget is not None):
+            need = (c.adapter_slots + 1) * ctx.adapter_slot_bytes
+            if need > ctx.adapter_hbm_budget:
+                return False, (
+                    f"adapter pool geometry: {c.adapter_slots}+1 slots x "
+                    f"{ctx.adapter_slot_bytes} padded-factor bytes = "
+                    f"{need} exceeds the {ctx.adapter_hbm_budget}-byte "
+                    f"adapter HBM budget")
         # KV arithmetic: a running set that cannot hold 1/overcommit of
         # its worst case permanently lives in the preemption path —
         # UNLESS the tier is on, where overflow parks host-ward instead

@@ -289,6 +289,52 @@ class KVTierConfig:
 
 
 @dataclasses.dataclass
+class AdapterConfig:
+    """Multi-tenant LoRA serving (ISSUE 18): a fixed-slot HBM pool of
+    rank-padded adapter factor pairs (``inference/adapters.py``) that a
+    mixed-adapter batch gathers from per row inside the one-dispatch
+    serving step. Slot indices are descriptor DATA — the compiled
+    program set is independent of which (or how many) adapters exist.
+
+    - ``slots``: resident adapters (device array carries slots+1; slot 0
+      is the reserved all-zeros null adapter no-adapter rows gather).
+    - ``max_rank``: LoRA rank ceiling; factors are zero-padded to it so
+      every adapter shares one device shape (padding contributes 0).
+    - ``targets``: attention projections adapted (FFN out of scope —
+      the delta seam lives in the engine's attention layer body).
+    - ``prefetch_depth``: adapters staged into pinned buffers ahead of
+      their expected acquire (kv_tier's double-buffer half)."""
+
+    enabled: bool = False
+    slots: int = 4
+    max_rank: int = 8
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+    prefetch_depth: int = 1
+
+    def __post_init__(self):
+        if not isinstance(self.enabled, bool):
+            raise ConfigError(
+                f"adapters.enabled must be a bool, got {self.enabled!r}")
+        for name in ("slots", "max_rank"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ConfigError(
+                    f"adapters.{name} must be an int >= 1, got {v!r}")
+        if not isinstance(self.prefetch_depth, int) \
+                or self.prefetch_depth < 0:
+            raise ConfigError(
+                f"adapters.prefetch_depth must be an int >= 0 (0 disables "
+                f"prefetch staging), got {self.prefetch_depth!r}")
+        self.targets = tuple(self.targets)
+        supported = ("wq", "wk", "wv", "wo")
+        bad = [t for t in self.targets if t not in supported]
+        if bad or not self.targets:
+            raise ConfigError(
+                f"adapters.targets must be a non-empty subset of "
+                f"{supported}, got {self.targets!r}")
+
+
+@dataclasses.dataclass
 class ServingConfig:
     """Continuous-batching scheduler knobs (``inference/scheduler.py`` —
     the Dynamic-SplitFuse scheduler the reference FastGen engine runs,
@@ -418,6 +464,12 @@ class RouterConfig:
     prefix_affinity_weight: float = 1.0
     queue_depth_weight: float = 1.0
     kv_pressure_weight: float = 1.0
+    # adapter-affinity placement (ISSUE 18): bonus for replicas whose
+    # AdapterPool already holds the request's adapter resident — a hit
+    # skips the host->HBM factor install (and a possible park), the
+    # prefix-affinity argument applied to adapter weights
+    adapter_affinity: bool = True
+    adapter_affinity_weight: float = 1.0
     min_replicas: int = 1
     max_replicas: int = 8
     scale_up_queue_depth: float = 8.0    # mean queued reqs/replica to grow
@@ -495,7 +547,7 @@ class RouterConfig:
                 f"router needs 1 <= min_replicas <= max_replicas, got "
                 f"min={self.min_replicas} max={self.max_replicas}")
         for name in ("prefix_affinity_weight", "queue_depth_weight",
-                     "kv_pressure_weight"):
+                     "kv_pressure_weight", "adapter_affinity_weight"):
             v = getattr(self, name)
             if not isinstance(v, (int, float)) or v < 0:
                 raise ConfigError(f"router.{name} must be >= 0, got {v!r}")
@@ -620,6 +672,9 @@ class InferenceConfig:
     # AIO pinned-buffer substrate so serving contexts can outgrow the
     # resident pool; the scheduler parks/unparks under KV pressure
     kv_tier: KVTierConfig = dataclasses.field(default_factory=KVTierConfig)
+    # multi-tenant LoRA serving (ISSUE 18): paged adapter pool + per-row
+    # batched adapter application in the one-dispatch serving step
+    adapters: AdapterConfig = dataclasses.field(default_factory=AdapterConfig)
     # continuous-batching scheduler (inference/scheduler.py, engine_v2.step)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     # multi-replica serving front (serving/router.py: placement, sticky
@@ -656,6 +711,16 @@ class InferenceConfig:
                     f"unknown kv_tier config keys {sorted(unknown)} "
                     f"(allowed: {sorted(allowed)})")
             self.kv_tier = KVTierConfig(**self.kv_tier)
+        if self.adapters is None:
+            self.adapters = AdapterConfig()
+        elif isinstance(self.adapters, dict):
+            allowed = {f.name for f in dataclasses.fields(AdapterConfig)}
+            unknown = set(self.adapters) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown adapters config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            self.adapters = AdapterConfig(**self.adapters)
         if self.sampling is None:
             self.sampling = SamplingParams()
         elif isinstance(self.sampling, dict):
@@ -755,6 +820,22 @@ class InferenceConfig:
         elif not isinstance(kt, KVTierConfig):
             raise ConfigError(f"kv_tier must be a dict or KVTierConfig, "
                               f"got {type(kt).__name__}")
+        ad = d.get("adapters")
+        if ad is None:
+            d.pop("adapters", None)   # empty section -> defaults
+        elif isinstance(ad, dict):
+            allowed = {f.name for f in dataclasses.fields(AdapterConfig)}
+            unknown = set(ad) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown adapters config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            d["adapters"] = AdapterConfig(
+                **{k: (tuple(v) if k == "targets" else v)
+                   for k, v in ad.items()})
+        elif not isinstance(ad, AdapterConfig):
+            raise ConfigError(f"adapters must be a dict or AdapterConfig, "
+                              f"got {type(ad).__name__}")
         smp = d.get("sampling")
         if smp is None:
             d.pop("sampling", None)   # empty section -> defaults
@@ -801,7 +882,7 @@ class InferenceConfig:
     #: model geometry, pool size, dtypes — is NOT a serving knob and must
     #: not ride in through an overlay file)
     OVERLAY_KEYS = ("serving", "kv_cache_dtype", "decode_kernel",
-                    "prefix_caching", "kv_tier")
+                    "prefix_caching", "kv_tier", "adapters")
 
     def serving_overlay(self) -> Dict[str, Any]:
         """This config's point in the serving knob space as a standalone
@@ -839,6 +920,14 @@ class InferenceConfig:
             # config applied to a tier-enabled base must turn spill off,
             # not silently inherit it
             out["kv_tier"] = {"enabled": False}
+        if self.adapters.enabled:
+            out["adapters"] = {
+                "enabled": True,
+                "slots": self.adapters.slots,
+                "prefetch_depth": self.adapters.prefetch_depth,
+            }
+        else:
+            out["adapters"] = {"enabled": False}
         return out
 
     def with_overlay(self, overlay: Dict[str, Any]) -> "InferenceConfig":
@@ -906,6 +995,22 @@ class InferenceConfig:
             kt_cur = {f.name: getattr(self.kv_tier, f.name)
                       for f in dataclasses.fields(KVTierConfig)}
             kv_tier = KVTierConfig(**{**kt_cur, **kt_patch})
+        ad_patch = d.pop("adapters", None)
+        adapters = self.adapters
+        if ad_patch is not None:
+            if not isinstance(ad_patch, dict):
+                raise ConfigError(
+                    f"overlay 'adapters' must be a dict, got "
+                    f"{type(ad_patch).__name__}")
+            ad_allowed = {f.name for f in dataclasses.fields(AdapterConfig)}
+            ad_unknown = set(ad_patch) - ad_allowed
+            if ad_unknown:
+                raise ConfigError(
+                    f"unknown adapters overlay keys {sorted(ad_unknown)} "
+                    f"(allowed: {sorted(ad_allowed)})")
+            ad_cur = {f.name: getattr(self.adapters, f.name)
+                      for f in dataclasses.fields(AdapterConfig)}
+            adapters = AdapterConfig(**{**ad_cur, **ad_patch})
         dk = d.get("decode_kernel")
         if dk is not None and dk not in ("auto", "pallas", "xla"):
             # __post_init__ leaves decode_kernel to from_dict; an overlay
@@ -913,7 +1018,7 @@ class InferenceConfig:
             raise ConfigError(
                 f'decode_kernel must be "auto", "pallas" or "xla", got {dk!r}')
         return dataclasses.replace(self, serving=serving, kv_tier=kv_tier,
-                                   **d)
+                                   adapters=adapters, **d)
 
     def jax_dtype(self) -> Any:
         import jax.numpy as jnp

@@ -397,7 +397,20 @@ class InferenceEngine:
         cos, sin = rope_table(self.config.max_seq_len, cfg.rotary_dims, cfg.rope_theta)
         return x, (cos, sin), positions
 
-    def _layer_body(self, lw, h, cos, sin, positions, attn_fn):
+    def _lora_add(self, base, x, lora, target):
+        """``base + (x @ A_slot[row]) @ B_slot[row]`` — the per-row paged
+        adapter delta (ISSUE 18). ``lora`` is ``(pool_slice, slots)``:
+        the layer's [S, din, R]/[S, R, dout] factor stacks and the
+        batch's i32 slot indices (slot 0 = zeros, an exact no-op)."""
+        pool, slots = lora
+        if target not in pool["a"]:
+            return base
+        from ..ops.lora_gemm import lora_delta
+
+        delta = lora_delta(x, pool["a"][target], pool["b"][target], slots)
+        return base + delta.astype(base.dtype)
+
+    def _layer_body(self, lw, h, cos, sin, positions, attn_fn, lora=None):
         """One transformer block shared by every cached path (v1/v2 ×
         prefill/decode) — norm → QKV(+RoPE) → ``attn_fn`` → residual → FFN.
         ``attn_fn(q, k, v) -> (attn [B,T,H,Dh], cache_out)`` supplies the
@@ -406,18 +419,32 @@ class InferenceEngine:
         On 1-token steps with ``decode_kernel`` resolved to "pallas", the
         QKV projection(+bias+RoPE) and the residual+MLP collapse into the
         fused kernels (ops/fused_decode.py) so each weight matrix streams
-        through VMEM exactly once per step."""
+        through VMEM exactly once per step.
+
+        ``lora`` (ISSUE 18) threads the adapter pool's per-layer factor
+        stacks + the batch's slot indices; the low-rank delta lands on
+        each projection AFTER the base matmul and BEFORE bias/RoPE (the
+        fused-QKV collapse is statically skipped — the engine only
+        passes ``lora`` when adapters are enabled)."""
         from ..models.transformer import _norm
 
         cfg = self._mcfg
         B, T = h.shape[:2]
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         y = _norm(h, lw["ln1_w"], lw.get("ln1_b", 0), cfg.norm, eps=cfg.norm_eps)
-        qkv = self._maybe_fused_qkv(lw, y, cos, sin, positions)
+        qkv = None if lora is not None else \
+            self._maybe_fused_qkv(lw, y, cos, sin, positions)
         if qkv is None:
-            q = (y @ lw["wq"]).reshape(B, T, H, Dh)
-            k = (y @ lw["wk"]).reshape(B, T, KV, Dh)
-            v = (y @ lw["wv"]).reshape(B, T, KV, Dh)
+            q = y @ lw["wq"]
+            k = y @ lw["wk"]
+            v = y @ lw["wv"]
+            if lora is not None:
+                q = self._lora_add(q, y, lora, "wq")
+                k = self._lora_add(k, y, lora, "wk")
+                v = self._lora_add(v, y, lora, "wv")
+            q = q.reshape(B, T, H, Dh)
+            k = k.reshape(B, T, KV, Dh)
+            v = v.reshape(B, T, KV, Dh)
             if cfg.attn_qkv_bias:
                 q = q + lw["b_q"].astype(y.dtype).reshape(H, Dh)
                 k = k + lw["b_k"].astype(y.dtype).reshape(KV, Dh)
@@ -429,9 +456,9 @@ class InferenceEngine:
         else:
             q, k, v = qkv
         attn, cache_out = attn_fn(q, k, v)
-        return self._block_tail(lw, h, y, attn), cache_out
+        return self._block_tail(lw, h, y, attn, lora=lora), cache_out
 
-    def _block_tail(self, lw, h, y, attn):
+    def _block_tail(self, lw, h, y, attn, lora=None):
         """Output projection + residual(s) + FFN — shared by the XLA and
         fused layer bodies (engine_v2's fused paged step re-enters here
         after its fused attention)."""
@@ -439,7 +466,10 @@ class InferenceEngine:
 
         cfg = self._mcfg
         B, T = h.shape[:2]
-        attn_out = attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ lw["wo"]
+        attn_flat = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
+        attn_out = attn_flat @ lw["wo"]
+        if lora is not None:
+            attn_out = self._lora_add(attn_out, attn_flat, lora, "wo")
         if cfg.attn_out_bias:
             attn_out = attn_out + lw["b_o"].astype(attn_out.dtype)
         if cfg.parallel_block:

@@ -71,6 +71,12 @@ class SequenceDescriptor:
     # fused in-dispatch sampler. None means greedy with no EOS — exactly
     # the pre-sampling engine contract, so step() callers never see it.
     sampling: Optional[object] = None
+    # multi-tenant LoRA (ISSUE 18): the adapter this sequence decodes
+    # under and its pinned AdapterPool slot. Slot 0 is the all-zeros
+    # null adapter — no-adapter rows ride the same program and add an
+    # exact 0.0, so the slot is ALWAYS a valid gather index.
+    adapter_id: Optional[str] = None
+    adapter_slot: int = 0
 
 
 @dataclasses.dataclass
@@ -205,6 +211,22 @@ class InferenceEngineV2(InferenceEngine):
 
             self.tier = HostKVTier(spill_dir=cfg.kv_tier.spill_dir,
                                    prefetch_depth=cfg.kv_tier.prefetch_depth)
+        # multi-tenant LoRA serving (ISSUE 18): paged pool of adapter
+        # factor pairs; per-row slot indices gather from it inside every
+        # serving program. ``_pending_adapter`` mirrors
+        # ``_pending_sampling`` — bindings registered before the uid's
+        # first prefill, consumed when admission creates the descriptor.
+        self.adapters = None
+        if cfg.adapters.enabled:
+            from .adapters import AdapterPool
+
+            self.adapters = AdapterPool(
+                mcfg, slots=cfg.adapters.slots,
+                max_rank=cfg.adapters.max_rank,
+                targets=cfg.adapters.targets,
+                prefetch_depth=cfg.adapters.prefetch_depth,
+                dtype=cfg.jax_dtype())
+        self._pending_adapter: Dict[int, str] = {}
 
     # -- scheduling queries (engine_v2.py:158-232) ---------------------
 
@@ -299,6 +321,25 @@ class InferenceEngineV2(InferenceEngine):
                 f"(largest single ask: uid {worst_uid} wants {worst_ask} new"
                 f"{cache_note}); flush finished sequences or raise "
                 f"num_kv_blocks{stop_note}")
+        if self.adapters is not None:
+            # adapter residency is the THIRD admission resource (ISSUE 18,
+            # after KV blocks and max_seq_len): a batch whose pending
+            # adapters cannot all be pinned is refused atomically, and the
+            # refusal names the adapter pool — NOT KV — so the scheduler
+            # parks the request instead of spilling KV that would not help
+            want = []
+            for uid in uids:
+                if self._seqs.get(uid) is None:
+                    aid = self._pending_adapter.get(uid)
+                    if aid is not None:
+                        want.append(aid)
+            if want:
+                aok, awhy = self.adapters.can_acquire_all(want)
+                if not aok:
+                    return False, need, (
+                        f"adapter pool (KV is fine: {need} blocks needed, "
+                        f"{self.allocator.free_blocks} free): {awhy}; park "
+                        f"until a running sequence releases its slot")
         return True, need, ""
 
     # -- device programs ----------------------------------------------
@@ -318,6 +359,32 @@ class InferenceEngineV2(InferenceEngine):
             return PagedKVCache(kp[0], vp[0], kp[1], vp[1])
         return PagedKVCache(kp, vp)
 
+    @staticmethod
+    def _apool_xs(apool):
+        """Adapter-pool xs for the layer scans: the pool's factor stacks
+        are [L, S, ...] so they join the per-layer scan alongside weights
+        and KV; each layer body sees its own [S, ...] slice. () when the
+        program runs without adapters — pytree structure (not values)
+        keys the jit specialization, so adapters-off programs are
+        byte-identical to the pre-adapter ones."""
+        return () if apool is None else (apool,)
+
+    def _aargs(self, descs, B: int):
+        """Trailing adapter operands for a dispatch: () when the pool is
+        off, else ``(device_operands, slots[B] i32)`` with padding rows on
+        the null slot. Slot VALUES are data — the operand shapes are
+        fixed by (pool geometry, B-bin), so new adapters never recompile."""
+        if self.adapters is None:
+            return ()
+        return (self.adapters.device_operands(), self._aslots(descs, B))
+
+    @staticmethod
+    def _aslots(descs, B: int):
+        s = np.zeros((B,), np.int32)
+        for i, d in enumerate(descs):
+            s[i] = d.adapter_slot
+        return s
+
     def _paged_prefill_fn(self, p: int, tpad: int):
         fn = self._prefill_cache.get((p, tpad))
         if fn is not None:
@@ -328,7 +395,8 @@ class InferenceEngineV2(InferenceEngine):
         self._prefill_cache[(p, tpad)] = fn
         return fn
 
-    def _paged_prefill_impl(self, params, cache: PagedKVCache, ids, plen, btables):
+    def _paged_prefill_impl(self, params, cache: PagedKVCache, ids, plen, btables,
+                            apool=None, aslots=None):
         """BATCHED prefill — all pending new sequences in ONE program
         (reference packs them into one ragged batch, engine_v2.py:107).
 
@@ -347,7 +415,8 @@ class InferenceEngineV2(InferenceEngine):
         x, (cos, sin), positions = self._embed_at(params, ids, jnp.zeros((P,), jnp.int32))
 
         def layer_fn(h, layer_and_cache):
-            lw, ck, cv = layer_and_cache
+            lw, ck, cv = layer_and_cache[:3]
+            lora = None if apool is None else (layer_and_cache[3], aslots)
 
             def attn_fn(q, k, v):
                 KV, Dh = k.shape[2], k.shape[3]
@@ -381,10 +450,12 @@ class InferenceEngineV2(InferenceEngine):
                                        impl=self.config.attention_impl,
                                        alibi_slopes=self._alibi), (ck2, cv2)
 
-            return self._layer_body(lw, h, cos, sin, positions, attn_fn)
+            return self._layer_body(lw, h, cos, sin, positions, attn_fn,
+                                    lora=lora)
 
         x, (kp, vp) = jax.lax.scan(layer_fn, x,
-                                   (params["layers"],) + self._kv_xs(cache))
+                                   (params["layers"],) + self._kv_xs(cache)
+                                   + self._apool_xs(apool))
         x_last = jnp.take_along_axis(x, (plen - 1)[:, None, None].astype(jnp.int32), axis=1)
         logits = self.model.head(params, x_last)[:, 0]
         return self._cache_of(kp, vp), logits
@@ -400,7 +471,7 @@ class InferenceEngineV2(InferenceEngine):
         return fn
 
     def _extend_layer(self, lw, h, ck, cv, cos, sin, positions, start, nnew,
-                      btables):
+                      btables, lora=None):
         """One chunked-prefill layer: scatter the chunk's K/V into the pool
         and attend through the block table. Shared by the pure extend
         program and the mixed Dynamic-SplitFuse step (step()). Returns
@@ -449,9 +520,11 @@ class InferenceEngineV2(InferenceEngine):
                                          nnew, alibi_slopes=self._alibi)
             return out, (ck2, cv2)
 
-        return self._layer_body(lw, h, cos, sin, positions, attn_fn)
+        return self._layer_body(lw, h, cos, sin, positions, attn_fn,
+                                lora=lora)
 
-    def _extend_impl(self, params, cache: PagedKVCache, ids, start, nnew, btables):
+    def _extend_impl(self, params, cache: PagedKVCache, ids, start, nnew, btables,
+                     apool=None, aslots=None):
         """Chunked-prefill extension — a C-token chunk per sequence in ONE
         program (one program per CHUNK, not per token; VERDICT r1 weak #4).
 
@@ -464,12 +537,14 @@ class InferenceEngineV2(InferenceEngine):
         x, (cos, sin), positions = self._embed_at(params, ids, start)
 
         def layer_fn(h, layer_and_cache):
-            lw, ck, cv = layer_and_cache
+            lw, ck, cv = layer_and_cache[:3]
+            lora = None if apool is None else (layer_and_cache[3], aslots)
             return self._extend_layer(lw, h, ck, cv, cos, sin, positions,
-                                      start, nnew, btables)
+                                      start, nnew, btables, lora=lora)
 
         x, (kp, vp) = jax.lax.scan(layer_fn, x,
-                                   (params["layers"],) + self._kv_xs(cache))
+                                   (params["layers"],) + self._kv_xs(cache)
+                                   + self._apool_xs(apool))
         x_last = jnp.take_along_axis(x, (nnew - 1)[:, None, None].astype(jnp.int32), axis=1)
         logits = self.model.head(params, x_last)[:, 0]
         return self._cache_of(kp, vp), logits
@@ -484,7 +559,8 @@ class InferenceEngineV2(InferenceEngine):
         self._decode_cache[b] = fn
         return fn
 
-    def _paged_decode_impl(self, params, cache: PagedKVCache, tok, pos, btables):
+    def _paged_decode_impl(self, params, cache: PagedKVCache, tok, pos, btables,
+                           apool=None, aslots=None):
         """tok [B], pos [B] (next slot), btables [B, max_blocks].
 
         Cache structure note (round 5, all three measured on-chip): this
@@ -510,20 +586,29 @@ class InferenceEngineV2(InferenceEngine):
         x, (cos, sin), _ = self._embed_at(params, tok[:, None], pos)
 
         def layer_fn(h, layer_and_cache):
-            lw, ck, cv = layer_and_cache
-            return self._decode_layer(lw, h, ck, cv, cos, sin, pos, btables)
+            lw, ck, cv = layer_and_cache[:3]
+            lora = None if apool is None else (layer_and_cache[3], aslots)
+            return self._decode_layer(lw, h, ck, cv, cos, sin, pos, btables,
+                                      lora=lora)
 
         x, (kp, vp) = jax.lax.scan(layer_fn, x,
-                                   (params["layers"],) + self._kv_xs(cache))
+                                   (params["layers"],) + self._kv_xs(cache)
+                                   + self._apool_xs(apool))
         logits = self.model.head(params, x)[:, 0]
         return self._cache_of(kp, vp), logits
 
-    def _decode_layer(self, lw, h, ck, cv, cos, sin, pos, btables):
+    def _decode_layer(self, lw, h, ck, cv, cos, sin, pos, btables, lora=None):
         """One decode layer (one token per sequence): fused Pallas path
         when eligible, else append + paged attention. Shared by the pure
         decode step, the fused decode_loop, and the mixed step(). Returns
-        ``(h2, (ck2, cv2))``."""
-        if self._decode_kernel == "pallas":
+        ``(h2, (ck2, cv2))``.
+
+        With ``lora`` set the fully-fused layer is skipped — its fused
+        QKV kernel bypasses ``_layer_body``'s projection seam where the
+        per-row adapter deltas apply — but the attention-only split-K
+        fusion below still runs (attention reads the pool, adapters only
+        touch the projections)."""
+        if self._decode_kernel == "pallas" and lora is None:
             fused = self._fused_paged_layer(lw, h, ck, cv, cos, sin,
                                             pos, btables)
             if fused is not None:
@@ -556,7 +641,7 @@ class InferenceEngineV2(InferenceEngine):
                                           kv_len=pos + 1,
                                           alibi_slopes=self._alibi), (ck2, cv2)
 
-        return self._layer_body(lw, h, cos, sin, pos, attn_fn)
+        return self._layer_body(lw, h, cos, sin, pos, attn_fn, lora=lora)
 
     def _fused_paged_layer(self, lw, h, ck, cv, cos, sin, pos, btables):
         """One fully-fused decode layer: fused QKV+RoPE+append writes the
@@ -944,6 +1029,19 @@ class InferenceEngineV2(InferenceEngine):
         if not tokens:
             raise ValueError(f"new uid {uid} with no tokens")
         desc = SequenceDescriptor(uid=uid)
+        aid = self._pending_adapter.get(uid)
+        if aid is not None:
+            if self.adapters is None:
+                raise RuntimeError(
+                    f"uid {uid} names adapter {aid!r} but adapters are "
+                    f"disabled (set adapters.enabled in the inference "
+                    f"config)")
+            # pin BEFORE any KV mutation: AdapterPoolDry here leaves the
+            # engine untouched (put()'s atomic-on-reject contract); the
+            # pending binding is only consumed on success
+            desc.adapter_slot = self.adapters.acquire(aid)
+            desc.adapter_id = aid
+            self._pending_adapter.pop(uid, None)
         if self.config.prefix_caching:
             bs = self.cache.block_size
             max_full = (len(tokens) - 1) // bs
@@ -989,6 +1087,10 @@ class InferenceEngineV2(InferenceEngine):
         if new_uid in self._seqs:
             raise ValueError(f"uid {new_uid} is already live")
         self._require_resident([parent_uid], "fork()")
+        if parent.adapter_id is not None:
+            # the clone decodes under the parent's adapter: bump the slot
+            # refcount (a resident-hit acquire) so eviction respects both
+            self.adapters.acquire(parent.adapter_id)
         self.allocator.retain(parent.blocks)
         self._seqs[new_uid] = SequenceDescriptor(
             uid=new_uid, seen_tokens=parent.seen_tokens,
@@ -997,7 +1099,8 @@ class InferenceEngineV2(InferenceEngine):
             else np.array(parent.last_logits),
             tokens=list(parent.tokens), committed=parent.committed,
             last_key=parent.last_key, no_commit=parent.no_commit,
-            sampling=parent.sampling)
+            sampling=parent.sampling, adapter_id=parent.adapter_id,
+            adapter_slot=parent.adapter_slot)
 
     def _table(self, desc: SequenceDescriptor,
                width: Optional[int] = None) -> np.ndarray:
@@ -1134,7 +1237,8 @@ class InferenceEngineV2(InferenceEngine):
         if prefills:
             P, tpad, ids, plen, btables = self._pack_prefill(prefills)
             fn = self._paged_prefill_fn(P, tpad)
-            self.cache, logits = fn(self.params, self.cache, ids, plen, btables)
+            self.cache, logits = fn(self.params, self.cache, ids, plen, btables,
+                                    *self._aargs([d for d, _ in prefills], P))
             self.dispatch_count += 1
             self._program_keys.add(("prefill", P, tpad))
             logits = np.asarray(logits)
@@ -1153,7 +1257,8 @@ class InferenceEngineV2(InferenceEngine):
             B, W, tok, pos, tables = self._pack_decode(
                 [d for d, _ in singles], [t for _, t in singles])
             fn = self._paged_decode_fn(B)
-            self.cache, logits = fn(self.params, self.cache, tok, pos, tables)
+            self.cache, logits = fn(self.params, self.cache, tok, pos, tables,
+                                    *self._aargs([d for d, _ in singles], B))
             self.dispatch_count += 1
             self._program_keys.add(("decode", B, W))
             logits = np.asarray(logits)
@@ -1179,7 +1284,9 @@ class InferenceEngineV2(InferenceEngine):
                 self._ensure_blocks(d, d.seen_tokens + len(chunk))
             B, C, W, ids, start, nnew, tables = self._pack_chunks(batch)
             fn = self._extend_fn((B, C))
-            self.cache, logits = fn(self.params, self.cache, ids, start, nnew, tables)
+            self.cache, logits = fn(self.params, self.cache, ids, start, nnew,
+                                    tables,
+                                    *self._aargs([d for d, _ in batch], B))
             self.dispatch_count += 1
             self._program_keys.add(("extend", B, C, W))
             logits = np.asarray(logits)
@@ -1204,7 +1311,8 @@ class InferenceEngineV2(InferenceEngine):
         return fn
 
     def _mixed_step_impl(self, params, cache: PagedKVCache, dtok, dpos,
-                         dtables, pids, pstart, pnnew, ptables):
+                         dtables, pids, pstart, pnnew, ptables,
+                         apool=None, daslots=None, paslots=None):
         """The Dynamic-SplitFuse mixed step: ONE program advances every
         running sequence by one decode token ([Bd] rows) AND absorbs a
         prefill chunk for every prefilling sequence ([Bp, C] rows) — the
@@ -1226,15 +1334,19 @@ class InferenceEngineV2(InferenceEngine):
 
         def layer_fn(carry, layer_and_cache):
             hd, hp = carry
-            lw, ck, cv = layer_and_cache
-            hd2, (ck2, cv2) = self._decode_layer(lw, hd, ck, cv, cos, sin,
-                                                 dpos, dtables)
-            hp2, (ck3, cv3) = self._extend_layer(lw, hp, ck2, cv2, cos, sin,
-                                                 ppos, pstart, pnnew, ptables)
+            lw, ck, cv = layer_and_cache[:3]
+            ap = None if apool is None else layer_and_cache[3]
+            hd2, (ck2, cv2) = self._decode_layer(
+                lw, hd, ck, cv, cos, sin, dpos, dtables,
+                lora=None if ap is None else (ap, daslots))
+            hp2, (ck3, cv3) = self._extend_layer(
+                lw, hp, ck2, cv2, cos, sin, ppos, pstart, pnnew, ptables,
+                lora=None if ap is None else (ap, paslots))
             return (hd2, hp2), (ck3, cv3)
 
         (xd, xp), (kp, vp) = jax.lax.scan(layer_fn, (xd, xp),
-                                          (params["layers"],) + self._kv_xs(cache))
+                                          (params["layers"],) + self._kv_xs(cache)
+                                          + self._apool_xs(apool))
         dlogits = self.model.head(params, xd)[:, 0]
         x_last = jnp.take_along_axis(xp, (pnnew - 1)[:, None, None].astype(jnp.int32),
                                      axis=1)
@@ -1253,7 +1365,8 @@ class InferenceEngineV2(InferenceEngine):
         self._mixed_cache[key] = fn
         return fn
 
-    def _spec_step_impl(self, params, cache: PagedKVCache, dops, pops, sops):
+    def _spec_step_impl(self, params, cache: PagedKVCache, dops, pops, sops,
+                        apool=None):
         """The speculative mixed step: ONE program advances plain decode
         rows by one token, absorbs prefill chunks, AND verifies draft
         rows — each draft row is ``[pending_token, d1..dk]`` running
@@ -1277,39 +1390,57 @@ class InferenceEngineV2(InferenceEngine):
         import jax.numpy as jnp
 
         dops, pops, sops = tuple(dops), tuple(pops), tuple(sops)
+        # adapter slots ride INSIDE the lane tuples (one trailing [B] i32
+        # per present lane) so lane presence still keys the program via
+        # pytree structure alone
+        dslots = pslots = sslots = None
         xd = xp = xs = None
         cos = sin = None
         if dops:
-            dtok, dpos, dtables = dops
+            if apool is not None:
+                dtok, dpos, dtables, dslots = dops
+            else:
+                dtok, dpos, dtables = dops
             xd, (cos, sin), _ = self._embed_at(params, dtok[:, None], dpos)
         if pops:
-            pids, pstart, pnnew, ptables = pops
+            if apool is not None:
+                pids, pstart, pnnew, ptables, pslots = pops
+            else:
+                pids, pstart, pnnew, ptables = pops
             xp, (cos, sin), ppos = self._embed_at(params, pids, pstart)
         if sops:
-            sids, sstart, snnew, stables = sops
+            if apool is not None:
+                sids, sstart, snnew, stables, sslots = sops
+            else:
+                sids, sstart, snnew, stables = sops
             xs, (cos, sin), spos = self._embed_at(params, sids, sstart)
 
         def layer_fn(carry, layer_and_cache):
             hd, hp, hs = carry
-            lw, ck, cv = layer_and_cache
+            lw, ck, cv = layer_and_cache[:3]
+            ap = None if apool is None else layer_and_cache[3]
             if hd is not None:
-                hd, (ck, cv) = self._decode_layer(lw, hd, ck, cv, cos, sin,
-                                                  dpos, dtables)
+                hd, (ck, cv) = self._decode_layer(
+                    lw, hd, ck, cv, cos, sin, dpos, dtables,
+                    lora=None if ap is None else (ap, dslots))
             if hp is not None:
-                hp, (ck, cv) = self._extend_layer(lw, hp, ck, cv, cos, sin,
-                                                  ppos, pstart, pnnew,
-                                                  ptables)
+                hp, (ck, cv) = self._extend_layer(
+                    lw, hp, ck, cv, cos, sin, ppos, pstart, pnnew, ptables,
+                    lora=None if ap is None else (ap, pslots))
             if hs is not None:
                 # the verify lane IS the extend path (ISSUE 8 satellite:
                 # k+1-wide rows are outside the single-token fused decode
-                # kernels — decode_fusion_eligibility's "verify" gate)
-                hs, (ck, cv) = self._extend_layer(lw, hs, ck, cv, cos, sin,
-                                                  spos, sstart, snnew,
-                                                  stables)
+                # kernels — decode_fusion_eligibility's "verify" gate);
+                # with adapters, the verify rows apply their own slots so
+                # drafts are verified under the SAME weights they decode
+                hs, (ck, cv) = self._extend_layer(
+                    lw, hs, ck, cv, cos, sin, spos, sstart, snnew, stables,
+                    lora=None if ap is None else (ap, sslots))
             return (hd, hp, hs), (ck, cv)
 
         (xd, xp, xs), (kp, vp) = jax.lax.scan(
-            layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache))
+            layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache)
+            + self._apool_xs(apool))
         dlogits = self.model.head(params, xd)[:, 0] if dops else None
         plogits = None
         if pops:
@@ -1375,13 +1506,38 @@ class InferenceEngineV2(InferenceEngine):
         if not ok:
             raise RuntimeError(f"cannot schedule {what}: {why}")
 
-        # admission passed: create descriptors for new prefill uids
+        # admission passed: pin this tick's new adapters FIRST (pool
+        # mutations precede any descriptor/KV mutation; a crashed fetch
+        # rolls the acquired refs back so the tick rejects atomically).
+        # Residents sort first so a miss's LRU eviction can never steal a
+        # slot an already-resident hit in this same batch is about to pin.
+        abind: Dict[int, Tuple[str, int]] = {}
+        if self.adapters is not None:
+            order = [(uid, self._pending_adapter[uid])
+                     for uid, _ in prefills
+                     if uid not in self._seqs
+                     and self._pending_adapter.get(uid) is not None]
+            order.sort(key=lambda t: self.adapters.slot_of(t[1]) is None)
+            done = []
+            try:
+                for uid, aid in order:
+                    abind[uid] = (aid, self.adapters.acquire(aid))
+                    done.append(aid)
+            except BaseException:
+                for aid in done:
+                    self.adapters.release(aid)
+                raise
+
+        # create descriptors for new prefill uids
         pdescs = []
         for uid, chunk in prefills:
             desc = self._seqs.get(uid)
             if desc is None:
                 desc = SequenceDescriptor(uid=uid)
                 desc.sampling = self._pending_sampling.pop(uid, None)
+                if uid in abind:
+                    desc.adapter_id, desc.adapter_slot = abind[uid]
+                    self._pending_adapter.pop(uid, None)
                 self._seqs[uid] = desc
             pdescs.append(desc)
         ddescs = [self._seqs[u] for u in decode_uids]
@@ -1453,14 +1609,19 @@ class InferenceEngineV2(InferenceEngine):
             Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
                 chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
             fn = self._mixed_fn((Bd, Wd, Bp, C, Wp))
+            ax = ()
+            if self.adapters is not None:
+                ax = (self.adapters.device_operands(),
+                      self._aslots(ddescs, Bd), self._aslots(pdescs, Bp))
             self.cache, dl, pl = fn(self.params, self.cache, tok, pos,
-                                    dtables, ids, start, nnew, ptables)
+                                    dtables, ids, start, nnew, ptables, *ax)
             self._program_keys.add(("mixed", Bd, Wd, Bp, C, Wp))
             dlogits, plogits = np.asarray(dl), np.asarray(pl)
         elif ddescs:
             Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs, decode_tokens)
             fn = self._paged_decode_fn(Bd)
-            self.cache, dl = fn(self.params, self.cache, tok, pos, dtables)
+            self.cache, dl = fn(self.params, self.cache, tok, pos, dtables,
+                                *self._aargs(ddescs, Bd))
             self._program_keys.add(("decode", Bd, Wd))
             dlogits = np.asarray(dl)
         elif pdescs:
@@ -1470,7 +1631,7 @@ class InferenceEngineV2(InferenceEngine):
                 chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
             fn = self._extend_fn((Bp, C))
             self.cache, pl = fn(self.params, self.cache, ids, start, nnew,
-                                ptables)
+                                ptables, *self._aargs(pdescs, Bp))
             self._program_keys.add(("extend", Bp, C, Wp))
             plogits = np.asarray(pl)
         else:
@@ -1499,16 +1660,21 @@ class InferenceEngineV2(InferenceEngine):
         V = self._mcfg.vocab_size
         dops = pops = sops = ()
         Bd = Wd = Bp = C = Wp = 0
+        lora = self.adapters is not None
         if ddescs:
             Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs,
                                                           decode_tokens)
             dops = (tok, pos, dtables)
+            if lora:
+                dops += (self._aslots(ddescs, Bd),)
         if pdescs:
             chunks = [(d, c) for d, (_, c) in zip(pdescs, prefills)]
             cmax = max(len(c) for _, c in chunks)
             Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
                 chunks, pad_chunk=sv.bin_chunk(cmax))
             pops = (ids, start, nnew, ptables)
+            if lora:
+                pops += (self._aslots(pdescs, Bp),)
         schunks = [(d, c) for d, (_, c) in zip(sdescs, speculative)]
         # verify width off the k ladder: a row carrying j drafts is j+1
         # tokens; pad to bin_k(max j) + 1 so the warmed server's verify
@@ -1517,11 +1683,14 @@ class InferenceEngineV2(InferenceEngine):
         Bs, Cs, Ws, sids, sstart, snnew, stables = self._pack_chunks(
             schunks, pad_chunk=sv.speculative.bin_k(kmax) + 1)
         sops = (sids, sstart, snnew, stables)
+        if lora:
+            sops += (self._aslots(sdescs, Bs),)
 
         key = ("spec", Bd, Wd, Bp, C, Wp, Bs, Cs, Ws)
         fn = self._spec_fn(key)
-        self.cache, dl, pl, sres = fn(self.params, self.cache, dops, pops,
-                                      sops)
+        self.cache, dl, pl, sres = fn(
+            self.params, self.cache, dops, pops, sops,
+            *((self.adapters.device_operands(),) if lora else ()))
         self.dispatch_count += 1
         self._program_keys.add(key)
         dlogits = (np.asarray(dl) if dl is not None
@@ -1583,6 +1752,43 @@ class InferenceEngineV2(InferenceEngine):
             self._pending_sampling.pop(uid, None)
         else:
             self._pending_sampling[uid] = params
+
+    def configure_adapter(self, uid: int, adapter_id: Optional[str]) -> None:
+        """Bind ``adapter_id`` to ``uid`` — the ``configure_sampling``
+        shape (ISSUE 18). Unknown uids register a PENDING binding consumed
+        when admission creates the descriptor (that is where the pool slot
+        is pinned, under the tick's atomic admission); live uids rebind in
+        place, acquiring the new adapter before releasing the old so a
+        failed acquire changes nothing. ``None`` restores the base model
+        (null slot 0)."""
+        desc = self._seqs.get(uid)
+        if desc is None:
+            if adapter_id is None:
+                self._pending_adapter.pop(uid, None)
+                return
+            if self.adapters is None:
+                raise RuntimeError(
+                    "configure_adapter: adapters are disabled (set "
+                    "adapters.enabled in the inference config)")
+            if not self.adapters.registered(adapter_id):
+                raise KeyError(
+                    f"configure_adapter: {adapter_id!r} is not registered "
+                    f"— publish it first")
+            self._pending_adapter[uid] = adapter_id
+            return
+        if adapter_id == desc.adapter_id:
+            return
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise RuntimeError(
+                    "configure_adapter: adapters are disabled (set "
+                    "adapters.enabled in the inference config)")
+            slot = self.adapters.acquire(adapter_id)
+        else:
+            slot = 0
+        if desc.adapter_id is not None:
+            self.adapters.release(desc.adapter_id)
+        desc.adapter_id, desc.adapter_slot = adapter_id, slot
 
     def _sampling_operands(self, descs, B: int):
         """Per-row traced sampling operands, padded to the binned batch:
@@ -1662,7 +1868,8 @@ class InferenceEngineV2(InferenceEngine):
 
     def _mixed_sampled_impl(self, params, cache: PagedKVCache, dtok, dpos,
                             dtables, dsp, dmask, pids, pstart, pnnew,
-                            ptables, psp, pmask):
+                            ptables, psp, pmask, apool=None, daslots=None,
+                            paslots=None):
         """The mixed step with the sampler fused at the head: identical
         trunk to ``_mixed_step_impl`` (same layer scan, same gather-last
         head projections), then ``seeded_tokens`` per lane. Returns
@@ -1671,7 +1878,8 @@ class InferenceEngineV2(InferenceEngine):
         from .sampling import seeded_tokens
 
         cache, dlogits, plogits = self._mixed_step_impl(
-            params, cache, dtok, dpos, dtables, pids, pstart, pnnew, ptables)
+            params, cache, dtok, dpos, dtables, pids, pstart, pnnew, ptables,
+            apool=apool, daslots=daslots, paslots=paslots)
         dseeds, dtemp, dtk, dtp, deos = dsp
         pseeds, ptemp, ptk, ptp, peos = psp
         # decode row emits the token at absolute index dpos+1 (dpos is the
@@ -1686,11 +1894,12 @@ class InferenceEngineV2(InferenceEngine):
         return cache, dtoks, ddone, ptoks, pdone
 
     def _decode_sampled_impl(self, params, cache: PagedKVCache, dtok, dpos,
-                             dtables, dsp, dmask):
+                             dtables, dsp, dmask, apool=None, daslots=None):
         from .sampling import seeded_tokens
 
         cache, dlogits = self._paged_decode_impl(params, cache, dtok, dpos,
-                                                 dtables)
+                                                 dtables, apool=apool,
+                                                 aslots=daslots)
         dseeds, dtemp, dtk, dtp, deos = dsp
         dtoks = seeded_tokens(dlogits, dseeds, dpos + 1, dtemp, dtk, dtp,
                               mask=dmask)
@@ -1698,11 +1907,13 @@ class InferenceEngineV2(InferenceEngine):
         return cache, dtoks, ddone
 
     def _extend_sampled_impl(self, params, cache: PagedKVCache, pids, pstart,
-                             pnnew, ptables, psp, pmask):
+                             pnnew, ptables, psp, pmask, apool=None,
+                             paslots=None):
         from .sampling import seeded_tokens
 
         cache, plogits = self._extend_impl(params, cache, pids, pstart,
-                                           pnnew, ptables)
+                                           pnnew, ptables, apool=apool,
+                                           aslots=paslots)
         pseeds, ptemp, ptk, ptp, peos = psp
         ptoks = seeded_tokens(plogits, pseeds, pstart + pnnew, ptemp, ptk,
                               ptp, mask=pmask)
@@ -1710,7 +1921,7 @@ class InferenceEngineV2(InferenceEngine):
         return cache, ptoks, pdone
 
     def _spec_sampled_impl(self, params, cache: PagedKVCache, dops, pops,
-                           sops, dsp, psp, ssp, dmask, pmask):
+                           sops, dsp, psp, ssp, dmask, pmask, apool=None):
         """The speculative mixed step generalized to TRUE speculative
         sampling: the verify lane evaluates the seeded sampling chain
         ``st[j] = seeded_tokens(logits_after_j, seed, sstart+j+1)`` at
@@ -1732,33 +1943,47 @@ class InferenceEngineV2(InferenceEngine):
         from .sampling import seeded_tokens
 
         dops, pops, sops = tuple(dops), tuple(pops), tuple(sops)
+        dslots = pslots = sslots = None
         xd = xp = xs = None
         cos = sin = None
         if dops:
-            dtok, dpos, dtables = dops
+            if apool is not None:
+                dtok, dpos, dtables, dslots = dops
+            else:
+                dtok, dpos, dtables = dops
             xd, (cos, sin), _ = self._embed_at(params, dtok[:, None], dpos)
         if pops:
-            pids, pstart, pnnew, ptables = pops
+            if apool is not None:
+                pids, pstart, pnnew, ptables, pslots = pops
+            else:
+                pids, pstart, pnnew, ptables = pops
             xp, (cos, sin), ppos = self._embed_at(params, pids, pstart)
-        sids, sstart, snnew, stables = sops
+        if apool is not None:
+            sids, sstart, snnew, stables, sslots = sops
+        else:
+            sids, sstart, snnew, stables = sops
         xs, (cos, sin), spos = self._embed_at(params, sids, sstart)
 
         def layer_fn(carry, layer_and_cache):
             hd, hp, hs = carry
-            lw, ck, cv = layer_and_cache
+            lw, ck, cv = layer_and_cache[:3]
+            ap = None if apool is None else layer_and_cache[3]
             if hd is not None:
-                hd, (ck, cv) = self._decode_layer(lw, hd, ck, cv, cos, sin,
-                                                  dpos, dtables)
+                hd, (ck, cv) = self._decode_layer(
+                    lw, hd, ck, cv, cos, sin, dpos, dtables,
+                    lora=None if ap is None else (ap, dslots))
             if hp is not None:
-                hp, (ck, cv) = self._extend_layer(lw, hp, ck, cv, cos, sin,
-                                                  ppos, pstart, pnnew,
-                                                  ptables)
-            hs, (ck, cv) = self._extend_layer(lw, hs, ck, cv, cos, sin,
-                                              spos, sstart, snnew, stables)
+                hp, (ck, cv) = self._extend_layer(
+                    lw, hp, ck, cv, cos, sin, ppos, pstart, pnnew, ptables,
+                    lora=None if ap is None else (ap, pslots))
+            hs, (ck, cv) = self._extend_layer(
+                lw, hs, ck, cv, cos, sin, spos, sstart, snnew, stables,
+                lora=None if ap is None else (ap, sslots))
             return (hd, hp, hs), (ck, cv)
 
         (xd, xp, xs), (kp, vp) = jax.lax.scan(
-            layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache))
+            layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache)
+            + self._apool_xs(apool))
         dres = pres = None
         if dops:
             dlogits = self.model.head(params, xd)[:, 0]
@@ -1849,9 +2074,13 @@ class InferenceEngineV2(InferenceEngine):
             masked = dmask is not None or pmask is not None
             key = (("mixed_m" if masked else "mixed"), Bd, Wd, Bp, C, Wp)
             fn = self._sampled_fn(("s",) + key, self._mixed_sampled_impl)
+            ax = ()
+            if self.adapters is not None:
+                ax = (self.adapters.device_operands(),
+                      self._aslots(ddescs, Bd), self._aslots(pdescs, Bp))
             self.cache, dt, dd, pt, pd = fn(
                 self.params, self.cache, tok, pos, dtables, dsp, dmask,
-                ids, start, nnew, ptables, psp, pmask)
+                ids, start, nnew, ptables, psp, pmask, *ax)
             self._assert_on_device_sampling(key, (dt, dd, pt, pd))
             self._program_keys.add(key)
             dtoks, ddone = np.asarray(dt), np.asarray(dd)
@@ -1864,7 +2093,8 @@ class InferenceEngineV2(InferenceEngine):
             key = (("decode_m" if dmask is not None else "decode"), Bd, Wd)
             fn = self._sampled_fn(("s",) + key, self._decode_sampled_impl)
             self.cache, dt, dd = fn(self.params, self.cache, tok, pos,
-                                    dtables, dsp, dmask)
+                                    dtables, dsp, dmask,
+                                    *self._aargs(ddescs, Bd))
             self._assert_on_device_sampling(key, (dt, dd))
             self._program_keys.add(key)
             dtoks, ddone = np.asarray(dt), np.asarray(dd)
@@ -1878,7 +2108,8 @@ class InferenceEngineV2(InferenceEngine):
             key = (("extend_m" if pmask is not None else "extend"), Bp, C, Wp)
             fn = self._sampled_fn(("s",) + key, self._extend_sampled_impl)
             self.cache, pt, pd = fn(self.params, self.cache, ids, start,
-                                    nnew, ptables, psp, pmask)
+                                    nnew, ptables, psp, pmask,
+                                    *self._aargs(pdescs, Bp))
             self._assert_on_device_sampling(key, (pt, pd))
             self._program_keys.add(key)
             ptoks, pdone = np.asarray(pt), np.asarray(pd)
@@ -1905,6 +2136,7 @@ class InferenceEngineV2(InferenceEngine):
         apply chain-match acceptance, rewind rejected draft KV, and emit
         the seeded chain per row."""
         sv = self.config.serving
+        lora = self.adapters is not None
         dops = pops = ()
         dsp = psp = ()
         dmask = pmask = None
@@ -1913,6 +2145,8 @@ class InferenceEngineV2(InferenceEngine):
             Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs,
                                                           decode_tokens)
             dops = (tok, pos, dtables)
+            if lora:
+                dops += (self._aslots(ddescs, Bd),)
             dsp = self._sampling_operands(ddescs, Bd)
             dmask = self._lane_masks(ddescs, [[t] for t in decode_tokens], Bd)
         if pdescs:
@@ -1921,6 +2155,8 @@ class InferenceEngineV2(InferenceEngine):
             Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
                 chunks, pad_chunk=sv.bin_chunk(cmax))
             pops = (ids, start, nnew, ptables)
+            if lora:
+                pops += (self._aslots(pdescs, Bp),)
             psp = self._sampling_operands(pdescs, Bp)
             pmask = self._lane_masks(pdescs, [c for _, c in prefills], Bp)
         schunks = [(d, c) for d, (_, c) in zip(sdescs, speculative)]
@@ -1928,15 +2164,18 @@ class InferenceEngineV2(InferenceEngine):
         Bs, Cs, Ws, sids, sstart, snnew, stables = self._pack_chunks(
             schunks, pad_chunk=sv.speculative.bin_k(kmax) + 1)
         sops = (sids, sstart, snnew, stables)
+        if lora:
+            sops += (self._aslots(sdescs, Bs),)
         ssp = self._sampling_operands(sdescs, Bs)
 
         masked = dmask is not None or pmask is not None
         key = (("spec_m" if masked else "spec"),
                Bd, Wd, Bp, C, Wp, Bs, Cs, Ws)
         fn = self._sampled_fn(("s",) + key, self._spec_sampled_impl)
-        self.cache, dres, pres, sres = fn(self.params, self.cache, dops,
-                                          pops, sops, dsp, psp, ssp,
-                                          dmask, pmask)
+        self.cache, dres, pres, sres = fn(
+            self.params, self.cache, dops, pops, sops, dsp, psp, ssp,
+            dmask, pmask,
+            *((self.adapters.device_operands(),) if lora else ()))
         self.dispatch_count += 1
         self._assert_on_device_sampling(key, (dres, pres, sres))
         self._program_keys.add(key)
@@ -1985,13 +2224,15 @@ class InferenceEngineV2(InferenceEngine):
 
         B, n_steps = key
 
-        def impl(params, cache, tok, pos, btables):
+        def impl(params, cache, tok, pos, btables, apool=None, aslots=None):
             import jax.numpy as jnp
 
             def step(carry, _):
                 cache, tok, pos, _ = carry
                 cache, logits = self._paged_decode_impl(params, cache, tok,
-                                                        pos, btables)
+                                                        pos, btables,
+                                                        apool=apool,
+                                                        aslots=aslots)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (cache, nxt, pos + 1, logits), nxt
 
@@ -2045,7 +2286,8 @@ class InferenceEngineV2(InferenceEngine):
         tok0 = np.asarray(tokens, np.int32)
         fn = self._decode_loop_fn((len(uids), int(n_steps)))
         self.cache, toks, last_logits = fn(self.params, self.cache, tok0,
-                                           pos, btables)
+                                           pos, btables,
+                                           *self._aargs(descs, len(uids)))
         self.dispatch_count += 1
         self._program_keys.add(("decode_loop", len(uids), int(n_steps), W))
         last_logits = np.asarray(last_logits)
@@ -2402,6 +2644,11 @@ class InferenceEngineV2(InferenceEngine):
             if desc is None:
                 raise ValueError(f"unknown uid {uid}")
             self._pending_sampling.pop(uid, None)
+            self._pending_adapter.pop(uid, None)
+            if desc.adapter_id is not None and self.adapters is not None:
+                # unpin the slot; the adapter stays resident (warm) until
+                # LRU eviction needs it
+                self.adapters.release(desc.adapter_id)
             if early_stop:
                 self.early_stop_freed_blocks += sum(
                     1 for b in desc.blocks if b >= 0)

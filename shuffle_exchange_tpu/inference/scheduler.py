@@ -135,6 +135,16 @@ class ServingRequest:
     # its token budget, returning its KV blocks and running slot early.
     sampling: Optional[SamplingParams] = None
     stopped: bool = False
+    # multi-tenant LoRA (ISSUE 18): the adapter this request decodes
+    # under (None = base model, the reserved null slot 0). The id rides
+    # every export/inject/failover snapshot so a re-placed request
+    # re-binds the SAME adapter on the survivor. ``adapter_waiting``
+    # marks a queued request parked on pool residency: it keeps its
+    # FIFO seat but yields its packing slot until a slot frees — park,
+    # never preempt, so adapter pressure costs queue time, not
+    # re-prefill compute.
+    adapter_id: Optional[str] = None
+    adapter_waiting: bool = False
 
     @property
     def prefill_target(self) -> List[int]:
@@ -236,6 +246,14 @@ class ContinuousBatchingScheduler:
         self.early_stops = 0
         self.dead_tokens_saved = 0
         self.sampling_resamples = 0
+        # multi-tenant LoRA (ISSUE 18): the engine's AdapterPool (None
+        # unless config.adapters.enabled), the residency-park counters,
+        # and the per-adapter emitted-token tally the adapter/* monitor
+        # group and per-tenant billing read
+        self.apool = getattr(engine, "adapters", None)
+        self.adapter_parks = 0
+        self.adapter_unparks = 0
+        self.adapter_tokens: Dict[str, int] = {}
 
     # -- request intake ------------------------------------------------
 
@@ -243,7 +261,8 @@ class ContinuousBatchingScheduler:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                uid: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               sampling: Optional[SamplingParams] = None) -> int:
+               sampling: Optional[SamplingParams] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Queue one request; returns its uid. Validates against the
         engine's hard caps up front so impossible requests fail at submit
         time with named numbers, not mid-serve. ``deadline_s`` caps the
@@ -265,6 +284,20 @@ class ContinuousBatchingScheduler:
             base = self.engine.config.sampling
             if base != SamplingParams():
                 sampling = base
+        # multi-tenant LoRA (ISSUE 18): an unregistered adapter fails at
+        # submit time with named numbers, never mid-serve — residency is
+        # NOT checked here (a non-resident registered adapter pages in
+        # at admission, or parks the request until a slot frees)
+        if adapter_id is not None:
+            if self.apool is None:
+                raise ValueError(
+                    f"replica {self.replica_id}: request names adapter "
+                    f"{adapter_id!r} but the adapter pool is disabled "
+                    f"(enable config.adapters)")
+            if not self.apool.registered(adapter_id):
+                raise ValueError(
+                    f"replica {self.replica_id}: adapter {adapter_id!r} "
+                    f"is not registered; publish_adapter it first")
         if self.draining:
             raise RuntimeError(
                 f"replica {self.replica_id} is draining and admits no new "
@@ -304,7 +337,8 @@ class ContinuousBatchingScheduler:
                            max_new_tokens=int(max_new_tokens),
                            submitted_at=self.clock(),
                            deadline_s=deadline_s,
-                           sampling=sampling)
+                           sampling=sampling,
+                           adapter_id=adapter_id)
         if sampling is not None:
             self.sampling_seen = True
         self.requests[uid] = r
@@ -454,6 +488,9 @@ class ContinuousBatchingScheduler:
             r.tpot_s.append(now - r.last_token_at)
             events.append(("serving/tpot_s", r.tpot_s[-1], self.ticks))
         r.last_token_at = now
+        if r.adapter_id is not None:
+            self.adapter_tokens[r.adapter_id] = \
+                self.adapter_tokens.get(r.adapter_id, 0) + 1
         if self.on_token is not None:
             self.on_token(r.uid, tok)
         # EOS (the on-device flag) / stop sequence (host suffix match)
@@ -639,6 +676,23 @@ class ContinuousBatchingScheduler:
                 # the head would stall every request behind it for the
                 # whole backoff
                 continue
+            if from_queue and r.adapter_id is not None and \
+                    self.apool is not None:
+                # multi-tenant LoRA (ISSUE 18): can the pool seat this
+                # request's adapter ALONGSIDE everything already planned
+                # this tick (batch-aware — a plan may not evict its own
+                # hits)? If not, park in place: the request keeps its
+                # FIFO seat, younger base-model or resident-adapter work
+                # may pass it, and NO running sequence is ever preempted
+                # for an adapter slot. The actual acquire happens at the
+                # admission commit below, so a loop that breaks early
+                # mutates nothing.
+                want = [a.adapter_id for a, _ in admitted] + [r.adapter_id]
+                if not self.apool.can_acquire_all(want)[0]:
+                    if not r.adapter_waiting:
+                        r.adapter_waiting = True
+                        self.adapter_parks += 1
+                    continue
             if from_queue and self.parked and \
                     self.parked[0].submitted_at <= r.submitted_at:
                 # tiered KV (ISSUE 15): freed blocks must fund the oldest
@@ -681,10 +735,20 @@ class ContinuousBatchingScheduler:
             prefills.append((r, target[pd:pd + chunk]))
             if from_queue:
                 admitted.append((r, pd))
+                if r.adapter_waiting:
+                    r.adapter_waiting = False
+                    self.adapter_unparks += 1
         for r, hit in admitted:
             self.queue.remove(r)
             self.active.append(r)
             r.state = PREFILL
+            # multi-tenant LoRA (ISSUE 18): stage the adapter binding
+            # BEFORE the engine admission — acquire_prefix consumes the
+            # pending binding and pins the pool slot, so the descriptor
+            # is born adapter-bound and this very tick's chunk already
+            # runs under the adapter's slot row
+            if r.adapter_id is not None:
+                eng.configure_adapter(r.uid, r.adapter_id)
             # admit in the engine NOW so shared prefix blocks are
             # ref-counted before the dispatch: the descriptor starts at
             # the cached boundary and this tick's chunk prefills only the
@@ -740,9 +804,12 @@ class ContinuousBatchingScheduler:
                     f"write) but only {eng.free_blocks} of "
                     f"{eng.allocator.num_blocks} are free and nothing is "
                     f"running to release more; raise num_kv_blocks")
-            if any(r.not_before > now0 for r in self.queue):
-                # everything eligible is in its failover backoff window —
-                # work remains, it just may not pack yet
+            if any(r.not_before > now0 or r.adapter_waiting
+                   for r in self.queue):
+                # everything eligible is in its failover backoff window
+                # or parked on adapter-pool residency — work remains, it
+                # just may not pack yet (running/parked sequences release
+                # slots as they finish)
                 return True
             head = next((r for r in self.active if r.state == PREFILL),
                         self.queue[0] if self.queue else None)
@@ -912,6 +979,41 @@ class ContinuousBatchingScheduler:
             depth = max(0, eng.config.kv_tier.prefetch_depth)
             for r in self.parked[:depth]:
                 self.tier.prefetch(r.uid)
+        if self.apool is not None:
+            # multi-tenant LoRA group (ISSUE 18): pool traffic plus the
+            # scheduler's residency parks — a park is a FIFO-seat yield,
+            # never a preemption, so adapter pressure shows up here as
+            # queue time, not re-prefill compute
+            ast = self.apool.stats()
+            events += [
+                ("adapter/hits", ast["hits"], self.ticks),
+                ("adapter/misses", ast["misses"], self.ticks),
+                ("adapter/evictions", ast["evictions"], self.ticks),
+                ("adapter/parks", self.adapter_parks, self.ticks),
+                ("adapter/unparks", self.adapter_unparks, self.ticks),
+                ("adapter/active_adapters", ast["resident"], self.ticks),
+            ]
+            for aid in sorted(self.adapter_tokens):
+                events.append((f"adapter/tokens/{aid}",
+                               self.adapter_tokens[aid], self.ticks))
+            # double-buffered adapter prefetch (the kv_tier discipline):
+            # stage the next waiting adapters' padded factor planes into
+            # pinned buffers one tick ahead of the admission that will
+            # install them, so the acquire-miss copy is pinned-host ->
+            # device only
+            depth = max(0, eng.config.adapters.prefetch_depth)
+            staged = 0
+            seen: set = set()
+            for r in self.queue:
+                if staged >= depth:
+                    break
+                aid = r.adapter_id
+                if aid is None or aid in seen or \
+                        self.apool.slot_of(aid) is not None:
+                    continue
+                self.apool.prefetch(aid)
+                seen.add(aid)
+                staged += 1
         # block state settled for this tick — refresh the placement-
         # pressure cache HERE, on the tick thread, where the _seqs walk
         # is safe (see __init__); load() only ever reads the int
@@ -958,6 +1060,9 @@ class ContinuousBatchingScheduler:
         self.queue.clear()
         self._spillable_cache = 0
         for r in exported:
+            # residency parks are THIS pool's state — a re-placed request
+            # re-evaluates against the destination replica's pool
+            r.adapter_waiting = False
             self.requests.pop(r.uid, None)
         self._write_events([
             ("serving/drained_requests", len(exported), self.ticks),
@@ -997,8 +1102,15 @@ class ContinuousBatchingScheduler:
                 f"replica {self.replica_id}: request needs up to {need_max} "
                 f"KV blocks but the pool has {usable} usable; route it to a "
                 f"bigger replica")
+        if r.adapter_id is not None and (
+                self.apool is None or not self.apool.registered(r.adapter_id)):
+            raise ValueError(
+                f"replica {self.replica_id}: request {r.uid} needs adapter "
+                f"{r.adapter_id!r} which is not registered here; "
+                f"publish_adapter to this replica first")
         r.state = QUEUED
         r.prefill_done = 0
+        r.adapter_waiting = False
         if r.sampling is not None:
             # the seed rides the request (ISSUE 16): its re-prefill replay
             # resumes the SAME seeded chain at the same absolute positions
@@ -1052,8 +1164,23 @@ class ContinuousBatchingScheduler:
             raise RuntimeError(
                 f"replica {self.replica_id}: running set is at max_running"
                 f"={self.cfg.max_running}; requeue uid {r.uid} instead")
+        if r.adapter_id is not None and (
+                self.apool is None or not self.apool.registered(r.adapter_id)):
+            raise ValueError(
+                f"replica {self.replica_id}: request {r.uid} needs adapter "
+                f"{r.adapter_id!r} which is not registered here; "
+                f"publish_adapter to this replica first")
+        if r.adapter_id is not None:
+            # the migrated descriptor is live but adapter-unbound (slot
+            # indices are replica-local); rebind so the next decode tick
+            # runs under this pool's slot for the same adapter. May page
+            # the adapter in — a refusal (pool fully pinned) lands before
+            # any scheduler mutation, so the caller falls back to
+            # inject() like any other adoption refusal.
+            self.engine.configure_adapter(r.uid, r.adapter_id)
         r.state = RUNNING
         r.prefill_done = len(r.prompt) + len(r.generated)
+        r.adapter_waiting = False
         if r.sampling is not None:
             self.sampling_seen = True
             self.engine.configure_sampling(r.uid, r.sampling)
@@ -1080,6 +1207,10 @@ class ContinuousBatchingScheduler:
             "spill_enabled": ecfg.kv_tier.enabled,
             "hot_block_fraction": ecfg.kv_tier.hot_block_fraction,
             "prefetch_depth": ecfg.kv_tier.prefetch_depth,
+            "adapter_slots": (ecfg.adapters.slots
+                              if ecfg.adapters.enabled else 0),
+            "adapter_prefetch_depth": (ecfg.adapters.prefetch_depth
+                                       if ecfg.adapters.enabled else 0),
         })
         return out
 
@@ -1106,6 +1237,12 @@ class ContinuousBatchingScheduler:
             "kv_pressure": max(
                 0.0, 1.0 - (eng.free_blocks + spillable) / usable),
             "draining": self.draining,
+            # multi-tenant LoRA (ISSUE 18): the placement-affinity signal
+            # — a request routes toward a replica whose pool already
+            # holds its adapter. The pool takes its own lock, so this is
+            # safe from router threads like the rest of load().
+            "resident_adapters": ([] if self.apool is None
+                                  else self.apool.resident_ids()),
         }
 
     # -- drivers --------------------------------------------------------
@@ -1121,7 +1258,9 @@ class ContinuousBatchingScheduler:
               deadline_s: Optional[float] = None,
               sampling: Optional[Union[SamplingParams,
                                        Sequence[Optional[SamplingParams]]]]
-              = None) -> Dict[int, List[int]]:
+              = None,
+              adapter_ids: Optional[Sequence[Optional[str]]] = None
+              ) -> Dict[int, List[int]]:
         """Serve a batch of requests to completion, continuous-batching
         style. ``requests``: prompts, or ``(prompt, max_new)`` pairs.
         ``arrivals``: optional arrival offsets in seconds (e.g. a Poisson
@@ -1130,8 +1269,10 @@ class ContinuousBatchingScheduler:
         applies one per-request deadline to every submission (an expired
         request FAILS with its partial tokens retained). ``sampling``
         (ISSUE 16): one SamplingParams for every request, or a per-request
-        sequence (None entries run greedy). Returns ``{uid: generated
-        tokens}`` in submission order."""
+        sequence (None entries run greedy). ``adapter_ids`` (ISSUE 18):
+        per-request adapter names (None entries serve the base model) —
+        a mixed trace exercises the multi-tenant pool. Returns ``{uid:
+        generated tokens}`` in submission order."""
         items = []
         for req in requests:
             if (isinstance(req, tuple) and len(req) == 2
@@ -1147,6 +1288,12 @@ class ContinuousBatchingScheduler:
             samplings = list(sampling)
             if len(samplings) != len(items):
                 raise ValueError("sampling must align with requests")
+        if adapter_ids is None:
+            aids: List[Optional[str]] = [None] * len(items)
+        else:
+            aids = list(adapter_ids)
+            if len(aids) != len(items):
+                raise ValueError("adapter_ids must align with requests")
         pending = deque(enumerate(items))
         t0 = self.clock()
         uids: List[int] = []
@@ -1156,7 +1303,8 @@ class ContinuousBatchingScheduler:
                 i, (prompt, mn) = pending.popleft()
                 uids.append(self.submit(prompt, max_new_tokens=mn,
                                         deadline_s=deadline_s,
-                                        sampling=samplings[i]))
+                                        sampling=samplings[i],
+                                        adapter_id=aids[i]))
             if not self.tick() and pending and arrivals is not None:
                 # idle: sleep until the next arrival is due (clock() may be
                 # a test fake, so never pass a negative to sleep)
@@ -1260,4 +1408,15 @@ class ContinuousBatchingScheduler:
                 "resamples": self.sampling_resamples,
                 "early_stop_freed_blocks": eng.early_stop_freed_blocks,
             },
+            # multi-tenant LoRA (ISSUE 18): None when the pool is off;
+            # with it on, pool traffic + the scheduler's residency parks
+            # (FIFO-seat yields, never preemptions) and the per-adapter
+            # emitted-token tally per-tenant billing reads
+            "adapters": (None if self.apool is None else {
+                **self.apool.stats(),
+                "parks": self.adapter_parks,
+                "unparks": self.adapter_unparks,
+                "waiting": sum(1 for r in self.queue if r.adapter_waiting),
+                "tokens_by_adapter": dict(self.adapter_tokens),
+            }),
         }

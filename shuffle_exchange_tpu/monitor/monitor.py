@@ -327,6 +327,25 @@ class FleetMonitor(Monitor):
                 samp[key] = total
         if samp:
             out["sampling"] = samp
+        # multi-tenant LoRA (ISSUE 18): the scheduler's adapter/* pool
+        # counters are cumulative per replica like sampling/* — fleet
+        # figures are sums of each replica's latest value. The group only
+        # appears on adapter-enabled fleets (base-model fleets emit no
+        # adapter/* events at all).
+        adp = {}
+        for key in ("hits", "misses", "evictions", "parks", "unparks",
+                    "active_adapters"):
+            total, seen = 0, False
+            for r in sorted(self._replica_ids):
+                label = f"replica{r}/adapter/{key}"
+                vals = [v for lbl, v, _ in events if lbl == label]
+                if vals:
+                    total += vals[-1]
+                    seen = True
+            if seen:
+                adp[key] = total
+        if adp:
+            out["adapter"] = adp
         # fleet fault tolerance (ISSUE 12): the router writes the
         # fleet/health/*, failover/* and shed/* counter groups straight
         # into the ring (they are fleet-level, not per-replica); the
@@ -363,6 +382,9 @@ class FleetMonitor(Monitor):
                    if isinstance(v, (int, float))]
         events += [(f"fleet/sampling/{k}", v, self._step)
                    for k, v in (agg.get("sampling") or {}).items()
+                   if isinstance(v, (int, float))]
+        events += [(f"fleet/adapter/{k}", v, self._step)
+                   for k, v in (agg.get("adapter") or {}).items()
                    if isinstance(v, (int, float))]
         # fault-tolerance groups (ISSUE 12) ride downstream under fleet/*
         # namespacing (health labels are already fleet/health/<k> in the

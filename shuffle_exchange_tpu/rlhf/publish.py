@@ -61,6 +61,7 @@ class WeightPublisher:
         self.memory_monitor = InMemoryMonitor(maxlen=1024)
         self._sinks: List[Monitor] = [monitor] if monitor is not None else []
         self.publishes = 0
+        self.adapter_publishes = 0
         self.gather_latency_s = 0.0
         self.publish_latency_s = 0.0
         self.last_version: Optional[int] = None
@@ -113,6 +114,42 @@ class WeightPublisher:
         self._emit([("weights/publish_s", dt, self.publishes),
                     ("weights/version", version, self.publishes)])
         return version
+
+    def publish_adapter(self, target, adapter_id: str, factors,
+                        alpha=None, version: Optional[int] = None) -> int:
+        """Deliver ONE tenant's LoRA factor pairs to a serving target
+        (ISSUE 18) — the factors-only analog of :meth:`publish`. Where
+        the dense flip gathers and fuses the whole model, a tenant flip
+        ships kilobytes per layer and fuses NOTHING: the serving pool
+        applies the low-rank delta per row at decode time, so base
+        weights, paged KV pools, and every compiled serving program are
+        untouched. ``target`` is a ``ReplicaRouter`` (fleet-wide
+        registration) or an ``InferenceEngineV2`` (its own pool).
+        ``factors`` maps target name -> (A, B) as
+        ``inference.adapters.AdapterPool.register`` takes them. The
+        version defaults to the training engine's ``global_steps`` —
+        the same optimizer-step watermark dense publishes stamp, so a
+        rollout log can name the adapter version a token decoded under."""
+        t0 = self.clock()
+        version = (int(self.engine.global_steps) if version is None
+                   else int(version))
+        if hasattr(target, "publish_adapter"):
+            got = target.publish_adapter(adapter_id, factors, alpha=alpha,
+                                         version=version)
+        else:
+            pool = getattr(target, "adapters", None)
+            if pool is None:
+                raise ValueError(
+                    "publish_adapter: target has no adapter pool — enable "
+                    "config.adapters on the serving engine")
+            got = pool.register(adapter_id, factors, alpha=alpha,
+                                version=version)
+        self.adapter_publishes += 1
+        dt = self.clock() - t0
+        self._emit([
+            ("weights/adapter_publish_s", dt, self.adapter_publishes),
+            ("weights/adapter_version", got, self.adapter_publishes)])
+        return int(got)
 
 
 @locked_by("_mu", "_inflight", "_ticket", "_slots_in_use")

@@ -54,11 +54,15 @@ _SITE = {"crash": "replica_crash", "hang": "replica_hang",
 
 
 def _reference_tokens(engine_factory, prompts: Sequence[List[int]],
-                      max_new: int) -> List[List[int]]:
+                      max_new: int,
+                      adapter_ids: Optional[Sequence[Optional[str]]] = None
+                      ) -> List[List[int]]:
     """Sequential single-engine greedy reference — the parity oracle."""
     eng = engine_factory()
     out = []
     for i, p in enumerate(prompts):
+        if adapter_ids is not None and adapter_ids[i] is not None:
+            eng.configure_adapter(i, adapter_ids[i])
         lg = eng.put([i], [p])
         first = int(np.argmax(lg[0]))
         toks = [first]
@@ -76,10 +80,11 @@ def _poisson_arrivals(n: int, span_s: float, rng) -> List[float]:
 
 def _serve_clean(engine_factory, n_replicas: int,
                  prompts, arrivals, max_new: int,
-                 sampling=None) -> Dict[str, object]:
+                 sampling=None, adapter_ids=None) -> Dict[str, object]:
     router = ReplicaRouter([engine_factory() for _ in range(n_replicas)])
     out = router.serve(prompts, max_new_tokens=max_new,
-                       arrivals=list(arrivals), sampling=sampling)
+                       arrivals=list(arrivals), sampling=sampling,
+                       adapter_ids=adapter_ids)
     st = router.stats()
     return {"tokens": [out[u] for u in out], "stats": st}
 
@@ -113,6 +118,7 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
                     timeout_s: float = 180.0,
                     arm_wait_s: float = 15.0,
                     sampling=None,
+                    adapter_ids: Optional[Sequence[Optional[str]]] = None,
                     check: bool = True) -> Dict[str, object]:
     """Run the drill; returns a machine-readable report (and raises
     ``AssertionError`` on a violated bar unless ``check=False``).
@@ -132,7 +138,13 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
     ``SamplingParams`` for every request or a per-request sequence; the
     parity oracle then becomes the clean no-kill fleet run under the
     SAME seeds (the sequential greedy reference no longer applies), so
-    the drill proves seed-carrying failover end to end.
+    the drill proves seed-carrying failover end to end. ``adapter_ids``
+    (ISSUE 18): per-request adapter names, aligned with ``n_requests``
+    (None entries run the base model) — the factory's engines must have
+    the adapters registered (enable ``config.adapters`` and register in
+    the factory, so revived replicas know them too); failover then has
+    to re-place victims onto adapter-resident survivors and replay
+    token-identically, the multi-tenant failover bar.
 
     Sizing ``router.tick_timeout_s`` for the drill host matters: the
     injected hang parks FOREVER, so a generous threshold only delays
@@ -162,16 +174,20 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
         if len(samplings) != n_requests:
             raise ValueError("sampling must align with n_requests")
     sampled = any(sp is not None for sp in samplings)
+    if adapter_ids is not None and len(adapter_ids) != n_requests:
+        raise ValueError("adapter_ids must align with n_requests")
+    aids = list(adapter_ids) if adapter_ids is not None else None
 
     # clean run calibrates the arrival span AND the TTFT baseline: total
     # service time / 2 offers ~2x capacity, the heavy-traffic regime
     probe = ReplicaRouter([engine_factory() for _ in range(n_replicas)])
-    probe.serve(prompts, max_new_tokens=max_new, sampling=samplings)
+    probe.serve(prompts, max_new_tokens=max_new, sampling=samplings,
+                adapter_ids=aids)
     cap = probe.stats()["sustained_tokens_per_sec"] or 1.0
     span = n_requests * max_new / cap / 2.0
     arrivals = _poisson_arrivals(n_requests, span, rng)
     clean = _serve_clean(engine_factory, n_replicas, prompts, arrivals,
-                         max_new, sampling=samplings)
+                         max_new, sampling=samplings, adapter_ids=aids)
     if sampled:
         # seeded drill (ISSUE 16): the per-request Gumbel chain is a pure
         # function of (seed, position, weights), so the clean no-kill run
@@ -179,7 +195,8 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
         # wrong distribution
         reference = clean["tokens"]
     else:
-        reference = _reference_tokens(engine_factory, prompts, max_new)
+        reference = _reference_tokens(engine_factory, prompts, max_new,
+                                      adapter_ids=aids)
         assert clean["tokens"] == reference, (
             "clean fleet run diverges from the sequential reference — fix "
             "serving before drilling faults")
@@ -231,10 +248,10 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
                                f"after {len(uids)} submissions")
             if i < n_requests and router.clock() - t0 >= arrivals[i]:
                 try:
-                    uids.append(router.submit(prompts[i],
-                                              max_new_tokens=max_new,
-                                              deadline_s=deadline_s,
-                                              sampling=samplings[i]))
+                    uids.append(router.submit(
+                        prompts[i], max_new_tokens=max_new,
+                        deadline_s=deadline_s, sampling=samplings[i],
+                        adapter_id=aids[i] if aids else None))
                 except LoadShedError:
                     uids.append(None)
                     shed += 1
@@ -303,6 +320,11 @@ def run_chaos_drill(engine_factory: Callable[[], object], *,
         # counters from the chaos run
         "sampled": sampled,
         "sampling": st["sampling"],
+        # ISSUE 18: whether the drill carried per-request adapters (the
+        # failover replays then had to land on adapter-resident pools)
+        # plus the fleet's adapter counters from the chaos run
+        "adapters_enabled": aids is not None,
+        "adapters": st.get("adapters"),
     }
     san_new = sanitizer.reports()[san_before:]
     report["sanitizer"] = {

@@ -157,6 +157,13 @@ class ProcessReplicaRouter:
         self.requeued = 0
         self.weight_publishes = 0
         self.published_version: Optional[int] = None
+        # multi-tenant LoRA (ISSUE 18): the retained wire payloads of
+        # every fleet-published adapter — replayed to newcomers at spawn
+        # so an elastic scale-up serves the same tenant set (mirrors the
+        # threaded router's _published_adapters catch-up)
+        self.adapter_publishes = 0
+        self._published_adapters: Dict[str, Tuple[dict,
+                                                  List[np.ndarray]]] = {}
         self._metrics_step = 0
         for _ in range(self.n_replicas):
             self.spawn_replica()
@@ -217,6 +224,15 @@ class ProcessReplicaRouter:
         h = WorkerHandle(rid, proc, client, int(info["port"]), log_path)
         try:
             client.call("ping", timeout_s=self.rcfg.rpc_ping_timeout_s)
+            # catch a newcomer up to the fleet's published adapter set —
+            # a request routed here must never be refused for a tenant
+            # every other replica already knows (ISSUE 18; mirrors the
+            # threaded router's _add_replica catch-up). Still inside the
+            # handshake: a failed catch-up fails THIS spawn cleanly
+            # instead of leaking a half-provisioned worker into traffic
+            for _aid, (meta, planes) in self._published_adapters.items():
+                client.call("publish_adapter", dict(meta), planes,
+                            timeout_s=self.rcfg.rpc_call_timeout_s)
         except Exception:
             # the handle is not registered yet, so no failover path will
             # ever reap this process — kill it here or it leaks live
@@ -336,26 +352,35 @@ class ProcessReplicaRouter:
 
     # -- placement / intake ---------------------------------------------
 
-    def _placement_order(self,
-                         handles: List[WorkerHandle]) -> List[WorkerHandle]:
+    def _placement_order(self, handles: List[WorkerHandle],
+                         adapter_id: Optional[str] = None
+                         ) -> List[WorkerHandle]:
         """Least-loaded first from the PUSHED reports — and health-ACTIVE
         workers strictly before SUSPECT ones: a suspected-hung worker
         costs a full RPC timeout per attempt, so it is only tried when no
-        healthy peer remains (it may just be mid-compile)."""
+        healthy peer remains (it may just be mid-compile). A request
+        naming an adapter (ISSUE 18) discounts workers whose pushed
+        report lists it resident — landing there skips a host->HBM page
+        of the factor pair, the same affinity the threaded router scores."""
         states = self.health.states()
+        affine = bool(self.rcfg.adapter_affinity and adapter_id is not None)
 
         def score(h: WorkerHandle):
             ld = h.load
-            return (0 if states.get(h.replica_id) == "active" else 1,
-                    self.rcfg.queue_depth_weight
+            cost = (self.rcfg.queue_depth_weight
                     * (ld.get("queue_depth", 0) + ld.get("running", 0))
                     + self.rcfg.kv_pressure_weight
-                    * ld.get("kv_pressure", 0.0), h.replica_id)
+                    * ld.get("kv_pressure", 0.0))
+            if affine and adapter_id in (ld.get("resident_adapters") or ()):
+                cost -= self.rcfg.adapter_affinity_weight
+            return (0 if states.get(h.replica_id) == "active" else 1,
+                    cost, h.replica_id)
 
         return sorted(handles, key=score)
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               deadline_s: Optional[float] = None, sampling=None) -> int:
+               deadline_s: Optional[float] = None, sampling=None,
+               adapter_id: Optional[str] = None) -> int:
         """Place one request; returns its fleet-wide uid. Raises the
         threaded taxonomy: LoadShedError past the shed bound,
         NoActiveReplicaError with zero survivors, and the aggregated
@@ -375,13 +400,14 @@ class ProcessReplicaRouter:
                                     len(active))
         wire_sampling = sampling_to_wire(sampling)   # rejects logit_mask
         refusals = []
-        for h in self._placement_order(active):
+        for h in self._placement_order(active, adapter_id=adapter_id):
             try:
                 self._call(h, "submit",
                            {"prompt": [int(t) for t in prompt],
                             "max_new_tokens": int(max_new_tokens),
                             "uid": uid, "deadline_s": deadline_s,
-                            "sampling": wire_sampling})
+                            "sampling": wire_sampling,
+                            "adapter_id": adapter_id})
             except RpcRemoteError as e:
                 refusals.append(f"replica {h.replica_id}: "
                                 f"{e.remote_type}: {e.remote_message}")
@@ -401,7 +427,8 @@ class ProcessReplicaRouter:
             r = ServingRequest(uid=uid,
                                prompt=[int(t) for t in prompt],
                                max_new_tokens=int(max_new_tokens),
-                               deadline_s=deadline_s, sampling=sampling)
+                               deadline_s=deadline_s, sampling=sampling,
+                               adapter_id=adapter_id)
             r.submitted_at = self.clock()
             self.requests[uid] = r
             self.owner[uid] = h.replica_id
@@ -490,7 +517,11 @@ class ProcessReplicaRouter:
                 remaining.append(uid)
                 continue
             target = None
-            for h in self._placement_order(self.active_workers):
+            # failover re-placement honors adapter affinity (ISSUE 18):
+            # a victim lands on a survivor whose pool already holds its
+            # adapter when one exists, so the replay pays no page-in
+            for h in self._placement_order(self.active_workers,
+                                           adapter_id=r.adapter_id):
                 try:
                     self._call(h, "inject",
                                {"request": request_to_wire(r),
@@ -715,6 +746,58 @@ class ProcessReplicaRouter:
         self.weight_publishes += 1
         return version
 
+    def publish_adapter(self, adapter_id: str, factors,
+                        alpha: Optional[float] = None,
+                        version: Optional[int] = None) -> int:
+        """Register one LoRA adapter on every ACTIVE worker (ISSUE 18):
+        the factors-only analogue of :meth:`publish_weights` — (A, B)
+        planes per target ride one frame each, no base weights move.
+        Single-phase by design: registration is content-keyed and
+        idempotent on the pool side and pins nothing, so a partial
+        publish needs no rollback — re-running it converges. Raises if
+        any ACTIVE worker refused (no pool / bad factors); a worker that
+        DIED mid-publish fails over normally and its replacement is
+        caught up at spawn from the retained payload. Returns the
+        version stamped on the fleet."""
+        if not adapter_id:
+            raise ValueError("publish_adapter: adapter_id must be non-empty")
+        targets = sorted(factors)
+        planes: List[np.ndarray] = []
+        for t in targets:
+            A, B = factors[t]
+            planes += [np.asarray(A), np.asarray(B)]
+        if version is None:
+            prev = self._published_adapters.get(adapter_id)
+            version = (int(prev[0].get("version", 0)) + 1) if prev else 1
+        meta = {"adapter_id": str(adapter_id),
+                "targets": [str(t) for t in targets],
+                "alpha": None if alpha is None else float(alpha),
+                "version": int(version)}
+        active = self.active_workers
+        if not active:
+            raise NoActiveReplicaError("no ACTIVE worker to publish to")
+        refusals = []
+        for h in active:
+            try:
+                self._call(h, "publish_adapter", dict(meta), bufs=planes)
+            except RpcRemoteError as e:
+                refusals.append(f"replica {h.replica_id}: "
+                                f"{e.remote_type}: {e.remote_message}")
+            except RpcError as e:
+                # death/hang: _call already ran the health consequence;
+                # the replacement worker is caught up from the retained
+                # payload at spawn, so this is not a refusal
+                logger.error(f"procfleet: worker {h.replica_id} lost "
+                             f"mid-adapter-publish ({e})")
+        if refusals:
+            raise RuntimeError(
+                f"publish_adapter({adapter_id!r}): refused by "
+                f"{'; '.join(refusals)} — registration is idempotent, "
+                f"re-run after fixing the refusal")
+        self._published_adapters[adapter_id] = (meta, planes)
+        self.adapter_publishes += 1
+        return int(version)
+
     # -- disagg KV handoff over the wire ---------------------------------
 
     def transfer_kv(self, src_rid: int, dst_rid: int, uid: int) -> int:
@@ -781,15 +864,25 @@ class ProcessReplicaRouter:
               arrivals: Optional[Sequence[float]] = None,
               deadline_s: Optional[float] = None,
               sampling=None,
+              adapter_ids: Optional[Sequence[Optional[str]]] = None,
               timeout_s: float = 600.0) -> Dict[int, List[int]]:
         """Poisson-style offered-load loop (threaded ``serve`` shape):
         submit each prompt at its arrival offset, poll/health-check
-        until every live uid reaches a terminal state."""
+        until every live uid reaches a terminal state. ``adapter_ids``
+        aligns per-request LoRA adapters with ``requests`` (None entries
+        serve the base model)."""
         n = len(requests)
         if sampling is None or not isinstance(sampling, (list, tuple)):
             samplings = [sampling] * n
         else:
             samplings = list(sampling)
+        if adapter_ids is None:
+            aids: List[Optional[str]] = [None] * n
+        else:
+            aids = list(adapter_ids)
+            if len(aids) != n:
+                raise ValueError(
+                    f"adapter_ids has {len(aids)} entries for {n} requests")
         arrivals = list(arrivals) if arrivals is not None else [0.0] * n
         t0 = self.clock()
         uids: List[Optional[int]] = []
@@ -806,7 +899,8 @@ class ProcessReplicaRouter:
                     uids.append(self.submit(requests[i],
                                             max_new_tokens=max_new_tokens,
                                             deadline_s=deadline_s,
-                                            sampling=samplings[i]))
+                                            sampling=samplings[i],
+                                            adapter_id=aids[i]))
                 except LoadShedError:
                     uids.append(None)
                 i += 1
@@ -859,6 +953,8 @@ class ProcessReplicaRouter:
             "requeued": self.requeued,
             "weight_publishes": self.weight_publishes,
             "published_version": self.published_version,
+            "adapter_publishes": self.adapter_publishes,
+            "published_adapters": sorted(self._published_adapters),
             "sustained_tokens_per_sec": (total / span) if span > 0 else None,
             "ttft_p50_s": pct(ttft, 50), "ttft_p95_s": pct(ttft, 95),
             "rpc": {rid: {"calls": h.client.calls,

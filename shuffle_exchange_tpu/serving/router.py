@@ -129,7 +129,8 @@ class Replica:
            "published_version", "_published_weights",
            "failovers", "recovered", "migrated_sequences",
            "migrated_blocks", "reprefill_tokens", "quarantined",
-           "retries_exhausted", "shed", "_channel")
+           "retries_exhausted", "shed", "_channel",
+           "adapter_publishes", "_published_adapters")
 class ReplicaRouter:
     """Place requests across replicas; tick them; aggregate their stats.
 
@@ -206,6 +207,13 @@ class ReplicaRouter:
         self.weight_publishes = 0
         self.published_version: Optional[int] = None
         self._published_weights = None
+        # multi-tenant LoRA (ISSUE 18): fleet-published adapters, kept by
+        # id so elastic scale-up catches a factory-built replica up to
+        # every published adapter (same rationale as _published_weights —
+        # without it a replica added after a publish_adapter would refuse
+        # that tenant's requests)
+        self.adapter_publishes = 0
+        self._published_adapters: Dict[str, tuple] = {}
         for eng in engines:
             self._add_replica(eng)
 
@@ -225,6 +233,10 @@ class ReplicaRouter:
         if self._published_weights is not None:
             engine.publish_weights(self._published_weights,
                                    version=self.published_version)
+        if self._published_adapters and engine.adapters is not None:
+            for aid, (factors, alpha, ver) in self._published_adapters.items():
+                engine.adapters.register(aid, factors, alpha=alpha,
+                                         version=ver)
         self.replicas.append(rep)
         self.health.register(rid)
         return rep
@@ -239,16 +251,24 @@ class ReplicaRouter:
 
     # -- placement ------------------------------------------------------
 
-    def _score(self, rep: Replica, prompt: Sequence[int]) -> float:
-        """Placement score (higher wins): prefix-cache affinity minus
-        queue-depth and KV-pressure penalties, per the router config's
-        weights. Deterministic, so placement decisions are testable."""
+    def _score(self, rep: Replica, prompt: Sequence[int],
+               adapter_id: Optional[str] = None) -> float:
+        """Placement score (higher wins): prefix-cache and adapter-pool
+        affinities minus queue-depth and KV-pressure penalties, per the
+        router config's weights. Deterministic, so placement decisions
+        are testable."""
         cfg = self.rcfg
         load = rep.scheduler.load()
         score = 0.0
         if cfg.prefix_affinity and rep.engine.config.prefix_caching:
             hit, _, _ = rep.engine.prefix_peek(list(prompt))
             score += cfg.prefix_affinity_weight * (hit / max(1, len(prompt)))
+        # multi-tenant LoRA (ISSUE 18): a request lands where its adapter
+        # already sits in HBM — the paging analog of prefix affinity (a
+        # miss costs an install + possibly an eviction somewhere else)
+        if cfg.adapter_affinity and adapter_id is not None and \
+                adapter_id in load.get("resident_adapters", ()):
+            score += cfg.adapter_affinity_weight
         max_running = rep.engine.config.serving.max_running
         score -= cfg.queue_depth_weight * (
             (load["queue_depth"] + load["running"]) / max(1, max_running))
@@ -256,7 +276,8 @@ class ReplicaRouter:
         return score
 
     def place(self, prompt: Sequence[int],
-              session_id: Optional[object] = None) -> Replica:
+              session_id: Optional[object] = None,
+              adapter_id: Optional[str] = None) -> Replica:
         """Pick the replica a request should land on (no mutation).
         Health-aware (ISSUE 12): SUSPECT replicas — missed heartbeats or
         a flagged hang — take no NEW placements while any healthy
@@ -278,13 +299,16 @@ class ReplicaRouter:
                     and self.replicas[rid] in candidates):
                 return self.replicas[rid]
         # stable max: ties go to the lowest replica id
-        return max(candidates, key=lambda r: (self._score(r, prompt),
-                                              -r.replica_id))
+        return max(candidates,
+                   key=lambda r: (self._score(r, prompt,
+                                              adapter_id=adapter_id),
+                                  -r.replica_id))
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                session_id: Optional[object] = None,
                deadline_s: Optional[float] = None,
-               sampling=None) -> int:
+               sampling=None,
+               adapter_id: Optional[str] = None) -> int:
         """Route one request; returns its fleet-global uid. When NO active
         replica can ever take the request, the error aggregates every
         replica's own needed-vs-free numbers (the ``_admission_detail``
@@ -309,7 +333,8 @@ class ReplicaRouter:
                         ("shed/queue_depth", depth, self.shed)])
                     raise LoadShedError(self._next_uid, depth, bound,
                                         len(self.active_replicas))
-            rep = self.place(prompt, session_id=session_id)
+            rep = self.place(prompt, session_id=session_id,
+                             adapter_id=adapter_id)
             uid = self._next_uid
             self._next_uid += 1
             try:
@@ -318,7 +343,8 @@ class ReplicaRouter:
                                          max_new_tokens=max_new_tokens,
                                          uid=uid,
                                          deadline_s=deadline_s,
-                                         sampling=sampling)
+                                         sampling=sampling,
+                                         adapter_id=adapter_id)
             # RuntimeError included (ISSUE 12): the placed replica may
             # have been fenced/drained between place() and the lock — a
             # draining refusal is retryable on the survivors
@@ -335,7 +361,7 @@ class ReplicaRouter:
                             other.scheduler.submit(
                                 prompt, max_new_tokens=max_new_tokens,
                                 uid=uid, deadline_s=deadline_s,
-                                sampling=sampling)
+                                sampling=sampling, adapter_id=adapter_id)
                         rep = other
                         break
                     except (ValueError, RuntimeError) as e:
@@ -586,7 +612,10 @@ class ReplicaRouter:
                     # ISSUE 16: the seed travels with the victim, so the
                     # survivor's replay re-samples the identical chain
                     sampling=old.sampling,
-                    stopped=old.stopped)
+                    stopped=old.stopped,
+                    # ISSUE 18: the adapter id travels too — the replay
+                    # re-binds the same adapter on the survivor's pool
+                    adapter_id=old.adapter_id)
                 self.requests[uid] = snap
                 if mid_exec:
                     snap.replica_deaths += 1
@@ -648,6 +677,20 @@ class ReplicaRouter:
                 f"migration), {len(self.quarantined)} quarantined total")
             return recovered
 
+    @staticmethod
+    def _failover_order(adapter_id: Optional[str]):
+        """Survivor preference for a victim: adapter-resident replicas
+        first (ISSUE 18 — re-placing onto a pool that already holds the
+        victim's adapter skips an install and possibly someone else's
+        eviction), then least loaded, ties to the lowest id."""
+        def key(s):
+            ld = s.scheduler.load()
+            resident = (adapter_id is not None
+                        and adapter_id in ld.get("resident_adapters", ()))
+            return (0 if resident else 1,
+                    ld["queue_depth"] + ld["running"], s.replica_id)
+        return key
+
     @requires_lock("_lock")
     def _migrate(self, rep: Replica, snap: ServingRequest,
                  survivors: List[Replica]) -> Optional[Replica]:
@@ -661,11 +704,8 @@ class ReplicaRouter:
         if self._channel is None:
             self._channel = KVTransferChannel(monitor=self.fleet)
 
-        def load_of(s):
-            ld = s.scheduler.load()
-            return (ld["queue_depth"] + ld["running"], s.replica_id)
-
-        for target in sorted(survivors, key=load_of):
+        for target in sorted(survivors,
+                             key=self._failover_order(snap.adapter_id)):
             with target.lock:
                 if (target.scheduler.draining
                         or len(target.scheduler.active)
@@ -707,11 +747,8 @@ class ReplicaRouter:
         request FAILED with a typed error when nobody can take it."""
         refusals = []
 
-        def load_of(s):
-            ld = s.scheduler.load()
-            return (ld["queue_depth"] + ld["running"], s.replica_id)
-
-        for target in sorted(survivors, key=load_of):
+        for target in sorted(survivors,
+                             key=self._failover_order(snap.adapter_id)):
             try:
                 with target.lock:
                     target.scheduler.inject(snap, front=True)
@@ -735,7 +772,8 @@ class ReplicaRouter:
               arrivals: Optional[Sequence[float]] = None,
               session_ids: Optional[Sequence[object]] = None,
               deadline_s: Optional[float] = None,
-              sampling=None
+              sampling=None,
+              adapter_ids: Optional[Sequence[Optional[str]]] = None
               ) -> Dict[int, List[int]]:
         """Serve a batch to completion across the fleet — the scheduler's
         Poisson-trace ``serve`` contract, routed. Returns ``{uid: tokens}``
@@ -744,7 +782,9 @@ class ReplicaRouter:
         Results survive mid-serve drains AND failovers: the router tracks
         the live ``ServingRequest`` objects, wherever they run.
         ``sampling`` (ISSUE 16): one ``SamplingParams`` for every request
-        or a per-request sequence (None entries = greedy)."""
+        or a per-request sequence (None entries = greedy). ``adapter_ids``
+        (ISSUE 18): per-request adapter names — affinity routing sends
+        each toward a replica whose pool already holds its adapter."""
         items = []
         for req in requests:
             if (isinstance(req, tuple) and len(req) == 2
@@ -762,6 +802,12 @@ class ReplicaRouter:
             samplings = list(sampling)
             if len(samplings) != len(items):
                 raise ValueError("sampling must align with requests")
+        if adapter_ids is None:
+            aids: List[Optional[str]] = [None] * len(items)
+        else:
+            aids = list(adapter_ids)
+            if len(aids) != len(items):
+                raise ValueError("adapter_ids must align with requests")
         pending = deque(enumerate(items))
         t0 = self.clock()
         uids: List[int] = []
@@ -774,7 +820,8 @@ class ReplicaRouter:
                 uids.append(self.submit(prompt, max_new_tokens=mn,
                                         session_id=sid,
                                         deadline_s=deadline_s,
-                                        sampling=samplings[i]))
+                                        sampling=samplings[i],
+                                        adapter_id=aids[i]))
             if not self.tick() and pending and arrivals is not None:
                 wait = arrivals[pending[0][0]] - (self.clock() - t0)
                 if wait > 0:
@@ -1053,6 +1100,50 @@ class ReplicaRouter:
                         f"{len(reps)} replicas")
             return version
 
+    @atomic_on_reject(check="validate")
+    def publish_adapter(self, adapter_id: str, factors, alpha=None,
+                        version: Optional[int] = None) -> int:
+        """Register one LoRA adapter in EVERY live replica's pool
+        (ISSUE 18) — factors only, never full weights: a tenant flip
+        ships kilobytes per layer, not the model. Host-side registration
+        only; residency stays acquire's business, so a publish never
+        evicts anything or touches a running batch. Content-keyed like
+        the pools themselves — republishing identical bytes is a no-op,
+        changed bytes bump the version and rewrite any resident slot in
+        place (running sequences pick the new factors up next step, the
+        publish_weights semantics at adapter granularity). The factors
+        are retained so elastic scale-up catches factory-built replicas
+        up to every published adapter. Returns the version stamped."""
+        with self._lock:
+            reps = [r for r in self.replicas if r.state != STOPPED]
+            if not reps:
+                raise RuntimeError(
+                    "publish_adapter: no live replicas (all stopped)")
+            no_pool = [r.replica_id for r in reps
+                       if r.engine.adapters is None]
+            if no_pool:
+                raise ValueError(
+                    f"publish_adapter: replicas {no_pool} have no adapter "
+                    f"pool (enable config.adapters fleet-wide)")
+            if version is None:
+                version = max((r.engine.adapters.version(adapter_id) or 0)
+                              for r in reps) + 1
+            version = int(version)
+            # the first register validates shapes/targets; identical
+            # model configs mean the rest cannot fail differently, so a
+            # bad publish raises before any replica mutates
+            for rep in reps:
+                rep.engine.adapters.register(adapter_id, factors,
+                                             alpha=alpha, version=version)
+            self._published_adapters[adapter_id] = (factors, alpha, version)
+            self.adapter_publishes += 1
+            self.fleet.write_events([
+                ("fleet/adapter_publishes", self.adapter_publishes,
+                 self.adapter_publishes)])
+            logger.info(f"router: published adapter {adapter_id!r} "
+                        f"version {version} to {len(reps)} replicas")
+            return version
+
     # -- observability --------------------------------------------------
 
     def stats(self) -> Dict[str, object]:
@@ -1118,6 +1209,9 @@ class ReplicaRouter:
             # resample accounting, same sums-not-averages discipline
             "sampling": self._sampling_aggregate(),
             "kv_tier": self._tier_aggregate(),
+            # multi-tenant LoRA (ISSUE 18): fleet-summed pool traffic and
+            # per-adapter token tallies, same sums-not-averages discipline
+            "adapters": self._adapter_aggregate(),
             "per_replica": [dict(r.scheduler.load(), state=r.state,
                                  preemptions=r.scheduler.preemptions)
                             for r in self.replicas],
@@ -1157,6 +1251,33 @@ class ReplicaRouter:
             "rejected": sum(r.scheduler.spec_rejected for r in self.replicas),
             "acceptance_rate": (accepted / proposed) if proposed else None,
             "rollbacks": sum(r.engine.spec_rollbacks for r in self.replicas),
+        }
+
+    def _adapter_aggregate(self) -> Dict[str, object]:
+        """Fleet-wide multi-tenant pool traffic (ISSUE 18): pool counters
+        summed over adapter-enabled replicas, per-adapter token tallies
+        merged across wherever each tenant's requests actually ran."""
+        pools = [(r, r.engine.adapters) for r in self.replicas
+                 if r.engine.adapters is not None]
+        if not pools:
+            return {"enabled": False}
+        ps = [p.stats() for _, p in pools]
+        tokens: Dict[str, int] = {}
+        for r, _ in pools:
+            for aid, n in r.scheduler.adapter_tokens.items():
+                tokens[aid] = tokens.get(aid, 0) + n
+        return {
+            "enabled": True,
+            "publishes": self.adapter_publishes,
+            "registered": max(p["registered"] for p in ps),
+            "resident": sum(p["resident"] for p in ps),
+            "hits": sum(p["hits"] for p in ps),
+            "misses": sum(p["misses"] for p in ps),
+            "evictions": sum(p["evictions"] for p in ps),
+            "installs": sum(p["installs"] for p in ps),
+            "parks": sum(r.scheduler.adapter_parks for r, _ in pools),
+            "unparks": sum(r.scheduler.adapter_unparks for r, _ in pools),
+            "tokens_by_adapter": tokens,
         }
 
     def _sampling_aggregate(self) -> Dict[str, object]:

@@ -141,6 +141,7 @@ def request_to_wire(r) -> dict:
             "deadline_s": r.deadline_s, "retries": r.retries,
             "replica_deaths": r.replica_deaths,
             "sampling": sampling_to_wire(r.sampling),
+            "adapter_id": r.adapter_id,
             "stopped": bool(r.stopped), "state": r.state}
 
 
@@ -155,6 +156,7 @@ def request_from_wire(d: dict):
         retries=int(d.get("retries", 0)),
         replica_deaths=int(d.get("replica_deaths", 0)),
         sampling=sampling_from_wire(d.get("sampling")),
+        adapter_id=d.get("adapter_id"),
         stopped=bool(d.get("stopped", False)))
 
 
@@ -274,6 +276,7 @@ class ReplicaWorker:
             "load": self._h_load,
             "stats": self._h_stats,
             "drain": self._h_drain,
+            "publish_adapter": self._h_publish_adapter,
             "stage_weights": self._h_stage_weights,
             "commit_weights": self._h_commit_weights,
             "discard_weights": self._h_discard_weights,
@@ -345,7 +348,8 @@ class ReplicaWorker:
                 max_new_tokens=int(payload.get("max_new_tokens", 32)),
                 uid=payload.get("uid"),
                 deadline_s=payload.get("deadline_s"),
-                sampling=sampling_from_wire(payload.get("sampling")))
+                sampling=sampling_from_wire(payload.get("sampling")),
+                adapter_id=payload.get("adapter_id"))
         return {"uid": uid}
 
     def _h_inject(self, payload, bufs):
@@ -412,6 +416,34 @@ class ReplicaWorker:
             wire = [request_to_wire(r) for r in exported]
         faults.maybe_die("rpc_drain_reply", self.replica_id)
         return {"requests": wire}
+
+    def _h_publish_adapter(self, payload, bufs):
+        """Register one LoRA adapter in this worker's pool (ISSUE 18).
+        The factor planes ride the frame as binary buffers — (A, B) per
+        target in ``payload["targets"]`` order — so a publish is one
+        message, content-keyed and idempotent on the pool side (a resend
+        after a lost reply is a no-op). Residency stays acquire's
+        business: registering never pins a slot."""
+        pool = getattr(self.engine, "adapters", None)
+        if pool is None:
+            raise ValueError(
+                f"replica {self.replica_id} has no adapter pool — enable "
+                f"inference config 'adapters' in the engine spec")
+        targets = [str(t) for t in payload.get("targets", ())]
+        if len(bufs) != 2 * len(targets):
+            raise ValueError(
+                f"publish_adapter wants {2 * len(targets)} factor planes "
+                f"(A, B per target), frame carries {len(bufs)}")
+        factors = {t: (bufs[2 * i], bufs[2 * i + 1])
+                   for i, t in enumerate(targets)}
+        alpha = payload.get("alpha")
+        with self._lock:
+            version = pool.register(
+                str(payload["adapter_id"]), factors,
+                alpha=None if alpha is None else float(alpha),
+                version=payload.get("version"))
+        return {"adapter_id": str(payload["adapter_id"]),
+                "version": int(version)}
 
     def _h_stage_weights(self, payload, bufs):
         import jax

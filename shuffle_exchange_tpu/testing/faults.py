@@ -50,6 +50,13 @@ Sites (``Fault.site``):
   device scatter commits: the cleanup frees the fresh blocks again, the
   tier entry survives untouched (NON-destructive load), and a retried
   fetch succeeds — atomic-on-reject at the tier boundary.
+- ``adapter_fetch``       — kill a LoRA adapter-pool install
+  (inference/adapters.py ``acquire``, ISSUE 18) after the miss chose its
+  victim slot but BEFORE any pool state mutates: residency, refcounts,
+  the free-slot list, and the device planes must be byte-identically
+  unchanged, and a retried acquire succeeds (tests/test_adapters.py
+  drills it; the scheduler's multi-adapter admission loop also rolls
+  back any slots it already pinned for the same batch).
 - ``autotune_trial``      — kill an autotune trial-journal commit
   (autotuning/runner.py ``TrialJournal.record``) between the tmp write and
   the rename: the stale ``.tmp-*`` partial must be swept on resume and the
@@ -137,6 +144,7 @@ SITES = (
     "rpc_drain_reply",
     "autotune_trial",
     "kv_spill", "kv_fetch",
+    "adapter_fetch",
 )
 
 

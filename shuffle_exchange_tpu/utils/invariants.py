@@ -88,6 +88,11 @@ LOCK_ORDER = {
     "KVTransferChannel._cv": 20,
     "WeightWire._mu": 20,
     "HostKVTier._mu": 20,
+    # the multi-tenant LoRA pool (ISSUE 18) sits with them: touched from
+    # replica ticks (admission acquire/release, prefetch staging) and
+    # from router threads (load() residency reads, publish_adapter);
+    # a leaf in practice — it acquires nothing while held.
+    "AdapterPool._mu": 20,
     # rank 30 — leaf locks: health records, monitor rings, and the RPC
     # server's connection roster (ISSUE 17 — handler dispatch runs
     # OUTSIDE it; it guards only the accept-loop's conn/thread lists).

@@ -1,0 +1,574 @@
+"""Multi-tenant LoRA serving (ISSUE 18): the paged adapter pool pages
+LRU under refcounts with content-keyed registration, the ragged
+grouped-GEMM kernel matches the XLA gather oracle bit-for-bit in
+interpret mode, a mixed-adapter batch serves in ONE dispatch with exact
+per-request token parity against dedicated single-adapter engines, a
+request naming a non-resident adapter PARKS (never preempts) and
+unparks once a slot frees, admission stays atomic-on-reject and names
+adapter-vs-KV pressure, and the fleet layer publishes adapters
+everywhere + routes/fails-over with adapter affinity.
+
+Fast portion shares one module-scoped engine (same tiny geometry as
+test_kv_tier, so the compile cache reuses its programs); the
+dedicated-engine parity sweeps and fleet probes are @slow (ci_full).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                            InferenceConfig,
+                                            InferenceEngineV2)
+from shuffle_exchange_tpu.inference.adapters import (NULL_SLOT,
+                                                     SUPPORTED_TARGETS,
+                                                     AdapterPool,
+                                                     AdapterPoolDry,
+                                                     pool_bytes,
+                                                     target_dims)
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.testing import faults
+from shuffle_exchange_tpu.testing.faults import InjectedFault
+
+RANK = 4
+
+
+@pytest.fixture(scope="module")
+def tcfg():
+    return tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+
+
+@pytest.fixture(scope="module")
+def model_and_params(tcfg):
+    model = Transformer(tcfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _icfg(slots=2, max_rank=RANK, **kw):
+    kw.setdefault("serving", {"token_budget": 16, "max_running": 4,
+                              "chunk_min": 4})
+    return InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        adapters={"enabled": True, "slots": slots, "max_rank": max_rank},
+        **kw)
+
+
+def _factors(tcfg, seed, rank=3, targets=("wq", "wk")):
+    """Small random (A, B) factor pairs per target; rank below the pool
+    ceiling so zero-padding is exercised on every registration."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for t in targets:
+        din, dout = target_dims(tcfg, t)
+        out[t] = (
+            (rng.standard_normal((tcfg.n_layers, din, rank)) * 0.05
+             ).astype(np.float32),
+            (rng.standard_normal((tcfg.n_layers, rank, dout)) * 0.05
+             ).astype(np.float32))
+    return out
+
+
+def _register3(eng, tcfg, alpha=8.0):
+    for i, aid in enumerate(("ad0", "ad1", "ad2")):
+        eng.adapters.register(aid, _factors(tcfg, seed=10 + i), alpha=alpha)
+
+
+# ---------------------------------------------------------------------------
+# pool geometry arithmetic (pure host — the autotuner's feasibility oracle)
+# ---------------------------------------------------------------------------
+
+
+def test_pool_bytes_formula(tcfg):
+    one_slot = pool_bytes(tcfg, 0, RANK)    # device pool = slots + 1
+    assert one_slot > 0
+    assert pool_bytes(tcfg, 3, RANK) == 4 * one_slot
+    assert pool_bytes(tcfg, 3, 2 * RANK) == 2 * pool_bytes(tcfg, 3, RANK)
+    wq = pool_bytes(tcfg, 0, RANK, targets=("wq",))
+    assert wq < one_slot    # per-target sum over SUPPORTED_TARGETS
+    din, dout = target_dims(tcfg, "wq")
+    assert wq == tcfg.n_layers * RANK * (din + dout) * 4
+
+
+# ---------------------------------------------------------------------------
+# AdapterPool: registration / residency / LRU / refcounts / faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def pool(tcfg):
+    return AdapterPool(tcfg, slots=2, max_rank=RANK,
+                       targets=SUPPORTED_TARGETS)
+
+
+class TestAdapterPool:
+    def test_register_is_content_keyed(self, pool, tcfg):
+        fac = _factors(tcfg, seed=1)
+        v1 = pool.register("a", fac, alpha=8.0)
+        assert pool.registered("a") and pool.version("a") == v1
+        assert pool.register("a", fac, alpha=8.0) == v1   # same bytes: no-op
+        v2 = pool.register("a", _factors(tcfg, seed=2), alpha=8.0)
+        assert v2 == v1 + 1   # changed bytes bump the version
+
+    def test_acquire_release_lru_eviction(self, pool, tcfg):
+        for i, aid in enumerate(("a", "b", "c")):
+            pool.register(aid, _factors(tcfg, seed=i))
+        sa, sb = pool.acquire("a"), pool.acquire("b")
+        assert NULL_SLOT not in (sa, sb) and sa != sb
+        assert pool.slot_of("a") == sa and pool.stats()["resident"] == 2
+        with pytest.raises(AdapterPoolDry):
+            pool.acquire("c")    # both slots pinned -> park, don't evict
+        pool.release("a")
+        assert pool.slot_of("a") == sa   # refs==0 stays resident (warm)
+        sc = pool.acquire("c")           # LRU refs==0 victim is "a"
+        assert sc == sa and pool.slot_of("a") is None
+        st = pool.stats()
+        assert st["evictions"] == 1 and st["resident"] == 2
+        assert pool.acquire("b") == sb   # already-resident: refcount hit
+        assert pool.stats()["hits"] >= 1
+        pool.release("b")
+        pool.release("b")
+        assert pool.can_acquire("a")     # b at refs==0 is evictable again
+
+    def test_acquire_unknown_raises(self, pool):
+        with pytest.raises(KeyError):
+            pool.acquire("never-registered")
+
+    def test_pool_dry_is_atomic(self, pool, tcfg):
+        for i, aid in enumerate(("a", "b", "c")):
+            pool.register(aid, _factors(tcfg, seed=i))
+        pool.acquire("a")
+        pool.acquire("b")
+        before = pool.stats()
+        resident = set(pool.resident_ids())
+        with pytest.raises(AdapterPoolDry):
+            pool.acquire("c")
+        assert pool.stats() == before       # refused call mutated nothing
+        assert set(pool.resident_ids()) == resident
+
+    def test_can_acquire_all_counts_batch_holdings(self, pool, tcfg):
+        for i, aid in enumerate(("a", "b", "c")):
+            pool.register(aid, _factors(tcfg, seed=i))
+        pool.acquire("a")
+        ok, why = pool.can_acquire_all(["a", "b"])
+        assert ok and why == ""
+        ok, why = pool.can_acquire_all(["a", "b", "c"])
+        assert not ok and "c" in why    # 3 distinct adapters, 2 slots
+        ok, _ = pool.can_acquire_all(["a", "a", "b"])   # dup costs one slot
+        assert ok
+
+    def test_prefetch_stages_ahead(self, pool, tcfg):
+        for i, aid in enumerate(("a", "b")):
+            pool.register(aid, _factors(tcfg, seed=i))
+        assert pool.prefetch("a")
+        assert not pool.prefetch("never-registered")
+        pool.acquire("a")
+        st = pool.stats()
+        assert st["prefetches"] == 1 and st["prefetch_hits"] == 1
+        assert not pool.prefetch("a")    # resident: nothing to stage
+
+    def test_adapter_fetch_fault_is_atomic(self, pool, tcfg):
+        """The chaos site: a publish/acquire install killed after the
+        victim is chosen but BEFORE mutation leaves residency, refcounts,
+        free slots, and counters untouched — the retried acquire
+        succeeds (testing/faults.py 'adapter_fetch')."""
+        for i, aid in enumerate(("a", "b", "c")):
+            pool.register(aid, _factors(tcfg, seed=i))
+        pool.acquire("a")
+        pool.acquire("b")
+        pool.release("a")
+        before = pool.stats()
+        resident = set(pool.resident_ids())
+        faults.arm("adapter_fetch")
+        with pytest.raises(InjectedFault):
+            pool.acquire("c")
+        assert pool.stats() == before
+        assert set(pool.resident_ids()) == resident
+        faults.clear()
+        assert pool.acquire("c") != NULL_SLOT   # retried verbatim: fine
+
+
+# ---------------------------------------------------------------------------
+# ragged grouped-GEMM: Pallas (interpret) vs the XLA gather oracle
+# ---------------------------------------------------------------------------
+
+
+def _gemm_operands(B=5, T=4, D=256, R=8, N=128, S=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    a = (rng.standard_normal((S, D, R)) * 0.1).astype(np.float32)
+    b = (rng.standard_normal((S, R, N)) * 0.1).astype(np.float32)
+    a[0], b[0] = 0.0, 0.0    # slot 0 is the null adapter
+    slots = np.array([0, 1, 2, 1, 3], np.int32)[:B]
+    return x, a, b, slots
+
+
+class TestLoraGemm:
+    def test_null_slot_adds_exact_zero(self):
+        from shuffle_exchange_tpu.ops.lora_gemm import lora_delta_oracle
+
+        x, a, b, _ = _gemm_operands()
+        delta = lora_delta_oracle(x, a, b, np.zeros((5,), np.int32))
+        assert np.array_equal(np.asarray(delta), np.zeros_like(x[..., :128]))
+
+    def test_pallas_interpret_matches_oracle(self):
+        from shuffle_exchange_tpu.ops.lora_gemm import (lora_delta_oracle,
+                                                        lora_delta_pallas)
+
+        x, a, b, slots = _gemm_operands()
+        want = np.asarray(lora_delta_oracle(x, a, b, slots))
+        got = np.asarray(lora_delta_pallas(x, a, b, slots, interpret=True))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_mixed_rows_independent(self):
+        """Per-row independence — the kernel-level half of the mixed-vs-
+        dedicated token parity contract: row i of a mixed-slot batch
+        equals the same row through a single-slot batch."""
+        from shuffle_exchange_tpu.ops.lora_gemm import lora_delta_oracle
+
+        x, a, b, slots = _gemm_operands()
+        mixed = np.asarray(lora_delta_oracle(x, a, b, slots))
+        for i, s in enumerate(slots):
+            solo = np.asarray(lora_delta_oracle(
+                x[i:i + 1], a, b, np.array([s], np.int32)))
+            np.testing.assert_array_equal(mixed[i], solo[0])
+
+    def test_static_gate_and_dispatch(self, monkeypatch):
+        from shuffle_exchange_tpu.ops.lora_gemm import (lora_delta,
+                                                        lora_delta_oracle,
+                                                        lora_pallas_ok)
+
+        x, a, b, slots = _gemm_operands()
+        assert lora_pallas_ok(x, a, b)
+        assert not lora_pallas_ok(x[..., :100], a[:, :100], b)   # D % 128
+        assert not lora_pallas_ok(x, a[:, :, :6], b[:, :6])      # R % 8
+        monkeypatch.setenv("SXT_FUSED_INTERPRET", "1")
+        got = np.asarray(lora_delta(x, a, b, slots))     # interpret Pallas
+        want = np.asarray(lora_delta_oracle(x, a, b, slots))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine + scheduler e2e (one shared engine: the fast-gate slice)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def eng_sched(model_and_params, tcfg):
+    model, params = model_and_params
+    eng = InferenceEngineV2(model, params, _icfg(slots=2))
+    _register3(eng, tcfg)
+    return eng, ContinuousBatchingScheduler(eng)
+
+
+class TestServingE2E:
+    def test_mixed_batch_pages_and_parks_never_preempts(self, eng_sched):
+        """Six tenants over a 2-slot pool: the adapter set larger than
+        residency serves to completion via LRU paging — evictions and
+        parks in the counters, ZERO adapter-pressure preemptions."""
+        eng, sched = eng_sched
+        prompts = [[2 + i, 5, 9, 13 + i] for i in range(6)]
+        aids = ["ad0", "ad1", "ad2", None, "ad0", "ad2"]
+        out = sched.serve(prompts, max_new_tokens=6, adapter_ids=aids)
+        assert len(out) == 6 and all(len(v) == 6 for v in out.values())
+        st = sched.stats()["adapters"]
+        assert st["evictions"] >= 1      # pool smaller than adapter set
+        assert st["parks"] >= 1 and st["unparks"] == st["parks"]
+        assert sched.preemptions == 0    # park-don't-preempt
+        assert set(st["tokens_by_adapter"]) == {"ad0", "ad1", "ad2"}
+        labels = {e[0] for e in sched.memory_monitor.events}
+        for lbl in ("adapter/hits", "adapter/evictions", "adapter/parks",
+                    "adapter/active_adapters", "adapter/tokens/ad0"):
+            assert lbl in labels, lbl
+        # pool refs all released at completion: every slot evictable again
+        assert eng.adapters.stats()["pinned"] == 0
+
+    def test_new_adapter_is_zero_recompile(self, eng_sched, tcfg):
+        """Adapter identity is DATA: a warmed server admits a never-seen
+        adapter id without adding one compiled program."""
+        eng, sched = eng_sched
+        prompts = [[3, 7, 11], [4, 8, 12]]
+        sched.serve(prompts, max_new_tokens=4, adapter_ids=["ad0", None])
+        programs = set(eng.program_shapes)
+        assert programs, "warm-up should have compiled serving programs"
+        eng.adapters.register("ad9", _factors(tcfg, seed=99), alpha=8.0)
+        out = sched.serve(prompts, max_new_tokens=4,
+                          adapter_ids=["ad9", "ad1"])
+        assert all(len(v) == 4 for v in out.values())
+        assert set(eng.program_shapes) == programs
+
+    def test_submit_validates_adapter(self, eng_sched, model_and_params):
+        eng, sched = eng_sched
+        with pytest.raises(ValueError, match="not registered"):
+            sched.submit([1, 2, 3], adapter_id="never-published")
+        model, params = model_and_params
+        plain = InferenceEngineV2(
+            model, params, InferenceConfig(
+                dtype="float32", max_seq_len=64, kv_block_size=8,
+                num_kv_blocks=40))
+        with pytest.raises(ValueError, match="disabled"):
+            ContinuousBatchingScheduler(plain).submit(
+                [1, 2, 3], adapter_id="ad0")
+
+    def test_admission_names_adapter_vs_kv(self, eng_sched):
+        """Atomic-on-reject with the THIRD resource named: a batch whose
+        pending adapters cannot all be pinned is refused before any
+        descriptor/pool mutation, and the refusal says adapter — not
+        KV."""
+        eng, _ = eng_sched
+        uids, toks = (9101, 9102, 9103), {}
+        try:
+            for uid, aid in zip(uids, ("ad0", "ad1", "ad2")):
+                eng.configure_adapter(uid, aid)
+                toks[uid] = [1, 2, 3]
+            before = eng.adapters.stats()
+            free_before = eng.allocator.free_blocks
+            ok, _, why = eng._admission_detail(
+                list(uids), [3, 3, 3], new_tokens=toks)
+            assert not ok
+            assert "adapter pool" in why and "KV is fine" in why
+            assert eng.adapters.stats() == before    # nothing acquired
+            assert eng.allocator.free_blocks == free_before
+            assert all(uid not in eng._seqs for uid in uids)
+        finally:
+            for uid in uids:
+                eng.configure_adapter(uid, None)
+
+
+# ---------------------------------------------------------------------------
+# dedicated-engine parity + compose matrix (@slow: extra engine compiles)
+# ---------------------------------------------------------------------------
+
+
+def _mk_engine(model, tcfg, params, slots=2, **kw):
+    eng = InferenceEngineV2(model, params, _icfg(slots=slots, **kw))
+    _register3(eng, tcfg)
+    return eng
+
+
+@pytest.mark.slow
+def test_mixed_batch_exact_token_parity(model_and_params, tcfg):
+    """Acceptance (c): every request in a mixed-adapter batch (3 distinct
+    adapters + a no-adapter row) decodes the EXACT token sequence a
+    dedicated engine serving only its adapter produces, under greedy."""
+    model, params = model_and_params
+    sched = ContinuousBatchingScheduler(_mk_engine(model, tcfg, params))
+    prompts = [[2 + i, 5, 9, 13 + i] for i in range(4)]
+    aids = ["ad0", "ad1", "ad2", None]
+    mixed = sched.serve(prompts, max_new_tokens=6, adapter_ids=aids)
+    for i, uid in enumerate(sorted(mixed)):
+        solo = ContinuousBatchingScheduler(
+            _mk_engine(model, tcfg, params)).serve(
+            [prompts[i]], max_new_tokens=6, adapter_ids=[aids[i]])
+        assert mixed[uid] == list(solo.values())[0], (i, aids[i])
+    # the adapters DO change the continuation (the delta is live, not 0)
+    assert mixed[sorted(mixed)[0]] != mixed[sorted(mixed)[3]] or \
+        mixed[sorted(mixed)[1]] != mixed[sorted(mixed)[3]]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("compose", [
+    {"prefix_caching": True},
+    {"kv_cache_dtype": "int8"},
+    {"serving": {"token_budget": 16, "max_running": 4, "chunk_min": 4,
+                 "speculative": {"enabled": True, "k": 2,
+                                 "drafter": "ngram"}}},
+])
+def test_adapters_compose(model_and_params, tcfg, compose):
+    """Adapters x prefix-cache x speculative x quantized KV: the slot
+    indices ride the descriptor through every lane, so each composition
+    serves a mixed batch to completion with per-request parity against
+    its own single-adapter engine."""
+    model, params = model_and_params
+    sched = ContinuousBatchingScheduler(
+        _mk_engine(model, tcfg, params, **compose))
+    prompts = [[2, 5, 9, 13], [3, 6, 10, 14], [4, 7, 11, 15]]
+    aids = ["ad0", "ad1", None]
+    mixed = sched.serve(prompts, max_new_tokens=6, adapter_ids=aids)
+    assert all(len(v) == 6 for v in mixed.values())
+    uid0 = sorted(mixed)[0]
+    solo = ContinuousBatchingScheduler(
+        _mk_engine(model, tcfg, params, **compose)).serve(
+        [prompts[0]], max_new_tokens=6, adapter_ids=["ad0"])
+    assert mixed[uid0] == list(solo.values())[0]
+
+
+# ---------------------------------------------------------------------------
+# fleet: publish-everywhere, affinity, failover re-placement (@slow)
+# ---------------------------------------------------------------------------
+
+
+def _router(model, params, n=2, **router_kw):
+    from shuffle_exchange_tpu.serving import ReplicaRouter
+
+    def factory():
+        return InferenceEngineV2(model, params,
+                                 _icfg(slots=2, router=router_kw or None))
+
+    return ReplicaRouter([factory() for _ in range(n)],
+                         engine_factory=factory)
+
+
+@pytest.mark.slow
+class TestFleet:
+    def test_publish_adapter_reaches_every_replica(self, model_and_params,
+                                                   tcfg):
+        model, params = model_and_params
+        router = _router(model, params, n=2)
+        fac = _factors(tcfg, seed=5)
+        ver = router.publish_adapter("tenant-a", fac, alpha=8.0)
+        for rep in router.replicas:
+            assert rep.engine.adapters.registered("tenant-a")
+            assert rep.engine.adapters.version("tenant-a") == ver
+        assert router.stats()["adapters"]["publishes"] == 1
+        # elastic scale-up catch-up: a newcomer knows the tenant set
+        router.scale_to(3)
+        assert all(r.engine.adapters.registered("tenant-a")
+                   for r in router.replicas if r.state == "active")
+
+    def test_adapter_affinity_placement(self, model_and_params, tcfg):
+        model, params = model_and_params
+        router = _router(model, params, n=2, adapter_affinity_weight=100.0)
+        router.publish_adapter("tenant-a", _factors(tcfg, seed=5))
+        # make the adapter resident on replica 1 ONLY
+        router.replicas[1].engine.adapters.acquire("tenant-a")
+        rep = router.place([1, 2, 3], adapter_id="tenant-a")
+        assert rep.replica_id == 1
+        router.replicas[1].engine.adapters.release("tenant-a")
+
+    def test_failover_replays_onto_adapter_resident_survivor(
+            self, model_and_params, tcfg):
+        """Acceptance (e), threads mode: killing a replica re-places its
+        adapter-bound victims preferentially onto a survivor whose pool
+        already holds the adapter, and the replay is token-identical."""
+        import time
+
+        model, params = model_and_params
+        reference = _router(model, params, n=1)
+        reference.publish_adapter("tenant-a", _factors(tcfg, seed=5))
+        prompts = [[2, 5, 9, 13], [3, 6, 10, 14]]
+        want = reference.serve(prompts, max_new_tokens=6,
+                               adapter_ids=[None, "tenant-a"])
+
+        router = _router(model, params, n=3)
+        router.publish_adapter("tenant-a", _factors(tcfg, seed=5))
+        # warm tenant-a's factors into replica 2's pool only, then pin
+        # both uids onto one replica (sticky session beats affinity) and
+        # kill it: the tenant-a victim must land on the adapter-resident
+        # survivor (2), not an emptier non-resident peer
+        router.replicas[2].engine.adapters.acquire("tenant-a")
+        router.replicas[2].engine.adapters.release("tenant-a")
+        uids = [router.submit(p, max_new_tokens=6, adapter_id=aid,
+                              session_id="pin")
+                for p, aid in zip(prompts, [None, "tenant-a"])]
+        victim = router.owner[uids[0]]
+        assert victim != 2 and router.owner[uids[1]] == victim
+        moved = router.fail_over(victim, reason="drill: adapter failover",
+                                 engine_reachable=False)
+        assert moved == len(uids)
+        assert router.owner[uids[1]] == 2   # adapter-resident survivor
+        router.start()
+        try:
+            deadline = time.time() + 120
+            while (any(router.requests[u].state != "finished"
+                       for u in uids) and time.time() < deadline):
+                time.sleep(0.005)
+        finally:
+            router.stop()
+        got = [list(router.requests[u].generated) for u in uids]
+        keys = sorted(want)
+        assert got[0] == want[keys[0]]
+        assert got[1] == want[keys[1]]   # tenant-a replayed token-exact
+
+
+# ---------------------------------------------------------------------------
+# publisher + monitor integration (fast: no engine builds)
+# ---------------------------------------------------------------------------
+
+
+def test_weight_publisher_publishes_adapters(model_and_params, tcfg,
+                                             eng_sched):
+    """rlhf.WeightPublisher.publish_adapter: factors-only publish — no
+    base weights move — version-stamped with the trainer's step."""
+
+    class _Trainer:   # the publisher only reads global_steps here
+        global_steps = 7
+
+    from shuffle_exchange_tpu.rlhf.publish import WeightPublisher
+
+    eng, _ = eng_sched
+    pub = WeightPublisher(_Trainer())
+    ver = pub.publish_adapter(eng, "rlhf-tenant", _factors(tcfg, seed=6))
+    assert ver == 7 and eng.adapters.registered("rlhf-tenant")
+    assert pub.adapter_publishes == 1
+    labels = {e[0] for e in pub.memory_monitor.events}
+    assert "weights/adapter_publish_s" in labels
+    assert "weights/adapter_version" in labels
+    with pytest.raises(ValueError):
+        pub.publish_adapter(object(), "x", _factors(tcfg, seed=6))
+
+
+def test_fleet_monitor_aggregates_adapter_counters():
+    from shuffle_exchange_tpu.monitor.monitor import FleetMonitor
+
+    fm = FleetMonitor()
+    fm.sink(0).write_events([("adapter/hits", 3.0, 1),
+                             ("adapter/parks", 1.0, 1)])
+    fm.sink(1).write_events([("adapter/hits", 2.0, 1),
+                             ("adapter/evictions", 4.0, 1)])
+    agg = fm.aggregate()
+    assert agg["adapter"]["hits"] == 5.0
+    assert agg["adapter"]["parks"] == 1.0
+    assert agg["adapter"]["evictions"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# autotuner knobs (pure host)
+# ---------------------------------------------------------------------------
+
+
+class TestAutotunerKnobs:
+    def _ctx(self, **kw):
+        from shuffle_exchange_tpu.autotuning.space import SpaceContext
+
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("kv_block_size", 8)
+        kw.setdefault("num_kv_blocks", 64)
+        return SpaceContext(**kw)
+
+    def test_axes_and_static_pool_geometry_prune(self):
+        from shuffle_exchange_tpu.autotuning.space import (KNOWN_AXES,
+                                                           ServingSearchSpace)
+
+        assert "adapter_slots" in KNOWN_AXES
+        assert "adapter_prefetch_depth" in KNOWN_AXES
+        ctx = self._ctx(adapter_slot_bytes=1000, adapter_hbm_budget=5000)
+        space = ServingSearchSpace({"adapter_slots": [2, 8]}, ctx)
+        by_slots = {c.adapter_slots: c for c in space.enumerate()}
+        assert by_slots[2].status == "pending"
+        assert by_slots[8].status == "pruned_static"
+        assert "HBM budget" in by_slots[8].prune_reason
+
+    def test_overlay_round_trip_and_name_dedup(self, tcfg):
+        from shuffle_exchange_tpu.autotuning.space import ServingCandidate
+
+        icfg = _icfg(slots=3)
+        cand = ServingCandidate(adapter_slots=6, adapter_prefetch_depth=2)
+        new = cand.apply(icfg)
+        assert new.adapters.slots == 6 and new.adapters.prefetch_depth == 2
+        assert new.adapters.max_rank == RANK   # geometry merges, not resets
+        assert "_as6" in cand.name and "_apd2" in cand.name
+        off = ServingCandidate(adapter_slots=0, adapter_prefetch_depth=2)
+        assert "_apd" not in off.name    # inert knob: dedup collapses
+        assert not off.apply(icfg).adapters.enabled
+        base = ServingCandidate.from_config(icfg)
+        assert base.adapter_slots == 3

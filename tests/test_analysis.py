@@ -1060,3 +1060,15 @@ def test_annotations_present_on_real_seams():
                  "prefetch_hits", "prefetch_misses", "spilled_blocks"):
         assert attr in HostKVTier.__sxt_locked_by__["_mu"], attr
     assert LOCK_ORDER["HostKVTier._mu"] == 20   # transfer-substrate rank
+    # the ISSUE 18 multi-tenant LoRA seams: the adapter pool's slot map,
+    # staging buffers, and counters ride its own rank-20 lock (touched
+    # from replica ticks AND router threads), and fleet-wide adapter
+    # publish is validate-then-mutate like the other router publishes
+    from shuffle_exchange_tpu.inference.adapters import AdapterPool
+
+    assert "_mu" in AdapterPool.__sxt_locked_by__
+    for attr in ("_resident", "_slot_owner", "_free_slots", "_staged",
+                 "hits", "misses", "evictions", "installs", "prefetches"):
+        assert attr in AdapterPool.__sxt_locked_by__["_mu"], attr
+    assert LOCK_ORDER["AdapterPool._mu"] == 20
+    assert hasattr(ReplicaRouter.publish_adapter, "__sxt_atomic_on_reject__")

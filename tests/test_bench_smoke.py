@@ -463,6 +463,53 @@ def test_serving_longctx_row_runs_at_toy_size():
     # toy scale wall-clock noise can swamp the re-prefill waste signal
 
 
+@pytest.mark.slow   # ~40s: 1/3/6-adapter sweep + solo parity replays; nightly via ci_full
+def test_serving_multi_tenant_row_runs_at_toy_size():
+    """The config-5 multi-tenant LoRA row (bench.serving_multi_tenant_row)
+    at toy size: the same Poisson trace striped across 1 vs 3 vs 6
+    adapters on a 2-slot pool — the oversubscribed entries must page (LRU
+    evictions), park rather than preempt, and keep mixed-vs-solo token
+    parity (asserted inside the row), so the published bench row cannot
+    rot on the CPU driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_multi_tenant_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = serving_multi_tenant_row(model, params, icfg, mcfg.vocab_size,
+                                   n_requests=6, adapter_counts=(1, 3, 6),
+                                   pool_slots=2, rank=4, prompt_lo=4,
+                                   prompt_hi=20, max_new=5, load=2.0,
+                                   parity_samples=2)
+    assert row["token_mismatches_mixed_vs_solo"] == 0
+    assert [e["n_adapters"] for e in row["entries"]] == [1, 3, 6]
+    e1, e3, e6 = row["entries"]
+    # resident single tenant: everything hits, nothing pages
+    assert e1["pool_hit_rate"] == 1.0 and e1["evictions"] == 0
+    # oversubscribed entries page through the 2-slot pool
+    assert e6["evictions"] > 0 and e6["pool_hit_rate"] < 1.0
+    # adapter pressure parks, never preempts (asserted in-row too)
+    assert all(e["preemptions"] == 0 for e in row["entries"])
+    assert all(e["parks"] == e["unparks"] for e in row["entries"])
+    assert all(e["sustained_tokens_per_sec"] > 0 for e in row["entries"])
+    assert e1["goodput_retention"] == 1.0
+    # adapter identity is data: the in-row fresh-adapter probe served a
+    # never-seen adapter id on the warmed engine without compiling
+    assert row["fresh_adapter_new_programs"] == 0
+
+
 @pytest.mark.slow   # ~90s: per-degree sxt.initialize + train steps; nightly via ci_full
 def test_ring_scaling_row_runs_at_toy_size():
     """The config-2 ring-attention scaling entry (bench.ring_scaling_row)

@@ -288,6 +288,26 @@ def test_grouped_gemm_lowers():
         x, w, gs).astype(jnp.float32).sum() ** 2, argnums=(0, 1)), x, w)
 
 
+def test_lora_grouped_gemm_lowers():
+    """Multi-tenant LoRA ragged grouped-GEMM (ISSUE 18): the per-row
+    scalar-prefetch slot gather driving the factor BlockSpec index maps
+    must pass the real Mosaic block checks at the serving decode shape
+    (T=1) and at a prefill-chunk shape — slot indices are data, so one
+    lowering covers every adapter mix."""
+    from shuffle_exchange_tpu.ops.lora_gemm import (lora_delta_pallas,
+                                                    lora_pallas_ok)
+
+    S, D, R, N = 5, 256, 8, 128
+    a = jnp.zeros((S, D, R), jnp.bfloat16)
+    b = jnp.zeros((S, R, N), jnp.bfloat16)
+    slots = jnp.zeros((4,), jnp.int32)
+    assert lora_pallas_ok(jnp.zeros((4, 1, D), jnp.bfloat16), a, b)
+    for T in (1, 8):
+        x = jnp.zeros((4, T, D), jnp.bfloat16)
+        _tpu_lower(lambda x, a, b, s: lora_delta_pallas(x, a, b, s),
+                   x, a, b, slots)
+
+
 @pytest.mark.parametrize("store", [jnp.int8, jnp.float8_e4m3fn])
 def test_paged_kernels_quantized_kv_lower(store):
     """kv_cache_dtype int8/fp8 (ISSUE 6): every streaming kernel that

@@ -257,7 +257,7 @@ def _bare_fleet():
     fleet.migrated_sequences = 0
     fleet.migrated_blocks = 0
     fleet.workers = {}
-    fleet._placement_order = lambda handles: sorted(
+    fleet._placement_order = lambda handles, adapter_id=None: sorted(
         handles, key=lambda h: h.replica_id)
     return fleet
 
