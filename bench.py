@@ -1200,6 +1200,130 @@ def serving_multi_tenant_row(model, params, icfg, vocab, *, n_requests=24,
     }
 
 
+def serving_moe_row(model, params, icfg, vocab, *, n_requests=16,
+                    n_experts=4, prompt_lo=64, prompt_hi=256, max_new=32,
+                    load=2.0, seed=0, parity_samples=3):
+    """Config-5 expert-parallel MoE serving row (ISSUE 19): the SAME
+    Poisson trace served by the dense baseline and by an MoE twin at
+    MATCHED total parameters (each of the ``n_experts`` experts gets
+    ``ff_dim // n_experts``, so the expert pool together weighs what the
+    dense FFN weighs, while each token only computes ``top_k/n_experts``
+    of it). The MoE engine pins ``serving.moe.moe_impl="ragged"`` — the
+    dropless sorted-route through ``ops/grouped_gemm.grouped_matmul``,
+    whose output is batch-composition independent, which is what makes
+    the batched-vs-sequential token-parity assert below exact. The row
+    reports goodput + TTFT/TPOT tails for both twins, the MoE routing
+    counters (dispatched/dropped/parks and the expert-load balance of the
+    final tick), and ASSERTS expert pressure never preempted. Reused at
+    toy size by tests/test_bench_smoke.py."""
+    import dataclasses as _dc
+
+    import jax as _jax
+
+    from shuffle_exchange_tpu.autotuning import poisson_arrivals
+    from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                                InferenceEngineV2)
+    from shuffle_exchange_tpu.models import Transformer
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+
+    dense_cfg = model.config
+    moe_cfg = _dc.replace(
+        dense_cfg, n_experts=n_experts, moe_top_k=2,
+        d_ff=max(128, dense_cfg.ff_dim // n_experts))
+    moe_model = Transformer(moe_cfg)
+    moe_params = moe_model.init(_jax.random.PRNGKey(seed))
+    moe_icfg = icfg.with_overlay(
+        {"serving": {"moe": {"moe_impl": "ragged"}}})
+
+    def pcount(p):
+        import jax.tree_util as _jtu
+        return sum(int(np.prod(l.shape)) for l in _jtu.tree_leaves(p))
+
+    def run(m, p, ic, arrivals=None):
+        eng = InferenceEngineV2(m, p, ic)
+        # throwaway pass warms the shape-bin ladder (same trace -> same
+        # shapes), so the measured pass carries no JIT wall-time
+        ContinuousBatchingScheduler(eng).serve(prompts,
+                                               max_new_tokens=max_new)
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=max_new,
+                          arrivals=arrivals)
+        return eng, out, sched.stats()
+
+    # capacity pass on the dense twin sets the paired arrival trace both
+    # twins replay — same prompts, same offsets, same offered load
+    _, _, st_cap = run(model, params, icfg)
+    cap = st_cap["sustained_tokens_per_sec"]
+    span = n_requests * max_new / cap / load
+    arrivals = list(poisson_arrivals(rng, n_requests, span))
+
+    entries = {}
+    _, _, st_dense = run(model, params, icfg, arrivals=arrivals)
+    moe_eng, moe_out, st_moe = run(moe_model, moe_params, moe_icfg,
+                                   arrivals=arrivals)
+    for name, st in (("dense", st_dense), ("moe", st_moe)):
+        entries[name] = {
+            "sustained_tokens_per_sec": round(
+                st["sustained_tokens_per_sec"], 1),
+            "ttft_p95_s": round(st["ttft_p95_s"], 4),
+            "ttft_p99_s": round(st["ttft_p99_s"], 4),
+            "tpot_p95_s": round(st["tpot_p95_s"], 4),
+            "tpot_p99_s": round(st["tpot_p99_s"], 4),
+            "ticks": st["ticks"],
+            "preemptions": st["preemptions"],
+        }
+    entries["dense"]["params"] = pcount(params)
+    entries["moe"]["params"] = pcount(moe_params)
+    entries["moe"].update({
+        "n_experts": n_experts, "top_k": moe_cfg.moe_top_k,
+        "d_ff_per_expert": moe_cfg.d_ff,
+        **{k: st_moe["moe"][k] for k in
+           ("dispatched", "dropped", "expert_load_max", "capacity_parks")},
+    })
+    # expert pressure parks at the queue's FIFO seat — it never preempts
+    assert st_moe["preemptions"] == 0, st_moe
+    assert st_moe["moe"]["dropped"] == 0, st_moe   # ragged is dropless
+    # expert-load balance of the final tick: mean/max over the per-expert
+    # routed-token counts (1.0 = perfectly balanced routing)
+    counts = moe_eng._moe_last_counts
+    balance = (round(float(counts.mean() / counts.max()), 3)
+               if counts is not None and counts.max() else None)
+    entries["moe"]["expert_load_balance"] = balance
+    # token parity vs the SEQUENTIAL oracle: each sampled request alone
+    # through put() + decode_loop() on a fresh engine — the dense-gather
+    # route a one-request batch takes. Ragged routing is batch-composition
+    # independent, so the Poisson-mixed run must emit identical tokens.
+    oracle_eng = InferenceEngineV2(moe_model, moe_params, moe_icfg)
+    mism = 0
+    for i in range(min(parity_samples, n_requests)):
+        lg = oracle_eng.put([i], [prompts[i]])
+        first = int(np.asarray(lg)[0].argmax())
+        toks = [first] + np.asarray(oracle_eng.decode_loop(
+            [i], [first], max_new - 1))[0].tolist()
+        mism += toks != moe_out[i]
+    assert mism == 0, (f"moe token parity broken: {mism}/{parity_samples} "
+                       f"sampled requests diverge batched-vs-sequential")
+    return {
+        "trace": _trace_record(seed, prompts, max_new, load, arrivals,
+                               capacity=cap),
+        "n_requests": n_requests,
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "moe_impl": "ragged",
+        "entries": entries,
+        "goodput_vs_dense": round(
+            entries["moe"]["sustained_tokens_per_sec"]
+            / entries["dense"]["sustained_tokens_per_sec"], 3),
+        "token_mismatches_vs_oracle": mism,
+        "parity_samples": parity_samples,
+    }
+
+
 def _jaxpr_peak_var_bytes(jaxpr) -> int:
     """Largest single intermediate array (bytes) in the jaxpr's MANUAL
     region (the shard_map body — vars there have per-chip local shapes),
@@ -1783,6 +1907,18 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               f"{_short_err(e)}", file=sys.stderr, flush=True)
         multi_tenant_row = None
 
+    # ---- expert-parallel MoE serving: the same Poisson trace on the
+    # dense baseline vs an MoE twin at matched total params (ISSUE 19) —
+    # goodput, TTFT/TPOT tails, routing counters and expert-load balance,
+    # with batched-vs-sequential token parity asserted under the ragged
+    # (dropless) route
+    try:
+        moe_row = serving_moe_row(model, params, icfg, cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving moe bench failed: {_short_err(e)}",
+              file=sys.stderr, flush=True)
+        moe_row = None
+
     # ---- serving autotune: bounded successive-halving search of the
     # serving knobs against the paired Poisson goodput trace (ISSUE 14) —
     # tuned-vs-default delta, static-prune and zero-recompile contracts,
@@ -1851,6 +1987,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_failover": failover_row,
         "serving_longctx": longctx_row,
         "serving_multi_tenant": multi_tenant_row,
+        "serving_moe": moe_row,
         "serving_autotune": autotune_row,
         "rlhf_rollout": rlhf_row,
         "engine_ms_per_token": (eng_best["engine_ms_per_token"]

@@ -100,6 +100,15 @@ env SXT_SANITIZE=1 python -m pytest tests/test_sampling.py -q "$@"
 # publish/affinity/failover-replay. Sanitized: the pool lock is rank 20
 # in the declared hierarchy and router threads touch it.
 env SXT_SANITIZE=1 python -m pytest tests/test_adapters.py -q "$@"
+# Expert-parallel MoE serving gates (ISSUE 19): grouped-GEMM (dropless
+# ragged) token dispatch inside the one-dispatch tick with exact batched-
+# vs-sequential oracle parity, expert-capacity admission (park — never
+# preempt — under routing pressure, drop policy as opt-in), two-warm-pass
+# zero-recompile, MoE x prefix-cache x speculative x kv-dtype compose,
+# and the fleet surface (tiny_moe engine spec over the wire, moe/*
+# counter aggregation with max-folded expert_load_max). Sanitized like
+# the other serving suites.
+env SXT_SANITIZE=1 python -m pytest tests/test_moe_serving.py -q "$@"
 # RLHF / HybridEngine v2 gates (ISSUE 11): train->serve flip parity with
 # a fresh engine on the gathered weights, zero recompiles across flips on
 # a warmed fleet, bit-exact rollout replay at the recorded weight
@@ -123,4 +132,5 @@ exec python -m pytest tests/ -q --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_sampling.py \
     --ignore=tests/test_rlhf.py \
     --ignore=tests/test_hybrid_engine.py \
-    --ignore=tests/test_adapters.py "$@"
+    --ignore=tests/test_adapters.py \
+    --ignore=tests/test_moe_serving.py "$@"

@@ -1,0 +1,38 @@
+#!/bin/sh
+# jax >= 0.5 capability lane (ROADMAP item 5, ISSUE 19): run tier-1 + the
+# multichip dryrun under a jax that exposes first-class jax.shard_map, so
+# the native expert/tensor-axis lowerings get exercised instead of only the
+# 0.4.x live-axis emulations (parallel/mesh.py native_shard_map()). The
+# dryrun's config-19 EP probe REQUIRES the all-to-all pair on this lane —
+# under 0.4.x it only requires some cross-partition collective.
+#
+# The interpreter is found, in order: $SXT_JAX_NEXT_PY, then the
+# conventional venv locations below. This image bakes only jax 0.4.x, so
+# on most boxes this script skips with a named message — that skip is the
+# honest state of the capability lane, not a pass.
+set -e
+cd "$(dirname "$0")/.."
+
+PY=""
+for cand in "${SXT_JAX_NEXT_PY:-}" \
+    /opt/venvs/jax-next/bin/python \
+    "$HOME/.venvs/jax-next/bin/python" \
+    .venv-jax-next/bin/python; do
+    [ -n "$cand" ] && [ -x "$cand" ] && PY="$cand" && break
+done
+if [ -z "$PY" ]; then
+    echo "ci_jax_next: SKIP — no jax>=0.5 venv found (set SXT_JAX_NEXT_PY" \
+         "or create /opt/venvs/jax-next); the 0.4.x emulation lane remains" \
+         "the only one exercised."
+    exit 0
+fi
+if ! "$PY" -c "import jax, sys; sys.exit(0 if hasattr(jax, 'shard_map') else 1)" 2>/dev/null; then
+    echo "ci_jax_next: SKIP — $PY has no first-class jax.shard_map" \
+         "(jax < 0.5); not a capability venv."
+    exit 0
+fi
+echo "ci_jax_next: using $PY (jax $("$PY" -c 'import jax; print(jax.__version__)'))"
+env JAX_PLATFORMS=cpu "$PY" -m pytest tests/ -q -m "not slow" \
+    -p no:cacheprovider "$@"
+"$PY" -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "ci_jax_next: ok — native shard_map lane green"

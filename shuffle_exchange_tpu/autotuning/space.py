@@ -52,6 +52,7 @@ __all__ = ["ServingCandidate", "ServingSearchSpace", "SpaceContext",
 _KV_DTYPES = ("bf16", "int8", "fp8")
 _DECODE_KERNELS = ("auto", "pallas", "xla")
 _DRAFTERS = ("ngram", "model")
+_MOE_IMPLS = ("auto", "capacity", "capacity_einsum", "ragged")
 
 #: the axes ServingSearchSpace accepts, i.e. the tunable knob families
 KNOWN_AXES = ("token_budget", "max_running", "chunk_min", "chunk_bins",
@@ -65,7 +66,11 @@ KNOWN_AXES = ("token_budget", "max_running", "chunk_min", "chunk_bins",
               # (0 = adapters off, None = inherit the base config's pool)
               # and how many queued-but-non-resident adapters stage into
               # pinned buffers one tick ahead of their expected acquire
-              "adapter_slots", "adapter_prefetch_depth")
+              "adapter_slots", "adapter_prefetch_depth",
+              # expert-parallel MoE serving (ISSUE 19): the routed-FFN
+              # capacity factor (headroom over balanced expert load) and
+              # the routing implementation the serving engines pin
+              "moe_capacity_factor", "moe_impl")
 
 
 def pow2_bin_count(n: int) -> int:
@@ -134,6 +139,13 @@ class SpaceContext:
     #: HBM bytes a candidate's adapter pool may spend (slots+1 slots x
     #: adapter_slot_bytes must fit). None disables the constraint.
     adapter_hbm_budget: Optional[int] = None
+    #: expert-pool geometry (ISSUE 19): expert count of the model the
+    #: candidates will serve (None/0 = dense — moe axes are inert and
+    #: non-default moe knobs prune statically), the router's top-k, and
+    #: the gating floor ``compute_capacity`` clamps to
+    moe_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_min_capacity: int = 4
 
     @property
     def usable_blocks(self) -> int:
@@ -171,6 +183,12 @@ class ServingCandidate:
     # 0 disables adapters explicitly
     adapter_slots: Optional[int] = None
     adapter_prefetch_depth: int = 1
+    # expert-parallel MoE serving (ISSUE 19): None / "auto" keep the base
+    # config's ``serving.moe`` section; a float capacity factor or a
+    # pinned impl overlays it (only meaningful on expert-routed models —
+    # check() prunes them as inert on dense ones)
+    moe_capacity_factor: Optional[float] = None
+    moe_impl: str = "auto"
     # search bookkeeping (mutated by the space/search, not identity)
     status: str = "pending"      # pending | pruned_static | ...
     prune_reason: str = ""
@@ -210,6 +228,13 @@ class ServingCandidate:
             # EXPLICIT 0, where omitting the suffix lets enumerate()'s
             # dedup collapse the identical configs
             n += f"_apd{self.adapter_prefetch_depth}"
+        # moe knobs: defaults (None / "auto") inherit the base config's
+        # serving.moe section, so they get no suffix and enumerate()'s
+        # dedup collapses the axes' inherit points into one candidate
+        if self.moe_capacity_factor is not None:
+            n += f"_mcf{self.moe_capacity_factor:g}"
+        if self.moe_impl != "auto":
+            n += f"_moe-{self.moe_impl}"
         return n
 
     # -- ladders (static; no config construction) -----------------------
@@ -304,6 +329,16 @@ class ServingCandidate:
             out["adapters"] = {
                 "prefetch_depth": self.adapter_prefetch_depth,
             }
+        # moe: a partial serving.moe section — with_overlay merges it
+        # over the base's, keeping the knobs the candidate didn't search
+        # (overload policy/threshold)
+        moe: Dict[str, object] = {}
+        if self.moe_capacity_factor is not None:
+            moe["capacity_factor"] = self.moe_capacity_factor
+        if self.moe_impl != "auto":
+            moe["moe_impl"] = self.moe_impl
+        if moe:
+            sv["moe"] = moe
         return out
 
     def apply(self, base_icfg):
@@ -319,6 +354,11 @@ class ServingCandidate:
         baseline every search measures its winner against."""
         sv = icfg.serving
         spec = sv.speculative
+        # the serving.moe section always exists (with defaults), so map
+        # section-default values back to the candidate's inherit point —
+        # otherwise every dense-model baseline would read as "moe-tuned"
+        # and check()'s inert-axis prune would reject the whole search
+        moe_default = type(sv.moe)()
         return cls(
             token_budget=sv.token_budget, max_running=sv.max_running,
             chunk_min=sv.chunk_min, chunk_bins=sv.chunk_bins,
@@ -332,7 +372,11 @@ class ServingCandidate:
             prefetch_depth=icfg.kv_tier.prefetch_depth,
             adapter_slots=(icfg.adapters.slots
                            if icfg.adapters.enabled else 0),
-            adapter_prefetch_depth=icfg.adapters.prefetch_depth)
+            adapter_prefetch_depth=icfg.adapters.prefetch_depth,
+            moe_capacity_factor=(
+                None if sv.moe.capacity_factor == moe_default.capacity_factor
+                else sv.moe.capacity_factor),
+            moe_impl=sv.moe.moe_impl)
 
 
 class ServingSearchSpace:
@@ -494,6 +538,36 @@ class ServingSearchSpace:
                     f"{ctx.adapter_slot_bytes} padded-factor bytes = "
                     f"{need} exceeds the {ctx.adapter_hbm_budget}-byte "
                     f"adapter HBM budget")
+        # expert-parallel MoE serving (ISSUE 19): knob validity, then
+        # expert-pool geometry — on a dense model the moe axes are inert
+        # (the engine never reads serving.moe), so non-default values
+        # prune: they would burn a measured trial per point on configs
+        # identical to the baseline
+        if c.moe_impl not in _MOE_IMPLS:
+            return False, f"moe_impl {c.moe_impl!r} not in {_MOE_IMPLS}"
+        if c.moe_capacity_factor is not None \
+                and not float(c.moe_capacity_factor) > 0:
+            return False, (f"moe_capacity_factor {c.moe_capacity_factor} "
+                           f"must be > 0")
+        moe_tuned = (c.moe_capacity_factor is not None
+                     or c.moe_impl != "auto")
+        if moe_tuned and not ctx.moe_experts:
+            return False, (
+                "moe axes are inert on a dense model (SpaceContext."
+                "moe_experts unset) — the candidate is config-identical "
+                "to its moe-default twin")
+        if (c.moe_capacity_factor is not None and ctx.moe_experts
+                and c.moe_capacity_factor * ctx.moe_top_k
+                > ctx.moe_experts):
+            # capacity = ceil(S*k/E * cf) >= S once cf*k > E: no expert
+            # can ever drop a token (each receives at most S), so the
+            # capacity impl degenerates to dropless at strictly more
+            # padded compute than impl="ragged" — over-provisioned
+            return False, (
+                f"moe_capacity_factor {c.moe_capacity_factor:g} x top_k "
+                f"{ctx.moe_top_k} > {ctx.moe_experts} experts — per-expert "
+                f"capacity covers every token, a dropless config at padded "
+                f"cost (use moe_impl='ragged' instead)")
         # KV arithmetic: a running set that cannot hold 1/overcommit of
         # its worst case permanently lives in the preemption path —
         # UNLESS the tier is on, where overflow parks host-ward instead

@@ -335,6 +335,60 @@ class AdapterConfig:
 
 
 @dataclasses.dataclass
+class MoEServingConfig:
+    """Expert-capacity serving knobs (ISSUE 19): how the one-dispatch
+    serving step routes MoE FFNs and how the scheduler treats expert
+    load as an admission resource (the next one after KV blocks, tier
+    residency, and adapter slots).
+
+    - ``capacity_factor``: per-expert buffer slack for the capacity
+      dispatch paths AND the admission pressure bar — balanced routing
+      loads each expert to ``1/capacity_factor`` of its capacity, so the
+      default 1.25 keeps balanced traffic below the park threshold.
+      Overrides the model config's training-time ``capacity_factor``
+      inside the serving engine only.
+    - ``moe_impl``: forwarded to ``moe/layer.py::moe_layer`` ("auto"
+      resolves exactly as training does — capacity under a scanned
+      stack or an expert axis > 1, dropless ragged grouped-GEMM
+      otherwise). "ragged" is the batch-composition-independent route
+      the exact-token parity tests pin.
+    - ``overload_policy``: "park" holds queued requests at their FIFO
+      seat while the previous tick's routing counts exceed the
+      capacity bar (park-don't-preempt — running sequences are never
+      preempted for expert pressure); "drop" disables the admission
+      gate and relies on the capacity path's GShard drop semantics.
+    - ``overload_threshold``: load_max/capacity ratio at which "park"
+      engages (1.0 = park when any expert would exceed its capacity).
+    """
+
+    capacity_factor: float = 1.25
+    moe_impl: str = "auto"
+    overload_policy: str = "park"
+    overload_threshold: float = 1.0
+
+    def __post_init__(self):
+        self.capacity_factor = float(self.capacity_factor)
+        if not self.capacity_factor > 0:
+            raise ConfigError(
+                f"serving.moe.capacity_factor must be > 0, got "
+                f"{self.capacity_factor!r}")
+        allowed = ("auto", "capacity", "capacity_einsum", "ragged")
+        if self.moe_impl not in allowed:
+            raise ConfigError(
+                f"serving.moe.moe_impl must be one of {allowed}, got "
+                f"{self.moe_impl!r}")
+        if self.overload_policy not in ("park", "drop"):
+            raise ConfigError(
+                f"serving.moe.overload_policy must be 'park' or 'drop', "
+                f"got {self.overload_policy!r}")
+        self.overload_threshold = float(self.overload_threshold)
+        if not self.overload_threshold > 0:
+            raise ConfigError(
+                f"serving.moe.overload_threshold must be > 0, got "
+                f"{self.overload_threshold!r}")
+
+
+@dataclasses.dataclass
 class ServingConfig:
     """Continuous-batching scheduler knobs (``inference/scheduler.py`` —
     the Dynamic-SplitFuse scheduler the reference FastGen engine runs,
@@ -357,6 +411,10 @@ class ServingConfig:
     # per tick, verified in the same one-dispatch mixed step
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
+    # expert-parallel MoE serving (ISSUE 19): capacity factor, dispatch
+    # impl, and the park-vs-drop expert-overload admission policy
+    moe: MoEServingConfig = dataclasses.field(
+        default_factory=MoEServingConfig)
 
     def __post_init__(self):
         if self.speculative is None:
@@ -369,6 +427,16 @@ class ServingConfig:
                     f"unknown serving.speculative config keys "
                     f"{sorted(unknown)} (allowed: {sorted(allowed)})")
             self.speculative = SpeculativeConfig(**self.speculative)
+        if self.moe is None:
+            self.moe = MoEServingConfig()
+        elif isinstance(self.moe, dict):
+            allowed = {f.name for f in dataclasses.fields(MoEServingConfig)}
+            unknown = set(self.moe) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown serving.moe config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            self.moe = MoEServingConfig(**self.moe)
         if self.token_budget < 1:
             raise ConfigError(f"serving.token_budget must be >= 1, got "
                               f"{self.token_budget}")
@@ -440,6 +508,11 @@ class ServingConfig:
             "speculative_k": spec.k if spec.enabled else 0,
             "k_bins": list(spec.bins()) if spec.enabled else [],
             "drafter": spec.drafter if spec.enabled else None,
+            # MoE serving (ISSUE 19): live only when the model has
+            # experts, but always recorded — the trial log's point must
+            # name the knobs it was (not) searched over either way
+            "moe_capacity_factor": self.moe.capacity_factor,
+            "moe_impl": self.moe.moe_impl,
         }
 
 

@@ -622,11 +622,28 @@ class InferenceEngine:
             # scanned=True: _ffn runs inside the lax.scan over stacked
             # layers — "auto" must not pick the megablox ragged path here
             # (the ~4x scanned-gmm cliff, moe/resolve_moe_impl), same as
-            # the training stack_apply call site
+            # the training stack_apply call site. Serving (engine_v2) may
+            # override impl/capacity_factor from serving.moe and arm a
+            # per-layer tap collecting routing counts; both are inert on
+            # the training-side engines (attributes absent).
+            impl = getattr(self, "_moe_impl_override", None) or cfg.moe_impl
+            cf = getattr(self, "_moe_cf_override", None)
             res = moe_layer(lw["moe_gate"], expert_params, y, k=cfg.moe_top_k,
-                            capacity_factor=cfg.capacity_factor, activation=cfg.activation,
-                            impl=cfg.moe_impl, normalize_weights=cfg.moe_norm_topk,
+                            capacity_factor=cfg.capacity_factor if cf is None else cf,
+                            activation=cfg.activation,
+                            impl=impl, normalize_weights=cfg.moe_norm_topk,
                             scanned=True)
+            tap = getattr(self, "_moe_tap", None)
+            if tap is not None:
+                # counts [E] i32 (capacity impl: post-drop; ragged: pre-drop
+                # with drop_fraction 0); dropped assignments = drop * S*k,
+                # exact because drop_fraction = 1 - kept/(S*k)
+                counts = res.metadata["expert_counts"]
+                drop = res.metadata.get("drop_fraction", 0.0)
+                total = 1
+                for d in y.shape[:-1]:
+                    total *= int(d)
+                tap.append((counts, drop * (total * cfg.moe_top_k)))
             out = res.output
             if cfg.moe_shared_expert_ff > 0:
                 shared = (jax.nn.silu(y @ lw["moe_shared_w_gate"])

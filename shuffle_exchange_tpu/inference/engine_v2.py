@@ -227,6 +227,30 @@ class InferenceEngineV2(InferenceEngine):
                 prefetch_depth=cfg.adapters.prefetch_depth,
                 dtype=cfg.jax_dtype())
         self._pending_adapter: Dict[int, str] = {}
+        # expert-parallel MoE serving (ISSUE 19): the engine serves MoE
+        # models through the same one-dispatch step — top-k routing is
+        # per-token DATA inside the layer scan (sorted-by-expert grouped
+        # GEMM / capacity dispatch), so expert assignment never keys a
+        # program shape and the warmed server's zero-recompile invariant
+        # holds. Per-tick routing counts ride out of every dispatch as an
+        # extra [L, E] output (the "_pop_moe" seam) and feed the
+        # scheduler's expert-capacity admission + the moe/* counters.
+        self._moe_serving = self._mcfg.n_experts > 0
+        self._moe_tap = None           # armed per layer-scan body (engine._ffn appends)
+        self.moe_dispatched = 0        # expert assignments routed (post-drop)
+        self.moe_dropped = 0           # assignments dropped at expert capacity
+        self.moe_expert_load_max = 0   # peak per-(layer, expert) load seen
+        self._moe_last_counts = None   # [E] worst-layer per-expert load, last tick
+        self._moe_last_total = 0       # S*k of the last tick (capacity denominator)
+        if self._moe_serving:
+            mo = cfg.serving.moe
+            # "auto" defers to the model config's moe_impl (which itself
+            # resolves scanned "auto" -> capacity, the ~4x scanned-gmm
+            # cliff); an explicit serving impl wins over the model config
+            self._moe_impl_override = (None if mo.moe_impl == "auto"
+                                       else mo.moe_impl)
+            self._moe_cf_override = mo.capacity_factor
+            self._shard_expert_weights()
 
     # -- scheduling queries (engine_v2.py:158-232) ---------------------
 
@@ -340,6 +364,26 @@ class InferenceEngineV2(InferenceEngine):
                         f"adapter pool (KV is fine: {need} blocks needed, "
                         f"{self.allocator.free_blocks} free): {awhy}; park "
                         f"until a running sequence releases its slot")
+        if self._moe_serving and any(self._seqs.get(u) is None for u in uids):
+            # expert capacity is the FOURTH admission resource (ISSUE 19,
+            # after KV blocks, max_seq_len, and adapter slots): when the
+            # previous tick's routing saturated some expert's buffer,
+            # NEW sequences are refused — named as expert-vs-KV pressure
+            # so the scheduler parks instead of spilling KV that would
+            # not help. Known uids always pass (running sequences keep
+            # ticking, which is also what drains the pressure; the
+            # ``self._seqs`` guard below keeps a stale reading from
+            # blocking an idle engine forever).
+            mo = self.config.serving.moe
+            pr = self.moe_pressure()
+            if (mo.overload_policy == "park" and self._seqs
+                    and pr > mo.overload_threshold):
+                return False, need, (
+                    f"expert capacity (KV is fine: {need} blocks needed, "
+                    f"{self.allocator.free_blocks} free): last tick's peak "
+                    f"expert ran at {pr:.2f}x capacity (threshold "
+                    f"{mo.overload_threshold:g}, policy park); hold new "
+                    f"sequences until routing pressure drains")
         return True, need, ""
 
     # -- device programs ----------------------------------------------
@@ -384,6 +428,127 @@ class InferenceEngineV2(InferenceEngine):
         for i, d in enumerate(descs):
             s[i] = d.adapter_slot
         return s
+
+    # -- expert-parallel MoE serving (ISSUE 19) ------------------------
+
+    def _shard_expert_weights(self) -> None:
+        """Expert-parallel weight placement: the stacked ``moe_*`` expert
+        leaves are [L, E, ...], sharded over the mesh "expert" axis so
+        each device holds E/ep experts and XLA lowers the dispatch/return
+        all-to-all pair from the sharding constraints (the moe/layer.py
+        pattern — ``_constrain_expert`` marks the activations inside the
+        layer). On jax 0.4.x the facade's live-expert-axis emulation
+        applies exactly as training does; both lanes are logged so the
+        placement is never silently wrong. No-op off-topology or when the
+        expert axis is 1 (single-chip serving: replicated experts)."""
+        from ..parallel.mesh import (get_topology, native_shard_map,
+                                     topology_is_initialized)
+        from ..utils.logging import logger
+
+        if not topology_is_initialized():
+            return
+        topo = get_topology()
+        ep = topo.expert_parallel_world_size
+        if ep <= 1:
+            return
+        import jax
+
+        E = self._mcfg.n_experts
+        if E % ep:
+            raise ValueError(
+                f"n_experts={E} is not divisible by the mesh expert axis "
+                f"({ep}) — expert-parallel serving shards whole experts")
+        sharding = topo.named_sharding(None, "expert")
+        layers = dict(self.params["layers"])
+        moved = []
+        for name, leaf in layers.items():
+            if (name.startswith("moe_") and name != "moe_gate"
+                    and not name.startswith("moe_shared")
+                    and getattr(leaf, "ndim", 0) >= 2):
+                layers[name] = jax.device_put(leaf, sharding)
+                moved.append(name)
+        if moved:
+            params = dict(self.params)
+            params["layers"] = layers
+            self.params = params
+            lane = ("native jax.shard_map lowering" if native_shard_map()
+                    else "jax 0.4.x live-expert-axis emulation")
+            logger.info(
+                f"MoE serving: sharded {moved} over expert axis ({ep}-way, "
+                f"{E // ep} experts/device, {lane}); dispatch/return "
+                f"all-to-all lowered by XLA from sharding constraints")
+
+    def _moe_arm(self):
+        """Arm the per-layer routing-counts tap consumed by the base
+        engine's ``_ffn`` (it appends ``(expert_counts [E], dropped)`` per
+        MoE FFN call, up to one per lane). Called at the top of every
+        layer-scan body — the tracers stay inside the scan trace and are
+        folded into the scan's ys by :meth:`_moe_ys`."""
+        if not self._moe_serving:
+            return None
+        tap = []
+        self._moe_tap = tap
+        return tap
+
+    def _moe_ys(self, tap):
+        """Close the tap and fold its entries (one per lane that ran this
+        layer) into scan-ys elements ``(counts [E] i32, dropped [] f32)``.
+        Returns ``()`` when MoE serving is off, so dense programs keep a
+        byte-identical pytree structure."""
+        if tap is None:
+            return ()
+        import jax.numpy as jnp
+
+        self._moe_tap = None
+        assert tap, "MoE serving armed a layer tap but no FFN appended " \
+            "routing counts — the layer body bypassed engine._ffn"
+        counts = sum(c.astype(jnp.int32) for c, _ in tap)
+        dropped = sum(jnp.asarray(d, jnp.float32) for _, d in tap)
+        return ((counts, dropped),)
+
+    def _pop_moe(self, out):
+        """Strip the trailing MoE routing-counts element off a dispatch
+        result and fold it into the per-tick accounting; identity when
+        MoE serving is off."""
+        if not self._moe_serving:
+            return out
+        self._note_moe_counts(out[-1])
+        return out[:-1]
+
+    def _note_moe_counts(self, moe) -> None:
+        """Host-side accounting from one dispatch's routing counts.
+        ``moe = (counts [..., L, E], dropped [..., L])`` (a leading steps
+        axis when the fused decode loop produced them). Updates the moe/*
+        counters and the previous-tick load snapshot ``moe_pressure``
+        reads — counts are post-drop for the capacity impl and pre-drop
+        (dropped == 0) for the dropless ragged impl, so
+        ``counts.sum() + dropped`` recovers S*k either way."""
+        E = self._mcfg.n_experts
+        counts = np.asarray(moe[0]).reshape(-1, E)
+        dropped = np.asarray(moe[1], np.float64).reshape(-1)
+        self.moe_dispatched += int(counts.sum())
+        self.moe_dropped += int(round(float(dropped.sum())))
+        self.moe_expert_load_max = max(self.moe_expert_load_max,
+                                       int(counts.max()))
+        self._moe_last_counts = counts.max(axis=0)
+        self._moe_last_total = int(round(float(counts[-1].sum()
+                                               + dropped[-1])))
+
+    def moe_pressure(self) -> float:
+        """Peak per-expert load from the previous tick's routing as a
+        fraction of that tick's expert capacity — the scheduler's
+        expert-overload signal (1/capacity_factor under balanced routing;
+        > 1.0 means some expert saturated its buffer). 0.0 before the
+        first MoE tick or on dense models."""
+        if not self._moe_serving or self._moe_last_counts is None:
+            return 0.0
+        from ..moe.gating import compute_capacity
+
+        k = max(1, self._mcfg.moe_top_k)
+        S = max(1, self._moe_last_total // k)
+        cap = compute_capacity(S, self._mcfg.n_experts, k,
+                               self._moe_cf_override)
+        return float(self._moe_last_counts.max()) / float(max(1, cap))
 
     def _paged_prefill_fn(self, p: int, tpad: int):
         fn = self._prefill_cache.get((p, tpad))
@@ -450,15 +615,18 @@ class InferenceEngineV2(InferenceEngine):
                                        impl=self.config.attention_impl,
                                        alibi_slopes=self._alibi), (ck2, cv2)
 
-            return self._layer_body(lw, h, cos, sin, positions, attn_fn,
-                                    lora=lora)
+            tap = self._moe_arm()
+            h2, (ck2, cv2) = self._layer_body(lw, h, cos, sin, positions,
+                                              attn_fn, lora=lora)
+            return h2, (ck2, cv2) + self._moe_ys(tap)
 
-        x, (kp, vp) = jax.lax.scan(layer_fn, x,
-                                   (params["layers"],) + self._kv_xs(cache)
-                                   + self._apool_xs(apool))
+        x, ys = jax.lax.scan(layer_fn, x,
+                             (params["layers"],) + self._kv_xs(cache)
+                             + self._apool_xs(apool))
+        kp, vp = ys[0], ys[1]
         x_last = jnp.take_along_axis(x, (plen - 1)[:, None, None].astype(jnp.int32), axis=1)
         logits = self.model.head(params, x_last)[:, 0]
-        return self._cache_of(kp, vp), logits
+        return (self._cache_of(kp, vp), logits) + tuple(ys[2:])
 
     def _extend_fn(self, c: int):
         fn = self._extend_cache.get(c)
@@ -539,15 +707,19 @@ class InferenceEngineV2(InferenceEngine):
         def layer_fn(h, layer_and_cache):
             lw, ck, cv = layer_and_cache[:3]
             lora = None if apool is None else (layer_and_cache[3], aslots)
-            return self._extend_layer(lw, h, ck, cv, cos, sin, positions,
-                                      start, nnew, btables, lora=lora)
+            tap = self._moe_arm()
+            h2, (ck2, cv2) = self._extend_layer(lw, h, ck, cv, cos, sin,
+                                                positions, start, nnew,
+                                                btables, lora=lora)
+            return h2, (ck2, cv2) + self._moe_ys(tap)
 
-        x, (kp, vp) = jax.lax.scan(layer_fn, x,
-                                   (params["layers"],) + self._kv_xs(cache)
-                                   + self._apool_xs(apool))
+        x, ys = jax.lax.scan(layer_fn, x,
+                             (params["layers"],) + self._kv_xs(cache)
+                             + self._apool_xs(apool))
+        kp, vp = ys[0], ys[1]
         x_last = jnp.take_along_axis(x, (nnew - 1)[:, None, None].astype(jnp.int32), axis=1)
         logits = self.model.head(params, x_last)[:, 0]
-        return self._cache_of(kp, vp), logits
+        return (self._cache_of(kp, vp), logits) + tuple(ys[2:])
 
     def _paged_decode_fn(self, b: int):
         fn = self._decode_cache.get(b)
@@ -588,14 +760,17 @@ class InferenceEngineV2(InferenceEngine):
         def layer_fn(h, layer_and_cache):
             lw, ck, cv = layer_and_cache[:3]
             lora = None if apool is None else (layer_and_cache[3], aslots)
-            return self._decode_layer(lw, h, ck, cv, cos, sin, pos, btables,
-                                      lora=lora)
+            tap = self._moe_arm()
+            h2, (ck2, cv2) = self._decode_layer(lw, h, ck, cv, cos, sin,
+                                                pos, btables, lora=lora)
+            return h2, (ck2, cv2) + self._moe_ys(tap)
 
-        x, (kp, vp) = jax.lax.scan(layer_fn, x,
-                                   (params["layers"],) + self._kv_xs(cache)
-                                   + self._apool_xs(apool))
+        x, ys = jax.lax.scan(layer_fn, x,
+                             (params["layers"],) + self._kv_xs(cache)
+                             + self._apool_xs(apool))
+        kp, vp = ys[0], ys[1]
         logits = self.model.head(params, x)[:, 0]
-        return self._cache_of(kp, vp), logits
+        return (self._cache_of(kp, vp), logits) + tuple(ys[2:])
 
     def _decode_layer(self, lw, h, ck, cv, cos, sin, pos, btables, lora=None):
         """One decode layer (one token per sequence): fused Pallas path
@@ -1237,8 +1412,9 @@ class InferenceEngineV2(InferenceEngine):
         if prefills:
             P, tpad, ids, plen, btables = self._pack_prefill(prefills)
             fn = self._paged_prefill_fn(P, tpad)
-            self.cache, logits = fn(self.params, self.cache, ids, plen, btables,
-                                    *self._aargs([d for d, _ in prefills], P))
+            self.cache, logits = self._pop_moe(
+                fn(self.params, self.cache, ids, plen, btables,
+                   *self._aargs([d for d, _ in prefills], P)))
             self.dispatch_count += 1
             self._program_keys.add(("prefill", P, tpad))
             logits = np.asarray(logits)
@@ -1257,8 +1433,9 @@ class InferenceEngineV2(InferenceEngine):
             B, W, tok, pos, tables = self._pack_decode(
                 [d for d, _ in singles], [t for _, t in singles])
             fn = self._paged_decode_fn(B)
-            self.cache, logits = fn(self.params, self.cache, tok, pos, tables,
-                                    *self._aargs([d for d, _ in singles], B))
+            self.cache, logits = self._pop_moe(
+                fn(self.params, self.cache, tok, pos, tables,
+                   *self._aargs([d for d, _ in singles], B)))
             self.dispatch_count += 1
             self._program_keys.add(("decode", B, W))
             logits = np.asarray(logits)
@@ -1284,9 +1461,9 @@ class InferenceEngineV2(InferenceEngine):
                 self._ensure_blocks(d, d.seen_tokens + len(chunk))
             B, C, W, ids, start, nnew, tables = self._pack_chunks(batch)
             fn = self._extend_fn((B, C))
-            self.cache, logits = fn(self.params, self.cache, ids, start, nnew,
-                                    tables,
-                                    *self._aargs([d for d, _ in batch], B))
+            self.cache, logits = self._pop_moe(
+                fn(self.params, self.cache, ids, start, nnew, tables,
+                   *self._aargs([d for d, _ in batch], B)))
             self.dispatch_count += 1
             self._program_keys.add(("extend", B, C, W))
             logits = np.asarray(logits)
@@ -1336,22 +1513,24 @@ class InferenceEngineV2(InferenceEngine):
             hd, hp = carry
             lw, ck, cv = layer_and_cache[:3]
             ap = None if apool is None else layer_and_cache[3]
+            tap = self._moe_arm()
             hd2, (ck2, cv2) = self._decode_layer(
                 lw, hd, ck, cv, cos, sin, dpos, dtables,
                 lora=None if ap is None else (ap, daslots))
             hp2, (ck3, cv3) = self._extend_layer(
                 lw, hp, ck2, cv2, cos, sin, ppos, pstart, pnnew, ptables,
                 lora=None if ap is None else (ap, paslots))
-            return (hd2, hp2), (ck3, cv3)
+            return (hd2, hp2), (ck3, cv3) + self._moe_ys(tap)
 
-        (xd, xp), (kp, vp) = jax.lax.scan(layer_fn, (xd, xp),
-                                          (params["layers"],) + self._kv_xs(cache)
-                                          + self._apool_xs(apool))
+        (xd, xp), ys = jax.lax.scan(layer_fn, (xd, xp),
+                                    (params["layers"],) + self._kv_xs(cache)
+                                    + self._apool_xs(apool))
+        kp, vp = ys[0], ys[1]
         dlogits = self.model.head(params, xd)[:, 0]
         x_last = jnp.take_along_axis(xp, (pnnew - 1)[:, None, None].astype(jnp.int32),
                                      axis=1)
         plogits = self.model.head(params, x_last)[:, 0]
-        return self._cache_of(kp, vp), dlogits, plogits
+        return (self._cache_of(kp, vp), dlogits, plogits) + tuple(ys[2:])
 
     # -- speculative mixed step (ISSUE 8) ------------------------------
 
@@ -1419,6 +1598,7 @@ class InferenceEngineV2(InferenceEngine):
             hd, hp, hs = carry
             lw, ck, cv = layer_and_cache[:3]
             ap = None if apool is None else layer_and_cache[3]
+            tap = self._moe_arm()
             if hd is not None:
                 hd, (ck, cv) = self._decode_layer(
                     lw, hd, ck, cv, cos, sin, dpos, dtables,
@@ -1436,11 +1616,12 @@ class InferenceEngineV2(InferenceEngine):
                 hs, (ck, cv) = self._extend_layer(
                     lw, hs, ck, cv, cos, sin, spos, sstart, snnew, stables,
                     lora=None if ap is None else (ap, sslots))
-            return (hd, hp, hs), (ck, cv)
+            return (hd, hp, hs), (ck, cv) + self._moe_ys(tap)
 
-        (xd, xp, xs), (kp, vp) = jax.lax.scan(
+        (xd, xp, xs), ys = jax.lax.scan(
             layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache)
             + self._apool_xs(apool))
+        kp, vp = ys[0], ys[1]
         dlogits = self.model.head(params, xd)[:, 0] if dops else None
         plogits = None
         if pops:
@@ -1461,7 +1642,7 @@ class InferenceEngineV2(InferenceEngine):
             slast = jnp.take_along_axis(
                 slog, accepted[:, None, None], axis=1)[:, 0]
             sres = (ver, accepted, slast)
-        return self._cache_of(kp, vp), dlogits, plogits, sres
+        return (self._cache_of(kp, vp), dlogits, plogits, sres) + tuple(ys[2:])
 
     @atomic_on_reject
     def _admit_step(self, decode_uids, decode_tokens, prefills, speculative,
@@ -1613,15 +1794,17 @@ class InferenceEngineV2(InferenceEngine):
             if self.adapters is not None:
                 ax = (self.adapters.device_operands(),
                       self._aslots(ddescs, Bd), self._aslots(pdescs, Bp))
-            self.cache, dl, pl = fn(self.params, self.cache, tok, pos,
-                                    dtables, ids, start, nnew, ptables, *ax)
+            self.cache, dl, pl = self._pop_moe(
+                fn(self.params, self.cache, tok, pos,
+                   dtables, ids, start, nnew, ptables, *ax))
             self._program_keys.add(("mixed", Bd, Wd, Bp, C, Wp))
             dlogits, plogits = np.asarray(dl), np.asarray(pl)
         elif ddescs:
             Bd, Wd, tok, pos, dtables = self._pack_decode(ddescs, decode_tokens)
             fn = self._paged_decode_fn(Bd)
-            self.cache, dl = fn(self.params, self.cache, tok, pos, dtables,
-                                *self._aargs(ddescs, Bd))
+            self.cache, dl = self._pop_moe(
+                fn(self.params, self.cache, tok, pos, dtables,
+                   *self._aargs(ddescs, Bd)))
             self._program_keys.add(("decode", Bd, Wd))
             dlogits = np.asarray(dl)
         elif pdescs:
@@ -1630,8 +1813,9 @@ class InferenceEngineV2(InferenceEngine):
             Bp, C, Wp, ids, start, nnew, ptables = self._pack_chunks(
                 chunks, pad_chunk=self.config.serving.bin_chunk(cmax))
             fn = self._extend_fn((Bp, C))
-            self.cache, pl = fn(self.params, self.cache, ids, start, nnew,
-                                ptables, *self._aargs(pdescs, Bp))
+            self.cache, pl = self._pop_moe(
+                fn(self.params, self.cache, ids, start, nnew,
+                   ptables, *self._aargs(pdescs, Bp)))
             self._program_keys.add(("extend", Bp, C, Wp))
             plogits = np.asarray(pl)
         else:
@@ -1688,9 +1872,9 @@ class InferenceEngineV2(InferenceEngine):
 
         key = ("spec", Bd, Wd, Bp, C, Wp, Bs, Cs, Ws)
         fn = self._spec_fn(key)
-        self.cache, dl, pl, sres = fn(
+        self.cache, dl, pl, sres = self._pop_moe(fn(
             self.params, self.cache, dops, pops, sops,
-            *((self.adapters.device_operands(),) if lora else ()))
+            *((self.adapters.device_operands(),) if lora else ())))
         self.dispatch_count += 1
         self._program_keys.add(key)
         dlogits = (np.asarray(dl) if dl is not None
@@ -1877,9 +2061,10 @@ class InferenceEngineV2(InferenceEngine):
         prefill_eos [Bp]) — int32/bool only, never [*, V]."""
         from .sampling import seeded_tokens
 
-        cache, dlogits, plogits = self._mixed_step_impl(
+        out = self._mixed_step_impl(
             params, cache, dtok, dpos, dtables, pids, pstart, pnnew, ptables,
             apool=apool, daslots=daslots, paslots=paslots)
+        cache, dlogits, plogits = out[:3]
         dseeds, dtemp, dtk, dtp, deos = dsp
         pseeds, ptemp, ptk, ptp, peos = psp
         # decode row emits the token at absolute index dpos+1 (dpos is the
@@ -1891,34 +2076,36 @@ class InferenceEngineV2(InferenceEngine):
                               ptp, mask=pmask)
         ddone = (dtoks == deos) & (deos >= 0)
         pdone = (ptoks == peos) & (peos >= 0)
-        return cache, dtoks, ddone, ptoks, pdone
+        return (cache, dtoks, ddone, ptoks, pdone) + out[3:]
 
     def _decode_sampled_impl(self, params, cache: PagedKVCache, dtok, dpos,
                              dtables, dsp, dmask, apool=None, daslots=None):
         from .sampling import seeded_tokens
 
-        cache, dlogits = self._paged_decode_impl(params, cache, dtok, dpos,
-                                                 dtables, apool=apool,
-                                                 aslots=daslots)
+        out = self._paged_decode_impl(params, cache, dtok, dpos,
+                                      dtables, apool=apool,
+                                      aslots=daslots)
+        cache, dlogits = out[:2]
         dseeds, dtemp, dtk, dtp, deos = dsp
         dtoks = seeded_tokens(dlogits, dseeds, dpos + 1, dtemp, dtk, dtp,
                               mask=dmask)
         ddone = (dtoks == deos) & (deos >= 0)
-        return cache, dtoks, ddone
+        return (cache, dtoks, ddone) + out[2:]
 
     def _extend_sampled_impl(self, params, cache: PagedKVCache, pids, pstart,
                              pnnew, ptables, psp, pmask, apool=None,
                              paslots=None):
         from .sampling import seeded_tokens
 
-        cache, plogits = self._extend_impl(params, cache, pids, pstart,
-                                           pnnew, ptables, apool=apool,
-                                           aslots=paslots)
+        out = self._extend_impl(params, cache, pids, pstart,
+                                pnnew, ptables, apool=apool,
+                                aslots=paslots)
+        cache, plogits = out[:2]
         pseeds, ptemp, ptk, ptp, peos = psp
         ptoks = seeded_tokens(plogits, pseeds, pstart + pnnew, ptemp, ptk,
                               ptp, mask=pmask)
         pdone = (ptoks == peos) & (peos >= 0)
-        return cache, ptoks, pdone
+        return (cache, ptoks, pdone) + out[2:]
 
     def _spec_sampled_impl(self, params, cache: PagedKVCache, dops, pops,
                            sops, dsp, psp, ssp, dmask, pmask, apool=None):
@@ -1968,6 +2155,7 @@ class InferenceEngineV2(InferenceEngine):
             hd, hp, hs = carry
             lw, ck, cv = layer_and_cache[:3]
             ap = None if apool is None else layer_and_cache[3]
+            tap = self._moe_arm()
             if hd is not None:
                 hd, (ck, cv) = self._decode_layer(
                     lw, hd, ck, cv, cos, sin, dpos, dtables,
@@ -1979,11 +2167,12 @@ class InferenceEngineV2(InferenceEngine):
             hs, (ck, cv) = self._extend_layer(
                 lw, hs, ck, cv, cos, sin, spos, sstart, snnew, stables,
                 lora=None if ap is None else (ap, sslots))
-            return (hd, hp, hs), (ck, cv)
+            return (hd, hp, hs), (ck, cv) + self._moe_ys(tap)
 
-        (xd, xp, xs), (kp, vp) = jax.lax.scan(
+        (xd, xp, xs), ys = jax.lax.scan(
             layer_fn, (xd, xp, xs), (params["layers"],) + self._kv_xs(cache)
             + self._apool_xs(apool))
+        kp, vp = ys[0], ys[1]
         dres = pres = None
         if dops:
             dlogits = self.model.head(params, xd)[:, 0]
@@ -2012,7 +2201,8 @@ class InferenceEngineV2(InferenceEngine):
         m = jnp.where(j < (snnew - 1)[:, None], chain == nxt, False)
         accepted = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1),
                            axis=1)                   # [Bs] in [0, k]
-        return self._cache_of(kp, vp), dres, pres, (chain, accepted)
+        return (self._cache_of(kp, vp), dres, pres,
+                (chain, accepted)) + tuple(ys[2:])
 
     @atomic_on_reject
     def step_sampled(self, decode_uids: Sequence[int],
@@ -2078,9 +2268,9 @@ class InferenceEngineV2(InferenceEngine):
             if self.adapters is not None:
                 ax = (self.adapters.device_operands(),
                       self._aslots(ddescs, Bd), self._aslots(pdescs, Bp))
-            self.cache, dt, dd, pt, pd = fn(
+            self.cache, dt, dd, pt, pd = self._pop_moe(fn(
                 self.params, self.cache, tok, pos, dtables, dsp, dmask,
-                ids, start, nnew, ptables, psp, pmask, *ax)
+                ids, start, nnew, ptables, psp, pmask, *ax))
             self._assert_on_device_sampling(key, (dt, dd, pt, pd))
             self._program_keys.add(key)
             dtoks, ddone = np.asarray(dt), np.asarray(dd)
@@ -2092,9 +2282,9 @@ class InferenceEngineV2(InferenceEngine):
             dmask = self._lane_masks(ddescs, [[t] for t in decode_tokens], Bd)
             key = (("decode_m" if dmask is not None else "decode"), Bd, Wd)
             fn = self._sampled_fn(("s",) + key, self._decode_sampled_impl)
-            self.cache, dt, dd = fn(self.params, self.cache, tok, pos,
-                                    dtables, dsp, dmask,
-                                    *self._aargs(ddescs, Bd))
+            self.cache, dt, dd = self._pop_moe(
+                fn(self.params, self.cache, tok, pos, dtables, dsp, dmask,
+                   *self._aargs(ddescs, Bd)))
             self._assert_on_device_sampling(key, (dt, dd))
             self._program_keys.add(key)
             dtoks, ddone = np.asarray(dt), np.asarray(dd)
@@ -2107,9 +2297,10 @@ class InferenceEngineV2(InferenceEngine):
             pmask = self._lane_masks(pdescs, [c for _, c in prefills], Bp)
             key = (("extend_m" if pmask is not None else "extend"), Bp, C, Wp)
             fn = self._sampled_fn(("s",) + key, self._extend_sampled_impl)
-            self.cache, pt, pd = fn(self.params, self.cache, ids, start,
-                                    nnew, ptables, psp, pmask,
-                                    *self._aargs(pdescs, Bp))
+            self.cache, pt, pd = self._pop_moe(
+                fn(self.params, self.cache, ids, start,
+                   nnew, ptables, psp, pmask,
+                   *self._aargs(pdescs, Bp)))
             self._assert_on_device_sampling(key, (pt, pd))
             self._program_keys.add(key)
             ptoks, pdone = np.asarray(pt), np.asarray(pd)
@@ -2172,10 +2363,10 @@ class InferenceEngineV2(InferenceEngine):
         key = (("spec_m" if masked else "spec"),
                Bd, Wd, Bp, C, Wp, Bs, Cs, Ws)
         fn = self._sampled_fn(("s",) + key, self._spec_sampled_impl)
-        self.cache, dres, pres, sres = fn(
+        self.cache, dres, pres, sres = self._pop_moe(fn(
             self.params, self.cache, dops, pops, sops, dsp, psp, ssp,
             dmask, pmask,
-            *((self.adapters.device_operands(),) if lora else ()))
+            *((self.adapters.device_operands(),) if lora else ())))
         self.dispatch_count += 1
         self._assert_on_device_sampling(key, (dres, pres, sres))
         self._program_keys.add(key)
@@ -2229,17 +2420,20 @@ class InferenceEngineV2(InferenceEngine):
 
             def step(carry, _):
                 cache, tok, pos, _ = carry
-                cache, logits = self._paged_decode_impl(params, cache, tok,
-                                                        pos, btables,
-                                                        apool=apool,
-                                                        aslots=aslots)
+                out = self._paged_decode_impl(params, cache, tok,
+                                              pos, btables,
+                                              apool=apool,
+                                              aslots=aslots)
+                cache, logits = out[:2]
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (cache, nxt, pos + 1, logits), nxt
+                return (cache, nxt, pos + 1, logits), (nxt,) + out[2:]
 
             logits0 = jnp.zeros((B, self._mcfg.vocab_size), jnp.float32)
-            (cache, _, _, logits), toks = jax.lax.scan(
+            (cache, _, _, logits), ys = jax.lax.scan(
                 step, (cache, tok, pos, logits0), None, length=n_steps)
-            return cache, toks.T, logits       # toks [B, n_steps]
+            # ys[0] [n_steps, B] tokens; a trailing MoE-counts element
+            # (stacked [n_steps, L, E]) rides when MoE serving is on
+            return (cache, ys[0].T, logits) + tuple(ys[1:])
 
         fn = jax.jit(impl, donate_argnums=_donate_cache())
         self._loop_cache[key] = fn
@@ -2285,9 +2479,9 @@ class InferenceEngineV2(InferenceEngine):
         pos = np.asarray([d.seen_tokens for d in descs], np.int32)
         tok0 = np.asarray(tokens, np.int32)
         fn = self._decode_loop_fn((len(uids), int(n_steps)))
-        self.cache, toks, last_logits = fn(self.params, self.cache, tok0,
-                                           pos, btables,
-                                           *self._aargs(descs, len(uids)))
+        self.cache, toks, last_logits = self._pop_moe(
+            fn(self.params, self.cache, tok0, pos, btables,
+               *self._aargs(descs, len(uids))))
         self.dispatch_count += 1
         self._program_keys.add(("decode_loop", len(uids), int(n_steps), W))
         last_logits = np.asarray(last_logits)

@@ -145,6 +145,12 @@ class ServingRequest:
     # re-prefill compute.
     adapter_id: Optional[str] = None
     adapter_waiting: bool = False
+    # expert-parallel MoE serving (ISSUE 19): a queued request parked on
+    # expert-capacity pressure — the previous tick's routing saturated
+    # some expert's buffer, so NEW sequences hold at their FIFO seat
+    # until running ticks drain the pressure. Park, never preempt:
+    # expert overload costs queue time, never a running sequence's KV.
+    moe_waiting: bool = False
 
     @property
     def prefill_target(self) -> List[int]:
@@ -254,6 +260,11 @@ class ContinuousBatchingScheduler:
         self.adapter_parks = 0
         self.adapter_unparks = 0
         self.adapter_tokens: Dict[str, int] = {}
+        # expert-parallel MoE serving (ISSUE 19): expert-capacity park
+        # counters for the moe/* monitor group (the engine owns the
+        # routing-count tallies; the scheduler owns the admission parks)
+        self.moe_capacity_parks = 0
+        self.moe_unparks = 0
 
     # -- request intake ------------------------------------------------
 
@@ -693,6 +704,25 @@ class ContinuousBatchingScheduler:
                         r.adapter_waiting = True
                         self.adapter_parks += 1
                     continue
+            if from_queue and getattr(eng, "_moe_serving", False) and \
+                    self.cfg.moe.overload_policy == "park" and \
+                    (self.active or admitted) and \
+                    eng.moe_pressure() > self.cfg.moe.overload_threshold:
+                # expert capacity is the next admission resource after KV
+                # blocks, tier residency, and adapter slots (ISSUE 19):
+                # the previous tick's routing counts say some expert ran
+                # past its buffer, so hold NEW sequences at their FIFO
+                # seat — running ticks keep decoding (their routing is
+                # what drains the pressure) and no sequence is ever
+                # preempted for expert load. The ``active or admitted``
+                # guard keeps a stale reading with nothing running from
+                # parking the whole queue forever. Policy "drop" admits
+                # anyway and lets the capacity impl drop overload tokens
+                # on device (counted in moe/dropped).
+                if not r.moe_waiting:
+                    r.moe_waiting = True
+                    self.moe_capacity_parks += 1
+                continue
             if from_queue and self.parked and \
                     self.parked[0].submitted_at <= r.submitted_at:
                 # tiered KV (ISSUE 15): freed blocks must fund the oldest
@@ -738,6 +768,9 @@ class ContinuousBatchingScheduler:
                 if r.adapter_waiting:
                     r.adapter_waiting = False
                     self.adapter_unparks += 1
+                if r.moe_waiting:
+                    r.moe_waiting = False
+                    self.moe_unparks += 1
         for r, hit in admitted:
             self.queue.remove(r)
             self.active.append(r)
@@ -805,7 +838,7 @@ class ContinuousBatchingScheduler:
                     f"{eng.allocator.num_blocks} are free and nothing is "
                     f"running to release more; raise num_kv_blocks")
             if any(r.not_before > now0 or r.adapter_waiting
-                   for r in self.queue):
+                   or r.moe_waiting for r in self.queue):
                 # everything eligible is in its failover backoff window
                 # or parked on adapter-pool residency — work remains, it
                 # just may not pack yet (running/parked sequences release
@@ -1014,6 +1047,18 @@ class ContinuousBatchingScheduler:
                 self.apool.prefetch(aid)
                 seen.add(aid)
                 staged += 1
+        if getattr(eng, "_moe_serving", False):
+            # expert-parallel MoE group (ISSUE 19): routing traffic from
+            # the engine's per-tick counts (dispatched assignments, drops
+            # at expert capacity, peak per-(layer, expert) load) plus the
+            # scheduler's capacity parks — like adapter parks, a park is
+            # a FIFO-seat yield under expert pressure, never a preemption
+            events += [
+                ("moe/dispatched", eng.moe_dispatched, self.ticks),
+                ("moe/dropped", eng.moe_dropped, self.ticks),
+                ("moe/capacity_parks", self.moe_capacity_parks, self.ticks),
+                ("moe/expert_load_max", eng.moe_expert_load_max, self.ticks),
+            ]
         # block state settled for this tick — refresh the placement-
         # pressure cache HERE, on the tick thread, where the _seqs walk
         # is safe (see __init__); load() only ever reads the int
@@ -1063,6 +1108,7 @@ class ContinuousBatchingScheduler:
             # residency parks are THIS pool's state — a re-placed request
             # re-evaluates against the destination replica's pool
             r.adapter_waiting = False
+            r.moe_waiting = False
             self.requests.pop(r.uid, None)
         self._write_events([
             ("serving/drained_requests", len(exported), self.ticks),
@@ -1111,6 +1157,7 @@ class ContinuousBatchingScheduler:
         r.state = QUEUED
         r.prefill_done = 0
         r.adapter_waiting = False
+        r.moe_waiting = False
         if r.sampling is not None:
             # the seed rides the request (ISSUE 16): its re-prefill replay
             # resumes the SAME seeded chain at the same absolute positions
@@ -1181,6 +1228,7 @@ class ContinuousBatchingScheduler:
         r.state = RUNNING
         r.prefill_done = len(r.prompt) + len(r.generated)
         r.adapter_waiting = False
+        r.moe_waiting = False
         if r.sampling is not None:
             self.sampling_seen = True
             self.engine.configure_sampling(r.uid, r.sampling)
@@ -1418,5 +1466,18 @@ class ContinuousBatchingScheduler:
                 "unparks": self.adapter_unparks,
                 "waiting": sum(1 for r in self.queue if r.adapter_waiting),
                 "tokens_by_adapter": dict(self.adapter_tokens),
+            }),
+            # expert-parallel MoE serving (ISSUE 19): None on dense models;
+            # with experts live, the routed-token traffic plus the
+            # scheduler's capacity parks (FIFO-seat holds under expert
+            # overload — never preemptions) and last tick's pressure
+            "moe": (None if not getattr(eng, "_moe_serving", False) else {
+                "dispatched": eng.moe_dispatched,
+                "dropped": eng.moe_dropped,
+                "expert_load_max": eng.moe_expert_load_max,
+                "pressure": eng.moe_pressure(),
+                "capacity_parks": self.moe_capacity_parks,
+                "unparks": self.moe_unparks,
+                "waiting": sum(1 for r in self.queue if r.moe_waiting),
             }),
         }

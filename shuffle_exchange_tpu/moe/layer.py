@@ -86,6 +86,34 @@ def expert_mlp(params, x, activation: str = "swiglu"):
     return b("b_down", jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype)))
 
 
+def _gather_expert_sharded(params, expert_axis: str = "expert"):
+    """GSPMD on jax 0.4.x mis-partitions ``lax.ragged_dot`` when the RHS
+    is sharded over the group (expert) dim — wrong numerics, not just a
+    slow program (observed on the 8-device CPU mesh: max err ~2.4 vs the
+    replicated reference). Under a live expert axis, pin the stacked
+    expert leaves to replicated inside the trace so XLA inserts an
+    explicit all-gather before the grouped matmuls: weights stay
+    expert-sharded at rest, the ragged math runs on the gathered copy."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from ..parallel.mesh import (constraint_mesh, get_topology,
+                                     topology_is_initialized)
+
+        if not topology_is_initialized():
+            return params
+        mesh = get_topology().mesh
+        if mesh.shape.get(expert_axis, 1) == 1:
+            return params
+        rep = NamedSharding(constraint_mesh(mesh), P())
+        return {k: jax.lax.with_sharding_constraint(v, rep)
+                for k, v in params.items()}
+    except Exception:
+        return params
+
+
 def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
     """Dropless grouped-GEMM experts (reference cutlass moe_gemm /
     megablocks, SURVEY §2.13): tokens sort by expert and one grouped matmul
@@ -98,6 +126,7 @@ def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
     import jax
     import jax.numpy as jnp
 
+    params = _gather_expert_sharded(params)
     S, M = xs.shape
     k = topk_idx.shape[1]
     E = params["w_up"].shape[0]

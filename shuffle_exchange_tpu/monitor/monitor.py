@@ -346,6 +346,27 @@ class FleetMonitor(Monitor):
                 adp[key] = total
         if adp:
             out["adapter"] = adp
+        # expert-parallel MoE serving (ISSUE 19): routed-token traffic is
+        # cumulative per replica (sum of latest values), but
+        # expert_load_max is a peak — the fleet figure is the MAX over
+        # replicas, never a sum. Dense fleets emit no moe/* events and
+        # publish no moe aggregate.
+        moe = {}
+        for key, fold in (("dispatched", "sum"), ("dropped", "sum"),
+                          ("capacity_parks", "sum"),
+                          ("expert_load_max", "max")):
+            acc, seen = 0, False
+            for r in sorted(self._replica_ids):
+                label = f"replica{r}/moe/{key}"
+                vals = [v for lbl, v, _ in events if lbl == label]
+                if vals:
+                    acc = acc + vals[-1] if fold == "sum" \
+                        else max(acc, vals[-1])
+                    seen = True
+            if seen:
+                moe[key] = acc
+        if moe:
+            out["moe"] = moe
         # fleet fault tolerance (ISSUE 12): the router writes the
         # fleet/health/*, failover/* and shed/* counter groups straight
         # into the ring (they are fleet-level, not per-replica); the
@@ -385,6 +406,9 @@ class FleetMonitor(Monitor):
                    if isinstance(v, (int, float))]
         events += [(f"fleet/adapter/{k}", v, self._step)
                    for k, v in (agg.get("adapter") or {}).items()
+                   if isinstance(v, (int, float))]
+        events += [(f"fleet/moe/{k}", v, self._step)
+                   for k, v in (agg.get("moe") or {}).items()
                    if isinstance(v, (int, float))]
         # fault-tolerance groups (ISSUE 12) ride downstream under fleet/*
         # namespacing (health labels are already fleet/health/<k> in the

@@ -22,6 +22,66 @@ def pallas_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def interpret_forced() -> bool:
+    """The ``SXT_FUSED_INTERPRET=1`` test hook: run Pallas kernels through
+    the interpreter so the CPU suite drives the kernel path end to end.
+    Shared by the fused-decode kernels and the grouped-GEMM seam — one
+    contract, one env var (``ops/fused_decode.py::_interpret_forced``
+    aliases this)."""
+    return bool(os.environ.get("SXT_FUSED_INTERPRET"))
+
+
+#: grouped-GEMM call sites sharing the eligibility/dispatch seam
+#: (ISSUE 19 satellite): the MoE megablox ``gmm`` route and the LoRA
+#: per-row pool-gather kernel
+_GROUPED_GEMM_KINDS = ("moe", "lora")
+
+
+def resolve_grouped_gemm(kind: str, *, shapes_ok: bool,
+                         interpret_capable: bool = False) -> str:
+    """Resolve a grouped-GEMM call site to "pallas", "interpret", or
+    "fallback" — the single seam ``ops/grouped_gemm.grouped_matmul``
+    (megablox ``gmm`` vs ``lax.ragged_dot``) and ``ops/lora_gemm
+    .lora_delta`` (pool-gather kernel vs XLA gather oracle) both resolve
+    through, on the same ``SXT_FUSED_INTERPRET``/:func:`pallas_enabled`
+    contract as :func:`resolve_decode_kernel`.
+
+    ``shapes_ok`` is the caller's static lane/sublane eligibility
+    (``_gmm_ok`` / ``lora_pallas_ok`` — TPU tiling wants lane-aligned
+    128 contractions and 8-row sublanes). ``interpret_capable`` says the
+    caller's kernel accepts ``interpret=True`` (the LoRA kernel does;
+    megablox ``gmm`` offers no interpret hook, so the MoE site falls
+    back to ``ragged_dot`` — which IS its numerics oracle — off-TPU).
+    """
+    if kind not in _GROUPED_GEMM_KINDS:
+        raise ValueError(f"grouped-GEMM kind must be one of "
+                         f"{_GROUPED_GEMM_KINDS}, got {kind!r}")
+    from ..utils.logging import warning_once
+
+    if not shapes_ok:
+        if pallas_enabled() or interpret_forced():
+            # sxt: ignore[SXT005] kind is one of two literals — dedup cardinality 2
+            warning_once(
+                f"grouped_gemm[{kind}]: shapes not lane/sublane aligned "
+                f"for the Pallas kernel; using the XLA fallback "
+                f"(ragged_dot / gather oracle)")
+        return "fallback"
+    if interpret_forced() and interpret_capable:
+        return "interpret"
+    if pallas_enabled():
+        return "pallas"
+    if os.environ.get("SXT_DISABLE_PALLAS"):
+        # the explicit kill-switch is the one fallback worth a note — a
+        # CPU host falling back is the expected contract (ragged_dot /
+        # the gather oracle IS the numerics reference there), same
+        # silence as resolve_decode_kernel's "auto" off-TPU
+        # sxt: ignore[SXT005] kind is one of two literals — dedup cardinality 2
+        warning_once(
+            f"grouped_gemm[{kind}]: SXT_DISABLE_PALLAS is set; using the "
+            f"XLA fallback (ragged_dot / gather oracle)")
+    return "fallback"
+
+
 def resolve_decode_kernel(mode: str, speculative_k: int = 0) -> str:
     """Resolve the serving ``decode_kernel`` knob to "pallas" or "xla".
 

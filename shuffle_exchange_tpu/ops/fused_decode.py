@@ -779,10 +779,12 @@ def fused_mlp_quant_pallas(resid, y_src, ln_w, ln_b, w_up, w_down,
 def _interpret_forced() -> bool:
     """Test hook: SXT_FUSED_INTERPRET=1 runs the fused kernels through the
     Pallas interpreter, letting the CPU suite drive the ENGINE-level fused
-    path (decode_kernel="pallas") end to end."""
-    import os
+    path (decode_kernel="pallas") end to end. Alias of
+    ``ops/dispatch.interpret_forced`` — one contract, one env var, shared
+    with the grouped-GEMM seam (``resolve_grouped_gemm``)."""
+    from .dispatch import interpret_forced
 
-    return bool(os.environ.get("SXT_FUSED_INTERPRET"))
+    return interpret_forced()
 
 
 def fused_qkv_rope(y, wq, wk, wv, **kw):

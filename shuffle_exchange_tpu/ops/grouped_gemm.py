@@ -29,10 +29,16 @@ def _gmm_ok(x, w) -> bool:
 
 def grouped_matmul(x, w, group_sizes):
     """x [N, K] (rows sorted by group), w [E, K, F], group_sizes [E] int32
-    -> [N, F] in x.dtype with fp32 accumulation semantics on TPU."""
-    from .dispatch import pallas_enabled
+    -> [N, F] in x.dtype with fp32 accumulation semantics on TPU.
 
-    if pallas_enabled() and _gmm_ok(x, w):
+    Eligibility/dispatch resolves through
+    :func:`ops.dispatch.resolve_grouped_gemm` — the seam shared with
+    ``ops/lora_gemm.lora_delta``. megablox ``gmm`` has no interpret hook,
+    so ``interpret_capable`` stays False and every non-TPU resolution is
+    "fallback" (``lax.ragged_dot``, which is also the numerics oracle)."""
+    from .dispatch import resolve_grouped_gemm
+
+    if resolve_grouped_gemm("moe", shapes_ok=_gmm_ok(x, w)) == "pallas":
         return _grouped_matmul_gmm(x, w, group_sizes)
     import jax
 

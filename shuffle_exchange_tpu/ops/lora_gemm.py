@@ -36,7 +36,7 @@ TPU variant is lowering-gated in tests/test_mosaic_lowering.py.
 
 from __future__ import annotations
 
-from .fused_decode import _compiler_params, _interpret_forced
+from .fused_decode import _compiler_params
 
 
 def lora_delta_oracle(x, a_stack, b_stack, slots):
@@ -114,12 +114,15 @@ def lora_pallas_ok(x, a_stack, b_stack) -> bool:
 def lora_delta(x, a_stack, b_stack, slots):
     """The dispatch seam the engine layer body calls: Pallas when the TPU
     backend is live (or ``SXT_FUSED_INTERPRET=1`` forces interpret mode)
-    and the shapes lower, XLA gather oracle otherwise."""
-    from .dispatch import pallas_enabled
+    and the shapes lower, XLA gather oracle otherwise. Resolution goes
+    through :func:`ops.dispatch.resolve_grouped_gemm` — the eligibility
+    seam shared with ``ops/grouped_gemm.grouped_matmul``."""
+    from .dispatch import resolve_grouped_gemm
 
-    interpret = _interpret_forced()
-    if (interpret or pallas_enabled()) and lora_pallas_ok(x, a_stack,
-                                                          b_stack):
-        return lora_delta_pallas(x, a_stack, b_stack, slots,
-                                 interpret=interpret)
-    return lora_delta_oracle(x, a_stack, b_stack, slots)
+    mode = resolve_grouped_gemm(
+        "lora", shapes_ok=lora_pallas_ok(x, a_stack, b_stack),
+        interpret_capable=True)
+    if mode == "fallback":
+        return lora_delta_oracle(x, a_stack, b_stack, slots)
+    return lora_delta_pallas(x, a_stack, b_stack, slots,
+                             interpret=mode == "interpret")

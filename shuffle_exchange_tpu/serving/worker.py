@@ -222,8 +222,17 @@ def build_engine_from_spec(spec: dict):
 
     from ..inference import InferenceConfig, InferenceEngineV2
     from ..models import Transformer, tiny
+    from ..models.transformer import tiny_moe
 
-    cfg = tiny(**spec.get("model", {}))
+    # "model_kind" picks the tiny factory — "tiny_moe" puts an
+    # expert-routed FFN on the wire (ISSUE 19) with the same seeded-init
+    # determinism, so process-fleet MoE parity stays checkable
+    kind = spec.get("model_kind", "tiny")
+    factories = {"tiny": tiny, "tiny_moe": tiny_moe}
+    if kind not in factories:
+        raise ValueError(f"unknown model_kind {kind!r}; "
+                         f"expected one of {sorted(factories)}")
+    cfg = factories[kind](**spec.get("model", {}))
     model = Transformer(cfg)
     params = model.init(jax.random.PRNGKey(int(spec.get("init_seed", 0))))
     return InferenceEngineV2(model, params,

@@ -218,6 +218,88 @@ class TestSpace:
         assert ServingCandidate.from_config(icfg2).name == cand.name
 
 
+class TestMoEAxes:
+    """Expert-parallel MoE serving knobs (ISSUE 19): the
+    moe_capacity_factor/moe_impl axes against the SpaceContext's
+    expert-pool geometry."""
+
+    def test_axes_enumerate_and_name_dedup(self):
+        sp = ServingSearchSpace(
+            {"moe_capacity_factor": [None, 1.0, 1.5],
+             "moe_impl": ["auto", "ragged"]},
+            _ctx(moe_experts=4, moe_top_k=2))
+        cands = sp.enumerate()
+        assert len(cands) == 6
+        assert len({c.name for c in cands}) == 6
+        assert all(c.status == "pending" for c in cands)
+        # inherit point (None/"auto") carries no moe suffix
+        base = next(c for c in cands if c.moe_capacity_factor is None
+                    and c.moe_impl == "auto")
+        assert "mcf" not in base.name and "moe-" not in base.name
+
+    def test_inert_on_dense_models_prunes(self):
+        sp = ServingSearchSpace(
+            {"moe_impl": ["auto", "ragged"]}, _ctx())   # no moe_experts
+        cands = sp.enumerate()
+        by_impl = {c.moe_impl: c for c in cands}
+        assert by_impl["auto"].status == "pending"      # inherit = baseline
+        assert by_impl["ragged"].status == "pruned_static"
+        assert "inert" in by_impl["ragged"].prune_reason
+
+    def test_invalid_impl_and_cf_rejected(self):
+        sp = ServingSearchSpace({}, _ctx(moe_experts=4))
+        ok, why = sp.check(ServingCandidate(moe_impl="mystery"))
+        assert not ok and "moe_impl" in why
+        ok, why = sp.check(ServingCandidate(moe_capacity_factor=0.0))
+        assert not ok and "must be > 0" in why
+
+    def test_overprovisioned_capacity_prunes(self):
+        """cf * top_k > n_experts means per-expert capacity covers every
+        token — the capacity impl degenerates to dropless at padded cost,
+        so the point is pruned toward moe_impl='ragged' instead."""
+        sp = ServingSearchSpace({}, _ctx(moe_experts=4, moe_top_k=2))
+        ok, why = sp.check(ServingCandidate(moe_capacity_factor=1.9))
+        assert ok, why
+        ok, why = sp.check(ServingCandidate(moe_capacity_factor=2.5))
+        assert not ok and "dropless" in why
+
+    def test_overlay_partial_section_and_roundtrip(self):
+        cand = ServingCandidate(moe_capacity_factor=1.5, moe_impl="ragged")
+        ov = cand.overlay()
+        assert ov["serving"]["moe"] == {"capacity_factor": 1.5,
+                                        "moe_impl": "ragged"}
+        icfg = InferenceConfig(dtype="float32", max_seq_len=64,
+                               kv_block_size=8, num_kv_blocks=40)
+        icfg2 = cand.apply(icfg)
+        assert icfg2.serving.moe.capacity_factor == 1.5
+        assert icfg2.serving.moe.moe_impl == "ragged"
+        # unsearched knobs keep the base's values
+        assert icfg2.serving.moe.overload_policy \
+            == icfg.serving.moe.overload_policy
+        # inherit points emit NO moe section at all
+        assert "moe" not in ServingCandidate().overlay()["serving"]
+
+    def test_from_config_maps_defaults_to_inherit(self):
+        """The serving.moe section always exists with defaults, so the
+        baseline candidate of a dense-model search must read as NOT
+        moe-tuned — otherwise check()'s inert-axis prune would reject
+        the whole search including its own baseline."""
+        icfg = InferenceConfig(dtype="float32", max_seq_len=64,
+                               kv_block_size=8, num_kv_blocks=40)
+        base = ServingCandidate.from_config(icfg)
+        assert base.moe_capacity_factor is None
+        assert base.moe_impl == "auto"
+        ok, why = ServingSearchSpace({}, _ctx()).check(base)
+        assert ok, why
+        # a pinned impl survives the roundtrip
+        icfg_moe = InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40,
+            serving={"moe": {"moe_impl": "ragged", "capacity_factor": 1.5}})
+        c = ServingCandidate.from_config(icfg_moe)
+        assert c.moe_impl == "ragged" and c.moe_capacity_factor == 1.5
+
+
 # ---------------------------------------------------------------------------
 # Overlay / knob introspection (inference/config.py seam)
 # ---------------------------------------------------------------------------

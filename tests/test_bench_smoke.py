@@ -510,6 +510,48 @@ def test_serving_multi_tenant_row_runs_at_toy_size():
     assert row["fresh_adapter_new_programs"] == 0
 
 
+@pytest.mark.slow   # ~60s: dense + MoE twin passes + oracle replays; nightly via ci_full
+def test_serving_moe_row_runs_at_toy_size():
+    """The config-5 expert-parallel MoE row (bench.serving_moe_row) at toy
+    size: the same Poisson trace on the dense baseline vs the MoE twin at
+    matched total params, with batched-vs-sequential token parity and
+    park-don't-preempt asserted inside the row — so the published bench
+    row cannot rot on the CPU driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_moe_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = serving_moe_row(model, params, icfg, mcfg.vocab_size,
+                          n_requests=6, n_experts=4, prompt_lo=4,
+                          prompt_hi=20, max_new=5, load=2.0,
+                          parity_samples=2)
+    assert row["token_mismatches_vs_oracle"] == 0
+    assert row["moe_impl"] == "ragged"
+    dense, moe = row["entries"]["dense"], row["entries"]["moe"]
+    assert dense["sustained_tokens_per_sec"] > 0
+    assert moe["sustained_tokens_per_sec"] > 0
+    assert row["goodput_vs_dense"] > 0
+    # expert pressure parks, never preempts; ragged routing never drops
+    assert dense["preemptions"] == 0 and moe["preemptions"] == 0
+    assert moe["dropped"] == 0
+    assert moe["dispatched"] > 0 and moe["expert_load_max"] >= 1
+    assert moe["n_experts"] == 4 and moe["top_k"] == 2
+    assert 0 < moe["expert_load_balance"] <= 1.0
+
+
 @pytest.mark.slow   # ~90s: per-degree sxt.initialize + train steps; nightly via ci_full
 def test_ring_scaling_row_runs_at_toy_size():
     """The config-2 ring-attention scaling entry (bench.ring_scaling_row)

@@ -1,0 +1,371 @@
+"""Expert-parallel MoE serving (ISSUE 19): the one-dispatch serving tick
+over an expert-routed FFN.
+
+What this file pins:
+
+- **Token parity vs the sequential oracle** — a Poisson-mixed batched run
+  through the continuous-batching scheduler emits EXACTLY the tokens each
+  request gets alone through put() + decode_loop() on a fresh engine, for
+  greedy AND seeded-sampling decoding. The tests pin
+  ``serving.moe.moe_impl="ragged"`` — the dropless sorted-by-expert route
+  through ``ops/grouped_gemm.grouped_matmul`` is batch-composition
+  independent (the capacity impl's drops depend on batch size, so its
+  batched output legitimately differs from sequential).
+- **One dispatch per tick** — a mixed decode+prefill MoE batch is one
+  jitted program (``engine.dispatch_count == scheduler.ticks``); routing
+  is data (an argmax over gate logits inside the program), never a
+  program shape.
+- **Expert capacity parks, never preempts** — under routing pressure the
+  scheduler holds NEW requests at their FIFO seat (``moe_waiting``) and
+  keeps ticking the running set (which drains the pressure);
+  ``preemptions`` stays 0 and the parked requests unpark and complete.
+- **Zero recompile** — a warmed engine serves fresh MoE requests off its
+  existing shape-bin ladder programs.
+- **Compose** — MoE x prefix caching x speculation x KV quantization x
+  LoRA adapters ride the same tick (spot-checked pairs; the full matrix
+  is @slow for ci_full).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.inference import (ContinuousBatchingScheduler,
+                                            InferenceConfig,
+                                            InferenceEngineV2,
+                                            SamplingParams)
+from shuffle_exchange_tpu.models import Transformer
+from shuffle_exchange_tpu.models.transformer import tiny_moe
+from shuffle_exchange_tpu.monitor import FleetMonitor
+
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny_moe(vocab=VOCAB, d=32, layers=2, heads=4, seq=128,
+                   experts=4, n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _icfg(num_kv_blocks=40, moe=None, **kw):
+    serving = {"token_budget": 16, "max_running": 4, "chunk_min": 4,
+               "moe": {"moe_impl": "ragged", **(moe or {})}}
+    serving.update(kw.pop("serving", {}))
+    return InferenceConfig(dtype="float32", max_seq_len=64, kv_block_size=8,
+                           num_kv_blocks=num_kv_blocks, serving=serving,
+                           **kw)
+
+
+def _prompts(rng, sizes):
+    return [rng.integers(1, 90, size=int(n)).tolist() for n in sizes]
+
+
+def _oracle(model, params, icfg, prompt, max_new):
+    """The sequential reference: one request alone, put() then a fused
+    greedy decode_loop — the dense-gather route a batch of one takes."""
+    eng = InferenceEngineV2(model, params, icfg)
+    lg = eng.put([0], [prompt])
+    first = int(np.asarray(lg)[0].argmax())
+    rest = np.asarray(eng.decode_loop([0], [first], max_new - 1))[0]
+    return [first] + rest.tolist()
+
+
+def _seed_pressure(eng, per_expert=100):
+    """Fake one tick's routing counts: everything on expert 0, so
+    ``moe_pressure()`` reads far over capacity."""
+    E = eng._mcfg.n_experts
+    counts = np.zeros((2, E), np.int32)
+    counts[:, 0] = per_expert
+    eng._note_moe_counts((counts, np.zeros(2, np.float32)))
+    eng._moe_last_total = int(counts[-1].sum())
+
+
+# ---------------------------------------------------------------------------
+# token parity vs the sequential oracle
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_greedy_batched_matches_sequential_oracle(self, model_and_params):
+        """Mixed continuous-batching ticks emit exactly the tokens each
+        request gets alone — the ragged (dropless) route is
+        batch-composition independent, so batching is invisible."""
+        model, params = model_and_params
+        rng = np.random.default_rng(3)
+        prompts = _prompts(rng, [5, 11, 17, 4, 9])
+        eng = InferenceEngineV2(model, params, _icfg())
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=6)
+        assert len(out) == 5 and all(len(v) == 6 for v in out.values())
+        for i, p in enumerate(prompts):
+            assert out[i] == _oracle(model, params, _icfg(), p, 6), \
+                f"request {i} diverges batched-vs-sequential"
+        # one-dispatch-per-tick held the whole run
+        assert eng.dispatch_count == sched.ticks
+        # routed traffic surfaced; dropless means dropped == 0
+        st = sched.stats()["moe"]
+        assert st["dispatched"] > 0 and st["dropped"] == 0
+        assert st["expert_load_max"] >= 1
+
+    @pytest.mark.slow
+    def test_seeded_sampling_batched_matches_solo(self, model_and_params):
+        """Per-request seeded sampling is batch-invariant too: the same
+        (seed, position) stream drives each row wherever it sits."""
+        model, params = model_and_params
+        rng = np.random.default_rng(4)
+        prompts = _prompts(rng, [6, 13, 8])
+        sps = [SamplingParams(temperature=0.8, top_p=0.9, seed=50 + i)
+               for i in range(3)]
+        eng = InferenceEngineV2(model, params, _icfg())
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=6, sampling=sps)
+        for i, p in enumerate(prompts):
+            solo = ContinuousBatchingScheduler(
+                InferenceEngineV2(model, params, _icfg())).serve(
+                    [p], max_new_tokens=6, sampling=[sps[i]])
+            assert out[i] == solo[0], \
+                f"request {i} diverges batched-vs-solo under sampling"
+        assert eng.dispatch_count == sched.ticks
+
+    def test_moe_events_flow_to_monitor(self, model_and_params):
+        model, params = model_and_params
+        rng = np.random.default_rng(5)
+        eng = InferenceEngineV2(model, params, _icfg())
+        sched = ContinuousBatchingScheduler(eng)
+        sched.serve(_prompts(rng, [5, 9]), max_new_tokens=4)
+        labels = {e[0] for e in sched.memory_monitor.events}
+        for lbl in ("moe/dispatched", "moe/dropped", "moe/capacity_parks",
+                    "moe/expert_load_max"):
+            assert lbl in labels, lbl
+
+
+# ---------------------------------------------------------------------------
+# expert capacity as an admission resource
+# ---------------------------------------------------------------------------
+
+class TestCapacityAdmission:
+    def test_overload_parks_never_preempts_then_drains(self,
+                                                       model_and_params):
+        """Seeded routing pressure makes the scheduler hold NEW queue
+        requests at their FIFO seat; the running set keeps ticking, the
+        pressure (recomputed from real counts) drains, the parked request
+        unparks and completes. Preemptions stay zero throughout."""
+        model, params = model_and_params
+        eng = InferenceEngineV2(model, params, _icfg())
+        sched = ContinuousBatchingScheduler(eng)
+        sched.submit([1, 2, 3], max_new_tokens=8)
+        sched.tick()                      # admitted before any pressure
+        _seed_pressure(eng)
+        assert eng.moe_pressure() > 1.0
+        sched.submit([4, 5, 6], max_new_tokens=4)
+        sched.tick()
+        st = sched.stats()["moe"]
+        assert st["capacity_parks"] >= 1
+        assert st["waiting"] == 1
+        assert sched.preemptions == 0
+        n = 0
+        while sched.tick() and n < 300:
+            n += 1
+        st = sched.stats()
+        assert st["requests"] == 2        # both completed
+        assert st["moe"]["unparks"] >= 1
+        assert st["moe"]["waiting"] == 0
+        assert sched.preemptions == 0     # parks replaced preemptions
+
+    def test_drop_policy_admits_under_pressure(self, model_and_params):
+        """overload_policy="drop" opts out of parking: admission proceeds
+        and the capacity impl's on-device drops absorb the overload."""
+        model, params = model_and_params
+        eng = InferenceEngineV2(
+            model, params, _icfg(moe={"overload_policy": "drop"}))
+        sched = ContinuousBatchingScheduler(eng)
+        sched.submit([1, 2, 3], max_new_tokens=4)
+        sched.tick()
+        _seed_pressure(eng)
+        sched.submit([4, 5, 6], max_new_tokens=4)
+        sched.tick()
+        assert sched.stats()["moe"]["capacity_parks"] == 0
+
+    def test_engine_admission_detail_names_expert_pressure(
+            self, model_and_params):
+        """The engine-side backstop for direct put() callers: the refusal
+        names expert capacity and says KV is fine, so the caller knows
+        which resource to wait on."""
+        model, params = model_and_params
+        eng = InferenceEngineV2(model, params, _icfg())
+        eng.put([0], [[1, 2, 3]])         # a running sequence to drain
+        _seed_pressure(eng)
+        ok, _, why = eng._admission_detail([7], [4])
+        assert not ok
+        assert "expert capacity" in why and "KV is fine" in why
+        # running uids are never refused: they DRAIN the pressure
+        ok2, _, _ = eng._admission_detail([0], [1])
+        assert ok2
+
+    def test_pressure_zero_on_dense_and_fresh_engines(self,
+                                                      model_and_params):
+        model, params = model_and_params
+        eng = InferenceEngineV2(model, params, _icfg())
+        assert eng.moe_pressure() == 0.0  # no ticks yet
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile + warmed-ladder reuse
+# ---------------------------------------------------------------------------
+
+class TestZeroRecompile:
+    @pytest.mark.slow
+    def test_fresh_requests_reuse_warmed_programs(self, model_and_params):
+        """Routing is DATA: after one warm pass over the trace, a fresh
+        set of different-content same-shape-bin requests serves without
+        compiling a single new program."""
+        model, params = model_and_params
+        rng = np.random.default_rng(7)
+        sizes = [5, 11, 17, 4]
+        eng = InferenceEngineV2(model, params, _icfg())
+        # two warm passes: the first starts pressure-free, every later
+        # pass starts with the previous tail's routing pressure — packing
+        # (and so the shape-bin set) only reaches steady state on pass 2
+        for _ in range(2):
+            ContinuousBatchingScheduler(eng).serve(
+                _prompts(rng, sizes), max_new_tokens=5)
+        programs = set(eng.program_shapes)
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(_prompts(rng, sizes), max_new_tokens=5)
+        assert len(out) == 4
+        new = set(eng.program_shapes) - programs
+        assert not new, f"fresh MoE requests compiled {sorted(new)}"
+
+
+# ---------------------------------------------------------------------------
+# composition with the rest of the serving stack
+# ---------------------------------------------------------------------------
+
+class TestCompose:
+    @pytest.mark.slow
+    def test_prefix_cache_compose_keeps_parity(self, model_and_params):
+        """Shared-system-prompt admission over cached blocks + routed FFN:
+        tokens still match the uncached oracle exactly."""
+        model, params = model_and_params
+        rng = np.random.default_rng(11)
+        sys_prompt = rng.integers(1, 90, size=12).tolist()
+        prompts = [sys_prompt + rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (4, 7, 5)]
+        icfg = _icfg(prefix_caching=True)
+        eng = InferenceEngineV2(model, params, icfg)
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=5)
+        hit = sched.stats()["prefix_cache"]["hit_tokens"]
+        assert hit > 0                    # the cache actually engaged
+        for i, p in enumerate(prompts):
+            assert out[i] == _oracle(model, params, _icfg(), p, 5)
+
+    @pytest.mark.slow
+    def test_speculative_compose_keeps_parity(self, model_and_params):
+        """Draft-verify over the routed FFN: the k+1-wide verify rows ride
+        the same grouped route, and greedy acceptance preserves tokens."""
+        model, params = model_and_params
+        rng = np.random.default_rng(13)
+        prompts = _prompts(rng, [6, 9])
+        icfg = _icfg(serving={"speculative": {"enabled": True, "k": 2},
+                              "token_budget": 16, "max_running": 4,
+                              "chunk_min": 4,
+                              "moe": {"moe_impl": "ragged"}})
+        eng = InferenceEngineV2(model, params, icfg)
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.serve(prompts, max_new_tokens=6)
+        for i, p in enumerate(prompts):
+            assert out[i] == _oracle(model, params, _icfg(), p, 6)
+        assert eng.dispatch_count == sched.ticks
+
+    @pytest.mark.slow
+    def test_kv_quant_compose_serves(self, model_and_params):
+        """int8 KV + MoE routing share the tick; quantization perturbs
+        logits so parity is vs the same-dtype oracle."""
+        model, params = model_and_params
+        rng = np.random.default_rng(17)
+        prompts = _prompts(rng, [5, 8])
+        icfg = _icfg(kv_cache_dtype="int8")
+        eng = InferenceEngineV2(model, params, icfg)
+        out = ContinuousBatchingScheduler(eng).serve(prompts,
+                                                     max_new_tokens=5)
+        for i, p in enumerate(prompts):
+            assert out[i] == _oracle(model, params,
+                                     _icfg(kv_cache_dtype="int8"), p, 5)
+
+    @pytest.mark.slow
+    def test_full_compose_matrix(self, model_and_params):
+        """ci_full's exhaustive sweep: prefix x speculation x KV dtype all
+        serving together over the routed FFN, parity vs the plain oracle
+        for every bf16-exact cell."""
+        model, params = model_and_params
+        rng = np.random.default_rng(19)
+        prompts = _prompts(rng, [5, 9, 13])
+        for prefix in (False, True):
+            for spec_k in (0, 2):
+                for kvd in ("bf16", "int8"):
+                    serving = {"token_budget": 16, "max_running": 4,
+                               "chunk_min": 4,
+                               "moe": {"moe_impl": "ragged"}}
+                    if spec_k:
+                        serving["speculative"] = {"enabled": True,
+                                                  "k": spec_k}
+                    icfg = InferenceConfig(
+                        dtype="float32", max_seq_len=64, kv_block_size=8,
+                        num_kv_blocks=40, prefix_caching=prefix,
+                        kv_cache_dtype=kvd, serving=serving)
+                    eng = InferenceEngineV2(model, params, icfg)
+                    sched = ContinuousBatchingScheduler(eng)
+                    out = sched.serve(prompts, max_new_tokens=5)
+                    assert all(len(v) == 5 for v in out.values()), \
+                        (prefix, spec_k, kvd)
+                    assert eng.dispatch_count == sched.ticks
+                    assert sched.preemptions == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet surface: RPC engine spec + FleetMonitor aggregation
+# ---------------------------------------------------------------------------
+
+class TestFleetSurface:
+    def test_build_engine_from_spec_tiny_moe(self):
+        from shuffle_exchange_tpu.serving.worker import build_engine_from_spec
+
+        eng = build_engine_from_spec({
+            "model_kind": "tiny_moe",
+            "model": {"vocab": VOCAB, "d": 32, "layers": 2, "heads": 4,
+                      "seq": 128, "experts": 4, "n_kv_heads": 2,
+                      "tie_embeddings": False},
+            "init_seed": 0,
+            "inference": {"dtype": "float32", "max_seq_len": 64,
+                          "kv_block_size": 8, "num_kv_blocks": 40,
+                          "serving": {"moe": {"moe_impl": "ragged"}}},
+        })
+        assert eng._moe_serving
+        assert eng._moe_impl_override == "ragged"
+        with pytest.raises(ValueError, match="model_kind"):
+            build_engine_from_spec({"model_kind": "nope"})
+
+    def test_fleet_monitor_aggregates_moe_group(self):
+        """Cumulative counters sum across replicas; expert_load_max is a
+        peak and folds with max, never a sum."""
+        fm = FleetMonitor()
+        s0, s1 = fm.sink(0), fm.sink(1)
+        s0.write_events([("moe/dispatched", 100, 1), ("moe/dropped", 0, 1),
+                         ("moe/capacity_parks", 2, 1),
+                         ("moe/expert_load_max", 7, 1)])
+        s1.write_events([("moe/dispatched", 50, 1), ("moe/dropped", 1, 1),
+                         ("moe/capacity_parks", 0, 1),
+                         ("moe/expert_load_max", 11, 1)])
+        agg = fm.aggregate()
+        assert agg["moe"] == {"dispatched": 150, "dropped": 1,
+                              "capacity_parks": 2, "expert_load_max": 11}
+        pub = fm.publish()
+        assert pub["moe"]["expert_load_max"] == 11
+
+    def test_dense_fleet_publishes_no_moe_group(self):
+        fm = FleetMonitor()
+        fm.sink(0).write_events([("serving/ttft_s", 0.1, 1)])
+        assert "moe" not in fm.aggregate()
