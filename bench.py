@@ -930,6 +930,157 @@ def serving_failover_row(model, params, icfg, vocab, *, n_requests=16,
     }
 
 
+def serving_async_publish_row(model, params, icfg, vocab, *, n_requests=16,
+                              prompt_lo=48, prompt_hi=192, max_new=24,
+                              publish_every_ticks=3, n_publishes=4,
+                              staleness_window=4, load=2.0, seed=0):
+    """Config-5 async-weight-sync row (ISSUE 20): the SAME Poisson trace
+    served by a 2-replica fleet while ``n_publishes`` weight publishes
+    land mid-trace, two ways:
+
+      - *barrier* (``router.sync`` off): each publish is the two-phase
+        stage-on-every-replica commit under the router lock — the
+        publish call's wall time IS the stall it imposes on the fleet
+        (no tick can run while it holds the lock), O(fleet);
+      - *async* (``router.sync`` on, Gossip): each publish retains one
+        host copy and kicks only the trainer peer's current edge
+        partners — O(edge-degree) — with cooperative ``sync_step()``
+        rounds playing the background gossip thread between ticks.
+
+    Publishes carry the SAME bytes as the boot weights, so every
+    version decodes identically and token parity between the two
+    variants (and versions) is assertable exactly. Headline figures:
+    the per-publish stall (p50/max) barrier vs async, goodput
+    retention, the honest ``weight_version`` census over finished
+    requests (how stale the fleet actually served), the bounded
+    staleness window holding over every stamp, and a final
+    ``converge()`` landing the whole surviving fleet on one version.
+    Reused at toy size by tests/test_bench_smoke.py so the published
+    row cannot rot on CPU."""
+    import dataclasses as _dc
+    from collections import Counter, deque as _deque
+
+    from shuffle_exchange_tpu.inference import InferenceEngineV2
+    from shuffle_exchange_tpu.serving import ReplicaRouter
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, size=int(n)).tolist()
+               for n in rng.integers(prompt_lo, prompt_hi + 1,
+                                     size=n_requests)]
+
+    def fleet(sync_on):
+        rcfg = ({"sync": {"enabled": True, "method": "Gossip",
+                          "gossip_prob": 1.0,
+                          "staleness_window": staleness_window}}
+                if sync_on else None)
+        cfg2 = _dc.replace(icfg, router=rcfg)
+        return ReplicaRouter([InferenceEngineV2(model, params, cfg2)
+                              for _ in range(2)])
+
+    def drive(router, arrivals, publish):
+        """serve() with mid-trace publish hooks: submit on the arrival
+        clock, tick cooperatively, publish every ``publish_every_ticks``
+        ticks (wall-timing each call), and run one gossip round per tick
+        when async sync is on."""
+        pending = _deque(enumerate(prompts))
+        t0 = router.clock()
+        uids, stalls, ticks, version = [], [], 0, 0
+        while pending or any(r.scheduler.active or r.scheduler.queue
+                             for r in router.replicas if r.active):
+            while pending and (arrivals is None or
+                               router.clock() - t0
+                               >= arrivals[pending[0][0]]):
+                i, prompt = pending.popleft()
+                uids.append(router.submit(prompt, max_new_tokens=max_new))
+            alive = router.tick()
+            ticks += 1
+            if (publish and version < n_publishes
+                    and ticks % publish_every_ticks == 0):
+                version += 1
+                tp = time.perf_counter()
+                router.publish_weights(params, version=version)
+                stalls.append(time.perf_counter() - tp)
+            if router._async_sync is not None:
+                router.sync_step()
+            if not alive and pending and arrivals is not None:
+                wait = arrivals[pending[0][0]] - (router.clock() - t0)
+                if wait > 0:
+                    time.sleep(wait)
+        # a short trace can drain before the tick schedule spends the
+        # publish budget: flush the remainder so both variants always
+        # time n_publishes calls (idle-fleet stalls still measure the
+        # stage/commit cost the call imposes)
+        while publish and version < n_publishes:
+            version += 1
+            tp = time.perf_counter()
+            router.publish_weights(params, version=version)
+            stalls.append(time.perf_counter() - tp)
+            if router._async_sync is not None:
+                router.sync_step()
+        out = {u: router.requests[u].generated for u in uids}
+        return out, stalls, uids
+
+    # throwaway pass warms the shape-bin ladder; capacity calibrates the
+    # arrivals both measured runs then replay at identical offsets
+    drive(fleet(False), None, publish=False)
+    cap_router = fleet(False)
+    drive(cap_router, None, publish=False)
+    cap = cap_router.stats()["sustained_tokens_per_sec"]
+    span = n_requests * max_new / cap / load
+    arrivals = np.cumsum(rng.exponential(span / n_requests,
+                                         size=n_requests)).tolist()
+
+    barrier_router = fleet(False)
+    out_b, stalls_b, _ = drive(barrier_router, list(arrivals), publish=True)
+    st_b = barrier_router.stats()
+
+    async_router = fleet(True)
+    out_a, stalls_a, uids_a = drive(async_router, list(arrivals),
+                                    publish=True)
+    st_a = async_router.stats()
+    sync = async_router._async_sync
+    newest = sync.newest_version
+    census = Counter(async_router.requests[u].weight_version
+                     for u in uids_a)
+    window_ok = all(0 <= newest - wv <= staleness_window for wv in census)
+    converged_v = async_router.converge()
+    converged = all(r.engine.weight_version == converged_v
+                    for r in async_router.replicas if r.active)
+    mismatches = sum(out_a[u] != out_b[u] for u in out_a)
+    return {
+        "n_requests": n_requests,
+        "prompt_tokens": [prompt_lo, prompt_hi],
+        "max_new_tokens": max_new,
+        "offered_load_x": load,
+        "publishes": n_publishes,
+        "staleness_window": staleness_window,
+        "publish_stall_p50_s_barrier": round(
+            float(np.median(stalls_b)), 5),
+        "publish_stall_max_s_barrier": round(max(stalls_b), 5),
+        "publish_stall_p50_s_async": round(float(np.median(stalls_a)), 5),
+        "publish_stall_max_s_async": round(max(stalls_a), 5),
+        "publish_stall_ratio": round(
+            float(np.median(stalls_b)) / max(float(np.median(stalls_a)),
+                                             1e-9), 1),
+        "sustained_tokens_per_sec_barrier": round(
+            st_b["sustained_tokens_per_sec"], 1),
+        "sustained_tokens_per_sec_async": round(
+            st_a["sustained_tokens_per_sec"], 1),
+        "goodput_retention": round(st_a["sustained_tokens_per_sec"]
+                                   / st_b["sustained_tokens_per_sec"], 3),
+        "token_mismatches_vs_barrier": mismatches,
+        "version_census": {int(k): int(v)
+                          for k, v in sorted(census.items())},
+        "staleness_window_held": bool(window_ok),
+        "forced_catchups": st_a["sync"]["forced_catchups"],
+        "edge_exchanges": st_a["sync"]["edge_exchanges"],
+        "failed_exchanges": st_a["sync"]["failed_exchanges"],
+        "publish_bytes": st_a["publish"]["bytes"],
+        "converged_version": converged_v,
+        "fleet_converged": bool(converged),
+    }
+
+
 def serving_longctx_row(model, params, icfg, vocab, *, n_requests=12,
                         prompt_blocks=16, grow_blocks=2, load=4.0, seed=0):
     """Config-5 long-context tier row (ISSUE 15): the SAME Poisson trace —
@@ -1883,6 +2034,19 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
               file=sys.stderr, flush=True)
         failover_row = None
 
+    # ---- async weight sync: the same Poisson trace with mid-trace
+    # publishes, barrier two-phase vs async shuffle-exchange gossip
+    # (ISSUE 20) — per-publish stall, goodput retention, the honest
+    # weight_version census, and the bounded-staleness + converge()
+    # contracts, with token parity asserted (same-bytes publishes)
+    try:
+        async_publish_row = serving_async_publish_row(model, params, icfg,
+                                                      cfg.vocab_size)
+    except Exception as e:
+        print(f"SXT_WARN serving async-publish bench failed: "
+              f"{_short_err(e)}", file=sys.stderr, flush=True)
+        async_publish_row = None
+
     # ---- long-context tiered KV: the same Poisson trace on constrained
     # pools, spill-on vs the refuse-admission baseline vs an
     # unconstrained-pool reference (ISSUE 15) — goodput, TTFT/TPOT p95,
@@ -1985,6 +2149,7 @@ def bench_serving(label, model_cfg, peak_flops, hbm_bw=None):
         "serving_speculative": spec_row,
         "serving_sampling": sampling_row,
         "serving_failover": failover_row,
+        "serving_async_publish": async_publish_row,
         "serving_longctx": longctx_row,
         "serving_multi_tenant": multi_tenant_row,
         "serving_moe": moe_row,

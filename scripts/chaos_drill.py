@@ -50,6 +50,12 @@ def main() -> int:
                     help="ISSUE 17: spawn REAL worker processes behind the "
                          "RPC boundary and kill them with real SIGKILL/"
                          "SIGSTOP (kill kinds: kill|stop)")
+    ap.add_argument("--async-publish", action="store_true",
+                    help="ISSUE 20: async shuffle-exchange weight-sync "
+                         "drill — mid-trace publishes over gossip edges, "
+                         "one replica killed mid-gossip; zero lost "
+                         "requests, token parity, bounded staleness, and "
+                         "survivors converge() to one version")
     ap.add_argument("--adapters", type=int, default=0, metavar="N",
                     help="ISSUE 18: stripe requests across N LoRA "
                          "adapters on a 2-slot pool (threads mode) — "
@@ -78,6 +84,8 @@ def main() -> int:
 
     if args.process:
         return _process_drill(args)
+    if args.async_publish:
+        return _async_publish_drill(args)
 
     cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
                activation="swiglu", norm="rmsnorm", position="rope",
@@ -164,6 +172,124 @@ def main() -> int:
                   f"2-slot pools, hits {ad.get('hits')}, "
                   f"misses {ad.get('misses')}, parks {ad.get('parks')}, "
                   f"token parity held through failover")
+    print("chaos drill: ok")
+    return 0
+
+
+def _async_publish_drill(args) -> int:
+    """ISSUE 20 acceptance drill: the fleet on the async shuffle-exchange
+    sync (Gossip edges, bounded staleness) with publishes landing
+    MID-TRACE and one replica killed mid-gossip. Publishes carry the same
+    bytes as the boot weights so token parity with the clean single-run
+    oracle is exact regardless of which version served each token. Bars:
+    zero lost requests, token parity, every finished request's stamped
+    ``weight_version`` inside the staleness window, the corpse out of the
+    gossip schedule (survivor staleness drains to 0), and ``converge()``
+    landing every live replica on one full-average version."""
+    import numpy as np
+
+    import jax
+
+    from shuffle_exchange_tpu.inference import (InferenceConfig,
+                                                InferenceEngineV2)
+    from shuffle_exchange_tpu.models import Transformer, tiny
+    from shuffle_exchange_tpu.serving import ReplicaRouter
+
+    window = 3
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    def mk():
+        return InferenceEngineV2(model, params, InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40,
+            serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+            router={"sync": {"enabled": True, "method": "Gossip",
+                             "gossip_prob": 1.0,
+                             "staleness_window": window}}))
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(1, 90, size=int(n)).tolist()
+               for n in rng.integers(4, 17, size=args.requests)]
+
+    # clean single-engine oracle (greedy): v1..vN publishes repeat the
+    # boot bytes, so EVERY version's decode matches this reference
+    oracle = []
+    for p in prompts:
+        eng = InferenceEngineV2(model, params, InferenceConfig(
+            dtype="float32", max_seq_len=64, kv_block_size=8,
+            num_kv_blocks=40,
+            serving={"token_budget": 16, "max_running": 4, "chunk_min": 4}))
+        lg = eng.put([0], [p])
+        first = int(np.argmax(lg[0]))
+        rest = eng.decode_loop([0], [first], args.max_new - 1)
+        oracle.append([first] + [int(t) for t in rest[0]])
+
+    router = ReplicaRouter([mk() for _ in range(args.replicas)])
+    uids = [router.submit(p, max_new_tokens=args.max_new) for p in prompts]
+    victim = args.replicas - 1
+    kill_tick = max(2, args.requests // 3)
+    publishes, ticks, version = max(2, args.requests // 4), 0, 0
+    killed = False
+    while router.tick():
+        ticks += 1
+        if version < publishes and ticks % 2 == 0:
+            version += 1
+            router.publish_weights(params, version=version)
+        router.sync_step()
+        if not killed and ticks == kill_tick:
+            # the mid-gossip kill: a publish is in flight somewhere on
+            # the edge schedule when the victim dies uncleanly
+            router.fail_over(victim, reason="drill: mid-gossip kill")
+            killed = True
+    while version < publishes:       # short trace: spend the budget
+        version += 1
+        router.publish_weights(params, version=version)
+        router.sync_step()
+
+    finished = sum(router.requests[u].state == "finished" for u in uids)
+    lost = args.requests - finished
+    mismatches = sum(router.requests[u].generated != want
+                     for u, want in zip(uids, oracle))
+    newest = router._async_sync.newest_version
+    stamps = [router.requests[u].weight_version for u in uids]
+    window_ok = all(wv is not None and 0 <= newest - wv <= window
+                    for wv in stamps)
+    router.sync_step()               # corpse out of the schedule: drains
+    st = router._async_sync.staleness()
+    cv = router.converge()
+    live = [r for r in router.replicas if r.active]
+    converged = bool(live) and all(r.engine.weight_version == cv
+                                   for r in live)
+    report = {
+        "n_requests": args.requests, "finished": finished, "lost": lost,
+        "token_mismatches": mismatches, "publishes": publishes,
+        "killed_replica": victim, "kill_tick": kill_tick,
+        "newest_version": newest, "staleness_window": window,
+        "staleness_window_held": window_ok,
+        "survivor_staleness_max": st["staleness_max"],
+        "forced_catchups": st["forced_catchups"],
+        "edge_exchanges": st["edge_exchanges"],
+        "converged_version": cv, "fleet_converged": converged,
+        "sync": router.stats()["sync"],
+    }
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(f"async-publish drill: {finished}/{args.requests} finished, "
+              f"{lost} lost, {mismatches} token mismatches, "
+              f"{publishes} publishes over gossip edges, replica {victim} "
+              f"killed at tick {kill_tick}, window<= {window} held="
+              f"{window_ok}, survivor staleness {st['staleness_max']}, "
+              f"converged v{cv} on {len(live)} survivors={converged}")
+    ok = (lost == 0 and mismatches == 0 and window_ok and killed
+          and st["staleness_max"] == 0 and converged)
+    if not ok:
+        print("chaos drill: FAILED", file=sys.stderr)
+        return 1
     print("chaos drill: ok")
     return 0
 

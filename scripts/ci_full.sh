@@ -69,6 +69,12 @@ env SXT_SANITIZE=1 python scripts/chaos_drill.py --process
 # re-place victims onto adapter-resident survivors and replay
 # token-identically (the reference oracle binds each uid's adapter).
 env SXT_SANITIZE=1 python scripts/chaos_drill.py --adapters 3
+# Async weight-sync chaos drill (ISSUE 20): the fleet on gossip-edge
+# publishes (no O(fleet) barrier) with one replica killed mid-gossip —
+# zero lost requests, token parity, every served stamp inside the
+# staleness window, survivor staleness drained to 0, and converge()
+# landing the survivors on one full-average version.
+env SXT_SANITIZE=1 python scripts/chaos_drill.py --async-publish
 # Serving-autotuner smoke (ISSUE 14): bounded successive-halving search
 # (tiny model, 2-round halving, <= 8 search trials) with the crash drill —
 # the search is killed at its 3rd trial-journal commit, resumed, and must

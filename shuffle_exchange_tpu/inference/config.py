@@ -517,6 +517,56 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class AsyncSyncConfig:
+    """Asynchronous shuffle-exchange weight sync for the serving fleet
+    (ISSUE 20, ``serving/async_sync.py``): trainer + N replicas as peers
+    on the decentralized topology (``runtime/sync/decentralized.py`` —
+    the repo's namesake RR / shuffle / H-RR / Gossip edge schedules,
+    SURVEY §2.1), with newest-version-wins weight propagation along the
+    schedule's edges instead of the O(fleet) two-phase publish barrier.
+
+    ``staleness_window`` is the serving contract: no ACTIVE replica may
+    answer from weights more than W versions behind the newest published
+    — a replica about to exceed it gets a forced catch-up edge the next
+    sync step, ahead of the schedule. ``converge()`` on the router
+    reduces the fleet to the reference's ``synchronization()``
+    full-average on demand (bit-equal across peers)."""
+
+    enabled: bool = False
+    method: str = "Gossip"        # RR | shuffle | H-RR | Gossip
+    rings: int = 2                # ring count for RR/H-RR/shuffle
+    shuffle_step: int = 50        # re-randomize ring assignment every N steps
+    gossip_prob: float = 1.0      # per-step send probability (Gossip)
+    staleness_window: int = 4     # max versions a replica may trail by
+    sync_interval_s: float = 0.05  # background sync-loop cadence (threads)
+    seed: int = 0                 # topology RNG seed (deterministic edges)
+
+    def __post_init__(self):
+        if self.method not in ("RR", "shuffle", "H-RR", "Gossip"):
+            raise ConfigError(
+                f"router.sync.method must be one of RR|shuffle|H-RR|Gossip, "
+                f"got {self.method!r}")
+        for name in ("rings", "shuffle_step", "staleness_window"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ConfigError(
+                    f"router.sync.{name} must be an int >= 1, got {v!r}")
+        if not isinstance(self.gossip_prob, (int, float)) \
+                or not 0.0 <= self.gossip_prob <= 1.0:
+            raise ConfigError(
+                f"router.sync.gossip_prob must be in [0, 1], got "
+                f"{self.gossip_prob!r}")
+        if not isinstance(self.sync_interval_s, (int, float)) \
+                or self.sync_interval_s <= 0:
+            raise ConfigError(
+                f"router.sync.sync_interval_s must be > 0, got "
+                f"{self.sync_interval_s!r}")
+        if not isinstance(self.seed, int) or self.seed < 0:
+            raise ConfigError(
+                f"router.sync.seed must be an int >= 0, got {self.seed!r}")
+
+
+@dataclasses.dataclass
 class RouterConfig:
     """Multi-replica serving-front knobs (``serving/router.py`` — the
     ISSUE 7 replica router: N engine+scheduler replicas behind a placement
@@ -604,6 +654,13 @@ class RouterConfig:
     # `worker_start_timeout_s` bounds the spawn->ready-file handshake
     # (cold workers sit in jax import + first compiles).
     fleet_mode: str = "threads"
+    # -- async shuffle-exchange weight sync (ISSUE 20) --
+    # Off by default: publishes keep the two-phase all-replica barrier.
+    # Enabled, publishes stage only to the trainer peer's current edge
+    # partners and background sync steps spread the version along the
+    # decentralized schedule inside sync.staleness_window.
+    sync: AsyncSyncConfig = dataclasses.field(
+        default_factory=AsyncSyncConfig)
     rpc_call_timeout_s: float = 60.0
     rpc_ping_timeout_s: float = 5.0
     rpc_connect_retries: int = 5
@@ -612,6 +669,16 @@ class RouterConfig:
     worker_start_timeout_s: float = 180.0
 
     def __post_init__(self):
+        if self.sync is None:
+            self.sync = AsyncSyncConfig()
+        elif isinstance(self.sync, dict):
+            allowed = {f.name for f in dataclasses.fields(AsyncSyncConfig)}
+            unknown = set(self.sync) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"unknown router.sync config keys {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})")
+            self.sync = AsyncSyncConfig(**self.sync)
         if self.num_replicas < 1:
             raise ConfigError(
                 f"router.num_replicas must be >= 1, got {self.num_replicas}")

@@ -321,8 +321,9 @@ class InferenceEngine:
         dequantizes into the dot (XLA fuses the convert, so weights cross
         HBM quantized — measured faster than the Pallas quant kernel at
         every serving shape, round 5: int8 generate 930 vs 612 tok/s).
-        MoE/unembed weights (einsum / fp32 head paths) keep the
-        rounding-only emulation."""
+        int8/fp8 MoE expert weights also take storage form (the grouped
+        GEMM / batched-einsum paths dequantize into the dot); int4 MoE
+        and unembed (fp32 head path) keep the rounding-only emulation."""
         import jax
 
         from ..ops.quant import quantize_dequantize
@@ -337,7 +338,21 @@ class InferenceEngine:
         # apply to the moe/unembed rounding path only
         storage_gs = min(gs, 256)
         storage_names = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
-        qdq_names = {"moe_w_gate", "moe_w_up", "moe_w_down", "unembed"}
+        moe_names = {"moe_w_gate", "moe_w_up", "moe_w_down"}
+        if self.config.quant_bits in (8, "fp8"):
+            # expert-sharded MoE FFN weights join int8/fp8 STORAGE (ISSUE
+            # 20 satellite): quantize_weight groups along K under the
+            # stacked [L, E] lead dims, and both expert compute paths
+            # dequantize into the dot (batched einsum in expert_mlp,
+            # grouped_matmul's ragged_dot/gmm dispatch) — so expert
+            # weights cross HBM at quantized width during streamed
+            # decode, same contract as the dense w_* leaves. int4 keeps
+            # the rounding emulation: its nibble-pair unpack is plumbed
+            # for the 2D serving matmul only.
+            storage_names = storage_names | moe_names
+            qdq_names = {"unembed"}
+        else:
+            qdq_names = moe_names | {"unembed"}
         dtype = self.config.jax_dtype()
 
         def walk(tree):

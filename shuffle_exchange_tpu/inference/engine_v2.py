@@ -465,6 +465,11 @@ class InferenceEngineV2(InferenceEngine):
             if (name.startswith("moe_") and name != "moe_gate"
                     and not name.startswith("moe_shared")
                     and getattr(leaf, "ndim", 0) >= 2):
+                # int8/fp8 QuantizedMatrix expert stacks shard the same
+                # way: device_put broadcasts the sharding over the
+                # pytree's children, and both q and scales carry E on
+                # dim 1 (scale groups run along K), so the expert split
+                # never cuts a scale group
                 layers[name] = jax.device_put(leaf, sharding)
                 moved.append(name)
         if moved:

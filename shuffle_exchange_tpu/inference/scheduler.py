@@ -145,6 +145,12 @@ class ServingRequest:
     # re-prefill compute.
     adapter_id: Optional[str] = None
     adapter_waiting: bool = False
+    # async weight sync (ISSUE 20): the serving weight version this
+    # request's LAST token sampled under, stamped at finish — the
+    # per-request staleness audit trail (bounded-window property tests
+    # and honest RolloutRecord stamping read it, instead of assuming
+    # every replica already serves the newest publish)
+    weight_version: Optional[int] = None
     # expert-parallel MoE serving (ISSUE 19): a queued request parked on
     # expert-capacity pressure — the previous tick's routing saturated
     # some expert's buffer, so NEW sequences hold at their FIFO seat
@@ -424,6 +430,7 @@ class ContinuousBatchingScheduler:
     def _finish(self, r: ServingRequest, now: float) -> None:
         r.state = FINISHED
         r.finished_at = now
+        r.weight_version = self.engine.weight_version
         if r.uid in self.engine._seqs:
             # an early-stopped flush (ISSUE 16) tallies the KV blocks the
             # stop returned ahead of the request's budgeted lifetime

@@ -320,7 +320,9 @@ def decode_fusion_eligibility(cfg: "TransformerConfig",
                "fused kernel's lane-roll rotate-half form does not cover it")
     mlp = None
     if cfg.n_experts > 0:
-        mlp = "MoE FFN (expert dispatch stays on the moe_layer path)"
+        mlp = ("MoE FFN (expert dispatch stays on the moe_layer path, "
+               "which itself admits int8/fp8 streamed expert weights — "
+               "the grouped-GEMM/einsum dequant fuses into the dot)")
     elif cfg.activation not in FUSABLE_ACTIVATIONS:
         mlp = (f"activation {cfg.activation!r} has no Mosaic lowering "
                f"(fusable: {', '.join(FUSABLE_ACTIVATIONS)})")

@@ -65,25 +65,41 @@ def expert_partition_specs(params):
     return {k: spec(k) for k in params}
 
 
+def _dense_w(w, dtype):
+    """Expert weight -> dense compute form. int8/fp8 STORAGE leaves
+    (``QuantizedMatrix``, inference quantized serving) dequantize HERE,
+    explicitly: XLA fuses the convert into the consuming einsum operand,
+    so expert weights cross HBM at quantized width and convert in
+    registers — the streamed-weight decode contract. (``.astype`` on a
+    QuantizedMatrix materializes identically; the explicit branch keeps
+    the contract visible at the use site.)"""
+    from ..ops.quant_matmul import QuantizedMatrix
+
+    if isinstance(w, QuantizedMatrix):
+        return w.dequantize().astype(dtype)
+    return w.astype(dtype)
+
+
 def expert_mlp(params, x, activation: str = "swiglu"):
     """x [E, C', M] -> [E, C', M]: per-expert FFN as one batched einsum.
     Optional per-expert biases (b_gate/b_up/b_down) add as [E, 1, F]
-    broadcasts — the Megatron biased-expert layout."""
+    broadcasts — the Megatron biased-expert layout. Expert weights may be
+    int8/fp8 ``QuantizedMatrix`` leaves (see :func:`_dense_w`)."""
     import jax
     import jax.numpy as jnp
 
     def b(key, t):
         return t + params[key].astype(t.dtype)[:, None, :] if key in params else t
 
-    up = b("b_up", jnp.einsum("ecm,emf->ecf", x, params["w_up"].astype(x.dtype)))
+    up = b("b_up", jnp.einsum("ecm,emf->ecf", x, _dense_w(params["w_up"], x.dtype)))
     if activation == "swiglu":
-        gate = b("b_gate", jnp.einsum("ecm,emf->ecf", x, params["w_gate"].astype(x.dtype)))
+        gate = b("b_gate", jnp.einsum("ecm,emf->ecf", x, _dense_w(params["w_gate"], x.dtype)))
         h = jax.nn.silu(gate) * up
     else:
         from ..models.transformer import activation_fn
 
         h = activation_fn(activation)(up)
-    return b("b_down", jnp.einsum("ecf,efm->ecm", h, params["w_down"].astype(x.dtype)))
+    return b("b_down", jnp.einsum("ecf,efm->ecm", h, _dense_w(params["w_down"], x.dtype)))
 
 
 def _gather_expert_sharded(params, expert_axis: str = "expert"):
@@ -108,8 +124,12 @@ def _gather_expert_sharded(params, expert_axis: str = "expert"):
         if mesh.shape.get(expert_axis, 1) == 1:
             return params
         rep = NamedSharding(constraint_mesh(mesh), P())
-        return {k: jax.lax.with_sharding_constraint(v, rep)
-                for k, v in params.items()}
+        # tree.map (not a dict comprehension) so QuantizedMatrix expert
+        # leaves pin BOTH children (q + scales) — a constraint on the
+        # wrapper node would be structure-mismatched, and skipping it
+        # would re-open the ragged_dot mispartition this gather fixes
+        return jax.tree.map(
+            lambda v: jax.lax.with_sharding_constraint(v, rep), params)
     except Exception:
         return params
 
@@ -137,6 +157,7 @@ def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
 
     from ..ops.grouped_gemm import grouped_matmul
+    from ..ops.quant_matmul import QuantizedMatrix
 
     dtype = xs.dtype
     e_sorted = jnp.take(flat_e, order)                   # [S*k] expert per row
@@ -147,15 +168,24 @@ def expert_mlp_ragged(params, xs, topk_idx, topk_w, activation: str = "swiglu"):
             return t
         return t + jnp.take(params[key].astype(dtype), e_sorted, axis=0)
 
-    up = b("b_up", grouped_matmul(xsort, params["w_up"].astype(dtype), group_sizes))
+    def w(key):
+        # int8/fp8 QuantizedMatrix expert stacks pass through UNCAST:
+        # grouped_matmul owns the dequant policy (fused into ragged_dot's
+        # operand on the fallback path; materialized once for the
+        # megablox kernel) — an .astype here would densify at the call
+        # site and forfeit the streamed-weight HBM win
+        wt = params[key]
+        return wt if isinstance(wt, QuantizedMatrix) else wt.astype(dtype)
+
+    up = b("b_up", grouped_matmul(xsort, w("w_up"), group_sizes))
     if activation == "swiglu":
-        gate = b("b_gate", grouped_matmul(xsort, params["w_gate"].astype(dtype), group_sizes))
+        gate = b("b_gate", grouped_matmul(xsort, w("w_gate"), group_sizes))
         h = jax.nn.silu(gate) * up
     else:
         from ..models.transformer import activation_fn
 
         h = activation_fn(activation)(up)
-    out_sorted = b("b_down", grouped_matmul(h, params["w_down"].astype(dtype), group_sizes))
+    out_sorted = b("b_down", grouped_matmul(h, w("w_down"), group_sizes))
     out_flat = jnp.zeros_like(out_sorted).at[order].set(out_sorted)   # unsort
     return (out_flat.reshape(S, k, M) * topk_w[..., None].astype(dtype)).sum(axis=1)
 

@@ -375,14 +375,25 @@ class FleetMonitor(Monitor):
         # rpc/* joins them in ISSUE 17: ProcessReplicaRouter.
         # publish_metrics() writes cumulative RPC call/timeout/reconnect
         # sums the same fleet-scoped way
+        # async weight sync (ISSUE 20): publish/* and sync/* join them —
+        # the router (or ProcessReplicaRouter.publish_metrics) writes
+        # both groups fleet-scoped. Staleness folds by MAX across the
+        # window's events (a dashboard must see the WORST staleness the
+        # fleet hit, not whichever value happened to land last).
         for group, prefix in (("health", "fleet/health/"),
                               ("failover", "failover/"),
                               ("shed", "shed/"),
-                              ("rpc", "rpc/")):
+                              ("rpc", "rpc/"),
+                              ("publish", "publish/"),
+                              ("sync", "sync/")):
             vals = {}
             for lbl, v, _ in events:
                 if lbl.startswith(prefix):
-                    vals[lbl[len(prefix):]] = v
+                    key = lbl[len(prefix):]
+                    if key.startswith("staleness"):
+                        vals[key] = max(vals.get(key, 0), v)
+                    else:
+                        vals[key] = v
             if vals:
                 out[group] = vals
         return out
@@ -413,7 +424,8 @@ class FleetMonitor(Monitor):
         # fault-tolerance groups (ISSUE 12) ride downstream under fleet/*
         # namespacing (health labels are already fleet/health/<k> in the
         # ring; failover/shed gain the fleet/ prefix here)
-        for group in ("health", "failover", "shed", "rpc"):
+        for group in ("health", "failover", "shed", "rpc", "publish",
+                      "sync"):
             events += [(f"fleet/{group}/{k}", v, self._step)
                        for k, v in (agg.get(group) or {}).items()
                        if isinstance(v, (int, float))]

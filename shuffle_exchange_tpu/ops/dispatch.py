@@ -38,7 +38,8 @@ _GROUPED_GEMM_KINDS = ("moe", "lora")
 
 
 def resolve_grouped_gemm(kind: str, *, shapes_ok: bool,
-                         interpret_capable: bool = False) -> str:
+                         interpret_capable: bool = False,
+                         quantized: bool = False) -> str:
     """Resolve a grouped-GEMM call site to "pallas", "interpret", or
     "fallback" — the single seam ``ops/grouped_gemm.grouped_matmul``
     (megablox ``gmm`` vs ``lax.ragged_dot``) and ``ops/lora_gemm
@@ -52,11 +53,27 @@ def resolve_grouped_gemm(kind: str, *, shapes_ok: bool,
     caller's kernel accepts ``interpret=True`` (the LoRA kernel does;
     megablox ``gmm`` offers no interpret hook, so the MoE site falls
     back to ``ragged_dot`` — which IS its numerics oracle — off-TPU).
+
+    ``quantized`` (ISSUE 20 satellite) marks an int8/fp8 streamed-weight
+    call (``QuantizedMatrix`` RHS). It never changes the routing — both
+    routes admit quantized weights — but a "pallas" resolution gets a
+    once-per-process note that the megablox kernel reads dense operands,
+    so the dequant materializes before the call instead of fusing into
+    the dot as the ragged_dot route does (relevant when comparing the
+    two routes' HBM traffic on-chip).
     """
     if kind not in _GROUPED_GEMM_KINDS:
         raise ValueError(f"grouped-GEMM kind must be one of "
                          f"{_GROUPED_GEMM_KINDS}, got {kind!r}")
     from ..utils.logging import warning_once
+
+    if quantized and shapes_ok and pallas_enabled() and not interpret_forced():
+        # sxt: ignore[SXT005] kind is one of two literals — dedup cardinality 2
+        warning_once(
+            f"grouped_gemm[{kind}]: quantized weights on the Pallas "
+            f"megablox route dequantize BEFORE the kernel (dense "
+            f"operands); the ragged_dot route fuses the convert into the "
+            f"dot — measure both if HBM-bound")
 
     if not shapes_ok:
         if pallas_enabled() or interpret_forced():

@@ -31,14 +31,29 @@ def grouped_matmul(x, w, group_sizes):
     """x [N, K] (rows sorted by group), w [E, K, F], group_sizes [E] int32
     -> [N, F] in x.dtype with fp32 accumulation semantics on TPU.
 
+    ``w`` may be an int8/fp8 :class:`~..ops.quant_matmul.QuantizedMatrix`
+    stack (quantized streamed-weight MoE decode, ISSUE 20 satellite): on
+    the ``ragged_dot`` path the dequant fuses into the dot's RHS operand —
+    expert weights cross HBM at quantized width and convert in registers,
+    the same contract as ``quant_matmul``'s default path; the megablox
+    kernel reads dense operands, so the Pallas route dequantizes once
+    before the call (the at-rest/transfer byte win survives; the compute
+    temp is freed after the gmm).
+
     Eligibility/dispatch resolves through
     :func:`ops.dispatch.resolve_grouped_gemm` — the seam shared with
     ``ops/lora_gemm.lora_delta``. megablox ``gmm`` has no interpret hook,
     so ``interpret_capable`` stays False and every non-TPU resolution is
     "fallback" (``lax.ragged_dot``, which is also the numerics oracle)."""
     from .dispatch import resolve_grouped_gemm
+    from .quant_matmul import QuantizedMatrix
 
-    if resolve_grouped_gemm("moe", shapes_ok=_gmm_ok(x, w)) == "pallas":
+    quantized = isinstance(w, QuantizedMatrix)
+    route = resolve_grouped_gemm("moe", shapes_ok=_gmm_ok(x, w),
+                                 quantized=quantized)
+    if quantized:
+        w = w.dequantize().astype(x.dtype)
+    if route == "pallas":
         return _grouped_matmul_gmm(x, w, group_sizes)
     import jax
 

@@ -373,8 +373,20 @@ class HybridEngineV2:
                       else self._inference_config().max_new_tokens)
         out = self.router.serve(plist, max_new_tokens=max_new,
                                 session_ids=session_ids, sampling=sps)
+
+        def served_version(uid):
+            # honest stamping (ISSUE 20): under async sync a replica may
+            # answer from a version behind the newest publish — record
+            # the version its scheduler stamped at finish, not the one
+            # the trainer just minted. Barrier fleets stamp identically.
+            r = self.router.requests.get(uid)
+            if r is not None and r.weight_version is not None:
+                return int(r.weight_version)
+            return version
+
         records = [RolloutRecord(prompt=p, tokens=list(toks),
-                                 weight_version=version, uid=uid,
+                                 weight_version=served_version(uid),
+                                 uid=uid,
                                  sampling=None if sp is None
                                  else sp.to_wire())
                    for (uid, toks), p, sp in zip(out.items(), plist, sps)]

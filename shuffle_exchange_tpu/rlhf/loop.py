@@ -346,3 +346,90 @@ class RLHFLoop:
                 "mean_rewards": mean_rewards, "weight_versions": versions,
                 "rollouts_logged": len(self.log),
                 "latency": self.hybrid.latency_report()}
+
+    def run_overlapped(self, prompt_batches: Sequence[Sequence[Sequence[int]]],
+                       max_new_tokens: int = 16) -> Dict[str, object]:
+        """Continuous RLHF over the async weight-sync fleet (ISSUE 20):
+        rollouts, scoring, and publishes OVERLAP instead of alternating
+        behind the eval()/train() flip barrier.
+
+        The shape: batch ``i+1`` is submitted to the started fleet (its
+        replica threads decode in the background) BEFORE batch ``i`` is
+        scored and trained on; each optimizer step's publish is the
+        async retain-and-kick (O(tree bytes) + first gossip hop), so the
+        in-flight batch never stalls on a fleet-wide stage/commit —
+        deliveries land at tick boundaries via the deferred staged swap.
+        Records are stamped with the weight version that ACTUALLY served
+        them (a replica mid-gossip answers from its previous committed
+        version — stale-but-honest, bounded by the staleness window), so
+        ``weight_versions`` here is a per-batch ``{version: count}``
+        census rather than the serial loop's single stamp. Requires
+        ``router.sync.enabled``; the serial :meth:`run` drives barrier
+        fleets."""
+        import time as _time
+
+        hybrid = self.hybrid
+        hybrid.eval()
+        router = hybrid.router
+        if getattr(router, "_async_sync", None) is None:
+            raise RuntimeError(
+                "run_overlapped needs the async weight-sync fleet "
+                "(router.sync.enabled); use run() for barrier publishes")
+        batches = [list(b) for b in prompt_batches]
+        if not batches:
+            return {"steps": 0, "losses": [], "mean_rewards": [],
+                    "weight_versions": [], "rollouts_logged": len(self.log),
+                    "latency": hybrid.latency_report()}
+        # the lazy fleet build above already gathered CURRENT training
+        # weights onto every replica (first build IS the publish), so the
+        # first batch needs no barrier — decoding starts immediately
+        router.start()
+
+        def _submit(prompts):
+            return [(list(p), router.submit(list(p),
+                                            max_new_tokens=max_new_tokens))
+                    for p in prompts]
+
+        def _collect(submitted):
+            uids = [u for _, u in submitted]
+            while not all(router.requests[u].state in ("finished", "failed")
+                          for u in uids):
+                _time.sleep(0.002)
+            records = []
+            for p, u in submitted:
+                r = router.requests[u]
+                wv = (r.weight_version if r.weight_version is not None
+                      else (hybrid.weight_version or 0))
+                rec = RolloutRecord(prompt=p, tokens=list(r.generated),
+                                    weight_version=int(wv), uid=u)
+                if self.reward_fn is not None:
+                    rec.reward = float(self.reward_fn(rec.prompt, rec.tokens))
+                records.append(rec)
+            self.log.extend(records)
+            return records
+
+        losses, mean_rewards, versions = [], [], []
+        try:
+            submitted = _submit(batches[0])
+            for nxt in batches[1:] + [None]:
+                records = _collect(submitted)
+                # the NEXT batch starts decoding now — scoring, the
+                # train step, and the publish below all overlap with it
+                submitted = _submit(nxt) if nxt is not None else None
+                mean_rewards.append(
+                    float(np.mean([r.reward or 0.0 for r in records])))
+                census: Dict[int, int] = {}
+                for r in records:
+                    census[r.weight_version] = \
+                        census.get(r.weight_version, 0) + 1
+                versions.append(census)
+                losses.append(float(hybrid.train_batch(
+                    self.pg_batch(records))))
+                hybrid.publish_weights()
+        finally:
+            router.stop()
+        return {"steps": len(losses), "losses": losses,
+                "mean_rewards": mean_rewards, "weight_versions": versions,
+                "rollouts_logged": len(self.log),
+                "staleness": router._async_sync.staleness(),
+                "latency": hybrid.latency_report()}

@@ -1,0 +1,408 @@
+"""Asynchronous shuffle-exchange weight sync for the serving fleet (ISSUE 20).
+
+The repo's namesake decentralized schedules (``runtime/sync/decentralized.py``
+— RR / shuffle-ring / H-RR / Gossip, SURVEY §2.1) applied to the serving
+side: trainer(s) and N serving replicas are PEERS on the shuffle-exchange
+topology, and a weight publish is no longer an O(fleet) two-phase barrier.
+Instead the trainer stamps a new version, hands the tree to this
+coordinator, and background sync steps move it along the schedule's edges —
+each delivery rides the byte-exact :class:`rlhf.publish.WeightWire`
+substrate and lands on the receiving replica through the existing
+``stage_weights`` / ``commit_staged_weights(defer=True)`` seam, so serving
+ticks never stall on a publish.
+
+Propagation is **newest-version-wins**: the serving fleet holds *copies* of
+trainer versions (the trainer is the sole version source), so mixing along
+an edge degenerates to "adopt the newer version" — exactly the
+shuffle-exchange communication pattern with the averaging replaced by
+version adoption, which keeps every replica's weights a *committed,
+stamped* tree at all times (stale-but-honest: ``weight_version`` stamping,
+KV version-refusal, and ``ReplayLog.verify()`` audit it).
+
+Two contracts bound the asynchrony:
+
+- **bounded staleness**: no ACTIVE peer may trail the newest published
+  version by ``staleness_window`` or more — a peer about to exceed it gets
+  a forced catch-up edge on the next :meth:`step`, ahead of the schedule.
+- **:meth:`converge`** reduces to the reference's ``synchronization()``
+  full-average on demand: gather every active peer's tree, apply the
+  uniform ``synchronization_matrix()`` via ``apply_mixing`` (the training
+  path's mixing kernel), and install the SAME averaged tree on every peer
+  — bit-equal across peers by construction, matching the reference
+  full-average row.
+"""
+
+from __future__ import annotations
+
+import threading
+from types import SimpleNamespace
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..rlhf.publish import WeightWire
+from ..runtime.sync.decentralized import DecentralizedSync, apply_mixing
+from ..testing import sanitizer
+from ..utils.invariants import (atomic_on_reject, locked_by, lock_rank,
+                                requires_lock)
+
+
+@locked_by("_mu", "_versions", "_trees", "_active", "edge_exchanges",
+           "forced_catchups", "sync_steps", "failed_exchanges")
+class AsyncWeightSync:
+    """Peer-version bookkeeping + edge scheduler for async weight sync.
+
+    ``n_trainers`` peers (indices ``0..n_trainers-1``) are version
+    *sources*: they publish, they are never applied to. The next
+    ``n_replicas`` peers are serving replicas; a delivery to replica ``r``
+    calls ``apply_fn(r, tree, version)`` — the router's closure that
+    stages + defer-commits onto the live engine (threads) or RPCs the
+    frames to the worker (process fleet). ``apply_fn`` runs WITH ``_mu``
+    held (rank 5) and may take the replica's rank-10 lock — that ordering
+    is the reason the rank exists (utils/invariants.LOCK_ORDER).
+
+    The coordinator is deliberately transport-agnostic about the *fleet*:
+    it never imports router/procfleet types. It owns the topology
+    (:class:`DecentralizedSync` over ``n_trainers + n_replicas`` peers),
+    the retained host trees per live version, the per-peer version map,
+    and the staleness accounting the monitor surfaces."""
+
+    def __init__(self, cfg, n_replicas: int,
+                 apply_fn: Callable[[int, object, int], None],
+                 n_trainers: int = 1,
+                 wire: Optional[WeightWire] = None):
+        if n_replicas < 1:
+            raise ValueError(f"AsyncWeightSync needs >= 1 replica peer, "
+                             f"got {n_replicas}")
+        if n_trainers < 1:
+            raise ValueError(f"AsyncWeightSync needs >= 1 trainer peer, "
+                             f"got {n_trainers}")
+        self.cfg = cfg
+        self.n_trainers = int(n_trainers)
+        self.n_replicas = int(n_replicas)
+        self.n_peers = self.n_trainers + self.n_replicas
+        self.apply_fn = apply_fn
+        self.wire = wire if wire is not None else WeightWire()
+        # The topology engine — the SAME schedule generator training runs
+        # (method/rings/shuffle_step/gossip_prob live on a config shim so
+        # the serving AsyncSyncConfig does not have to subclass the
+        # training ShuffleExchangeConfig).
+        self._dsync = self._make_dsync(seed=cfg.seed)
+        assert lock_rank("AsyncWeightSync._mu") is not None, \
+            "AsyncWeightSync._mu must carry a declared LOCK_ORDER rank"
+        self._mu = sanitizer.wrap(threading.Lock(), "AsyncWeightSync._mu")
+        # peer -> newest version its serving weights are stamped with.
+        # Peers start at 0 = "the weights the fleet booted with".
+        self._versions: List[int] = [0] * self.n_peers
+        self._active: List[bool] = [True] * self.n_peers
+        # version -> retained host tree (byte-exact wire output); pruned
+        # once every active peer has moved past it.
+        self._trees: Dict[int, object] = {}
+        self.edge_exchanges = 0
+        self.forced_catchups = 0
+        self.failed_exchanges = 0
+        self.sync_steps = 0
+
+    def _make_dsync(self, seed: int) -> DecentralizedSync:
+        """Build the topology over the current peer count. Serving peer
+        counts are arbitrary (trainers + N replicas), so ring counts
+        snap to the largest divisor <= cfg.rings for the shuffle method,
+        and H-RR over an odd peer count falls back to RR (the reference
+        hard-codes two hierarchy levels; RR is the identical mixing)."""
+        method = self.cfg.method
+        rings = max(1, min(int(self.cfg.rings), self.n_peers))
+        if method == "shuffle":
+            while self.n_peers % rings:
+                rings -= 1
+        if method == "H-RR" and self.n_peers % 2:
+            method = "RR"
+        return DecentralizedSync(
+            SimpleNamespace(method=method, rings=rings,
+                            shuffle_step=self.cfg.shuffle_step,
+                            gossip_prob=self.cfg.gossip_prob),
+            self.n_peers, seed=seed)
+
+    # -- introspection ------------------------------------------------
+
+    @property
+    def newest_version(self) -> int:
+        with self._mu:
+            return self._newest()
+
+    @requires_lock("_mu")
+    def _newest(self) -> int:
+        live = [v for v, a in zip(self._versions, self._active) if a]
+        return max(live) if live else 0
+
+    def versions(self) -> List[int]:
+        """Per-peer version snapshot (trainers first, then replicas)."""
+        with self._mu:
+            return list(self._versions)
+
+    def replica_version(self, r: int) -> int:
+        with self._mu:
+            return self._versions[self.n_trainers + r]
+
+    def staleness(self) -> Dict[str, int]:
+        """The monitor's view: how far the fleet trails the newest
+        published version. ``staleness_max`` folds by MAX across the
+        fleet (FleetMonitor.aggregate)."""
+        with self._mu:
+            newest = self._newest()
+            behind = [newest - self._versions[self.n_trainers + r]
+                      for r in range(self.n_replicas)
+                      if self._active[self.n_trainers + r]]
+            return {
+                "staleness_max": max(behind) if behind else 0,
+                "versions_behind": sum(behind),
+                "edge_exchanges": self.edge_exchanges,
+                "forced_catchups": self.forced_catchups,
+                "failed_exchanges": self.failed_exchanges,
+                "sync_steps": self.sync_steps,
+            }
+
+    # -- peer liveness (failover compose) ------------------------------
+
+    def deactivate_peer(self, r: int) -> None:
+        """A replica died (health DEAD): drop it from the schedule and
+        the staleness accounting. Its last committed version stays
+        recorded for a later :meth:`reactivate_peer`."""
+        with self._mu:
+            self._active[self.n_trainers + r] = False
+
+    def reactivate_peer(self, r: int, version: int = 0) -> None:
+        """A replacement replica joined at ``version`` (the router's
+        catch-up publish stamps it). It re-enters the schedule and the
+        bounded-staleness contract immediately."""
+        with self._mu:
+            p = self.n_trainers + r
+            self._active[p] = True
+            self._versions[p] = int(version)
+            self._prune()
+
+    def add_peer(self) -> int:
+        """Grow the fleet by one replica peer (scale-up). The topology
+        is rebuilt over the new peer count — ring assignment
+        re-randomizes exactly as a shuffle step would."""
+        with self._mu:
+            self.n_replicas += 1
+            self.n_peers += 1
+            self._versions.append(0)
+            self._active.append(True)
+            self._dsync = self._make_dsync(
+                seed=self.cfg.seed + self.sync_steps)
+            return self.n_replicas - 1
+
+    def catch_up(self, r: int) -> bool:
+        """Deliver the newest retained version straight to replica ``r``
+        (scale-up catch-up: a newcomer rebuilt the spec's version-0
+        weights and should not wait a full gossip propagation to serve
+        current ones). No-op when nothing has been published or the peer
+        is already current. Returns True when a delivery applied."""
+        with self._mu:
+            newest = self._newest()
+            p = self.n_trainers + r
+            if (newest not in self._trees or not self._active[p]
+                    or self._versions[p] >= newest):
+                return False
+            ok = self._deliver(p, newest)
+            if ok:
+                self.forced_catchups += 1
+            self._prune()
+            return ok
+
+    # -- publish (trainer side) ----------------------------------------
+
+    def publish(self, tree, version: int, trainer: int = 0):
+        """A trainer peer stamps a new version. The tree crosses the
+        :class:`WeightWire` ONCE here (byte-exact host copy retained for
+        every later edge delivery); no replica is touched — propagation
+        is :meth:`step`'s job, so this returns in O(tree bytes), not
+        O(fleet). Returns the retained host tree (callers that want an
+        eager first hop can pass it straight to ``kick``)."""
+        version = int(version)
+        ticket = self.wire.send(tree)
+        try:
+            retained = self.wire.recv(ticket)
+        except BaseException:
+            self.wire.cancel(ticket)
+            raise
+        with self._mu:
+            if version <= max(self._versions[:self.n_trainers]):
+                raise ValueError(
+                    f"async publish version {version} is not newer than the "
+                    f"trainer's current "
+                    f"{max(self._versions[:self.n_trainers])} — versions are "
+                    f"the monotone optimizer-step watermark")
+            self._trees[version] = retained
+            self._versions[trainer] = version
+        return retained
+
+    # -- the sync step (background loop / tick piggyback) ---------------
+
+    def step(self) -> int:
+        """One edge round: draw this step's mixing matrix from the
+        decentralized schedule, adopt newer versions along its
+        off-diagonal edges, then force catch-up edges for any peer about
+        to violate the staleness window. Returns the number of
+        deliveries applied. A delivery that raises (peer dying
+        mid-gossip) leaves that peer on its previous committed version —
+        the failover machinery owns the corpse; sync just counts it."""
+        with self._mu:
+            self.sync_steps += 1
+            self._dsync.shuffle_exchange()
+            m = np.asarray(self._dsync.advance())
+            newest = self._newest()
+            window = int(self.cfg.staleness_window)
+            deliveries = []  # (peer, version, forced)
+            planned = {}
+            for i in range(self.n_peers):
+                if i < self.n_trainers or not self._active[i]:
+                    continue
+                partners = [j for j in range(self.n_peers)
+                            if j != i and m[i, j] > 0 and self._active[j]]
+                if partners:
+                    best = max(partners, key=lambda j: self._versions[j])
+                    v = self._versions[best]
+                    if v > self._versions[i]:
+                        planned[i] = (v, False)
+            for r in range(self.n_replicas):
+                i = self.n_trainers + r
+                if not self._active[i]:
+                    continue
+                v = planned.get(i, (self._versions[i], False))[0]
+                # the staleness contract: if after this round the peer
+                # would still trail by >= window, force a direct
+                # catch-up to the newest version, ahead of the schedule
+                if newest - v >= window:
+                    planned[i] = (newest, True)
+            deliveries = [(i, v, forced)
+                          for i, (v, forced) in sorted(planned.items())]
+            applied = 0
+            for i, v, forced in deliveries:
+                if self._deliver(i, v):
+                    applied += 1
+                    if forced:
+                        self.forced_catchups += 1
+            self._prune()
+        return applied
+
+    def kick(self, version: Optional[int] = None) -> int:
+        """Deliver ``version`` (default newest) to the trainer's CURRENT
+        edge partners only — the publish-time first hop that replaces
+        the all-replica barrier. O(edge degree), not O(fleet)."""
+        with self._mu:
+            v = self._newest() if version is None else int(version)
+            m = np.asarray(self._dsync.current_matrix())
+            applied = 0
+            for t in range(self.n_trainers):
+                for i in range(self.n_trainers, self.n_peers):
+                    if not self._active[i] or self._versions[i] >= v:
+                        continue
+                    if m[i, t] > 0 or m[t, i] > 0:
+                        if self._deliver(i, v):
+                            applied += 1
+            self._prune()
+            return applied
+
+    @atomic_on_reject(check="validate")
+    @requires_lock("_mu")
+    def _deliver(self, peer: int, version: int) -> bool:
+        """One edge delivery: wire the retained tree to the peer and
+        apply it through the staged-swap seam. Validates the retained
+        tree EXISTS before any mutation; a failed apply leaves the
+        peer's version untouched (it is still serving its previous
+        committed tree — stale-but-honest)."""
+        tree = self._trees.get(version)
+        if tree is None:
+            raise KeyError(
+                f"async sync: no retained tree for version {version} "
+                f"(retained: {sorted(self._trees)})")
+        ticket = self.wire.send(tree)
+        try:
+            delivered = self.wire.recv(ticket)
+            self.apply_fn(peer - self.n_trainers, delivered, version)
+        except BaseException:
+            self.wire.cancel(ticket)
+            self.failed_exchanges += 1
+            return False
+        self._versions[peer] = version
+        self.edge_exchanges += 1
+        return True
+
+    @requires_lock("_mu")
+    def _prune(self) -> None:
+        live = [v for v, a in zip(self._versions, self._active) if a]
+        floor = min(live) if live else 0
+        for v in [v for v in self._trees if v < floor]:
+            del self._trees[v]
+
+    # -- converge: the reference synchronization() full-average ---------
+
+    def converge(self, gather_fn: Optional[Callable[[int], object]] = None,
+                 version: Optional[int] = None):
+        """Reduce the fleet to the reference ``synchronization()``
+        full-average: gather every ACTIVE peer's current tree
+        (``gather_fn(peer)`` — trainers included; the trainer's tree is
+        its newest retained publish), mix with the uniform
+        ``synchronization_matrix()`` through the training path's
+        ``apply_mixing``, and install ONE averaged tree (row 0 of the
+        mixed stack) on every replica peer — bit-equal across peers by
+        construction. Mints ``version`` (default newest+1: the averaged
+        weights are new weights; replay at older versions is untouched).
+        Returns ``(tree, version)``."""
+        import jax
+
+        with self._mu:
+            peers = [p for p in range(self.n_peers) if self._active[p]]
+            if gather_fn is None:
+                # default: every peer serves a byte-copy of a retained
+                # published version, so its "current tree" IS that
+                # retained tree — no engine access needed. A peer still
+                # on unpublished boot weights (version with no retained
+                # tree) is force-caught-up to the newest version first:
+                # boot weights never crossed the wire, so they cannot
+                # contribute to the average.
+                newest = self._newest()
+                if newest not in self._trees:
+                    raise RuntimeError(
+                        "converge: nothing has been published yet — the "
+                        "full-average is over published versions")
+                for p in peers:
+                    if (p >= self.n_trainers
+                            and self._versions[p] not in self._trees):
+                        self._deliver(p, newest)
+                trees = [self._trees.get(self._versions[p],
+                                         self._trees[newest])
+                         for p in peers]
+            else:
+                trees = [gather_fn(p) for p in peers]
+            stacked = jax.tree_util.tree_map(
+                lambda *ls: np.stack([np.asarray(x) for x in ls]), *trees)
+            # the reference synchronization() full-average over the LIVE
+            # peer set: with every peer active this is exactly
+            # self._dsync.synchronization_matrix(); after a failover the
+            # uniform row shrinks to the survivors (a dead peer's stale
+            # tree must not drag the average — and the stack above only
+            # holds active peers' trees)
+            k = len(trees)
+            uniform = (self._dsync.synchronization_matrix()
+                       if k == self.n_peers
+                       else np.full((k, k), 1.0 / k, dtype=np.float32))
+            mixed = apply_mixing(stacked, uniform)
+            avg = jax.tree_util.tree_map(lambda l: np.asarray(l[0]), mixed)
+            v = (self._newest() + 1) if version is None else int(version)
+            ticket = self.wire.send(avg)
+            try:
+                retained = self.wire.recv(ticket)
+            except BaseException:
+                self.wire.cancel(ticket)
+                raise
+            self._trees[v] = retained
+            for t in range(self.n_trainers):
+                self._versions[t] = v
+            for p in peers:
+                if p >= self.n_trainers:
+                    self._deliver(p, v)
+            self._prune()
+        return retained, v

@@ -165,8 +165,25 @@ class ProcessReplicaRouter:
         self._published_adapters: Dict[str, Tuple[dict,
                                                   List[np.ndarray]]] = {}
         self._metrics_step = 0
+        # async shuffle-exchange weight sync (ISSUE 20): built after the
+        # spawn loop so the coordinator's peer count matches the fleet.
+        # Deaths discovered INSIDE a delivery (_sync_apply -> _call ->
+        # _fail_over) are deferred into _sync_dead and drained at the top
+        # of sync_step(): deactivate_peer takes the coordinator's _mu,
+        # which _deliver already holds at that point — safe because this
+        # router is a single-threaded control loop.
+        self._async_sync = None
+        self._sync_dead: set = set()
+        self.publish_stage_s = 0.0
+        self.publish_commit_s = 0.0
+        self.publish_bytes = 0
         for _ in range(self.n_replicas):
             self.spawn_replica()
+        if self.rcfg.sync.enabled:
+            from .async_sync import AsyncWeightSync
+            self._async_sync = AsyncWeightSync(
+                self.rcfg.sync, n_replicas=self._next_rid,
+                apply_fn=self._sync_apply)
 
     # -- membership -----------------------------------------------------
 
@@ -247,6 +264,15 @@ class ProcessReplicaRouter:
             raise
         self.workers[rid] = h
         self.health.register(rid)
+        sync = getattr(self, "_async_sync", None)
+        if sync is not None:
+            # a replacement/newcomer rejoins the gossip schedule at the
+            # spec weights (version 0) — catch_up in scale_to / sync_step
+            # brings it forward from the retained newest tree
+            if rid >= sync.n_replicas:
+                sync.add_peer()
+            sync.reactivate_peer(rid, version=0)
+            self._sync_dead.discard(rid)
         logger.info(f"procfleet: worker {rid} up (pid {h.pid}, port "
                     f"{h.port})")
         return h
@@ -271,7 +297,18 @@ class ProcessReplicaRouter:
         grown = 0
         while len(self.active_workers) < n:
             h = self.spawn_replica()
-            if self.published_version is not None:
+            if self._async_sync is not None:
+                # the async coordinator RETAINS the newest published tree
+                # (byte-exact wire copy), so the newcomer is caught up
+                # here instead of waiting a full gossip propagation — no
+                # republish from the caller needed
+                caught = self._async_sync.catch_up(h.replica_id)
+                if caught:
+                    logger.info(
+                        f"procfleet: worker {h.replica_id} caught up to "
+                        f"version {self._async_sync.newest_version} from "
+                        f"the retained publish")
+            elif self.published_version is not None:
                 # a fresh worker rebuilt version-0 weights from the spec;
                 # republishing to IT alone would need the tree — the
                 # caller republished through publish_weights, which
@@ -602,6 +639,12 @@ class ProcessReplicaRouter:
         h.state = DEAD
         self.failovers += 1
         self.health.mark_dead(replica_id, reason, engine_reachable)
+        if self._async_sync is not None:
+            # deferred, NOT deactivate_peer here: this very failover may
+            # have been classified inside an edge delivery (_sync_apply
+            # under the coordinator's _mu) — sync_step drains the set
+            # before its next round, outside any delivery
+            self._sync_dead.add(replica_id)
         try:
             h.proc.kill()
         except OSError:
@@ -695,6 +738,10 @@ class ProcessReplicaRouter:
             h.proc.kill()
         h.client.close()
         self.health.retire(replica_id)
+        if self._async_sync is not None:
+            # drain runs from user code, never inside an edge delivery —
+            # direct deactivation is safe here
+            self._async_sync.deactivate_peer(replica_id)
         self._place_pending()
         return len(exported)
 
@@ -707,9 +754,15 @@ class ProcessReplicaRouter:
         on the OLD version — the PR 10 atomicity bar). A worker dying
         between its stage and its commit fails over; the survivors'
         commits proceed (its replacement rebuilds from the spec and is
-        republished by the caller)."""
+        republished by the caller).
+
+        With ``router.sync.enabled`` (ISSUE 20) the barrier is replaced:
+        the tree is retained once and flows to workers edge-by-edge over
+        the decentralized schedule — see :meth:`_publish_async`."""
         import jax
 
+        if self._async_sync is not None:
+            return self._publish_async(params, version)
         leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
         if version is None:
             version = (self.published_version or 0) + 1
@@ -745,6 +798,94 @@ class ProcessReplicaRouter:
         self.published_version = version
         self.weight_publishes += 1
         return version
+
+    # -- async shuffle-exchange weight sync (ISSUE 20) -------------------
+
+    def _sync_apply(self, rid: int, tree, version: int) -> None:
+        """One edge delivery onto a worker process: the coordinator's
+        ``apply_fn``. Ships the host leaves over the RPC frames and
+        defer-commits, so the worker's tick boundary does the flip.
+        Runs with the coordinator's ``_mu`` held; a death classified by
+        ``_call`` lands in ``_sync_dead`` (via ``_fail_over``) rather
+        than re-entering the coordinator, and the raise makes
+        ``_deliver`` count a failed exchange."""
+        import jax
+
+        h = self.workers.get(rid)
+        if h is None or h.state != ACTIVE:
+            raise RuntimeError(f"sync apply: worker {rid} is not ACTIVE")
+        leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+        self._call(h, "stage_weights", {"version": int(version)},
+                   bufs=leaves)
+        self._call(h, "commit_weights", {"defer": True})
+
+    def _publish_async(self, params, version: Optional[int]) -> int:
+        """The barrier-free publish: retain the tree once (O(tree
+        bytes)), kick the trainer's current edge partners, and let
+        ``sync_step`` (driven from the serve loop) propagate the rest.
+        No fleet-wide stage/commit fan-out, no rollback choreography —
+        a worker that never hears this version keeps serving its
+        previous committed one (stale-but-honest, bounded by the
+        staleness window)."""
+        import jax
+
+        sync = self._async_sync
+        if not self.active_workers:
+            raise NoActiveReplicaError("no ACTIVE worker to publish to")
+        if version is None:
+            version = max(sync.newest_version,
+                          self.published_version or 0) + 1
+        version = int(version)
+        t0 = self.clock()
+        retained = sync.publish(params, version)
+        stage_dt = self.clock() - t0
+        t1 = self.clock()
+        kicked = sync.kick(version)
+        commit_dt = self.clock() - t1
+        self.weight_publishes += 1
+        self.published_version = version
+        self.publish_stage_s += stage_dt
+        self.publish_commit_s += commit_dt
+        self.publish_bytes += sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(retained))
+        logger.info(
+            f"procfleet: async publish v{version} retained in "
+            f"{stage_dt * 1e3:.1f}ms, first hop reached {kicked} edge "
+            f"partner(s); gossip owns the rest (window "
+            f"{self.rcfg.sync.staleness_window})")
+        return version
+
+    def sync_step(self) -> int:
+        """One background sync round. Deaths discovered inside an edge
+        delivery were deferred into ``_sync_dead`` (the coordinator's
+        ``_mu`` was held there) — drain them into ``deactivate_peer``
+        first, outside any delivery, then run the edge round."""
+        sync = self._async_sync
+        if sync is None:
+            return 0
+        while self._sync_dead:
+            sync.deactivate_peer(self._sync_dead.pop())
+        return sync.step()
+
+    def converge(self) -> int:
+        """Reduce the fleet to the reference ``synchronization()``
+        full-average on demand and record the minted version (see
+        ``AsyncWeightSync.converge``). Every ACTIVE worker lands on the
+        SAME averaged tree — bit-equal across processes because one
+        retained host tree crosses the wire to all of them."""
+        sync = self._async_sync
+        if sync is None:
+            raise RuntimeError(
+                "converge: async sync is disabled (router.sync.enabled)")
+        while self._sync_dead:
+            sync.deactivate_peer(self._sync_dead.pop())
+        _tree, version = sync.converge()
+        self.weight_publishes += 1
+        self.published_version = int(version)
+        logger.info(f"procfleet: converge() installed full-average "
+                    f"v{version} on every ACTIVE worker")
+        return int(version)
 
     def publish_adapter(self, adapter_id: str, factors,
                         alpha: Optional[float] = None,
@@ -907,6 +1048,11 @@ class ProcessReplicaRouter:
                 continue
             self.poll()
             self.check_health()
+            if self._async_sync is not None:
+                # the background gossip round rides the control loop —
+                # one edge set per iteration, never blocking a worker's
+                # tick (deliveries defer-commit at tick boundaries)
+                self.sync_step()
             self._place_pending()
             # serve() has no revive hook: with zero survivors nobody will
             # ever adopt the pending requests — fail them typed, don't hang
@@ -953,6 +1099,12 @@ class ProcessReplicaRouter:
             "requeued": self.requeued,
             "weight_publishes": self.weight_publishes,
             "published_version": self.published_version,
+            "publish": {"stage_s": self.publish_stage_s,
+                        "commit_s": self.publish_commit_s,
+                        "bytes": self.publish_bytes},
+            "sync": (dict(self._async_sync.staleness(), enabled=True)
+                     if self._async_sync is not None
+                     else {"enabled": False}),
             "adapter_publishes": self.adapter_publishes,
             "published_adapters": sorted(self._published_adapters),
             "sustained_tokens_per_sec": (total / span) if span > 0 else None,
@@ -986,6 +1138,18 @@ class ProcessReplicaRouter:
             "failover/reprefill_tokens": self.reprefill_tokens,
             "shed/rejected": self.shed,
         }
+        # getattr: duck-typed fleets (tests/metrics shims) carry only the
+        # core counters; the publish/sync groups default to quiet zeros
+        vals["publish/stage_s"] = getattr(self, "publish_stage_s", 0.0)
+        vals["publish/commit_s"] = getattr(self, "publish_commit_s", 0.0)
+        vals["publish/bytes"] = getattr(self, "publish_bytes", 0)
+        sync = getattr(self, "_async_sync", None)
+        if sync is not None:
+            st = sync.staleness()
+            vals["sync/edge_exchanges"] = st["edge_exchanges"]
+            vals["sync/staleness_max"] = st["staleness_max"]
+            vals["sync/versions_behind"] = st["versions_behind"]
+            vals["sync/forced_catchups"] = st["forced_catchups"]
         self._metrics_step += 1
         fleet_monitor.write_events(
             [(label, v, self._metrics_step) for label, v in vals.items()])

@@ -130,7 +130,8 @@ class Replica:
            "failovers", "recovered", "migrated_sequences",
            "migrated_blocks", "reprefill_tokens", "quarantined",
            "retries_exhausted", "shed", "_channel",
-           "adapter_publishes", "_published_adapters")
+           "adapter_publishes", "_published_adapters",
+           "publish_stage_s", "publish_commit_s", "publish_bytes")
 class ReplicaRouter:
     """Place requests across replicas; tick them; aggregate their stats.
 
@@ -214,8 +215,24 @@ class ReplicaRouter:
         # that tenant's requests)
         self.adapter_publishes = 0
         self._published_adapters: Dict[str, tuple] = {}
+        # async shuffle-exchange weight sync (ISSUE 20): when
+        # rcfg.sync.enabled, publishes stage only to the trainer peer's
+        # current edge partners and a background loop (or cooperative
+        # tick piggyback) spreads the version along the decentralized
+        # schedule — built after the replica roster below so the peer
+        # count is known. Publish-path meters ride the same roster.
+        self._async_sync = None
+        self._sync_thread: Optional[threading.Thread] = None
+        self.publish_stage_s = 0.0
+        self.publish_commit_s = 0.0
+        self.publish_bytes = 0
         for eng in engines:
             self._add_replica(eng)
+        if self.rcfg.sync.enabled:
+            from .async_sync import AsyncWeightSync
+            self._async_sync = AsyncWeightSync(
+                self.rcfg.sync, n_replicas=len(self.replicas),
+                apply_fn=self._sync_apply)
 
     # -- fleet membership ----------------------------------------------
 
@@ -239,6 +256,13 @@ class ReplicaRouter:
                                          version=ver)
         self.replicas.append(rep)
         self.health.register(rid)
+        # async sync (ISSUE 20): a scale-up replica joins the topology as
+        # a fresh peer, already caught up to the published version above
+        sync = getattr(self, "_async_sync", None)
+        if sync is not None:
+            if rid >= sync.n_replicas:
+                sync.add_peer()
+            sync.reactivate_peer(rid, version=self.published_version or 0)
         return rep
 
     def _emit_token(self, uid: int, tok: int) -> None:
@@ -434,6 +458,12 @@ class ReplicaRouter:
             else:
                 self._on_tick_failure(rep, err)
                 busy = True   # failed-over work now lives on survivors
+        # cooperative drivers (serve()/direct tick loops) advance the
+        # async weight sync here; the threaded driver has its own loop
+        if self._async_sync is not None and (
+                self._sync_thread is None
+                or not self._sync_thread.is_alive()):
+            self.sync_step()
         return busy
 
     def request_drain(self, replica_id: int) -> None:
@@ -572,6 +602,11 @@ class ReplicaRouter:
                 return 0
             rep.state = STOPPED
             self.health.mark_dead(replica_id, reason, engine_reachable)
+            if self._async_sync is not None:
+                # the dead peer leaves the gossip schedule mid-exchange;
+                # its last committed version stays recorded, so a
+                # replacement re-enters via _add_replica's reactivation
+                self._async_sync.deactivate_peer(replica_id)
             self.failovers += 1
             victims = sorted(
                 uid for uid, rid in self.owner.items()
@@ -850,6 +885,13 @@ class ReplicaRouter:
                 target=self._health_loop, daemon=True,
                 name="serving-health-monitor")
             self._health_thread.start()
+        if self._async_sync is not None and (
+                self._sync_thread is None
+                or not self._sync_thread.is_alive()):
+            self._sync_thread = threading.Thread(
+                target=self._sync_loop, daemon=True,
+                name="serving-weight-sync")
+            self._sync_thread.start()
 
     def _replica_loop(self, rep: Replica) -> None:
         while not self._stop.is_set() and rep.state != STOPPED:
@@ -889,6 +931,9 @@ class ReplicaRouter:
         if self._health_thread is not None:
             self._health_thread.join(timeout=5.0)
             self._health_thread = None
+        if self._sync_thread is not None:
+            self._sync_thread.join(timeout=5.0)
+            self._sync_thread = None
 
     # -- elastic lifecycle ---------------------------------------------
 
@@ -962,6 +1007,8 @@ class ReplicaRouter:
                     del self.sessions[sid]
             rep.state = STOPPED
             self.health.retire(replica_id)   # clean exit, not a symptom
+            if self._async_sync is not None:
+                self._async_sync.deactivate_peer(replica_id)
             self.drains += 1
             self.requeued += len(exported)
             self.fleet.write_events([
@@ -1046,9 +1093,17 @@ class ReplicaRouter:
 
         ``version`` stamps every replica's ``weight_version`` (default:
         one past the fleet's current max). Returns the published version.
+
+        With ``rcfg.sync.enabled`` (ISSUE 20) the barrier is gone: the
+        publish records the version with the async coordinator, stages
+        only to the trainer peer's CURRENT edge partners, and returns —
+        background sync steps spread it inside the bounded staleness
+        window (``_publish_async``).
         """
         from ..testing import faults
 
+        if self._async_sync is not None:
+            return self._publish_async(params, version)
         with self._lock:
             reps = [r for r in self.replicas if r.state != STOPPED]
             if not reps:
@@ -1099,6 +1154,121 @@ class ReplicaRouter:
             logger.info(f"router: published weight version {version} to "
                         f"{len(reps)} replicas")
             return version
+
+    # -- async shuffle-exchange weight sync (ISSUE 20) ------------------
+
+    def _sync_apply(self, rid: int, tree, version: int) -> None:
+        """One edge delivery landing on a replica: prepare+stage OUTSIDE
+        the replica lock (the expensive cast/quantize/place half), then
+        defer-commit under it — a host pointer flip the replica applies
+        at its next tick boundary, so a serving tick never stalls on the
+        publish. Runs with AsyncWeightSync._mu (rank 5) held; rep.lock
+        is rank 10 — ascending, per the declared order."""
+        rep = self.replicas[rid]
+        if rep.state == STOPPED:
+            raise RuntimeError(f"sync apply: replica {rid} is stopped")
+        rep.engine.stage_weights(tree, version=version)
+        with rep.lock:
+            rep.engine.commit_staged_weights(defer=True)
+
+    def _publish_async(self, params, version: Optional[int]) -> int:
+        """The barrier-free publish: wire the tree to the coordinator
+        (one byte-exact host copy retained), stamp the version, and
+        deliver only to the trainer peer's current edge partners —
+        O(edge degree), not O(fleet). Everyone else picks it up from
+        background :meth:`sync_step` rounds inside the staleness
+        window."""
+        import jax
+
+        sync = self._async_sync
+        t0 = self.clock()
+        with self._lock:
+            reps = [r for r in self.replicas if r.state != STOPPED]
+            if not reps:
+                raise RuntimeError(
+                    "publish_weights: no live replicas (all stopped)")
+            if version is None:
+                version = max(sync.newest_version,
+                              max(r.engine.weight_version for r in reps)) + 1
+            version = int(version)
+            retained = sync.publish(params, version)
+            stage_dt = self.clock() - t0
+            t1 = self.clock()
+            kicked = sync.kick(version)
+            commit_dt = self.clock() - t1
+            self.weight_publishes += 1
+            self.published_version = version
+            self._published_weights = retained
+            self.publish_stage_s += stage_dt
+            self.publish_commit_s += commit_dt
+            self.publish_bytes += sum(
+                np.asarray(leaf).nbytes
+                for leaf in jax.tree_util.tree_leaves(retained))
+            self.fleet.write_events([
+                ("fleet/weight_version", version, self.weight_publishes),
+                ("fleet/weight_publishes", self.weight_publishes,
+                 self.weight_publishes),
+                ("publish/stage_s", stage_dt, self.weight_publishes),
+                ("publish/commit_s", commit_dt, self.weight_publishes),
+                ("publish/bytes", self.publish_bytes,
+                 self.weight_publishes)])
+            logger.info(
+                f"router: async-published weight version {version} "
+                f"(first hop: {kicked} edge partners; fleet converges "
+                f"inside staleness window "
+                f"{self.rcfg.sync.staleness_window})")
+            return version
+
+    def sync_step(self) -> int:
+        """One manual edge round of the async coordinator (tests and
+        cooperative drivers; the threaded driver runs these from the
+        loop ``start()`` spawns). Returns deliveries applied and
+        surfaces the staleness counters through the fleet monitor."""
+        sync = self._async_sync
+        if sync is None:
+            return 0
+        applied = sync.step()
+        st = sync.staleness()
+        self.fleet.write_events([
+            ("sync/edge_exchanges", st["edge_exchanges"],
+             st["sync_steps"]),
+            ("sync/staleness_max", st["staleness_max"], st["sync_steps"]),
+            ("sync/versions_behind", st["versions_behind"],
+             st["sync_steps"]),
+            ("sync/forced_catchups", st["forced_catchups"],
+             st["sync_steps"])])
+        return applied
+
+    def converge(self) -> int:
+        """Reduce the fleet to the reference ``synchronization()``
+        full-average on demand (SURVEY §2.1): every active peer's tree is
+        mixed with the uniform matrix and the SAME averaged tree lands on
+        every replica — bit-equal across peers. Returns the version the
+        converged weights are stamped with."""
+        sync = self._async_sync
+        if sync is None:
+            raise RuntimeError(
+                "converge: async sync is disabled (router.sync.enabled)")
+        tree, version = sync.converge()
+        with self._lock:
+            self.weight_publishes += 1
+            self.published_version = version
+            self._published_weights = tree
+            self.fleet.write_events([
+                ("fleet/weight_version", version, self.weight_publishes),
+                ("fleet/weight_publishes", self.weight_publishes,
+                 self.weight_publishes)])
+        logger.info(f"router: fleet converged to full-average at version "
+                    f"{version}")
+        return version
+
+    def _sync_loop(self) -> None:
+        interval = self.rcfg.sync.sync_interval_s
+        while not self._stop.wait(interval):
+            try:
+                self.sync_step()
+            except Exception:
+                logger.exception("async weight-sync step failed")
 
     @atomic_on_reject(check="validate")
     def publish_adapter(self, adapter_id: str, factors, alpha=None,
@@ -1201,6 +1371,16 @@ class ReplicaRouter:
             "published_version": self.published_version,
             "weight_versions": {r.replica_id: r.engine.weight_version
                                 for r in self.replicas},
+            # async shuffle-exchange sync (ISSUE 20): publish-path timing
+            # plus the coordinator's staleness/propagation counters
+            "publish": {
+                "stage_s": self.publish_stage_s,
+                "commit_s": self.publish_commit_s,
+                "bytes": self.publish_bytes,
+            },
+            "sync": (dict(self._async_sync.staleness(), enabled=True)
+                     if self._async_sync is not None
+                     else {"enabled": False}),
             # fleet-aggregated speculative group (ISSUE 8): sums over
             # replicas; acceptance_rate re-derived from the sums so it is
             # token-weighted, not an average of per-replica averages

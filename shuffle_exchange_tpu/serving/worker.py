@@ -342,7 +342,12 @@ class ReplicaWorker:
         (plain int reads) so it stays answerable mid-compile."""
         rep = dict(self.scheduler.load())
         rep.update(pid=os.getpid(), ticks=self.ticks,
-                   tick_errors=self.tick_errors, last_error=self.last_error)
+                   tick_errors=self.tick_errors, last_error=self.last_error,
+                   # the stamped serving version rides every reply so the
+                   # router can audit async-sync staleness (ISSUE 20)
+                   # without a dedicated call — a plain int read, still
+                   # answerable mid-compile
+                   weight_version=self.engine.weight_version)
         return rep
 
     # -- handlers --------------------------------------------------------

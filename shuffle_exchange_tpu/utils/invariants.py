@@ -69,6 +69,12 @@ LOCK_ORDER = {
     # across placement decisions and failover re-homing; must NEVER wait
     # on anything below (the PR 11 incident shape).
     "ReplicaRouter._lock": 0,
+    # rank 5 — async weight-sync peer state (ISSUE 20): per-peer version
+    # map, edge schedule, staleness accounting. Sits BETWEEN the router
+    # lock and the replica locks because a sync step holds it while
+    # staging/committing onto a replica (rank 10), and the router's
+    # publish path may take it while already holding rank 0.
+    "AsyncWeightSync._mu": 5,
     # rank 10 — one replica's scheduler guard (tick vs submit/inject/
     # export). The tick dispatch runs under it, so nothing that can be
     # held while a tick is in flight may rank above it. The process

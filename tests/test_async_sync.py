@@ -1,0 +1,377 @@
+"""Async shuffle-exchange weight sync (ISSUE 20): the staleness state
+machine, end to end.
+
+What this file pins:
+
+- **Bounded staleness** — no ACTIVE replica trails the newest published
+  version by ``staleness_window`` or more after a sync step: a peer about
+  to violate the window gets a forced catch-up edge ahead of the schedule
+  (unit property with gossip disabled, and a fleet-level property over the
+  ``weight_version`` stamped on every served request).
+- **Stale-but-honest stamping** — a request served by a replica behind
+  the newest publish is stamped with the version that ACTUALLY produced
+  its tokens, and greedy replay at that stamped version is
+  token-identical (the replay-audit contract).
+- **Crash mid-gossip** — a replica dying leaves every surviving peer on a
+  committed version with zero lost requests; the survivors still
+  converge.
+- **converge() == synchronization()** — the on-demand full-average is
+  bit-equal to ``apply_mixing`` with the reference's uniform
+  ``synchronization_matrix`` row, and every peer receives the SAME bytes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shuffle_exchange_tpu.config import ConfigError
+from shuffle_exchange_tpu.inference import InferenceConfig, InferenceEngineV2
+from shuffle_exchange_tpu.inference.config import AsyncSyncConfig
+from shuffle_exchange_tpu.models import Transformer, tiny
+from shuffle_exchange_tpu.serving import ReplicaRouter
+from shuffle_exchange_tpu.serving.async_sync import AsyncWeightSync
+
+
+# ---------------------------------------------------------------------------
+# unit: the coordinator's state machine (no engines, fake apply)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(enabled=True, method="Gossip", gossip_prob=1.0,
+                staleness_window=4, seed=0)
+    base.update(kw)
+    return AsyncSyncConfig(**base)
+
+
+def _tree(v: int):
+    return {"w": np.full((4, 4), float(v), np.float32),
+            "b": np.arange(4, dtype=np.float32) + v}
+
+
+class _Recorder:
+    """Fake fleet: records every delivery; optionally fails one replica."""
+
+    def __init__(self, fail_rid=None):
+        self.applied = []          # (rid, version, tree-bytes-snapshot)
+        self.fail_rid = fail_rid
+
+    def __call__(self, rid, tree, version):
+        if rid == self.fail_rid:
+            raise RuntimeError(f"replica {rid} died mid-exchange")
+        self.applied.append((rid, version,
+                             {k: np.asarray(v).copy()
+                              for k, v in tree.items()}))
+
+
+class TestCoordinator:
+    def test_config_and_constructor_validation(self):
+        with pytest.raises(ConfigError, match="method"):
+            _cfg(method="ring-allreduce")
+        with pytest.raises(ConfigError, match="staleness_window"):
+            _cfg(staleness_window=0)
+        with pytest.raises(ConfigError, match="gossip_prob"):
+            _cfg(gossip_prob=1.5)
+        rec = _Recorder()
+        with pytest.raises(ValueError, match="replica"):
+            AsyncWeightSync(_cfg(), n_replicas=0, apply_fn=rec)
+        with pytest.raises(ValueError, match="trainer"):
+            AsyncWeightSync(_cfg(), n_replicas=2, apply_fn=rec, n_trainers=0)
+
+    def test_publish_is_o_tree_not_o_fleet_and_monotone(self):
+        """publish() retains one host copy and touches NO replica (the
+        first hop is kick's job); version stamps are strictly monotone."""
+        rec = _Recorder()
+        sync = AsyncWeightSync(_cfg(), n_replicas=3, apply_fn=rec)
+        sync.publish(_tree(1), 1)
+        assert rec.applied == []                 # no replica touched
+        assert sync.newest_version == 1
+        assert sync.versions() == [1, 0, 0, 0]   # trainer first
+        with pytest.raises(ValueError, match="monotone"):
+            sync.publish(_tree(1), 1)
+        with pytest.raises(ValueError, match="monotone"):
+            sync.publish(_tree(0), 0)
+
+    def test_gossip_steps_propagate_newest_version(self):
+        """Edge rounds spread the version fleet-wide without any direct
+        trainer->replica fan-out; staleness drains to zero."""
+        rec = _Recorder()
+        sync = AsyncWeightSync(_cfg(gossip_prob=1.0), n_replicas=4,
+                               apply_fn=rec)
+        sync.publish(_tree(1), 1)
+        for _ in range(20):
+            sync.step()
+            if sync.versions() == [1] * 5:
+                break
+        assert sync.versions() == [1] * 5
+        st = sync.staleness()
+        assert st["staleness_max"] == 0 and st["versions_behind"] == 0
+        assert st["edge_exchanges"] >= 4
+        # every replica got the published bytes exactly once
+        assert sorted(rid for rid, _, _ in rec.applied) == [0, 1, 2, 3]
+        for _, v, tr in rec.applied:
+            assert v == 1
+            np.testing.assert_array_equal(tr["w"], _tree(1)["w"])
+
+    def test_forced_catchup_bounds_staleness(self):
+        """With gossip silenced (prob 0: every matrix is the identity, no
+        edges ever fire) the ONLY delivery mechanism is the staleness
+        contract — a peer about to trail by >= window gets a forced
+        catch-up edge, so no step ever leaves a peer outside the window."""
+        rec = _Recorder()
+        sync = AsyncWeightSync(_cfg(gossip_prob=0.0, staleness_window=2),
+                               n_replicas=3, apply_fn=rec)
+        sync.publish(_tree(1), 1)
+        sync.step()
+        assert sync.versions()[1:] == [0, 0, 0]   # 1 behind < window
+        assert sync.staleness()["forced_catchups"] == 0
+        sync.publish(_tree(2), 2)
+        sync.step()                               # 2 behind >= window: force
+        assert sync.versions() == [2, 2, 2, 2]
+        st = sync.staleness()
+        assert st["forced_catchups"] == 3
+        assert st["staleness_max"] == 0
+        # the superseded tree is pruned once nobody can need it
+        assert 1 not in sync._trees
+
+    def test_failed_delivery_leaves_previous_committed_version(self):
+        """A peer dying mid-exchange keeps its LAST committed version —
+        never a torn tree — and the failure is counted, not raised."""
+        rec = _Recorder(fail_rid=1)
+        sync = AsyncWeightSync(_cfg(gossip_prob=0.0, staleness_window=1),
+                               n_replicas=3, apply_fn=rec)
+        sync.publish(_tree(1), 1)
+        sync.step()                                # window 1: all forced
+        assert sync.versions() == [1, 1, 0, 1]     # rid 1 stays on 0
+        st = sync.staleness()
+        assert st["failed_exchanges"] == 1
+        assert st["staleness_max"] == 1            # honest accounting
+        rec.fail_rid = None                        # replica recovers
+        sync.step()
+        assert sync.versions() == [1, 1, 1, 1]
+
+    def test_liveness_catchup_and_scale_up(self):
+        """deactivate/reactivate drop and re-enter the schedule;
+        add_peer + catch_up is the scale-up fast path (no full gossip
+        propagation wait for a newcomer)."""
+        rec = _Recorder()
+        sync = AsyncWeightSync(_cfg(gossip_prob=0.0), n_replicas=2,
+                               apply_fn=rec)
+        sync.publish(_tree(3), 3)
+        sync.deactivate_peer(0)
+        assert sync.staleness()["versions_behind"] == 3   # only peer 1
+        assert not sync.catch_up(0)                       # inactive: no-op
+        assert sync.catch_up(1)
+        assert sync.replica_version(1) == 3
+        assert not sync.catch_up(1)                       # already current
+        sync.reactivate_peer(0, version=0)
+        r = sync.add_peer()
+        assert r == 2 and sync.n_replicas == 3
+        assert sync.catch_up(r)
+        assert sync.versions() == [3, 0, 3, 3]
+        assert sync.staleness()["forced_catchups"] == 2
+
+    def test_converge_is_bit_equal_to_synchronization_full_average(self):
+        """The acceptance pin: converge() == the reference
+        ``synchronization()`` full-average — apply_mixing with the uniform
+        matrix, row 0 — bit-for-bit, and every replica receives the SAME
+        bytes."""
+        from shuffle_exchange_tpu.runtime.sync.decentralized import \
+            apply_mixing
+
+        rec = _Recorder()
+        sync = AsyncWeightSync(_cfg(gossip_prob=0.0, staleness_window=10),
+                               n_replicas=3, apply_fn=rec)
+        sync.publish(_tree(1), 1)
+        sync.catch_up(0)                 # peer spread: r0@1
+        sync.publish(_tree(5), 5)
+        sync.catch_up(1)                 # r1@5; r2 stays on boot (v0)
+        # expected: peers [trainer@5, r0@1, r1@5, r2] — r2 never saw a
+        # published tree, so converge force-delivers newest (5) to it
+        # first; the average is then over [t(5), t(1), t(5), t(5)]
+        expect_stack = {
+            k: np.stack([_tree(5)[k], _tree(1)[k], _tree(5)[k], _tree(5)[k]])
+            for k in _tree(0)
+        }
+        mixed = apply_mixing(expect_stack,
+                             sync._dsync.synchronization_matrix())
+        want = {k: np.asarray(v[0]) for k, v in mixed.items()}
+        rec.applied.clear()
+        tree, version = sync.converge()
+        assert version == 6              # averaged weights are NEW weights
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(tree[k]), want[k])
+        # every replica got the identical averaged bytes
+        assert sorted(rid for rid, v, _ in rec.applied
+                      if v == 6) == [0, 1, 2]
+        for rid, v, tr in rec.applied:
+            if v != 6:
+                continue                 # r2's pre-average catch-up
+            for k in want:
+                np.testing.assert_array_equal(tr[k], want[k])
+        assert sync.versions() == [6, 6, 6, 6]
+
+    def test_converge_before_any_publish_refuses(self):
+        sync = AsyncWeightSync(_cfg(), n_replicas=2, apply_fn=_Recorder())
+        with pytest.raises(RuntimeError, match="published"):
+            sync.converge()
+
+    def test_shuffle_rings_snap_and_hrr_odd_fallback(self):
+        """Arbitrary serving peer counts never crash the topology build:
+        shuffle ring counts snap to a divisor; H-RR over an odd peer
+        count falls back to RR (identical mixing, two levels assumed)."""
+        rec = _Recorder()
+        s = AsyncWeightSync(_cfg(method="shuffle", rings=2), n_replicas=4,
+                            apply_fn=rec)    # 5 peers: rings snap to 1
+        s.publish(_tree(1), 1)
+        for _ in range(10):
+            s.step()
+        assert s.versions() == [1] * 5
+        s2 = AsyncWeightSync(_cfg(method="H-RR"), n_replicas=2,
+                             apply_fn=rec)   # 3 peers: odd -> RR
+        s2.publish(_tree(1), 1)
+        for _ in range(10):
+            s2.step()
+        assert s2.versions() == [1] * 3
+
+
+# ---------------------------------------------------------------------------
+# fleet: the threaded router driven cooperatively (no background loops)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+               activation="swiglu", norm="rmsnorm", position="rope",
+               n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _icfg(sync=None, **router):
+    if sync is not None:
+        router = dict(router, sync=sync)
+    return InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4},
+        router=router or None)
+
+
+def _engines(model, params, n=2, **kw):
+    return [InferenceEngineV2(model, params, _icfg(**kw)) for _ in range(n)]
+
+
+def _reference(model, params, prompt, n_new):
+    eng = InferenceEngineV2(model, params, _icfg())
+    lg = eng.put([0], [prompt])
+    first = int(np.argmax(lg[0]))
+    toks = eng.decode_loop([0], [first], n_new - 1)
+    return [first] + [int(t) for t in toks[0]]
+
+
+def _bump(params, scale):
+    return jax.tree_util.tree_map(lambda x: x * scale, params)
+
+
+class TestFleetStaleness:
+    def test_served_tokens_stay_inside_the_window(self, model_and_params):
+        """The fleet-level bounded-window property: across a stream of
+        async publishes, every finished request's stamped
+        ``weight_version`` trails the newest published version by at most
+        the window (+0 after a sync step; the deferred tick-boundary swap
+        means a request finishing in the very tick a delivery lands may
+        stamp one version earlier — still committed, still honest)."""
+        model, params = model_and_params
+        window = 2
+        router = ReplicaRouter(
+            _engines(model, params, 2,
+                     sync={"enabled": True, "method": "Gossip",
+                           "gossip_prob": 1.0,
+                           "staleness_window": window}))
+        rng = np.random.default_rng(4)
+        seen = []
+        for v in (1, 2, 3):
+            router.publish_weights(_bump(params, 1.0 + 0.01 * v), version=v)
+            router.sync_step()
+            out = router.serve([rng.integers(1, 90, size=6).tolist()
+                                for _ in range(2)], max_new_tokens=3)
+            newest = router._async_sync.newest_version
+            for uid in out:
+                wv = router.requests[uid].weight_version
+                assert wv is not None
+                assert 0 <= newest - wv <= window, \
+                    f"uid {uid} served at v{wv}, newest v{newest}"
+                seen.append(wv)
+        # the async path actually exercised staleness (not all-current)
+        st = router.stats()
+        assert st["sync"]["enabled"]
+        assert st["publish"]["bytes"] > 0
+        assert router.weight_publishes == 3
+
+    def test_stale_stamp_replays_token_identical(self, model_and_params):
+        """Stale-but-honest: with gossip silenced, only replica 0 is
+        caught up to v1 — requests landing on replica 1 are stamped with
+        the BOOT version 0, and greedy replay of each record at its
+        stamped version's weights is token-identical."""
+        model, params = model_and_params
+        v1_params = _bump(params, 1.05)
+        router = ReplicaRouter(
+            _engines(model, params, 2,
+                     sync={"enabled": True, "method": "Gossip",
+                           "gossip_prob": 0.0, "staleness_window": 5}))
+        router.publish_weights(v1_params, version=1)
+        assert router._async_sync.catch_up(0)
+        assert router._async_sync.versions() == [1, 1, 0]
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 90, size=6).tolist() for _ in range(4)]
+        uids = [router.submit(p, max_new_tokens=4) for p in prompts]
+        while router.tick():
+            pass
+        by_version = {0: [], 1: []}
+        for p, u in zip(prompts, uids):
+            r = router.requests[u]
+            # honest stamp: the replica that served it, not the publish
+            assert r.weight_version == (1 if router.owner[u] == 0 else 0)
+            by_version[r.weight_version].append((p, r.generated))
+        assert by_version[0] and by_version[1]   # both versions served
+        for wv, weights in ((0, params), (1, v1_params)):
+            for p, toks in by_version[wv]:
+                assert toks == _reference(model, weights, p, 4), \
+                    f"replay at stamped v{wv} diverged"
+
+    def test_crash_mid_gossip_zero_loss_then_converge(self, model_and_params):
+        """A replica dying mid-flight leaves every survivor on a
+        committed version with ZERO lost requests (greedy drain-replay is
+        token-identical), the corpse drops out of the schedule, and the
+        surviving fleet still reduces to the full-average on demand."""
+        model, params = model_and_params
+        router = ReplicaRouter(
+            _engines(model, params, 2,
+                     sync={"enabled": True, "method": "Gossip",
+                           "gossip_prob": 1.0, "staleness_window": 4}))
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, 90, size=int(n)).tolist()
+                   for n in (12, 5, 9, 7)]
+        want = [_reference(model, params, p, 6) for p in prompts]
+        uids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(2):
+            router.tick()
+        router.publish_weights(_bump(params, 1.0), version=1)  # same bytes
+        moved = router.fail_over(1, reason="drill: mid-gossip kill")
+        assert moved >= 1
+        while router.tick():
+            pass
+        # zero lost requests, token-identical re-placement (v1 == boot
+        # bytes, so the replay oracle is unchanged)
+        assert [router.requests[u].generated for u in uids] == want
+        assert all(router.requests[u].state == "finished" for u in uids)
+        # the corpse left the schedule: staleness counts survivors only
+        router.sync_step()
+        st = router._async_sync.staleness()
+        assert st["staleness_max"] == 0
+        v = router.converge()
+        assert v == 2
+        live = [r for r in router.replicas if r.active]
+        assert live and all(r.engine.weight_version == v for r in live)

@@ -193,6 +193,54 @@ def test_serving_failover_row_runs_at_toy_size():
     assert row["ttft_p95_s_failover"] >= row["ttft_p50_s_failover"] > 0
 
 
+@pytest.mark.slow   # ~15s: 4 fleet passes (warm/cap/barrier/async) + converge; nightly via ci_full
+def test_serving_async_publish_row_runs_at_toy_size():
+    """The config-5 async-weight-sync row (bench.serving_async_publish_row)
+    at toy size: the same Poisson trace with mid-trace publishes, barrier
+    two-phase vs async shuffle-exchange gossip — per-publish stall,
+    goodput retention, honest version census, bounded staleness,
+    converge() — runs on CPU, so the published row cannot rot on the
+    driver box."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from bench import serving_async_publish_row
+    from shuffle_exchange_tpu.inference import InferenceConfig
+    from shuffle_exchange_tpu.models import Transformer, tiny
+
+    mcfg = tiny(vocab=97, d=32, layers=2, heads=4, seq=128,
+                activation="swiglu", norm="rmsnorm", position="rope",
+                n_kv_heads=2, tie_embeddings=False)
+    model = Transformer(mcfg)
+    params = model.init(jax.random.PRNGKey(0))
+    icfg = InferenceConfig(
+        dtype="float32", max_seq_len=64, kv_block_size=8, num_kv_blocks=40,
+        serving={"token_budget": 16, "max_running": 4, "chunk_min": 4})
+    row = serving_async_publish_row(model, params, icfg, mcfg.vocab_size,
+                                    n_requests=4, prompt_lo=4, prompt_hi=16,
+                                    max_new=4, publish_every_ticks=2,
+                                    n_publishes=3, staleness_window=2,
+                                    load=2.0)
+    assert row["publishes"] == 3
+    # same-bytes publishes: version churn never costs output fidelity
+    assert row["token_mismatches_vs_barrier"] == 0
+    # the acceptance pins: no stamp outside the window, and converge()
+    # lands every live replica on one version
+    assert row["staleness_window_held"]
+    assert row["fleet_converged"]
+    assert row["converged_version"] > 3
+    assert sum(row["version_census"].values()) == 4
+    assert row["publish_bytes"] > 0
+    assert row["publish_stall_p50_s_barrier"] > 0
+    assert row["publish_stall_p50_s_async"] > 0
+    assert row["sustained_tokens_per_sec_barrier"] > 0
+    assert row["sustained_tokens_per_sec_async"] > 0
+    assert row["goodput_retention"] > 0
+    assert row["failed_exchanges"] == 0
+
+
 def test_prefix_cache_row_runs_at_toy_size():
     """The config-5 prefix-cache row (bench.prefix_cache_row) at toy size:
     the shared-system-prompt trace served with and without prefix_caching
